@@ -1,0 +1,103 @@
+"""Configuration dataclasses for the framework.
+
+The reference drives everything with per-script argparse flags
+(/root/reference/train.py:34-47, eval_pf_pascal.py:28-30, eval_inloc.py:30-40)
+and smuggles architecture hyper-parameters inside checkpoints
+(/root/reference/lib/model.py:215-220).  Here every entry point is driven by a
+typed config; CLI flags keep the reference's names/defaults so command-line
+compatibility holds, and checkpoints carry the full `ModelConfig` so loading a
+checkpoint reproduces its architecture exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of the NCNet model.
+
+    Defaults mirror the reference ImMatchNet defaults
+    (/root/reference/lib/model.py:193-205) with the PF-Pascal training values
+    from /root/reference/train.py:42-43 left to the train config.
+    """
+
+    backbone: str = "resnet101"          # 'resnet101' | 'vgg' | identity variants for tests
+    backbone_last_layer: str = ""        # '' → layer3 (resnet) / pool4 (vgg)
+    ncons_kernel_sizes: Sequence[int] = (3, 3, 3)
+    ncons_channels: Sequence[int] = (10, 10, 1)
+    symmetric_mode: bool = True
+    normalize_features: bool = True
+    relocalization_k_size: int = 0       # >1 enables maxpool4d relocalization
+    half_precision: bool = False         # bf16 volume + NC weights (TPU-native fp16 analog)
+    train_backbone: bool = False
+    checkpoint: str = ""                 # path to orbax dir or torch .pth.tar
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Weak-supervision training run (reference train.py:34-47 flags)."""
+
+    model: ModelConfig = ModelConfig(
+        ncons_kernel_sizes=(5, 5, 5), ncons_channels=(16, 16, 1)
+    )
+    image_size: int = 400
+    dataset_image_path: str = "datasets/pf-pascal/"
+    dataset_csv_path: str = "datasets/pf-pascal/image_pairs/"
+    num_epochs: int = 5
+    batch_size: int = 16
+    lr: float = 5e-4
+    result_model_fn: str = "checkpoint_adam"
+    result_model_dir: str = "trained_models"
+    fe_finetune_params: int = 0
+    seed: int = 1
+    num_workers: int = 0
+    eval_num_workers: int = 4
+    log_interval: int = 1
+    # TPU-native additions (no reference analog):
+    data_parallel: bool = True           # shard the pair batch over the mesh 'data' axis
+    donate_state: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalPFPascalConfig:
+    """PCK evaluation on PF-Pascal (reference eval_pf_pascal.py:28-30)."""
+
+    checkpoint: str = ""
+    image_size: int = 400
+    eval_dataset_path: str = "datasets/pf-pascal/"
+    pck_alpha: float = 0.1
+    pck_procedure: str = "scnet"
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalInLocConfig:
+    """Dense matching for InLoc localization (reference eval_inloc.py:30-40)."""
+
+    checkpoint: str = ""
+    inloc_shortlist: str = "datasets/inloc/densePE_top100_shortlist_cvpr18.mat"
+    k_size: int = 2
+    image_size: int = 3200
+    n_queries: int = 356
+    n_panos: int = 10
+    softmax: bool = True
+    matching_both_directions: bool = True
+    flip_matching_direction: bool = False
+    pano_path: str = "datasets/inloc/pano/"
+    query_path: str = "datasets/inloc/query/iphone7/"
+    output_root: str = "matches"
+    # TPU-native addition: shard the 4D volume spatially over this many devices.
+    spatial_shards: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout.  axes: data-parallel pairs × spatial volume shards."""
+
+    data: int = 1
+    spatial: int = 1

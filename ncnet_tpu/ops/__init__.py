@@ -1,0 +1,56 @@
+"""Pure-function compute ops (JAX).
+
+Conventions (TPU-first, channels-last):
+  * image features:   ``(B, H, W, C)``
+  * 4D corr volume:   ``(B, hA, wA, hB, wB)`` — scalar cells
+  * NC filter state:  ``(B, hA, wA, hB, wB, C)`` — channels-last for conv
+
+The reference keeps PyTorch NCHW / (B,1,hA,wA,hB,wB) layouts
+(/root/reference/lib/model.py:115); we deliberately do not.
+"""
+
+from ncnet_tpu.ops.norm import feature_l2_norm
+from ncnet_tpu.ops.correlation import correlation_4d, correlation_3d
+from ncnet_tpu.ops.conv4d import conv4d, conv4d_init
+from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
+from ncnet_tpu.ops.matching import (
+    Matches,
+    mutual_matching,
+    corr_to_matches,
+    nearest_neighbor_point_tnf,
+    bilinear_interp_point_tnf,
+    normalize_axis,
+    unnormalize_axis,
+    points_to_unit_coords,
+    points_to_pixel_coords,
+)
+from ncnet_tpu.ops.image import (
+    resize_bilinear_align_corners,
+    resize_bilinear_align_corners_np,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    normalize_imagenet,
+)
+
+__all__ = [
+    "Matches",
+    "feature_l2_norm",
+    "correlation_4d",
+    "correlation_3d",
+    "conv4d",
+    "conv4d_init",
+    "maxpool4d_with_argmax",
+    "mutual_matching",
+    "corr_to_matches",
+    "nearest_neighbor_point_tnf",
+    "bilinear_interp_point_tnf",
+    "normalize_axis",
+    "unnormalize_axis",
+    "points_to_unit_coords",
+    "points_to_pixel_coords",
+    "resize_bilinear_align_corners",
+    "resize_bilinear_align_corners_np",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+    "normalize_imagenet",
+]

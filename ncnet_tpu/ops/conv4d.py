@@ -1,0 +1,96 @@
+"""4D convolution for neighbourhood-consensus filtering.
+
+The reference implements conv4d as a *Python loop* over the first spatial dim,
+each iteration dispatching an F.conv3d (/root/reference/lib/conv4d.py:39-48) —
+the single hottest anti-pattern to avoid on TPU.  Here the k_A-tap
+decomposition is a statically-unrolled sum of ``lax.conv_general_dilated`` 3D
+convolutions over the *whole* volume: under ``jit`` the unroll is traced once,
+XLA fuses the shifted reads, and each conv runs batched over ``B·hA`` on the
+MXU.
+
+Semantics: cross-correlation (like torch convNd), "same" zero padding of
+``k//2`` per spatial dim, stride/dilation/groups fixed at 1 — exactly the
+envelope the reference supports (conv4d.py:59-62).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv4d(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    *,
+    precision=None,
+) -> jnp.ndarray:
+    """4D "same" convolution over the correlation volume.
+
+    Args:
+      x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume.
+      weight: ``(kA, kWA, kB, kWB, C_in, C_out)``.
+      bias:   ``(C_out,)`` or None.
+
+    Returns:
+      ``(B, hA, wA, hB, wB, C_out)``.
+    """
+    b, ha, wa, hb, wb, c_in = x.shape
+    ka, kwa, kb, kwb, wc_in, c_out = weight.shape
+    assert wc_in == c_in, f"channel mismatch: {wc_in} vs {c_in}"
+
+    pad_a = ka // 2
+    # Zero-pad the leading spatial dim once; the other three dims are padded
+    # inside the 3D conv below.
+    xp = jnp.pad(x, ((0, 0), (pad_a, pad_a), (0, 0), (0, 0), (0, 0), (0, 0)))
+
+    pads3 = [(kwa // 2, kwa // 2), (kb // 2, kb // 2), (kwb // 2, kwb // 2)]
+    dn = lax.conv_dimension_numbers(
+        (b * ha, wa, hb, wb, c_in), (kwa, kb, kwb, c_in, c_out), ("NDHWC", "DHWIO", "NDHWC")
+    )
+
+    out = None
+    for p in range(ka):  # static unroll: ka ≤ 5, traced once under jit
+        # shifted slice s.t. out[i] = Σ_p x[i + p - pad_a] * w[p]  (the same
+        # tap alignment as the reference loop, conv4d.py:39-48)
+        sl = lax.slice_in_dim(xp, p, p + ha, axis=1)
+        o = lax.conv_general_dilated(
+            sl.reshape(b * ha, wa, hb, wb, c_in),
+            weight[p],
+            window_strides=(1, 1, 1),
+            padding=pads3,
+            dimension_numbers=dn,
+            precision=precision,
+        )
+        out = o if out is None else out + o
+    out = out.reshape(b, ha, wa, hb, wb, c_out)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def conv4d_init(
+    key: jax.Array, kernel_size: int, c_in: int, c_out: int, dtype=jnp.float32
+):
+    """torch-_ConvNd-style uniform init ±1/√(C_in·k⁴), the distribution the
+    reference's Conv4d inherits (conv4d.py:53-82 via _ConvNd defaults), so
+    training dynamics start from a comparable point.
+
+    Returns ``(weight, bias)`` with weight ``(k,k,k,k,C_in,C_out)``.
+    """
+    k_w, k_b = jax.random.split(key)
+    fan_in = c_in * kernel_size**4
+    bound = 1.0 / math.sqrt(fan_in)
+    weight = jax.random.uniform(
+        k_w,
+        (kernel_size,) * 4 + (c_in, c_out),
+        minval=-bound,
+        maxval=bound,
+        dtype=dtype,
+    )
+    bias = jax.random.uniform(k_b, (c_out,), minval=-bound, maxval=bound, dtype=dtype)
+    return weight, bias

@@ -1,0 +1,71 @@
+"""Dense feature correlation.
+
+The reference builds the 4D correlation volume with a batched matmul over
+flattened spatial dims (/root/reference/lib/model.py:106-115).  On TPU the
+natural expression is a single einsum — XLA lowers it straight onto the MXU
+with no reshapes materialized.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.norm import feature_l2_norm
+
+
+def correlation_4d(
+    feature_a: jnp.ndarray,
+    feature_b: jnp.ndarray,
+    *,
+    accumulate_dtype: jnp.dtype | None = jnp.float32,
+) -> jnp.ndarray:
+    """Full 4D correlation volume between two feature maps.
+
+    Args:
+      feature_a: ``(B, hA, wA, C)`` (channels-last; the reference is NCHW).
+      feature_b: ``(B, hB, wB, C)``.
+      accumulate_dtype: MXU accumulation type.  bf16 inputs with f32
+        accumulation is the TPU-native analog of the reference's fp16 volume
+        (/root/reference/lib/model.py:265-267) with better numerics.
+
+    Returns:
+      ``(B, hA, wA, hB, wB)`` — cell (i,j,k,l) is ⟨f_A[i,j], f_B[k,l]⟩,
+      the same indexing as the reference's ``[batch, row_A, col_A, row_B,
+      col_B]`` volume (/root/reference/lib/model.py:114).
+    """
+    out = jnp.einsum(
+        "bijc,bklc->bijkl",
+        feature_a,
+        feature_b,
+        preferred_element_type=accumulate_dtype,
+    )
+    if accumulate_dtype is not None and feature_a.dtype != accumulate_dtype:
+        out = out.astype(feature_a.dtype)
+    return out
+
+
+def correlation_3d(
+    feature_a: jnp.ndarray,
+    feature_b: jnp.ndarray,
+    *,
+    normalization: bool = True,
+) -> jnp.ndarray:
+    """Legacy '3D' correlation (reference FeatureCorrelation shape='3D',
+    /root/reference/lib/model.py:97-105): same-shape maps, output indexed
+    ``[batch, idx_A = row_A + h*col_A, row_B, col_B]``.
+
+    Args:
+      feature_a, feature_b: ``(B, H, W, C)``.
+
+    Returns:
+      ``(B, H*W, H, W)`` with the reference's column-major A index, optionally
+      ReLU + L2-normalized over the match dim (model.py:117-118).
+    """
+    b, h, w, c = feature_a.shape
+    # idx_A = row_A + h * col_A  →  A flattened column-major (transpose(2,3)
+    # in the reference); implemented by swapping to (w, h) then flattening.
+    fa = jnp.transpose(feature_a, (0, 2, 1, 3)).reshape(b, w * h, c)
+    corr = jnp.einsum("bmc,bklc->bmkl", fa, feature_b)
+    if normalization:
+        corr = feature_l2_norm(jnp.maximum(corr, 0.0), axis=1)
+    return corr

@@ -1,0 +1,71 @@
+"""Image resizing + normalization.
+
+The reference resizes by sampling an identity affine grid with bilinear
+``F.grid_sample`` (/root/reference/lib/transformation.py:25-46) and upsamples
+InLoc images with ``F.upsample(mode='bilinear')`` (eval_inloc.py:84-89) — both
+are *align-corners* bilinear resampling in torch-0.3 semantics.
+``jax.image.resize`` uses half-pixel centers, which would shift every feature
+half a cell and move PCK; so we implement align-corners bilinear directly
+(a gather + lerp, fully fused by XLA).  A numpy twin serves the host-side
+input pipeline without bouncing images through the device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# torchvision ImageNet statistics (reference lib/normalization.py:19-20)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+
+def _align_corners_coords(out_len: int, in_len: int, xp):
+    if out_len == 1 or in_len == 1:
+        return xp.zeros((out_len,), dtype=xp.float32)
+    return xp.linspace(0.0, in_len - 1.0, out_len, dtype=xp.float32)
+
+
+def _resize_bilinear(img, out_h: int, out_w: int, xp):
+    """Shared align-corners bilinear body; ``img``: (B, H, W, C)."""
+    b, h, w, c = img.shape
+    ys = _align_corners_coords(out_h, h, xp)
+    xs = _align_corners_coords(out_w, w, xp)
+    y0 = xp.clip(xp.floor(ys).astype(xp.int32), 0, h - 1)
+    x0 = xp.clip(xp.floor(xs).astype(xp.int32), 0, w - 1)
+    y1 = xp.minimum(y0 + 1, h - 1)
+    x1 = xp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    top_rows = img[:, y0]
+    bot_rows = img[:, y1]
+    top = top_rows[:, :, x0] * (1 - wx) + top_rows[:, :, x1] * wx
+    bot = bot_rows[:, :, x0] * (1 - wx) + bot_rows[:, :, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def resize_bilinear_align_corners(img: jnp.ndarray, out_h: int, out_w: int) -> jnp.ndarray:
+    """Bilinear resize with align-corners sampling.
+
+    Args:
+      img: ``(B, H, W, C)`` or ``(H, W, C)``.
+    """
+    squeeze = img.ndim == 3
+    if squeeze:
+        img = img[None]
+    out = _resize_bilinear(img, out_h, out_w, jnp)
+    return out[0] if squeeze else out
+
+
+def resize_bilinear_align_corners_np(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Numpy twin of :func:`resize_bilinear_align_corners` for the host-side
+    data pipeline (no device bounce).  ``img``: (H, W, C) float."""
+    return _resize_bilinear(img[None], out_h, out_w, np)[0]
+
+
+def normalize_imagenet(img, *, scale_255: bool = True):
+    """0-255 image → ImageNet-normalized float (lib/normalization.py:16-27).
+    Works on numpy or jnp arrays, channels-last."""
+    xp = jnp if isinstance(img, jnp.ndarray) else np
+    x = img / 255.0 if scale_255 else img
+    return (x - xp.asarray(IMAGENET_MEAN)) / xp.asarray(IMAGENET_STD)

@@ -1,0 +1,232 @@
+"""Mutual matching, match extraction, and point-transfer transforms.
+
+Everything here is pure ``jnp`` — reshapes, reductions and gathers — and is
+therefore trivially jittable and shardable.  Reference semantics being matched:
+  * MutualMatching         /root/reference/lib/model.py:155-175
+  * corr_to_matches        /root/reference/lib/point_tnf.py:12-80
+  * nearest/bilinear tnf   /root/reference/lib/point_tnf.py:82-148
+  * axis (un)normalization /root/reference/lib/point_tnf.py:6-10,151-167
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mutual_matching(corr: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Soft mutual-nearest-neighbour gating of the 4D volume.
+
+    ``corr * (corr / (max_over_Bdims + eps)) * (corr / (max_over_Adims + eps))``
+    with the reference's eps=1e-5 and its symmetry-preserving parenthesization
+    (model.py:166-173).
+
+    Args:
+      corr: ``(B, hA, wA, hB, wB)``.
+    """
+    max_over_a = jnp.max(corr, axis=(1, 2), keepdims=True)  # best A for each B cell
+    max_over_b = jnp.max(corr, axis=(3, 4), keepdims=True)  # best B for each A cell
+    ratio_b = corr / (max_over_a + eps)
+    ratio_a = corr / (max_over_b + eps)
+    return corr * (ratio_a * ratio_b)
+
+
+def normalize_axis(x, length):
+    """Pixel coord (1-indexed convention) → [-1, 1] (point_tnf.py:6-7)."""
+    return (x - 1 - (length - 1) / 2) * 2 / (length - 1)
+
+
+def unnormalize_axis(x, length):
+    """[-1, 1] → pixel coord (1-indexed convention) (point_tnf.py:9-10)."""
+    return x * (length - 1) / 2 + 1 + (length - 1) / 2
+
+
+class Matches(NamedTuple):
+    """Dense matches extracted from a corr volume; all fields ``(B, N)``."""
+
+    xA: jnp.ndarray
+    yA: jnp.ndarray
+    xB: jnp.ndarray
+    yB: jnp.ndarray
+    score: jnp.ndarray
+
+
+def corr_to_matches(
+    corr: jnp.ndarray,
+    delta4d=None,
+    k_size: int = 1,
+    do_softmax: bool = False,
+    scale: str = "centered",
+    invert_matching_direction: bool = False,
+    return_indices: bool = False,
+):
+    """Read hard matches + scores out of the (filtered) 4D volume.
+
+    Args:
+      corr: ``(B, hA, wA, hB, wB)``.
+      delta4d: optional relocalization offsets from
+        :func:`ncnet_tpu.ops.pooling.maxpool4d_with_argmax`; when given, match
+        coordinates live on the ``k_size``× finer grid.
+      do_softmax: softmax over the match dim before scoring.
+      scale: 'centered' → coords in [-1,1]; 'positive' → [0,1].
+      invert_matching_direction: False → for every B cell pick the best A
+        (reference default); True → for every A cell pick the best B.
+
+    Returns:
+      :class:`Matches`, optionally extended with integer grid indices
+      ``(iA, jA, iB, jB)`` when ``return_indices``.
+    """
+    b, fs1, fs2, fs3, fs4 = corr.shape
+    lo = -1.0 if scale == "centered" else 0.0
+    if scale not in ("centered", "positive"):
+        raise ValueError(f"unknown scale {scale!r}")
+    grid_ya = jnp.linspace(lo, 1.0, fs1 * k_size)
+    grid_xa = jnp.linspace(lo, 1.0, fs2 * k_size)
+    grid_yb = jnp.linspace(lo, 1.0, fs3 * k_size)
+    grid_xb = jnp.linspace(lo, 1.0, fs4 * k_size)
+
+    if invert_matching_direction:
+        # for each A cell, best B (point_tnf.py:32-44)
+        nc = corr.reshape(b, fs1 * fs2, fs3 * fs4)
+        if do_softmax:
+            nc = jax.nn.softmax(nc, axis=2)
+        score = jnp.max(nc, axis=2)
+        idx = jnp.argmax(nc, axis=2)  # (B, fs1*fs2) into flattened B dims
+        i_b, j_b = idx // fs4, idx % fs4
+        i_a = jnp.broadcast_to(
+            (jnp.arange(fs1 * fs2) // fs2)[None, :], idx.shape
+        )
+        j_a = jnp.broadcast_to((jnp.arange(fs1 * fs2) % fs2)[None, :], idx.shape)
+    else:
+        # for each B cell, best A (point_tnf.py:47-59)
+        nc = corr.reshape(b, fs1 * fs2, fs3 * fs4)
+        if do_softmax:
+            nc = jax.nn.softmax(nc, axis=1)
+        score = jnp.max(nc, axis=1)
+        idx = jnp.argmax(nc, axis=1)  # (B, fs3*fs4) into flattened A dims
+        i_a, j_a = idx // fs2, idx % fs2
+        i_b = jnp.broadcast_to((jnp.arange(fs3 * fs4) // fs4)[None, :], idx.shape)
+        j_b = jnp.broadcast_to((jnp.arange(fs3 * fs4) % fs4)[None, :], idx.shape)
+
+    if delta4d is not None:  # relocalization onto the fine grid (point_tnf.py:61-70)
+        di_a, dj_a, di_b, dj_b = delta4d
+        bidx = jnp.arange(b)[:, None]
+        # gather all four offsets at the coarse (iA,jA,iB,jB) cells, then
+        # promote coarse indices to the fine grid: fine = coarse*k + delta
+        g = lambda d: d[bidx, i_a, j_a, i_b, j_b]  # noqa: E731
+        d_ia, d_ja, d_ib, d_jb = g(di_a), g(dj_a), g(di_b), g(dj_b)
+        i_a = i_a * k_size + d_ia
+        j_a = j_a * k_size + d_ja
+        i_b = i_b * k_size + d_ib
+        j_b = j_b * k_size + d_jb
+
+    xa = grid_xa[j_a]
+    ya = grid_ya[i_a]
+    xb = grid_xb[j_b]
+    yb = grid_yb[i_b]
+    m = Matches(xa, ya, xb, yb, score)
+    if return_indices:
+        return m, (i_a, j_a, i_b, j_b)
+    return m
+
+
+def nearest_neighbor_point_tnf(matches: Matches, target_points_norm: jnp.ndarray):
+    """Warp normalized target points by snapping to the nearest match's B
+    coordinate and emitting its A coordinate (point_tnf.py:82-94).
+
+    Args:
+      target_points_norm: ``(B, 2, N)`` in [-1, 1].
+    Returns:
+      ``(B, 2, N)`` warped points.
+    """
+    dx = target_points_norm[:, 0, :, None] - matches.xB[:, None, :]
+    dy = target_points_norm[:, 1, :, None] - matches.yB[:, None, :]
+    dist = jnp.sqrt(dx**2 + dy**2)  # (B, N, M)
+    idx = jnp.argmin(dist, axis=2)
+    bidx = jnp.arange(dist.shape[0])[:, None]
+    wx = matches.xA[bidx, idx]
+    wy = matches.yA[bidx, idx]
+    return jnp.stack([wx, wy], axis=1)
+
+
+def bilinear_interp_point_tnf(matches: Matches, target_points_norm: jnp.ndarray):
+    """Warp normalized target points by inverse-bilinear interpolation of the
+    match field at the 4 surrounding B-grid corners (point_tnf.py:96-148).
+
+    Assumes matches came from the default (B→A) direction of
+    :func:`corr_to_matches` on a *square* feature grid, so ``(xB, yB)`` is the
+    regular row-major grid — the same assumption the reference bakes in via
+    ``feature_size = sqrt(len(xB))``.
+
+    Args:
+      target_points_norm: ``(B, 2, N)`` in [-1, 1].
+    Returns:
+      ``(B, 2, N)`` warped points.
+    """
+    b, _, n = target_points_norm.shape
+    fs = int(round(float(jnp.sqrt(matches.xB.shape[-1]))))
+    grid = jnp.linspace(-1.0, 1.0, fs)
+
+    def lower_index(coords):  # (B, N) → index of grid node strictly below
+        cnt = jnp.sum((coords[:, :, None] - grid[None, None, :]) > 0, axis=2) - 1
+        return jnp.clip(cnt, 0, fs - 2)
+
+    x_minus = lower_index(target_points_norm[:, 0, :])
+    y_minus = lower_index(target_points_norm[:, 1, :])
+    x_plus = x_minus + 1
+    y_plus = y_minus + 1
+
+    to_idx = lambda x, y: y * fs + x  # noqa: E731 — row-major B grid
+    bidx = jnp.arange(b)[:, None]
+
+    def at(field_x, field_y, idx):
+        return jnp.stack([field_x[bidx, idx], field_y[bidx, idx]], axis=1)
+
+    mm, pp = to_idx(x_minus, y_minus), to_idx(x_plus, y_plus)
+    pm, mp = to_idx(x_plus, y_minus), to_idx(x_minus, y_plus)
+
+    p_mm = at(matches.xB, matches.yB, mm)
+    p_pp = at(matches.xB, matches.yB, pp)
+    p_pm = at(matches.xB, matches.yB, pm)
+    p_mp = at(matches.xB, matches.yB, mp)
+
+    area = lambda d: jnp.abs(d[:, 0, :] * d[:, 1, :])  # noqa: E731
+    f_pp = area(target_points_norm - p_mm)
+    f_mm = area(target_points_norm - p_pp)
+    f_mp = area(target_points_norm - p_pm)
+    f_pm = area(target_points_norm - p_mp)
+
+    q_mm = at(matches.xA, matches.yA, mm)
+    q_pp = at(matches.xA, matches.yA, pp)
+    q_pm = at(matches.xA, matches.yA, pm)
+    q_mp = at(matches.xA, matches.yA, mp)
+
+    num = (
+        q_mm * f_mm[:, None, :]
+        + q_pp * f_pp[:, None, :]
+        + q_mp * f_mp[:, None, :]
+        + q_pm * f_pm[:, None, :]
+    )
+    den = (f_pp + f_mm + f_mp + f_pm)[:, None, :]
+    return num / den
+
+
+def points_to_unit_coords(points: jnp.ndarray, im_size: jnp.ndarray):
+    """Pixel → [-1,1] coords.  ``points``: (B,2,N) with row 0 = x (normalized
+    by width), row 1 = y (by height); ``im_size``: (B,2) as (h, w)
+    (point_tnf.py:151-158)."""
+    h, w = im_size[:, 0], im_size[:, 1]
+    x = normalize_axis(points[:, 0, :], w[:, None])
+    y = normalize_axis(points[:, 1, :], h[:, None])
+    return jnp.stack([x, y], axis=1)
+
+
+def points_to_pixel_coords(points: jnp.ndarray, im_size: jnp.ndarray):
+    """[-1,1] → pixel coords; inverse of :func:`points_to_unit_coords`
+    (point_tnf.py:160-167)."""
+    h, w = im_size[:, 0], im_size[:, 1]
+    x = unnormalize_axis(points[:, 0, :], w[:, None])
+    y = unnormalize_axis(points[:, 1, :], h[:, None])
+    return jnp.stack([x, y], axis=1)

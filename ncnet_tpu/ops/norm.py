@@ -1,0 +1,16 @@
+"""Feature normalization."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def feature_l2_norm(x: jnp.ndarray, axis: int = -1, eps: float = 1e-6) -> jnp.ndarray:
+    """Per-location L2 normalization over `axis`.
+
+    Matches the reference's featureL2Norm (/root/reference/lib/model.py:14-17):
+    the epsilon sits *inside* the square root — ``x / sqrt(sum(x^2) + eps)`` —
+    which matters for golden parity on near-zero features.
+    """
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return x / norm

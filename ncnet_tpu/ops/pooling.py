@@ -1,0 +1,40 @@
+"""4D max-pooling with argmax decomposition ("relocalization").
+
+NCNet's long-context trick (/root/reference/lib/model.py:177-191): correlate at
+k× grid resolution, 4D-max-pool by k (k⁴× volume reduction) while remembering
+*relative* argmax offsets, filter the pooled volume, and add the offsets back
+at match extraction.  The reference gathers k⁴ strided slices in a Python
+loop; here it is one reshape + transpose + argmax — a fully fused XLA program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def maxpool4d_with_argmax(corr: jnp.ndarray, k: int):
+    """Pool ``(B, hA, wA, hB, wB)`` by ``k`` along all four spatial dims.
+
+    Returns:
+      pooled: ``(B, hA/k, wA/k, hB/k, wB/k)``
+      deltas: tuple ``(di, dj, dk, dl)`` of int32 arrays shaped like
+        ``pooled`` — the offset of the max within each k⁴ box, with the same
+        ``((di·k + dj)·k + dk)·k + dl`` linearization the reference decodes
+        by repeated fmod/div (model.py:186-189).
+    """
+    b, ha, wa, hb, wb = corr.shape
+    assert ha % k == 0 and wa % k == 0 and hb % k == 0 and wb % k == 0, (
+        f"volume dims {corr.shape[1:]} must be divisible by k={k}"
+    )
+    v = corr.reshape(b, ha // k, k, wa // k, k, hb // k, k, wb // k, k)
+    # bring the four intra-box dims to the back, in (di, dj, dk, dl) order
+    v = v.transpose(0, 1, 3, 5, 7, 2, 4, 6, 8).reshape(
+        b, ha // k, wa // k, hb // k, wb // k, k**4
+    )
+    idx = jnp.argmax(v, axis=-1)
+    pooled = jnp.max(v, axis=-1)
+    dl = idx % k
+    dk = (idx // k) % k
+    dj = (idx // (k * k)) % k
+    di = idx // (k * k * k)
+    return pooled, (di.astype(jnp.int32), dj.astype(jnp.int32), dk.astype(jnp.int32), dl.astype(jnp.int32))

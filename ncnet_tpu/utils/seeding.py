@@ -1,0 +1,25 @@
+"""Determinism helpers.
+
+The reference seeds torch + numpy globally (/root/reference/train.py:25-29) and
+vendors a DataLoader purely to get per-worker numpy seeding
+(/root/reference/lib/dataloader.py:39-43).  JAX is explicit-PRNG so model-side
+determinism is structural; these helpers cover the host-side (numpy) pipeline
+and give each data worker an independent, reproducible stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def global_seed(seed: int = 1) -> np.random.Generator:
+    """Seed host-side numpy (legacy global RNG, used by augmentations) and
+    return a fresh Generator for code that takes one explicitly."""
+    np.random.seed(seed)
+    return np.random.default_rng(seed)
+
+
+def worker_rng(base_seed: int, worker_id: int) -> np.random.Generator:
+    """Independent stream per data-loading worker (reference's reason for
+    vendoring its DataLoader — lib/dataloader.py:39-43)."""
+    return np.random.default_rng(np.random.SeedSequence([base_seed, worker_id]))

@@ -1,0 +1,26 @@
+"""Test configuration: force CPU with 8 virtual devices.
+
+Multi-chip hardware isn't available in CI; the sharding/parallelism tests run
+on a virtual 8-device CPU mesh instead (the same substitution SURVEY.md §4
+prescribes).  Note: this environment pre-imports jax at interpreter startup
+(axon sitecustomize), so env vars alone are too late — we override the
+platform through jax.config before the backend is first initialized.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,112 @@
+"""Unit tests for norm / correlation / pooling / mutual matching against
+numpy brute-force oracles."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ncnet_tpu import ops
+
+
+def test_feature_l2_norm(rng):
+    x = rng.standard_normal((2, 3, 4, 8)).astype(np.float32)
+    out = np.asarray(ops.feature_l2_norm(jnp.asarray(x)))
+    expected = x / np.sqrt((x**2).sum(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_correlation_4d_matches_bruteforce(rng):
+    fa = rng.standard_normal((2, 3, 4, 8)).astype(np.float32)
+    fb = rng.standard_normal((2, 5, 6, 8)).astype(np.float32)
+    out = np.asarray(ops.correlation_4d(jnp.asarray(fa), jnp.asarray(fb)))
+    expected = np.einsum("bijc,bklc->bijkl", fa, fb)
+    assert out.shape == (2, 3, 4, 5, 6)
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_correlation_3d_column_major_a_index(rng):
+    b, h, w, c = 1, 3, 4, 5
+    fa = rng.standard_normal((b, h, w, c)).astype(np.float32)
+    fb = rng.standard_normal((b, h, w, c)).astype(np.float32)
+    out = np.asarray(ops.correlation_3d(jnp.asarray(fa), jnp.asarray(fb), normalization=False))
+    assert out.shape == (b, h * w, h, w)
+    # reference indexing: idx_A = row_A + h * col_A (lib/model.py:104)
+    for ia in range(h):
+        for ja in range(w):
+            for ib in range(h):
+                for jb in range(w):
+                    expected = fa[0, ia, ja] @ fb[0, ib, jb]
+                    np.testing.assert_allclose(
+                        out[0, ia + h * ja, ib, jb], expected, rtol=1e-5
+                    )
+
+
+def test_mutual_matching_bruteforce(rng):
+    corr = rng.standard_normal((2, 3, 4, 5, 2)).astype(np.float32)
+    out = np.asarray(ops.mutual_matching(jnp.asarray(corr)))
+    eps = 1e-5
+    max_a = corr.max(axis=(1, 2), keepdims=True)
+    max_b = corr.max(axis=(3, 4), keepdims=True)
+    expected = corr * ((corr / (max_b + eps)) * (corr / (max_a + eps)))
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_maxpool4d_with_argmax(rng):
+    k = 2
+    corr = rng.standard_normal((1, 4, 4, 6, 2)).astype(np.float32)
+    pooled, (di, dj, dk, dl) = ops.maxpool4d_with_argmax(jnp.asarray(corr), k)
+    pooled = np.asarray(pooled)
+    assert pooled.shape == (1, 2, 2, 3, 1)
+    for i in range(2):
+        for j in range(2):
+            for kk in range(3):
+                for ll in range(1):
+                    box = corr[0, i * k:(i + 1) * k, j * k:(j + 1) * k,
+                               kk * k:(kk + 1) * k, ll * k:(ll + 1) * k]
+                    assert pooled[0, i, j, kk, ll] == box.max()
+                    # offsets point at the max element
+                    off = (int(di[0, i, j, kk, ll]), int(dj[0, i, j, kk, ll]),
+                           int(dk[0, i, j, kk, ll]), int(dl[0, i, j, kk, ll]))
+                    assert box[off] == box.max()
+
+
+def test_conv4d_matches_bruteforce(rng):
+    b, ha, wa, hb, wb, cin, cout, k = 2, 3, 4, 3, 2, 2, 3, 3
+    x = rng.standard_normal((b, ha, wa, hb, wb, cin)).astype(np.float32)
+    w = rng.standard_normal((k, k, k, k, cin, cout)).astype(np.float32)
+    bias = rng.standard_normal((cout,)).astype(np.float32)
+    out = np.asarray(ops.conv4d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(bias)))
+    assert out.shape == (b, ha, wa, hb, wb, cout)
+
+    pad = k // 2
+    xp = np.zeros((b, ha + 2 * pad, wa + 2 * pad, hb + 2 * pad, wb + 2 * pad, cin),
+                  dtype=np.float32)
+    xp[:, pad:-pad, pad:-pad, pad:-pad, pad:-pad] = x
+    expected = np.zeros_like(out)
+    for i in range(ha):
+        for j in range(wa):
+            for m in range(hb):
+                for n in range(wb):
+                    patch = xp[:, i:i + k, j:j + k, m:m + k, n:n + k, :]
+                    expected[:, i, j, m, n, :] = (
+                        np.tensordot(patch, w, axes=([1, 2, 3, 4, 5], [0, 1, 2, 3, 4]))
+                    )
+    expected += bias
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_conv4d_kernel5(rng):
+    b, ha, wa, hb, wb, cin, cout, k = 1, 5, 5, 5, 5, 1, 2, 5
+    x = rng.standard_normal((b, ha, wa, hb, wb, cin)).astype(np.float32)
+    w = rng.standard_normal((k, k, k, k, cin, cout)).astype(np.float32)
+    out = np.asarray(ops.conv4d(jnp.asarray(x), jnp.asarray(w)))
+    pad = k // 2
+    xp = np.pad(x, [(0, 0)] + [(pad, pad)] * 4 + [(0, 0)])
+    expected = np.zeros((b, ha, wa, hb, wb, cout), dtype=np.float32)
+    for i in range(ha):
+        for j in range(wa):
+            for m in range(hb):
+                for n in range(wb):
+                    patch = xp[:, i:i + k, j:j + k, m:m + k, n:n + k, :]
+                    expected[:, i, j, m, n, :] = np.tensordot(
+                        patch, w, axes=([1, 2, 3, 4, 5], [0, 1, 2, 3, 4]))
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-3)

@@ -1,0 +1,32 @@
+"""Resize semantics: must match torch align-corners bilinear (the reference's
+identity-affine grid_sample / F.upsample path).  torch (CPU) is used purely as
+an independent oracle."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ncnet_tpu import ops
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.mark.parametrize("shape,out", [((13, 17), (7, 5)), ((5, 6), (10, 12)),
+                                       ((8, 8), (8, 8))])
+def test_resize_matches_torch_align_corners(rng, shape, out):
+    img = rng.standard_normal((*shape, 3)).astype(np.float32)
+    ours = np.asarray(ops.resize_bilinear_align_corners(jnp.asarray(img), *out))
+    ours_np = ops.resize_bilinear_align_corners_np(img, *out)
+    t = torch.nn.functional.interpolate(
+        torch.from_numpy(img.transpose(2, 0, 1))[None], size=out,
+        mode="bilinear", align_corners=True,
+    )[0].numpy().transpose(1, 2, 0)
+    np.testing.assert_allclose(ours, t, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ours_np, t, rtol=1e-4, atol=1e-5)
+
+
+def test_normalize_imagenet():
+    img = np.full((4, 4, 3), 255.0, dtype=np.float32)
+    out = ops.normalize_imagenet(img)
+    expected = (1.0 - ops.IMAGENET_MEAN) / ops.IMAGENET_STD
+    np.testing.assert_allclose(out[0, 0], expected, rtol=1e-5)

@@ -1,0 +1,143 @@
+"""Tests for corr_to_matches / point transfer / coordinate transforms."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ncnet_tpu import ops
+
+
+def _np_corr_to_matches(corr, do_softmax=False, scale="centered", invert=False,
+                        delta4d=None, k_size=1):
+    """Independent numpy oracle following the reference's documented
+    semantics (point_tnf.py:12-80)."""
+    b, fs1, fs2, fs3, fs4 = corr.shape
+    lo = -1.0 if scale == "centered" else 0.0
+    gxa = np.linspace(lo, 1, fs2 * k_size)
+    gya = np.linspace(lo, 1, fs1 * k_size)
+    gxb = np.linspace(lo, 1, fs4 * k_size)
+    gyb = np.linspace(lo, 1, fs3 * k_size)
+    if invert:
+        nc = corr.reshape(b, fs1 * fs2, fs3 * fs4)
+        if do_softmax:
+            e = np.exp(nc - nc.max(2, keepdims=True))
+            nc = e / e.sum(2, keepdims=True)
+        score = nc.max(2)
+        idx = nc.argmax(2)
+        i_b, j_b = idx // fs4, idx % fs4
+        i_a = np.broadcast_to((np.arange(fs1 * fs2) // fs2)[None], idx.shape)
+        j_a = np.broadcast_to((np.arange(fs1 * fs2) % fs2)[None], idx.shape)
+    else:
+        nc = corr.reshape(b, fs1 * fs2, fs3 * fs4)
+        if do_softmax:
+            e = np.exp(nc - nc.max(1, keepdims=True))
+            nc = e / e.sum(1, keepdims=True)
+        score = nc.max(1)
+        idx = nc.argmax(1)
+        i_a, j_a = idx // fs2, idx % fs2
+        i_b = np.broadcast_to((np.arange(fs3 * fs4) // fs4)[None], idx.shape)
+        j_b = np.broadcast_to((np.arange(fs3 * fs4) % fs4)[None], idx.shape)
+    if delta4d is not None:
+        dia, dja, dib, djb = delta4d
+        bi = np.arange(b)[:, None]
+        i_a, j_a, i_b, j_b = (
+            i_a * k_size + dia[bi, i_a, j_a, i_b, j_b],
+            j_a * k_size + dja[bi, i_a, j_a, i_b, j_b],
+            i_b * k_size + dib[bi, i_a, j_a, i_b, j_b],
+            j_b * k_size + djb[bi, i_a, j_a, i_b, j_b],
+        )
+    return gxa[j_a], gya[i_a], gxb[j_b], gyb[i_b], score
+
+
+def test_corr_to_matches_directions_and_softmax(rng):
+    corr = rng.standard_normal((2, 3, 4, 5, 2)).astype(np.float32)
+    for invert in (False, True):
+        for do_softmax in (False, True):
+            for scale in ("centered", "positive"):
+                m = ops.corr_to_matches(
+                    jnp.asarray(corr), do_softmax=do_softmax, scale=scale,
+                    invert_matching_direction=invert)
+                xa, ya, xb, yb, score = _np_corr_to_matches(
+                    corr, do_softmax=do_softmax, scale=scale, invert=invert)
+                np.testing.assert_allclose(np.asarray(m.xA), xa, rtol=1e-5)
+                np.testing.assert_allclose(np.asarray(m.yA), ya, rtol=1e-5)
+                np.testing.assert_allclose(np.asarray(m.xB), xb, rtol=1e-5)
+                np.testing.assert_allclose(np.asarray(m.yB), yb, rtol=1e-5)
+                np.testing.assert_allclose(np.asarray(m.score), score,
+                                           rtol=1e-5, atol=1e-6)
+
+
+def test_corr_to_matches_relocalization(rng):
+    """Full relocalization roundtrip: hi-res volume → maxpool4d → matches on
+    the fine grid must equal the oracle on the pooled volume + offsets."""
+    k = 2
+    hi = rng.standard_normal((1, 6, 4, 6, 4)).astype(np.float32)
+    pooled, delta = ops.maxpool4d_with_argmax(jnp.asarray(hi), k)
+    m = ops.corr_to_matches(pooled, delta4d=delta, k_size=k, scale="positive")
+    delta_np = tuple(np.asarray(d) for d in delta)
+    xa, ya, xb, yb, score = _np_corr_to_matches(
+        np.asarray(pooled), scale="positive", delta4d=delta_np, k_size=k)
+    np.testing.assert_allclose(np.asarray(m.xA), xa, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m.yA), ya, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m.xB), xb, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m.yB), yb, rtol=1e-5)
+
+
+def test_normalize_axis_roundtrip(rng):
+    x = rng.uniform(1, 200, size=(7,)).astype(np.float32)
+    n = ops.normalize_axis(x, 200.0)
+    back = ops.unnormalize_axis(n, 200.0)
+    np.testing.assert_allclose(back, x, rtol=1e-5)
+    # reference convention: pixel 1 → -1, pixel L → +1 (1-indexed)
+    np.testing.assert_allclose(ops.normalize_axis(1.0, 100.0), -1.0)
+    np.testing.assert_allclose(ops.normalize_axis(100.0, 100.0), 1.0)
+
+
+def test_points_unit_pixel_roundtrip(rng):
+    pts = rng.uniform(1, 90, size=(2, 2, 5)).astype(np.float32)
+    im_size = np.array([[100.0, 120.0], [50.0, 60.0]], dtype=np.float32)
+    unit = ops.points_to_unit_coords(jnp.asarray(pts), jnp.asarray(im_size))
+    back = ops.points_to_pixel_coords(unit, jnp.asarray(im_size))
+    np.testing.assert_allclose(np.asarray(back), pts, rtol=1e-4)
+
+
+def _identity_matches(fs):
+    """Matches where every B cell maps to the same A cell position."""
+    g = np.linspace(-1, 1, fs).astype(np.float32)
+    xb, yb = np.meshgrid(g, g)
+    xb, yb = xb.reshape(1, -1), yb.reshape(1, -1)
+    return ops.Matches(jnp.asarray(xb), jnp.asarray(yb),
+                       jnp.asarray(xb), jnp.asarray(yb),
+                       jnp.ones_like(jnp.asarray(xb)))
+
+
+def test_bilinear_interp_identity_field():
+    fs = 5
+    m = _identity_matches(fs)
+    pts = np.array([[[-0.3, 0.1, 0.77], [0.2, -0.6, 0.33]]], dtype=np.float32)
+    warped = np.asarray(ops.bilinear_interp_point_tnf(m, jnp.asarray(pts)))
+    np.testing.assert_allclose(warped, pts, atol=1e-5)
+
+
+def test_nearest_neighbor_identity_field():
+    fs = 5
+    m = _identity_matches(fs)
+    g = np.linspace(-1, 1, fs)
+    pts = np.array([[[g[1] + 0.01, g[3]], [g[2], g[0] + 0.02]]], dtype=np.float32)
+    warped = np.asarray(ops.nearest_neighbor_point_tnf(m, jnp.asarray(pts)))
+    np.testing.assert_allclose(warped[0, 0], [g[1], g[3]], atol=1e-6)
+    np.testing.assert_allclose(warped[0, 1], [g[2], g[0]], atol=1e-6)
+
+
+def test_bilinear_interp_affine_field():
+    """A linear match field must be reproduced exactly by bilinear interp."""
+    fs = 6
+    g = np.linspace(-1, 1, fs).astype(np.float32)
+    xb, yb = np.meshgrid(g, g)
+    xa = 0.5 * xb + 0.1
+    ya = -0.25 * yb - 0.05
+    m = ops.Matches(*(jnp.asarray(v.reshape(1, -1)) for v in (xa, ya, xb, yb)),
+                    jnp.ones((1, fs * fs)))
+    pts = np.array([[[-0.5, 0.3], [0.7, -0.2]]], dtype=np.float32)
+    warped = np.asarray(ops.bilinear_interp_point_tnf(m, jnp.asarray(pts)))
+    np.testing.assert_allclose(warped[:, 0], 0.5 * pts[:, 0] + 0.1, atol=1e-5)
+    np.testing.assert_allclose(warped[:, 1], -0.25 * pts[:, 1] - 0.05, atol=1e-5)
