@@ -1,0 +1,342 @@
+"""Feature-extraction backbones (functional, NHWC, frozen-BN).
+
+Reference: ``FeatureExtraction`` (/root/reference/lib/model.py:19-87) wraps a
+*pretrained, frozen* torchvision trunk — ResNet-101 cut after ``layer3``
+(model.py:38-44, the default) or VGG-16 cut after ``pool4`` (model.py:24-35) —
+always run in eval mode (model.py:251), optionally with the last few blocks
+unfrozen for finetuning (train.py:60-63).  The ``resnet101fpn`` variant is dead
+code upstream (undefined ``fpn_body``, model.py:61) and is not carried forward.
+
+TPU-first design decisions:
+  * plain pytree params + pure apply functions — no framework Module needed for
+    a frozen trunk, and ``jax.grad`` flows through the pytree when finetuning;
+  * NHWC layout end-to-end (MXU-native), vs. the reference's NCHW;
+  * BatchNorm is *inference-only by construction*: stored as raw
+    ``(scale, bias, mean, var)`` for checkpoint fidelity, applied as a folded
+    affine — matching eval-mode semantics of the always-frozen reference BN;
+  * a ``tiny`` backbone (2 strided convs) for fast tests and dry-runs.
+
+``import_torch_backbone`` converts a torchvision-style ``state_dict`` (as
+numpy arrays) into these pytrees, for golden parity with released checkpoints.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BN_EPS = 1e-5
+
+# torchvision resnet101: blocks per stage; we build conv1..layer3 (stride 16).
+RESNET101_STAGES = {"layer1": 3, "layer2": 4, "layer3": 23}
+RESNET101_PLANES = {"layer1": 64, "layer2": 128, "layer3": 256}
+
+# VGG-16 `features` sequence up to pool4 (torchvision indices 0..23):
+# channel plan per conv layer, '-1' marks a maxpool.
+VGG16_PLAN = (64, 64, -1, 128, 128, -1, 256, 256, 256, -1, 512, 512, 512, -1)
+
+OUTPUT_CHANNELS = {"resnet101": 1024, "vgg": 512, "tiny": 32}
+OUTPUT_STRIDE = {"resnet101": 16, "vgg": 16, "tiny": 16}
+
+
+# ---------------------------------------------------------------------------
+# primitive appliers
+# ---------------------------------------------------------------------------
+
+
+def _conv(x, w, stride=1, padding=0):
+    """NHWC conv with HWIO weights, torch-style explicit symmetric padding."""
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(x, p):
+    """Eval-mode batch norm from stored running stats (torch eps=1e-5)."""
+    inv = p["scale"] * lax.rsqrt(p["var"] + BN_EPS)
+    return x * inv + (p["bias"] - p["mean"] * inv)
+
+
+def _maxpool(x, window=3, stride=2, padding=1):
+    """torch MaxPool2d semantics (pads with -inf)."""
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=((0, 0), (padding, padding), (padding, padding), (0, 0)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+
+def _he_conv(key, kh, kw, cin, cout, dtype):
+    fan_out = kh * kw * cout
+    std = math.sqrt(2.0 / fan_out)
+    return std * jax.random.normal(key, (kh, kw, cin, cout), dtype)
+
+
+def _bn_init(c, dtype):
+    return {
+        "scale": jnp.ones((c,), dtype),
+        "bias": jnp.zeros((c,), dtype),
+        "mean": jnp.zeros((c,), dtype),
+        "var": jnp.ones((c,), dtype),
+    }
+
+
+def init_resnet101(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
+    """Random-init ResNet-101 trunk (conv1..layer3), torchvision layout."""
+    keys = iter(jax.random.split(key, 256))
+    params: Dict[str, Any] = {
+        "conv1": {"w": _he_conv(next(keys), 7, 7, 3, 64, dtype)},
+        "bn1": _bn_init(64, dtype),
+    }
+    inplanes = 64
+    for stage, nblocks in RESNET101_STAGES.items():
+        planes = RESNET101_PLANES[stage]
+        stride = 1 if stage == "layer1" else 2
+        blocks = []
+        for i in range(nblocks):
+            s = stride if i == 0 else 1
+            blk = {
+                "conv1": {"w": _he_conv(next(keys), 1, 1, inplanes, planes, dtype)},
+                "bn1": _bn_init(planes, dtype),
+                "conv2": {"w": _he_conv(next(keys), 3, 3, planes, planes, dtype)},
+                "bn2": _bn_init(planes, dtype),
+                "conv3": {"w": _he_conv(next(keys), 1, 1, planes, planes * 4, dtype)},
+                "bn3": _bn_init(planes * 4, dtype),
+            }
+            if i == 0:
+                blk["downsample"] = {
+                    "conv": {"w": _he_conv(next(keys), 1, 1, inplanes, planes * 4, dtype)},
+                    "bn": _bn_init(planes * 4, dtype),
+                }
+                inplanes = planes * 4
+            blocks.append(blk)
+        params[stage] = blocks
+    return params
+
+
+def init_vgg16(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
+    """Random-init VGG-16 features up to pool4 (conv layers carry biases)."""
+    keys = iter(jax.random.split(key, 32))
+    convs = []
+    cin = 3
+    for cout in VGG16_PLAN:
+        if cout == -1:
+            continue
+        convs.append(
+            {
+                "w": _he_conv(next(keys), 3, 3, cin, cout, dtype),
+                "b": jnp.zeros((cout,), dtype),
+            }
+        )
+        cin = cout
+    return {"convs": convs}
+
+
+def init_tiny(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
+    """Tiny 2-conv stride-16 trunk for tests/dry-runs (no reference analog)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "conv1": {"w": _he_conv(k1, 5, 5, 3, 16, dtype), "b": jnp.zeros((16,), dtype)},
+        "conv2": {"w": _he_conv(k2, 5, 5, 16, 32, dtype), "b": jnp.zeros((32,), dtype)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward passes
+# ---------------------------------------------------------------------------
+
+
+def _bottleneck(x, blk, stride):
+    """torchvision Bottleneck (stride on the 3x3 conv)."""
+    out = jax.nn.relu(_bn(_conv(x, blk["conv1"]["w"]), blk["bn1"]))
+    out = jax.nn.relu(_bn(_conv(out, blk["conv2"]["w"], stride=stride, padding=1), blk["bn2"]))
+    out = _bn(_conv(out, blk["conv3"]["w"]), blk["bn3"])
+    if "downsample" in blk:
+        x = _bn(_conv(x, blk["downsample"]["conv"]["w"], stride=stride), blk["downsample"]["bn"])
+    return jax.nn.relu(out + x)
+
+
+def resnet101_features(params: Dict[str, Any], images: jnp.ndarray) -> jnp.ndarray:
+    """``(B, H, W, 3)`` → ``(B, H/16, W/16, 1024)`` (conv1..layer3)."""
+    x = jax.nn.relu(_bn(_conv(images, params["conv1"]["w"], stride=2, padding=3), params["bn1"]))
+    x = _maxpool(x)
+    for stage in RESNET101_STAGES:
+        stride = 1 if stage == "layer1" else 2
+        for i, blk in enumerate(params[stage]):
+            x = _bottleneck(x, blk, stride if i == 0 else 1)
+    return x
+
+
+def vgg16_features(params: Dict[str, Any], images: jnp.ndarray) -> jnp.ndarray:
+    """``(B, H, W, 3)`` → ``(B, H/16, W/16, 512)`` (features through pool4)."""
+    x = images
+    it = iter(params["convs"])
+    for cout in VGG16_PLAN:
+        if cout == -1:
+            x = _maxpool(x, window=2, stride=2, padding=0)
+        else:
+            c = next(it)
+            x = jax.nn.relu(_conv(x, c["w"], padding=1) + c["b"])
+    return x
+
+
+def tiny_features(params: Dict[str, Any], images: jnp.ndarray) -> jnp.ndarray:
+    x = jax.nn.relu(_conv(images, params["conv1"]["w"], stride=4, padding=2) + params["conv1"]["b"])
+    return jax.nn.relu(_conv(x, params["conv2"]["w"], stride=4, padding=2) + params["conv2"]["b"])
+
+
+_INITS = {"resnet101": init_resnet101, "vgg": init_vgg16, "tiny": init_tiny}
+_APPLYS = {"resnet101": resnet101_features, "vgg": vgg16_features, "tiny": tiny_features}
+
+
+def backbone_init(name: str, key: jax.Array, dtype=jnp.float32):
+    if name not in _INITS:
+        raise ValueError(f"unknown backbone {name!r}; have {sorted(_INITS)}")
+    return _INITS[name](key, dtype)
+
+
+def backbone_apply(name: str, params, images: jnp.ndarray) -> jnp.ndarray:
+    if name not in _APPLYS:
+        raise ValueError(f"unknown backbone {name!r}; have {sorted(_APPLYS)}")
+    return _APPLYS[name](params, images)
+
+
+# ---------------------------------------------------------------------------
+# finetune partitioning (reference train.py:60-63 semantics)
+# ---------------------------------------------------------------------------
+
+
+def finetune_labels(name: str, params, n_finetune_blocks: int):
+    """Pytree of {'frozen','trainable'} labels for optax.multi_transform.
+
+    The reference unfreezes the *last* ``fe_finetune_params`` child modules of
+    the trunk (train.py:60-63 iterates reversed ``model.FeatureExtraction``
+    children) — but only ``.parameters()``: BatchNorm running stats are
+    buffers and stay frozen even in finetuned blocks.  Here the unit is a
+    residual block (resnet) / conv layer (vgg).
+    """
+
+    def _unfreeze(subtree):
+        # conv weights + BN affine train; BN running stats never do.
+        return jax.tree.map_with_path(
+            lambda path, _: "frozen"
+            if any(getattr(k, "key", None) in ("mean", "var") for k in path)
+            else "trainable",
+            subtree,
+        )
+
+    labels = jax.tree.map(lambda _: "frozen", params)
+    if n_finetune_blocks <= 0:
+        return labels
+    if name == "resnet101":
+        flat_blocks = [(s, i) for s in RESNET101_STAGES for i in range(len(params[s]))]
+        for s, i in flat_blocks[-n_finetune_blocks:]:
+            labels[s][i] = _unfreeze(labels[s][i])
+    elif name == "vgg":
+        for i in range(len(params["convs"]))[-n_finetune_blocks:]:
+            labels["convs"][i] = _unfreeze(labels["convs"][i])
+    else:
+        labels = _unfreeze(params)
+    return labels
+
+
+# ---------------------------------------------------------------------------
+# torch state_dict import
+# ---------------------------------------------------------------------------
+
+
+def _t2j_conv(w: np.ndarray) -> jnp.ndarray:
+    """torch conv weight (O, I, kH, kW) → HWIO."""
+    return jnp.asarray(np.transpose(w, (2, 3, 1, 0)))
+
+
+def _t2j_bn(sd, prefix) -> Dict[str, jnp.ndarray]:
+    return {
+        "scale": jnp.asarray(sd[prefix + ".weight"]),
+        "bias": jnp.asarray(sd[prefix + ".bias"]),
+        "mean": jnp.asarray(sd[prefix + ".running_mean"]),
+        "var": jnp.asarray(sd[prefix + ".running_var"]),
+    }
+
+
+def import_torch_backbone(state_dict, name: str = "resnet101", prefix: str = ""):
+    """Convert a torchvision-style ``state_dict`` into a backbone pytree.
+
+    Accepts the key naming of torchvision ``resnet101`` / ``vgg16.features``;
+    ``prefix`` strips a leading path (e.g. the reference checkpoint nests the
+    trunk under ``FeatureExtraction.model.<idx>.`` — see
+    /root/reference/lib/model.py:242-249 and models/checkpoint.py).
+    Values may be torch tensors or numpy arrays.
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        if prefix and not k.startswith(prefix):
+            continue
+        k = k[len(prefix):]
+        sd[k] = v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+
+    if name == "resnet101":
+        params: Dict[str, Any] = {
+            "conv1": {"w": _t2j_conv(sd["conv1.weight"])},
+            "bn1": _t2j_bn(sd, "bn1"),
+        }
+        for stage, nblocks in RESNET101_STAGES.items():
+            blocks = []
+            for i in range(nblocks):
+                p = f"{stage}.{i}"
+                blk = {
+                    "conv1": {"w": _t2j_conv(sd[f"{p}.conv1.weight"])},
+                    "bn1": _t2j_bn(sd, f"{p}.bn1"),
+                    "conv2": {"w": _t2j_conv(sd[f"{p}.conv2.weight"])},
+                    "bn2": _t2j_bn(sd, f"{p}.bn2"),
+                    "conv3": {"w": _t2j_conv(sd[f"{p}.conv3.weight"])},
+                    "bn3": _t2j_bn(sd, f"{p}.bn3"),
+                }
+                if f"{p}.downsample.0.weight" in sd:
+                    blk["downsample"] = {
+                        "conv": {"w": _t2j_conv(sd[f"{p}.downsample.0.weight"])},
+                        "bn": _t2j_bn(sd, f"{p}.downsample.1"),
+                    }
+                blocks.append(blk)
+            params[stage] = blocks
+        return params
+
+    if name == "vgg":
+        # torchvision vgg16.features is an nn.Sequential; conv layers sit at
+        # indices 0,2,5,7,10,12,14,17,19,21 (pre-pool4 slice).
+        conv_idx = []
+        idx = 0
+        for cout in VGG16_PLAN:
+            if cout == -1:
+                idx += 1  # the pool layer
+            else:
+                conv_idx.append(idx)
+                idx += 2  # conv + relu
+        convs = []
+        for i in conv_idx:
+            convs.append(
+                {
+                    "w": _t2j_conv(sd[f"{i}.weight"]),
+                    "b": jnp.asarray(sd[f"{i}.bias"]),
+                }
+            )
+        return {"convs": convs}
+
+    raise ValueError(f"no torch importer for backbone {name!r}")

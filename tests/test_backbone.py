@@ -1,0 +1,169 @@
+"""Backbone parity vs. a torch functional oracle.
+
+The reference trunk is torchvision resnet101[:layer3] / vgg16[:pool4] in eval
+mode (/root/reference/lib/model.py:24-44).  torchvision is not installed here,
+so the oracle is a functional re-statement of those architectures driven by a
+synthetic torchvision-style state_dict — the same dict is imported through
+``import_torch_backbone``, so this tests both the converter and the forward.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.models import backbone as bb
+
+RNG = np.random.default_rng(0)
+
+
+def _conv_w(cout, cin, k):
+    std = 0.3 / np.sqrt(cin * k * k)
+    return RNG.normal(0, std, (cout, cin, k, k)).astype(np.float32)
+
+
+def _bn_sd(sd, prefix, c):
+    sd[prefix + ".weight"] = RNG.uniform(0.5, 1.5, c).astype(np.float32)
+    sd[prefix + ".bias"] = RNG.normal(0, 0.1, c).astype(np.float32)
+    sd[prefix + ".running_mean"] = RNG.normal(0, 0.1, c).astype(np.float32)
+    sd[prefix + ".running_var"] = RNG.uniform(0.5, 1.5, c).astype(np.float32)
+
+
+def make_resnet101_state_dict():
+    sd = {}
+    sd["conv1.weight"] = _conv_w(64, 3, 7)
+    _bn_sd(sd, "bn1", 64)
+    inplanes = 64
+    for stage, n in bb.RESNET101_STAGES.items():
+        planes = bb.RESNET101_PLANES[stage]
+        for i in range(n):
+            p = f"{stage}.{i}"
+            sd[p + ".conv1.weight"] = _conv_w(planes, inplanes, 1)
+            _bn_sd(sd, p + ".bn1", planes)
+            sd[p + ".conv2.weight"] = _conv_w(planes, planes, 3)
+            _bn_sd(sd, p + ".bn2", planes)
+            sd[p + ".conv3.weight"] = _conv_w(planes * 4, planes, 1)
+            _bn_sd(sd, p + ".bn3", planes * 4)
+            if i == 0:
+                sd[p + ".downsample.0.weight"] = _conv_w(planes * 4, inplanes, 1)
+                _bn_sd(sd, p + ".downsample.1", planes * 4)
+                inplanes = planes * 4
+    return sd
+
+
+def torch_resnet101_features(sd, x):
+    t = {k: torch.from_numpy(v) for k, v in sd.items()}
+
+    def bn(y, p):
+        return F.batch_norm(
+            y, t[p + ".running_mean"], t[p + ".running_var"],
+            t[p + ".weight"], t[p + ".bias"], training=False, eps=1e-5,
+        )
+
+    x = F.relu(bn(F.conv2d(x, t["conv1.weight"], stride=2, padding=3), "bn1"))
+    x = F.max_pool2d(x, 3, 2, 1)
+    for stage, n in bb.RESNET101_STAGES.items():
+        for i in range(n):
+            p = f"{stage}.{i}"
+            stride = 2 if (i == 0 and stage != "layer1") else 1
+            out = F.relu(bn(F.conv2d(x, t[p + ".conv1.weight"]), p + ".bn1"))
+            out = F.relu(bn(F.conv2d(out, t[p + ".conv2.weight"], stride=stride, padding=1), p + ".bn2"))
+            out = bn(F.conv2d(out, t[p + ".conv3.weight"]), p + ".bn3")
+            if p + ".downsample.0.weight" in sd:
+                x = bn(F.conv2d(x, t[p + ".downsample.0.weight"], stride=stride), p + ".downsample.1")
+            x = F.relu(out + x)
+    return x
+
+
+def make_vgg16_state_dict():
+    sd = {}
+    cin, idx = 3, 0
+    for cout in bb.VGG16_PLAN:
+        if cout == -1:
+            idx += 1
+            continue
+        sd[f"{idx}.weight"] = _conv_w(cout, cin, 3)
+        sd[f"{idx}.bias"] = RNG.normal(0, 0.05, cout).astype(np.float32)
+        cin = cout
+        idx += 2
+    return sd
+
+
+def torch_vgg16_features(sd, x):
+    t = {k: torch.from_numpy(v) for k, v in sd.items()}
+    idx = 0
+    for cout in bb.VGG16_PLAN:
+        if cout == -1:
+            x = F.max_pool2d(x, 2, 2)
+            idx += 1
+        else:
+            x = F.relu(F.conv2d(x, t[f"{idx}.weight"], t[f"{idx}.bias"], padding=1))
+            idx += 2
+    return x
+
+
+@pytest.mark.parametrize("hw", [(64, 64), (64, 48)])
+def test_resnet101_matches_torch(hw):
+    sd = make_resnet101_state_dict()
+    x = RNG.normal(0, 1, (1, 3, *hw)).astype(np.float32)
+    want = torch_resnet101_features(sd, torch.from_numpy(x)).numpy()
+
+    params = bb.import_torch_backbone(sd, "resnet101")
+    got = bb.resnet101_features(params, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+    got = np.transpose(np.asarray(got), (0, 3, 1, 2))
+
+    assert got.shape == want.shape == (1, 1024, hw[0] // 16, hw[1] // 16)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_vgg16_matches_torch():
+    sd = make_vgg16_state_dict()
+    x = RNG.normal(0, 1, (2, 3, 48, 64)).astype(np.float32)
+    want = torch_vgg16_features(sd, torch.from_numpy(x)).numpy()
+
+    params = bb.import_torch_backbone(sd, "vgg")
+    got = bb.vgg16_features(params, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+    got = np.transpose(np.asarray(got), (0, 3, 1, 2))
+
+    assert got.shape == want.shape == (2, 512, 3, 4)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_tiny_backbone_shape_and_stride():
+    params = bb.backbone_init("tiny", jax.random.key(0))
+    out = bb.backbone_apply("tiny", params, jnp.zeros((2, 64, 48, 3)))
+    assert out.shape == (2, 4, 3, 32)
+
+
+def test_random_init_shapes_match_import_shapes():
+    sd = make_resnet101_state_dict()
+    imported = bb.import_torch_backbone(sd, "resnet101")
+    initialized = bb.init_resnet101(jax.random.key(0))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(a.shape, b.shape),
+                 imported, initialized)
+
+
+def test_finetune_labels_partition():
+    params = bb.init_vgg16(jax.random.key(0))
+    labels = bb.finetune_labels("vgg", params, 2)
+    flat = jax.tree.leaves(labels)
+    assert "trainable" in flat and "frozen" in flat
+    # exactly the last 2 conv layers (w+b each) are trainable
+    assert sum(1 for l in flat if l == "trainable") == 4
+
+
+def test_finetune_labels_keep_bn_stats_frozen():
+    """Reference finetuning unfreezes .parameters() only (train.py:60-63);
+    BN running stats are buffers and must never train."""
+    params = bb.init_resnet101(jax.random.key(0))
+    labels = bb.finetune_labels("resnet101", params, 2)
+    last = labels["layer3"][-1]
+    assert last["conv1"]["w"] == "trainable"
+    assert last["bn1"]["scale"] == "trainable"
+    assert last["bn1"]["mean"] == "frozen"
+    assert last["bn1"]["var"] == "frozen"
+    # untouched blocks fully frozen
+    assert set(jax.tree.leaves(labels["layer1"])) == {"frozen"}
