@@ -1,0 +1,39 @@
+"""Model assembly: backbones, NCNet composition, checkpoint I/O."""
+
+from ncnet_tpu.models.backbone import (
+    backbone_apply,
+    backbone_init,
+    finetune_labels,
+    import_torch_backbone,
+)
+from ncnet_tpu.models.ncnet import (
+    NCNet,
+    NCNetOutput,
+    extract_features,
+    init_ncnet,
+    ncnet_filter,
+    ncnet_forward,
+    neigh_consensus,
+)
+from ncnet_tpu.models.checkpoint import (
+    import_torch_checkpoint,
+    load_params,
+    save_params,
+)
+
+__all__ = [
+    "NCNet",
+    "NCNetOutput",
+    "backbone_apply",
+    "backbone_init",
+    "extract_features",
+    "finetune_labels",
+    "import_torch_backbone",
+    "import_torch_checkpoint",
+    "init_ncnet",
+    "load_params",
+    "ncnet_filter",
+    "ncnet_forward",
+    "neigh_consensus",
+    "save_params",
+]

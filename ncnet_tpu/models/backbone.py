@@ -32,9 +32,54 @@ from jax import lax
 
 BN_EPS = 1e-5
 
-# torchvision resnet101: blocks per stage; we build conv1..layer3 (stride 16).
+# torchvision resnet101: blocks per stage; we build conv1..layer3 (stride 16),
+# the deepest cut the reference uses (model.py:38-44; layer4 is never taken).
 RESNET101_STAGES = {"layer1": 3, "layer2": 4, "layer3": 23}
 RESNET101_PLANES = {"layer1": 64, "layer2": 128, "layer3": 256}
+
+
+def _resnet_stages(last_layer: str):
+    """Stages up to the cut point; '' means the reference default 'layer3'."""
+    last = last_layer or "layer3"
+    if last not in RESNET101_STAGES:
+        raise ValueError(
+            f"unsupported resnet101 cut {last!r}; have {list(RESNET101_STAGES)}"
+        )
+    names = list(RESNET101_STAGES)
+    return names[: names.index(last) + 1]
+
+
+def _vgg_units(last_layer: str):
+    """Unit ops (('conv', i) | ('relu',) | ('pool',)) up to the cut, inclusive.
+
+    Names follow the reference's vgg_feature_layers (model.py:26-31):
+    'convN_M' / 'reluN_M' / 'poolN', default cut 'pool4'.  A cut at a conv
+    name ends on the RAW conv output (no trailing ReLU), exactly like the
+    reference's Sequential slice.
+    """
+    last = last_layer or "pool4"
+    units, names = [], []
+    block, c, ci = 1, 0, 0
+    for cout in VGG16_PLAN:
+        if cout == -1:
+            units.append(("pool",))
+            names.append(f"pool{block}")
+            block += 1
+            c = 0
+        else:
+            c += 1
+            units.append(("conv", ci))
+            names.append(f"conv{block}_{c}")
+            units.append(("relu",))
+            names.append(f"relu{block}_{c}")
+            ci += 1
+    if last not in names:
+        raise ValueError(f"unsupported vgg cut {last!r}; have {names}")
+    return units[: names.index(last) + 1]
+
+
+def _vgg_num_convs(last_layer: str) -> int:
+    return sum(1 for u in _vgg_units(last_layer) if u[0] == "conv")
 
 # VGG-16 `features` sequence up to pool4 (torchvision indices 0..23):
 # channel plan per conv layer, '-1' marks a maxpool.
@@ -98,15 +143,16 @@ def _bn_init(c, dtype):
     }
 
 
-def init_resnet101(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
-    """Random-init ResNet-101 trunk (conv1..layer3), torchvision layout."""
+def init_resnet101(key: jax.Array, dtype=jnp.float32, last_layer: str = "") -> Dict[str, Any]:
+    """Random-init ResNet-101 trunk (conv1..``last_layer``), torchvision layout."""
     keys = iter(jax.random.split(key, 256))
     params: Dict[str, Any] = {
         "conv1": {"w": _he_conv(next(keys), 7, 7, 3, 64, dtype)},
         "bn1": _bn_init(64, dtype),
     }
     inplanes = 64
-    for stage, nblocks in RESNET101_STAGES.items():
+    for stage in _resnet_stages(last_layer):
+        nblocks = RESNET101_STAGES[stage]
         planes = RESNET101_PLANES[stage]
         stride = 1 if stage == "layer1" else 2
         blocks = []
@@ -131,14 +177,13 @@ def init_resnet101(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
     return params
 
 
-def init_vgg16(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
-    """Random-init VGG-16 features up to pool4 (conv layers carry biases)."""
+def init_vgg16(key: jax.Array, dtype=jnp.float32, last_layer: str = "") -> Dict[str, Any]:
+    """Random-init VGG-16 features up to ``last_layer`` (convs carry biases)."""
     keys = iter(jax.random.split(key, 32))
     convs = []
     cin = 3
-    for cout in VGG16_PLAN:
-        if cout == -1:
-            continue
+    plan = [c for c in VGG16_PLAN if c != -1][: _vgg_num_convs(last_layer)]
+    for cout in plan:
         convs.append(
             {
                 "w": _he_conv(next(keys), 3, 3, cin, cout, dtype),
@@ -149,7 +194,7 @@ def init_vgg16(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
     return {"convs": convs}
 
 
-def init_tiny(key: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:
+def init_tiny(key: jax.Array, dtype=jnp.float32, last_layer: str = "") -> Dict[str, Any]:
     """Tiny 2-conv stride-16 trunk for tests/dry-runs (no reference analog)."""
     k1, k2 = jax.random.split(key)
     return {
@@ -173,31 +218,38 @@ def _bottleneck(x, blk, stride):
     return jax.nn.relu(out + x)
 
 
-def resnet101_features(params: Dict[str, Any], images: jnp.ndarray) -> jnp.ndarray:
-    """``(B, H, W, 3)`` → ``(B, H/16, W/16, 1024)`` (conv1..layer3)."""
+def resnet101_features(
+    params: Dict[str, Any], images: jnp.ndarray, last_layer: str = ""
+) -> jnp.ndarray:
+    """``(B, H, W, 3)`` → ``(B, H/16, W/16, 1024)`` at the default layer3 cut."""
     x = jax.nn.relu(_bn(_conv(images, params["conv1"]["w"], stride=2, padding=3), params["bn1"]))
     x = _maxpool(x)
-    for stage in RESNET101_STAGES:
+    for stage in _resnet_stages(last_layer):
         stride = 1 if stage == "layer1" else 2
         for i, blk in enumerate(params[stage]):
             x = _bottleneck(x, blk, stride if i == 0 else 1)
     return x
 
 
-def vgg16_features(params: Dict[str, Any], images: jnp.ndarray) -> jnp.ndarray:
-    """``(B, H, W, 3)`` → ``(B, H/16, W/16, 512)`` (features through pool4)."""
+def vgg16_features(
+    params: Dict[str, Any], images: jnp.ndarray, last_layer: str = ""
+) -> jnp.ndarray:
+    """``(B, H, W, 3)`` → ``(B, H/16, W/16, 512)`` at the default pool4 cut."""
     x = images
-    it = iter(params["convs"])
-    for cout in VGG16_PLAN:
-        if cout == -1:
+    for unit in _vgg_units(last_layer):
+        if unit[0] == "pool":
             x = _maxpool(x, window=2, stride=2, padding=0)
+        elif unit[0] == "conv":
+            c = params["convs"][unit[1]]
+            x = _conv(x, c["w"], padding=1) + c["b"]
         else:
-            c = next(it)
-            x = jax.nn.relu(_conv(x, c["w"], padding=1) + c["b"])
+            x = jax.nn.relu(x)
     return x
 
 
-def tiny_features(params: Dict[str, Any], images: jnp.ndarray) -> jnp.ndarray:
+def tiny_features(
+    params: Dict[str, Any], images: jnp.ndarray, last_layer: str = ""
+) -> jnp.ndarray:
     x = jax.nn.relu(_conv(images, params["conv1"]["w"], stride=4, padding=2) + params["conv1"]["b"])
     return jax.nn.relu(_conv(x, params["conv2"]["w"], stride=4, padding=2) + params["conv2"]["b"])
 
@@ -206,16 +258,16 @@ _INITS = {"resnet101": init_resnet101, "vgg": init_vgg16, "tiny": init_tiny}
 _APPLYS = {"resnet101": resnet101_features, "vgg": vgg16_features, "tiny": tiny_features}
 
 
-def backbone_init(name: str, key: jax.Array, dtype=jnp.float32):
+def backbone_init(name: str, key: jax.Array, dtype=jnp.float32, last_layer: str = ""):
     if name not in _INITS:
         raise ValueError(f"unknown backbone {name!r}; have {sorted(_INITS)}")
-    return _INITS[name](key, dtype)
+    return _INITS[name](key, dtype, last_layer)
 
 
-def backbone_apply(name: str, params, images: jnp.ndarray) -> jnp.ndarray:
+def backbone_apply(name: str, params, images: jnp.ndarray, last_layer: str = "") -> jnp.ndarray:
     if name not in _APPLYS:
         raise ValueError(f"unknown backbone {name!r}; have {sorted(_APPLYS)}")
-    return _APPLYS[name](params, images)
+    return _APPLYS[name](params, images, last_layer)
 
 
 # ---------------------------------------------------------------------------
@@ -242,17 +294,21 @@ def finetune_labels(name: str, params, n_finetune_blocks: int):
             subtree,
         )
 
+    if name not in _APPLYS:
+        raise ValueError(f"unknown backbone {name!r}; have {sorted(_APPLYS)}")
     labels = jax.tree.map(lambda _: "frozen", params)
     if n_finetune_blocks <= 0:
         return labels
     if name == "resnet101":
-        flat_blocks = [(s, i) for s in RESNET101_STAGES for i in range(len(params[s]))]
+        flat_blocks = [
+            (s, i) for s in RESNET101_STAGES if s in params for i in range(len(params[s]))
+        ]
         for s, i in flat_blocks[-n_finetune_blocks:]:
             labels[s][i] = _unfreeze(labels[s][i])
     elif name == "vgg":
         for i in range(len(params["convs"]))[-n_finetune_blocks:]:
             labels["convs"][i] = _unfreeze(labels["convs"][i])
-    else:
+    else:  # tiny: the whole (non-pretrained) trunk trains
         labels = _unfreeze(params)
     return labels
 
@@ -276,7 +332,9 @@ def _t2j_bn(sd, prefix) -> Dict[str, jnp.ndarray]:
     }
 
 
-def import_torch_backbone(state_dict, name: str = "resnet101", prefix: str = ""):
+def import_torch_backbone(
+    state_dict, name: str = "resnet101", prefix: str = "", last_layer: str = ""
+):
     """Convert a torchvision-style ``state_dict`` into a backbone pytree.
 
     Accepts the key naming of torchvision ``resnet101`` / ``vgg16.features``;
@@ -297,9 +355,9 @@ def import_torch_backbone(state_dict, name: str = "resnet101", prefix: str = "")
             "conv1": {"w": _t2j_conv(sd["conv1.weight"])},
             "bn1": _t2j_bn(sd, "bn1"),
         }
-        for stage, nblocks in RESNET101_STAGES.items():
+        for stage in _resnet_stages(last_layer):
             blocks = []
-            for i in range(nblocks):
+            for i in range(RESNET101_STAGES[stage]):
                 p = f"{stage}.{i}"
                 blk = {
                     "conv1": {"w": _t2j_conv(sd[f"{p}.conv1.weight"])},
@@ -323,7 +381,10 @@ def import_torch_backbone(state_dict, name: str = "resnet101", prefix: str = "")
         # indices 0,2,5,7,10,12,14,17,19,21 (pre-pool4 slice).
         conv_idx = []
         idx = 0
+        n_convs = _vgg_num_convs(last_layer)
         for cout in VGG16_PLAN:
+            if len(conv_idx) == n_convs:
+                break
             if cout == -1:
                 idx += 1  # the pool layer
             else:

@@ -1,0 +1,176 @@
+"""NCNet model assembly (the reference's ImMatchNet).
+
+Composes: backbone feature extraction → L2 norm → 4D correlation →
+[maxpool4d relocalization] → mutual matching → neighbourhood-consensus conv4d
+stack → mutual matching.  Reference: ``ImMatchNet``
+(/root/reference/lib/model.py:193-282) and ``NeighConsensus``
+(model.py:122-153).
+
+Functional design: parameters are a plain pytree
+``{"backbone": ..., "nc": [{"w", "b"}, ...]}``; the forward is a pure function
+of ``(config, params, images)`` — jit/grad/shard-friendly.  ``half_precision``
+maps to bfloat16 (TPU-native) rather than the reference's fp16
+(model.py:253-258, 265-267), with f32 MXU accumulation in the correlation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.models import backbone as bb
+from ncnet_tpu.ops import (
+    conv4d,
+    conv4d_init,
+    correlation_4d,
+    feature_l2_norm,
+    maxpool4d_with_argmax,
+    mutual_matching,
+)
+
+
+class NCNetOutput(NamedTuple):
+    """Filtered correlation volume (+ relocalization offsets when k>1)."""
+
+    corr: jnp.ndarray                      # (B, hA, wA, hB, wB)
+    delta4d: Optional[Tuple[jnp.ndarray, ...]] = None
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_ncnet(config: ModelConfig, key: jax.Array) -> Dict[str, Any]:
+    """Random-init parameters for the full model."""
+    k_bb, k_nc = jax.random.split(key)
+    params: Dict[str, Any] = {
+        "backbone": bb.backbone_init(
+            config.backbone, k_bb, last_layer=config.backbone_last_layer
+        )
+    }
+    nc: List[Dict[str, jnp.ndarray]] = []
+    c_in = 1
+    for k_size, c_out in zip(config.ncons_kernel_sizes, config.ncons_channels):
+        k_nc, sub = jax.random.split(k_nc)
+        w, b = conv4d_init(sub, k_size, c_in, c_out)
+        nc.append({"w": w, "b": b})
+        c_in = c_out
+    params["nc"] = nc
+    return params
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def neigh_consensus(
+    nc_params: List[Dict[str, jnp.ndarray]],
+    corr: jnp.ndarray,
+    *,
+    symmetric: bool = True,
+) -> jnp.ndarray:
+    """Neighbourhood-consensus filtering of the 4D volume.
+
+    ``corr``: ``(B, hA, wA, hB, wB)`` scalar volume.  The conv stack runs
+    channels-last; symmetric mode applies the *whole* stack to the volume and
+    to its A↔B transpose, transposing back and summing — exactly the
+    reference's stack-level symmetry (model.py:144-150), which is NOT the same
+    as symmetrizing each layer because of the interleaved ReLUs.
+    """
+
+    def stack(x: jnp.ndarray) -> jnp.ndarray:
+        for layer in nc_params:
+            x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
+        return x
+
+    x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
+    if symmetric:
+        xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))  # swap (hA,wA) ↔ (hB,wB)
+        out = stack(x) + jnp.transpose(stack(xt), (0, 3, 4, 1, 2, 5))
+    else:
+        out = stack(x)
+    return out[..., 0]
+
+
+def extract_features(config: ModelConfig, params, images: jnp.ndarray) -> jnp.ndarray:
+    """Backbone features, optionally L2-normalized per location
+    (reference FeatureExtraction.forward, model.py:83-87)."""
+    feats = bb.backbone_apply(
+        config.backbone, params["backbone"], images,
+        last_layer=config.backbone_last_layer,
+    )
+    if config.normalize_features:
+        feats = feature_l2_norm(feats)
+    return feats
+
+
+def ncnet_forward(
+    config: ModelConfig,
+    params,
+    source_images: jnp.ndarray,
+    target_images: jnp.ndarray,
+) -> NCNetOutput:
+    """Full forward pass on an image-pair batch.
+
+    Args:
+      source_images, target_images: ``(B, H, W, 3)`` normalized images.
+
+    Returns:
+      :class:`NCNetOutput` with the filtered volume ``(B, hA, wA, hB, wB)``
+      and, when ``config.relocalization_k_size > 1``, the ``delta4d`` offsets
+      for fine-grid match recovery (reference model.py:261-282).
+    """
+    fa = extract_features(config, params, source_images)
+    fb = extract_features(config, params, target_images)
+    if config.half_precision:
+        fa = fa.astype(jnp.bfloat16)
+        fb = fb.astype(jnp.bfloat16)
+    corr = correlation_4d(fa, fb)
+    return ncnet_filter(config, params, corr)
+
+
+def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray) -> NCNetOutput:
+    """The post-correlation half of the forward pass: [maxpool4d] →
+    MutualMatching → NeighConsensus → MutualMatching.  Split out so the
+    high-res/sharded paths can feed their own correlation volume."""
+    nc_params = params["nc"]
+    if config.half_precision:
+        nc_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), nc_params)
+        corr = corr.astype(jnp.bfloat16)
+    delta4d = None
+    if config.relocalization_k_size > 1:
+        corr, delta4d = maxpool4d_with_argmax(corr, config.relocalization_k_size)
+    corr = mutual_matching(corr)
+    corr = neigh_consensus(nc_params, corr, symmetric=config.symmetric_mode)
+    corr = mutual_matching(corr)
+    return NCNetOutput(corr, delta4d)
+
+
+class NCNet:
+    """Thin convenience wrapper bundling config + params with a jitted call.
+
+    The functional API (``init_ncnet`` / ``ncnet_forward``) is the real
+    surface; this mirrors the reference's ``model = ImMatchNet(...);
+    model(batch)`` usage for scripts and notebooks.
+    """
+
+    def __init__(self, config: ModelConfig = ModelConfig(), params=None, seed: int = 1):
+        from ncnet_tpu.models.checkpoint import load_params  # lazy, avoids cycle
+
+        self.config = config
+        if params is None and config.checkpoint:
+            self.config, params = load_params(config.checkpoint, config)
+        self.params = params if params is not None else init_ncnet(
+            self.config, jax.random.key(seed)
+        )
+        self._jitted = jax.jit(
+            lambda p, s, t: ncnet_forward(self.config, p, s, t)
+        )
+
+    def __call__(self, source_images, target_images) -> NCNetOutput:
+        return self._jitted(self.params, source_images, target_images)

@@ -146,6 +146,37 @@ def test_random_init_shapes_match_import_shapes():
                  imported, initialized)
 
 
+def test_last_layer_cut():
+    """backbone_last_layer (reference feature_extraction_last_layer) changes
+    the cut point; unknown names fail fast."""
+    p2 = bb.backbone_init("resnet101", jax.random.key(0), last_layer="layer2")
+    assert "layer3" not in p2
+    out = bb.backbone_apply("resnet101", p2, jnp.zeros((1, 64, 64, 3)), last_layer="layer2")
+    assert out.shape == (1, 8, 8, 512)  # stride 8, 512 ch at layer2
+
+    pv = bb.backbone_init("vgg", jax.random.key(0), last_layer="pool3")
+    assert len(pv["convs"]) == 7
+    out = bb.backbone_apply("vgg", pv, jnp.zeros((1, 64, 64, 3)), last_layer="pool3")
+    assert out.shape == (1, 8, 8, 256)
+
+    with pytest.raises(ValueError):
+        bb.backbone_init("resnet101", jax.random.key(0), last_layer="layer9")
+    with pytest.raises(ValueError):
+        bb.finetune_labels("resnet", {}, 1)
+
+
+def test_vgg_conv_cut_excludes_trailing_relu():
+    """A cut at 'convN_M' ends on the raw conv output (reference Sequential
+    slice semantics, model.py:26-35); 'reluN_M' includes the activation."""
+    pv = bb.backbone_init("vgg", jax.random.key(3), last_layer="conv2_1")
+    assert len(pv["convs"]) == 3
+    x = jnp.asarray(RNG.normal(0, 1, (1, 32, 32, 3)).astype(np.float32))
+    raw = bb.backbone_apply("vgg", pv, x, last_layer="conv2_1")
+    relu = bb.backbone_apply("vgg", pv, x, last_layer="relu2_1")
+    assert float(jnp.min(raw)) < 0  # negatives preserved at conv cut
+    np.testing.assert_allclose(np.asarray(jnp.maximum(raw, 0)), np.asarray(relu), rtol=1e-6)
+
+
 def test_finetune_labels_partition():
     params = bb.init_vgg16(jax.random.key(0))
     labels = bb.finetune_labels("vgg", params, 2)
