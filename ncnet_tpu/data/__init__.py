@@ -1,0 +1,20 @@
+"""Host-side input pipeline: datasets, loader, synthetic fixtures."""
+
+from ncnet_tpu.data.datasets import (
+    ImagePairDataset,
+    MAX_KEYPOINTS,
+    PASCAL_CATEGORIES,
+    PFPascalDataset,
+    load_image,
+)
+from ncnet_tpu.data.loader import DataLoader, default_collate
+
+__all__ = [
+    "DataLoader",
+    "ImagePairDataset",
+    "MAX_KEYPOINTS",
+    "PASCAL_CATEGORIES",
+    "PFPascalDataset",
+    "default_collate",
+    "load_image",
+]

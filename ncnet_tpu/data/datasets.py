@@ -1,0 +1,214 @@
+"""Host-side datasets: weak-supervision image pairs + PF-Pascal keypoints.
+
+Reference: ``ImagePairDataset`` (/root/reference/lib/im_pair_dataset.py:26-94)
+and ``PFPascalDataset`` (/root/reference/lib/pf_dataset.py:26-113).  Same CSV
+schemas, same preprocessing order (grayscale→3ch, random crop, flip, record
+im_size, THEN resize), same −1 keypoint padding to 20 and 'pf'/'scnet' PCK
+procedures — but emitting channels-last numpy arrays for the TPU pipeline and
+using a seeded ``np.random.Generator`` instead of ambient global RNG state.
+
+Images are decoded with PIL (the reference uses skimage.io); resizing is the
+align-corners bilinear twin of the reference's identity-affine grid_sample
+(lib/transformation.py:25-46) — see ncnet_tpu/ops/image.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+from PIL import Image
+
+from ncnet_tpu.ops.image import normalize_imagenet, resize_bilinear_align_corners_np
+
+PASCAL_CATEGORIES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+)
+
+MAX_KEYPOINTS = 20  # reference pads keypoint arrays to 20 (pf_dataset.py:106-108)
+
+
+def load_image(path: str) -> np.ndarray:
+    """Decode to (H, W, 3) uint8; grayscale replicated to 3 channels
+    (im_pair_dataset.py:64-65)."""
+    with Image.open(path) as im:
+        arr = np.asarray(im)
+    if arr.ndim == 2:
+        arr = np.repeat(arr[:, :, None], 3, axis=2)
+    if arr.shape[2] > 3:  # drop alpha
+        arr = arr[:, :, :3]
+    return arr
+
+
+def _preprocess(
+    image: np.ndarray, out_h: int, out_w: int, normalize: bool
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Record (h, w, c) size then resize; optional ImageNet normalization
+    (the reference's NormalizeImageDict transform, lib/normalization.py)."""
+    im_size = np.asarray(image.shape, dtype=np.float32)
+    image = resize_bilinear_align_corners_np(image.astype(np.float32), out_h, out_w)
+    if normalize:
+        image = normalize_imagenet(image).astype(np.float32)
+    return image, im_size
+
+
+class ImagePairDataset:
+    """Weak-supervision pairs from a ``source,target,class,flip`` CSV
+    (im_pair_dataset.py:26-57)."""
+
+    def __init__(
+        self,
+        dataset_csv_path: str,
+        dataset_csv_file: str,
+        dataset_image_path: str,
+        dataset_size: int = 0,
+        output_size: Tuple[int, int] = (240, 240),
+        normalize: bool = True,
+        random_crop: bool = False,
+        seed: int = 1,
+    ):
+        self.out_h, self.out_w = output_size
+        self.random_crop = random_crop
+        self.normalize = normalize
+        df = pd.read_csv(os.path.join(dataset_csv_path, dataset_csv_file))
+        if dataset_size:
+            df = df.iloc[: min(dataset_size, len(df))]
+        self.img_a_names = df.iloc[:, 0].tolist()
+        self.img_b_names = df.iloc[:, 1].tolist()
+        self.set = df.iloc[:, 2].to_numpy()
+        self.flip = df.iloc[:, 3].to_numpy().astype(np.int64)
+        self.image_path = dataset_image_path
+        self.seed = seed
+        self.epoch = 0  # set via set_epoch (DataLoader does this per epoch)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Vary augmentation draws across epochs while staying deterministic;
+        the role the reference's per-worker reseeding played
+        (lib/dataloader.py:39-43)."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.img_a_names)
+
+    def _get_image(self, name: str, flip: int, rng) -> Tuple[np.ndarray, np.ndarray]:
+        image = load_image(os.path.join(self.image_path, name))
+        if self.random_crop:
+            # crop bounds exactly as the reference draws them
+            # (im_pair_dataset.py:68-74)
+            h, w, _ = image.shape
+            top = int(rng.integers(h // 4))
+            bottom = int(3 * h / 4 + rng.integers(h // 4))
+            left = int(rng.integers(w // 4))
+            right = int(3 * w / 4 + rng.integers(w // 4))
+            image = image[top:bottom, left:right]
+        if flip:
+            image = image[:, ::-1]
+        return _preprocess(image, self.out_h, self.out_w, self.normalize)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        # per-(seed, epoch, sample) generator: deterministic under any thread
+        # scheduling (a single shared Generator is not thread-safe)
+        rng = np.random.default_rng([self.seed, self.epoch, idx])
+        flip = self.flip[idx]
+        image_a, size_a = self._get_image(self.img_a_names[idx], flip, rng)
+        image_b, size_b = self._get_image(self.img_b_names[idx], flip, rng)
+        return {
+            "source_image": image_a,
+            "target_image": image_b,
+            "source_im_size": size_a,
+            "target_im_size": size_b,
+            "set": self.set[idx],
+        }
+
+
+def _parse_points(x_str: str, y_str: str) -> np.ndarray:
+    """';'-separated keypoint strings → (2, 20) with −1 padding
+    (pf_dataset.py:104-108)."""
+    def parse(s):
+        if not isinstance(s, str):
+            return np.atleast_1d(np.asarray(s, dtype=np.float64))
+        return np.asarray([float(v) for v in s.split(";") if v.strip()])
+
+    x, y = parse(x_str), parse(y_str)
+    pts = -np.ones((2, MAX_KEYPOINTS), dtype=np.float32)
+    pts[0, : len(x)] = x
+    pts[1, : len(x)] = y
+    return pts
+
+
+class PFPascalDataset:
+    """PF-Pascal keypoint-annotated pairs (pf_dataset.py:26-113).
+
+    CSV columns: source, target, class, XA;YA strings, XB;YB strings.
+    ``pck_procedure``: 'pf' (L_pck = max bbox side of valid A points) or
+    'scnet' (points rescaled to 224×224, L_pck = 224).
+    """
+
+    def __init__(
+        self,
+        csv_file: str,
+        dataset_path: str,
+        output_size: Tuple[int, int] = (240, 240),
+        normalize: bool = True,
+        category: Optional[int] = None,
+        pck_procedure: str = "pf",
+    ):
+        self.out_h, self.out_w = output_size
+        self.normalize = normalize
+        self.pck_procedure = pck_procedure
+        df = pd.read_csv(csv_file)
+        self.category = df.iloc[:, 2].to_numpy().astype(np.float32)
+        if category is not None:
+            keep = np.nonzero(self.category == category)[0]
+            self.category = self.category[keep]
+            df = df.iloc[keep]
+        self.img_a_names = df.iloc[:, 0].tolist()
+        self.img_b_names = df.iloc[:, 1].tolist()
+        self.point_a = df.iloc[:, 3:5]
+        self.point_b = df.iloc[:, 5:7]
+        self.dataset_path = dataset_path
+
+    def __len__(self) -> int:
+        return len(self.img_a_names)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        image_a = load_image(os.path.join(self.dataset_path, self.img_a_names[idx]))
+        image_b = load_image(os.path.join(self.dataset_path, self.img_b_names[idx]))
+        image_a, size_a = _preprocess(image_a, self.out_h, self.out_w, self.normalize)
+        image_b, size_b = _preprocess(image_b, self.out_h, self.out_w, self.normalize)
+
+        pts_a = _parse_points(self.point_a.iloc[idx, 0], self.point_a.iloc[idx, 1])
+        pts_b = _parse_points(self.point_b.iloc[idx, 0], self.point_b.iloc[idx, 1])
+        n_pts = int(np.sum(pts_a[0] != -1))
+
+        if self.pck_procedure == "pf":
+            valid = pts_a[:, :n_pts]
+            l_pck = np.asarray(
+                [np.max(valid.max(axis=1) - valid.min(axis=1))], dtype=np.float32
+            )
+        elif self.pck_procedure == "scnet":
+            # SCNet evaluation: rescale everything to a virtual 224×224 image
+            # (pf_dataset.py:64-75)
+            pts_a[0, :n_pts] *= 224 / size_a[1]
+            pts_a[1, :n_pts] *= 224 / size_a[0]
+            pts_b[0, :n_pts] *= 224 / size_b[1]
+            pts_b[1, :n_pts] *= 224 / size_b[0]
+            size_a = np.asarray([224, 224, 3], dtype=np.float32)
+            size_b = np.asarray([224, 224, 3], dtype=np.float32)
+            l_pck = np.asarray([224.0], dtype=np.float32)
+        else:
+            raise ValueError(f"unknown pck_procedure {self.pck_procedure!r}")
+
+        return {
+            "source_image": image_a,
+            "target_image": image_b,
+            "source_im_size": size_a,
+            "target_im_size": size_b,
+            "source_points": pts_a,
+            "target_points": pts_b,
+            "L_pck": l_pck,
+        }

@@ -1,0 +1,109 @@
+"""Synthetic reference-schema datasets for tests, smoke runs and benchmarks.
+
+The reference repo ships only download scripts for its datasets (PF-Pascal,
+IVD, InLoc — datasets/*/download.sh); nothing can be fetched in a hermetic
+environment.  This module fabricates tiny datasets with the exact CSV schemas
+(/root/reference/datasets/pf-pascal/image_pairs/*.csv) from procedurally
+generated images, with *known ground-truth correspondence*: the target image
+is a shifted crop of the source, so keypoint transfer and match recovery have
+an analytic answer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+from PIL import Image
+
+
+def _textured_image(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
+    """Smooth random texture (low-res noise, bilinearly upsampled) — gives
+    local structure that feature extractors can actually match."""
+    low = rng.uniform(0, 255, (max(h // 8, 2), max(w // 8, 2), 3))
+    img = np.asarray(
+        Image.fromarray(low.astype(np.uint8)).resize((w, h), Image.BILINEAR)
+    )
+    noise = rng.uniform(-12, 12, (h, w, 3))
+    return np.clip(img + noise, 0, 255).astype(np.uint8)
+
+
+def make_shifted_pair(
+    rng: np.random.Generator, h: int, w: int, shift: Tuple[int, int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Source + target where target[y + dy, x + dx] = source[y, x] on the
+    overlap (content moves by (+dy, +dx) source→target); both (h, w, 3)."""
+    dy, dx = shift
+    big = _textured_image(rng, h + abs(dy), w + abs(dx))
+    y0, x0 = max(dy, 0), max(dx, 0)
+    src = big[y0 : y0 + h, x0 : x0 + w]
+    tgt = big[y0 - dy : y0 - dy + h, x0 - dx : x0 - dx + w]
+    return src, tgt
+
+
+def write_pair_dataset(
+    root: str,
+    n_pairs: int = 6,
+    image_hw: Tuple[int, int] = (96, 128),
+    shift: Tuple[int, int] = (16, 16),
+    seed: int = 0,
+    splits: Tuple[str, ...] = ("train", "val"),
+) -> str:
+    """Weak-supervision layout: ``root/images/*.jpg`` +
+    ``root/image_pairs/{split}_pairs.csv`` with the reference's
+    ``source_image,target_image,class,flip`` columns."""
+    rng = np.random.default_rng(seed)
+    h, w = image_hw
+    img_dir = os.path.join(root, "images")
+    csv_dir = os.path.join(root, "image_pairs")
+    os.makedirs(img_dir, exist_ok=True)
+    os.makedirs(csv_dir, exist_ok=True)
+    for split in splits:
+        rows = ["source_image,target_image,class,flip"]
+        for i in range(n_pairs):
+            src, tgt = make_shifted_pair(rng, h, w, shift)
+            a = f"images/{split}_{i}_a.jpg"
+            b = f"images/{split}_{i}_b.jpg"
+            Image.fromarray(src).save(os.path.join(root, a), quality=95)
+            Image.fromarray(tgt).save(os.path.join(root, b), quality=95)
+            rows.append(f"{a},{b},{1 + i % 3},0")
+        with open(os.path.join(csv_dir, f"{split}_pairs.csv"), "w") as f:
+            f.write("\n".join(rows) + "\n")
+    return root
+
+
+def write_pf_pascal_like(
+    root: str,
+    n_pairs: int = 4,
+    image_hw: Tuple[int, int] = (96, 128),
+    shift: Tuple[int, int] = (16, 16),
+    n_points: int = 6,
+    seed: int = 0,
+) -> str:
+    """Keypoint-annotated layout mirroring PF-Pascal's test CSV: columns
+    ``source_image,target_image,class,XA,YA,XB,YB`` with ';'-joined 1-indexed
+    pixel coordinates.  GT: content shifts by (+dy, +dx) source→target, so
+    ``(xB, yB) = (xA + dx, yA + dy)``."""
+    rng = np.random.default_rng(seed)
+    h, w = image_hw
+    dy, dx = shift
+    img_dir = os.path.join(root, "images")
+    os.makedirs(img_dir, exist_ok=True)
+    rows = ["source_image,target_image,class,XA,YA,XB,YB"]
+    margin = 4
+    for i in range(n_pairs):
+        src, tgt = make_shifted_pair(rng, h, w, shift)
+        a, b = f"images/test_{i}_a.jpg", f"images/test_{i}_b.jpg"
+        Image.fromarray(src).save(os.path.join(root, a), quality=95)
+        Image.fromarray(tgt).save(os.path.join(root, b), quality=95)
+        # A-points anywhere whose B twin stays inside the frame (1-indexed)
+        xa = rng.integers(max(-dx, 0) + margin, w - max(dx, 0) - margin, n_points) + 1
+        ya = rng.integers(max(-dy, 0) + margin, h - max(dy, 0) - margin, n_points) + 1
+        xb, yb = xa + dx, ya + dy
+        fmt = lambda v: ";".join(str(float(x)) for x in v)  # noqa: E731
+        rows.append(f"{a},{b},{1 + i % 3},{fmt(xa)},{fmt(ya)},{fmt(xb)},{fmt(yb)}")
+    csv_path = os.path.join(root, "test_pairs.csv")
+    with open(csv_path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return csv_path

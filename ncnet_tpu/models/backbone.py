@@ -32,10 +32,11 @@ from jax import lax
 
 BN_EPS = 1e-5
 
-# torchvision resnet101: blocks per stage; we build conv1..layer3 (stride 16),
-# the deepest cut the reference uses (model.py:38-44; layer4 is never taken).
-RESNET101_STAGES = {"layer1": 3, "layer2": 4, "layer3": 23}
-RESNET101_PLANES = {"layer1": 64, "layer2": 128, "layer3": 256}
+# torchvision resnet101: blocks per stage.  The reference default cut is
+# layer3 / stride 16 (model.py:38-44) but its FeatureExtraction accepts any
+# stage up to layer4, so all four are constructible here.
+RESNET101_STAGES = {"layer1": 3, "layer2": 4, "layer3": 23, "layer4": 3}
+RESNET101_PLANES = {"layer1": 64, "layer2": 128, "layer3": 256, "layer4": 512}
 
 
 def _resnet_stages(last_layer: str):
@@ -81,9 +82,12 @@ def _vgg_units(last_layer: str):
 def _vgg_num_convs(last_layer: str) -> int:
     return sum(1 for u in _vgg_units(last_layer) if u[0] == "conv")
 
-# VGG-16 `features` sequence up to pool4 (torchvision indices 0..23):
-# channel plan per conv layer, '-1' marks a maxpool.
-VGG16_PLAN = (64, 64, -1, 128, 128, -1, 256, 256, 256, -1, 512, 512, 512, -1)
+# VGG-16 `features` sequence through pool5: channel plan per conv layer,
+# '-1' marks a maxpool.  The reference default cut is pool4 (model.py:24-35).
+VGG16_PLAN = (
+    64, 64, -1, 128, 128, -1, 256, 256, 256, -1,
+    512, 512, 512, -1, 512, 512, 512, -1,
+)
 
 OUTPUT_CHANNELS = {"resnet101": 1024, "vgg": 512, "tiny": 32}
 OUTPUT_STRIDE = {"resnet101": 16, "vgg": 16, "tiny": 16}

@@ -46,6 +46,11 @@ class NCNetOutput(NamedTuple):
 
 def init_ncnet(config: ModelConfig, key: jax.Array) -> Dict[str, Any]:
     """Random-init parameters for the full model."""
+    if len(config.ncons_kernel_sizes) != len(config.ncons_channels):
+        raise ValueError(
+            "ncons_kernel_sizes and ncons_channels must have equal length, got "
+            f"{config.ncons_kernel_sizes} vs {config.ncons_channels}"
+        )
     k_bb, k_nc = jax.random.split(key)
     params: Dict[str, Any] = {
         "backbone": bb.backbone_init(

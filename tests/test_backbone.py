@@ -19,6 +19,10 @@ from ncnet_tpu.models import backbone as bb
 
 RNG = np.random.default_rng(0)
 
+# the reference-default trunk cuts: conv1..layer3 / features..pool4
+STAGES_L3 = {k: v for k, v in bb.RESNET101_STAGES.items() if k != "layer4"}
+VGG_PLAN_P4 = bb.VGG16_PLAN[:14]  # through the 4th maxpool
+
 
 def _conv_w(cout, cin, k):
     std = 0.3 / np.sqrt(cin * k * k)
@@ -37,7 +41,7 @@ def make_resnet101_state_dict():
     sd["conv1.weight"] = _conv_w(64, 3, 7)
     _bn_sd(sd, "bn1", 64)
     inplanes = 64
-    for stage, n in bb.RESNET101_STAGES.items():
+    for stage, n in STAGES_L3.items():
         planes = bb.RESNET101_PLANES[stage]
         for i in range(n):
             p = f"{stage}.{i}"
@@ -65,7 +69,7 @@ def torch_resnet101_features(sd, x):
 
     x = F.relu(bn(F.conv2d(x, t["conv1.weight"], stride=2, padding=3), "bn1"))
     x = F.max_pool2d(x, 3, 2, 1)
-    for stage, n in bb.RESNET101_STAGES.items():
+    for stage, n in STAGES_L3.items():
         for i in range(n):
             p = f"{stage}.{i}"
             stride = 2 if (i == 0 and stage != "layer1") else 1
@@ -81,7 +85,7 @@ def torch_resnet101_features(sd, x):
 def make_vgg16_state_dict():
     sd = {}
     cin, idx = 3, 0
-    for cout in bb.VGG16_PLAN:
+    for cout in VGG_PLAN_P4:
         if cout == -1:
             idx += 1
             continue
@@ -95,7 +99,7 @@ def make_vgg16_state_dict():
 def torch_vgg16_features(sd, x):
     t = {k: torch.from_numpy(v) for k, v in sd.items()}
     idx = 0
-    for cout in bb.VGG16_PLAN:
+    for cout in VGG_PLAN_P4:
         if cout == -1:
             x = F.max_pool2d(x, 2, 2)
             idx += 1
@@ -198,3 +202,16 @@ def test_finetune_labels_keep_bn_stats_frozen():
     assert last["bn1"]["var"] == "frozen"
     # untouched blocks fully frozen
     assert set(jax.tree.leaves(labels["layer1"])) == {"frozen"}
+
+
+def test_deep_cuts_layer4_and_pool5():
+    """The reference FeatureExtraction accepts cuts beyond the defaults
+    (resnet layer4, vgg pool5); they must be constructible and shape-correct."""
+    p4 = bb.backbone_init("resnet101", jax.random.key(1), last_layer="layer4")
+    out = bb.backbone_apply("resnet101", p4, jnp.zeros((1, 64, 64, 3)), last_layer="layer4")
+    assert out.shape == (1, 2, 2, 2048)  # stride 32
+
+    pv = bb.backbone_init("vgg", jax.random.key(1), last_layer="pool5")
+    assert len(pv["convs"]) == 13
+    out = bb.backbone_apply("vgg", pv, jnp.zeros((1, 64, 64, 3)), last_layer="pool5")
+    assert out.shape == (1, 2, 2, 512)  # stride 32

@@ -1,0 +1,195 @@
+"""Data pipeline tests: CSV schemas, preprocessing order, loader semantics."""
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.data import (
+    DataLoader,
+    ImagePairDataset,
+    PFPascalDataset,
+    default_collate,
+)
+from ncnet_tpu.data.synthetic import write_pair_dataset, write_pf_pascal_like
+from ncnet_tpu.ops.image import IMAGENET_MEAN, IMAGENET_STD
+
+
+@pytest.fixture(scope="module")
+def pair_root(tmp_path_factory):
+    return write_pair_dataset(str(tmp_path_factory.mktemp("pairs")), n_pairs=5)
+
+
+@pytest.fixture(scope="module")
+def pf_csv(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("pf"))
+    return write_pf_pascal_like(root, n_pairs=3), root
+
+
+def test_image_pair_dataset_sample(pair_root):
+    ds = ImagePairDataset(
+        pair_root + "/image_pairs", "train_pairs.csv", pair_root,
+        output_size=(64, 80),
+    )
+    assert len(ds) == 5
+    s = ds[0]
+    assert s["source_image"].shape == (64, 80, 3)
+    assert s["target_image"].shape == (64, 80, 3)
+    # im_size records the PRE-resize shape (im_pair_dataset.py:81)
+    np.testing.assert_array_equal(s["source_im_size"], [96, 128, 3])
+    # ImageNet normalization applied
+    assert s["source_image"].dtype == np.float32
+    assert -3 < s["source_image"].mean() < 3
+
+
+def test_image_pair_dataset_flip_applies_to_both(pair_root, tmp_path):
+    import pandas as pd
+
+    csv = pair_root + "/image_pairs/train_pairs.csv"
+    df = pd.read_csv(csv)
+    df["flip"] = 1
+    flipped_csv_dir = str(tmp_path)
+    df.to_csv(flipped_csv_dir + "/train_pairs.csv", index=False)
+
+    ds0 = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv", pair_root,
+                           output_size=(96, 128), normalize=False)
+    ds1 = ImagePairDataset(flipped_csv_dir, "train_pairs.csv", pair_root,
+                           output_size=(96, 128), normalize=False)
+    a0, a1 = ds0[0]["source_image"], ds1[0]["source_image"]
+    b0, b1 = ds0[0]["target_image"], ds1[0]["target_image"]
+    np.testing.assert_allclose(a1, a0[:, ::-1], atol=1e-4)
+    np.testing.assert_allclose(b1, b0[:, ::-1], atol=1e-4)
+
+
+def test_pf_pascal_dataset_pf_procedure(pf_csv):
+    csv, root = pf_csv
+    ds = PFPascalDataset(csv, root, output_size=(64, 80), pck_procedure="pf")
+    s = ds[0]
+    pts = s["source_points"]
+    assert pts.shape == (2, 20)
+    n_valid = int((pts[0] != -1).sum())
+    assert n_valid == 6
+    assert (pts[:, n_valid:] == -1).all()
+    valid = pts[:, :n_valid]
+    expected_l = np.max(valid.max(axis=1) - valid.min(axis=1))
+    np.testing.assert_allclose(s["L_pck"], [expected_l])
+    # GT shift: B = A + (dx, dy) with default shift (16, 16)
+    tgt = s["target_points"][:, :n_valid]
+    np.testing.assert_allclose(valid + 16, tgt)
+
+
+def test_pf_pascal_dataset_scnet_procedure(pf_csv):
+    csv, root = pf_csv
+    raw = PFPascalDataset(csv, root, pck_procedure="pf")[1]
+    s = PFPascalDataset(csv, root, pck_procedure="scnet")[1]
+    np.testing.assert_allclose(s["L_pck"], [224.0])
+    np.testing.assert_array_equal(s["source_im_size"], [224, 224, 3])
+    n = int((s["source_points"][0] != -1).sum())
+    # scnet points = raw points rescaled by 224/original size (pf_dataset.py:64-75)
+    np.testing.assert_allclose(
+        s["source_points"][0, :n], raw["source_points"][0, :n] * 224.0 / 128.0,
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        s["source_points"][1, :n], raw["source_points"][1, :n] * 224.0 / 96.0,
+        rtol=1e-5,
+    )
+    assert (s["source_points"][:, n:] == -1).all()
+
+
+def test_loader_batching_and_collate(pair_root):
+    ds = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv", pair_root,
+                          output_size=(32, 32))
+    loader = DataLoader(ds, batch_size=2)
+    batches = list(loader)
+    assert len(loader) == len(batches) == 3
+    assert batches[0]["source_image"].shape == (2, 32, 32, 3)
+    assert batches[-1]["source_image"].shape == (1, 32, 32, 3)
+    loader_dl = DataLoader(ds, batch_size=2, drop_last=True)
+    assert len(list(loader_dl)) == len(loader_dl) == 2
+
+
+def test_loader_shuffle_deterministic_and_epoch_keyed(pair_root):
+    ds = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv", pair_root,
+                          output_size=(16, 16))
+    l1 = DataLoader(ds, batch_size=5, shuffle=True, seed=7)
+    l2 = DataLoader(ds, batch_size=5, shuffle=True, seed=7)
+    b1, b2 = next(iter(l1)), next(iter(l2))
+    np.testing.assert_array_equal(b1["source_image"], b2["source_image"])
+    l2.set_epoch(1)
+    b3 = next(iter(l2))
+    assert not np.array_equal(b1["source_image"], b3["source_image"])
+
+
+def test_loader_sharding_disjoint(pair_root):
+    ds = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv", pair_root,
+                          output_size=(16, 16))
+    idx0 = DataLoader(ds, batch_size=2, num_shards=2, shard_index=0, shuffle=True,
+                      seed=3)._epoch_indices()
+    idx1 = DataLoader(ds, batch_size=2, num_shards=2, shard_index=1, shuffle=True,
+                      seed=3)._epoch_indices()
+    assert len(idx0) == len(idx1) == 2
+    assert set(idx0.tolist()).isdisjoint(idx1.tolist())
+
+
+def test_loader_prefetch_matches_sync(pair_root):
+    ds = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv", pair_root,
+                          output_size=(24, 24))
+    sync = list(DataLoader(ds, batch_size=2, num_workers=0))
+    pre = list(DataLoader(ds, batch_size=2, num_workers=2))
+    assert len(sync) == len(pre)
+    for a, b in zip(sync, pre):
+        np.testing.assert_array_equal(a["source_image"], b["source_image"])
+
+
+def test_loader_propagates_worker_errors():
+    class Boom:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            raise RuntimeError("decode failed")
+
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(DataLoader(Boom(), batch_size=2, num_workers=2))
+
+
+def test_collate_mixed_types():
+    batch = default_collate(
+        [{"a": np.zeros((2, 2)), "s": "x", "n": 1}, {"a": np.ones((2, 2)), "s": "y", "n": 2}]
+    )
+    assert batch["a"].shape == (2, 2, 2)
+    assert batch["s"] == ["x", "y"]
+    np.testing.assert_array_equal(batch["n"], [1, 2])
+
+
+def test_loader_early_break_no_deadlock(pair_root):
+    """Abandoning a prefetching iterator must stop the producer thread."""
+    import threading
+
+    ds = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv", pair_root,
+                          output_size=(16, 16))
+    before = threading.active_count()
+    for _ in range(3):
+        for batch in DataLoader(ds, batch_size=1, num_workers=2, prefetch_batches=1):
+            break  # abandon mid-epoch
+    assert threading.active_count() <= before + 1
+
+
+def test_random_crop_deterministic_across_workers(pair_root):
+    """Per-(seed, epoch, idx) RNG: crops must not depend on thread timing."""
+    def batches(workers):
+        ds = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv",
+                              pair_root, output_size=(32, 32), random_crop=True,
+                              seed=5)
+        return list(DataLoader(ds, batch_size=2, num_workers=workers))
+
+    for a, b in zip(batches(0), batches(3)):
+        np.testing.assert_array_equal(a["source_image"], b["source_image"])
+
+    # and epoch changes the draws
+    ds = ImagePairDataset(pair_root + "/image_pairs", "train_pairs.csv",
+                          pair_root, output_size=(32, 32), random_crop=True, seed=5)
+    l = DataLoader(ds, batch_size=2, shuffle=False, num_workers=0)
+    e0 = next(iter(l))
+    l.set_epoch(1)
+    e1 = next(iter(l))
+    assert not np.array_equal(e0["source_image"], e1["source_image"])

@@ -189,3 +189,9 @@ def test_orbax_load_keeps_runtime_flags(tiny_cfg, tmp_path):
     assert config.ncons_channels == tiny_cfg.ncons_channels
     assert config.relocalization_k_size == 2
     assert config.half_precision is True
+
+
+def test_init_ncnet_rejects_mismatched_config():
+    bad = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3, 3, 3), ncons_channels=(10, 1))
+    with pytest.raises(ValueError, match="equal length"):
+        models.init_ncnet(bad, jax.random.key(0))
