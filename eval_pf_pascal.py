@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Entry shim: PF-Pascal PCK evaluation (see ncnet_tpu/cli/eval_pf_pascal.py)."""
+import sys
+
+from ncnet_tpu.cli.eval_pf_pascal import main
+
+if __name__ == "__main__":
+    sys.exit(main())

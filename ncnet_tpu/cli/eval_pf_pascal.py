@@ -1,0 +1,51 @@
+"""CLI for PF-Pascal PCK evaluation.
+
+Flag names/defaults mirror the reference (/root/reference/eval_pf_pascal.py:
+27-30) so existing command lines keep working; --batch_size is a TPU-native
+extension (the reference hard-codes 1, eval_pf_pascal.py:52-53).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Compute PF Pascal matches")
+    p.add_argument("--checkpoint", type=str, default="")
+    p.add_argument("--image_size", type=int, default=400)
+    p.add_argument("--eval_dataset_path", type=str, default="datasets/pf-pascal/",
+                   help="path to PF Pascal dataset")
+    p.add_argument("--batch_size", type=int, default=1)
+    p.add_argument("--num_workers", type=int, default=0)
+    p.add_argument("--backbone", type=str, default="resnet101",
+                   help="used only when no checkpoint is given")
+    return p
+
+
+def main(argv=None) -> int:
+    print("NCNet evaluation script - PF Pascal dataset")
+    args = build_parser().parse_args(argv)
+    # deferred imports: --help and flag errors shouldn't pay the jax startup
+    from ncnet_tpu.config import EvalPFPascalConfig, ModelConfig
+    from ncnet_tpu.evaluation import run_eval
+
+    config = EvalPFPascalConfig(
+        checkpoint=args.checkpoint,
+        image_size=args.image_size,
+        eval_dataset_path=args.eval_dataset_path,
+    )
+    stats = run_eval(
+        config,
+        model_config=ModelConfig(backbone=args.backbone),
+        batch_size=args.batch_size,
+        num_workers=args.num_workers,
+    )
+    print("Total: " + str(stats["total"]))
+    print("Valid: " + str(stats["valid"]))
+    print("PCK:", "{:.2%}".format(stats["pck"]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

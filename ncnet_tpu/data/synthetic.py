@@ -81,7 +81,8 @@ def write_pf_pascal_like(
     n_points: int = 6,
     seed: int = 0,
 ) -> str:
-    """Keypoint-annotated layout mirroring PF-Pascal's test CSV: columns
+    """Keypoint-annotated layout mirroring PF-Pascal's real on-disk layout
+    (``root/image_pairs/test_pairs.csv`` + ``root/images/``): columns
     ``source_image,target_image,class,XA,YA,XB,YB`` with ';'-joined 1-indexed
     pixel coordinates.  GT: content shifts by (+dy, +dx) source→target, so
     ``(xB, yB) = (xA + dx, yA + dy)``."""
@@ -89,7 +90,9 @@ def write_pf_pascal_like(
     h, w = image_hw
     dy, dx = shift
     img_dir = os.path.join(root, "images")
+    csv_dir = os.path.join(root, "image_pairs")
     os.makedirs(img_dir, exist_ok=True)
+    os.makedirs(csv_dir, exist_ok=True)
     rows = ["source_image,target_image,class,XA,YA,XB,YB"]
     margin = 4
     for i in range(n_pairs):
@@ -103,7 +106,7 @@ def write_pf_pascal_like(
         xb, yb = xa + dx, ya + dy
         fmt = lambda v: ";".join(str(float(x)) for x in v)  # noqa: E731
         rows.append(f"{a},{b},{1 + i % 3},{fmt(xa)},{fmt(ya)},{fmt(xb)},{fmt(yb)}")
-    csv_path = os.path.join(root, "test_pairs.csv")
+    csv_path = os.path.join(csv_dir, "test_pairs.csv")
     with open(csv_path, "w") as f:
         f.write("\n".join(rows) + "\n")
     return csv_path

@@ -1,0 +1,58 @@
+"""PCK (percentage of correct keypoints) metric.
+
+Reference: ``pck`` / ``pck_metric`` (/root/reference/lib/eval_util.py:12-50).
+The reference loops per sample and slices the first N valid keypoints; here
+the whole computation is a masked, batched jnp program (keypoints are padded
+to 20 with −1, padding is a suffix — lib/pf_dataset.py:106-108), so it jits
+and batches freely instead of being locked to batch_size 1.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ncnet_tpu.ops import (
+    Matches,
+    bilinear_interp_point_tnf,
+    points_to_pixel_coords,
+    points_to_unit_coords,
+)
+
+
+def pck(
+    source_points: jnp.ndarray,
+    warped_points: jnp.ndarray,
+    l_pck: jnp.ndarray,
+    alpha: float = 0.1,
+) -> jnp.ndarray:
+    """Per-sample fraction of keypoints within ``alpha * L_pck``.
+
+    Args:
+      source_points: ``(B, 2, N)`` pixel coords, −1-padded (suffix).
+      warped_points: ``(B, 2, N)`` estimated correspondents of the targets.
+      l_pck: ``(B,)`` or ``(B, 1)`` normalization length.
+
+    Returns:
+      ``(B,)`` PCK values (NaN when a sample has zero valid points — the
+      reference produces NaN there too and filters downstream).
+    """
+    valid = (source_points[:, 0, :] != -1) & (source_points[:, 1, :] != -1)
+    dist = jnp.sqrt(jnp.sum((source_points - warped_points) ** 2, axis=1))
+    thresh = jnp.reshape(l_pck, (-1, 1)) * alpha
+    correct = (dist <= thresh) & valid
+    return jnp.sum(correct, axis=1) / jnp.sum(valid, axis=1)
+
+
+def pck_metric(batch: Dict[str, jnp.ndarray], matches: Matches, alpha: float = 0.1):
+    """Warp target keypoints through the match field and score PCK against the
+    source keypoints (eval_util.py:27-50).
+
+    ``batch`` needs: source/target_points ``(B, 2, N)``, source/target_im_size
+    ``(B, 3)`` as (h, w, c), L_pck ``(B, 1)``.
+    """
+    target_norm = points_to_unit_coords(batch["target_points"], batch["target_im_size"])
+    warped_norm = bilinear_interp_point_tnf(matches, target_norm)
+    warped = points_to_pixel_coords(warped_norm, batch["source_im_size"])
+    return pck(batch["source_points"], warped, batch["L_pck"], alpha)

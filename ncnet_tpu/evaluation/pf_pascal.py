@@ -1,0 +1,84 @@
+"""PF-Pascal PCK evaluation (the reference's eval_pf_pascal.py as a library).
+
+One jitted step per batch: forward → softmax match extraction → keypoint warp
+→ PCK.  Unlike the reference ("Only batch_size=1 is supported",
+eval_pf_pascal.py:52-53) any batch size works — all PF-Pascal eval images are
+resized to the same square, so shapes are static.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ncnet_tpu.config import EvalPFPascalConfig, ModelConfig
+from ncnet_tpu.data import DataLoader, PFPascalDataset
+from ncnet_tpu.evaluation.pck import pck_metric
+from ncnet_tpu.models import NCNet
+from ncnet_tpu.ops import corr_to_matches
+
+
+def make_eval_step(net: NCNet, alpha: float):
+    """Jitted (params, images..., points...) → per-sample PCK."""
+
+    def step(params, batch):
+        out = net.forward_fn(params, batch["source_image"], batch["target_image"])
+        matches = corr_to_matches(out.corr, do_softmax=True)
+        return pck_metric(batch, matches, alpha)
+
+    return jax.jit(step)
+
+
+def run_eval(
+    config: EvalPFPascalConfig,
+    model_config: Optional[ModelConfig] = None,
+    net: Optional[NCNet] = None,
+    batch_size: int = 1,
+    num_workers: int = 0,
+    progress: bool = True,
+) -> Dict[str, float]:
+    """Evaluate PCK@alpha on the PF-Pascal test split.
+
+    Returns ``{"pck": mean over valid pairs, "total": N, "valid": N_valid}``
+    — the same three numbers the reference prints (eval_pf_pascal.py:84-89).
+    """
+    if net is None:
+        mc = (model_config or ModelConfig()).replace(checkpoint=config.checkpoint)
+        net = NCNet(mc)
+
+    dataset = PFPascalDataset(
+        csv_file=f"{config.eval_dataset_path.rstrip('/')}/image_pairs/test_pairs.csv",
+        dataset_path=config.eval_dataset_path,
+        output_size=(config.image_size, config.image_size),
+        pck_procedure=config.pck_procedure,
+    )
+    loader = DataLoader(dataset, batch_size=batch_size, shuffle=False,
+                        num_workers=num_workers)
+    step = make_eval_step(net, config.pck_alpha)
+
+    results = []
+    n_batches = len(loader)
+    for i, batch in enumerate(loader):
+        jb = {
+            k: jnp.asarray(v)
+            for k, v in batch.items()
+            if k in ("source_image", "target_image", "source_points",
+                     "target_points", "source_im_size", "target_im_size", "L_pck")
+        }
+        results.append(np.asarray(step(net.params, jb)))
+        if progress:
+            print(f"Batch: [{i}/{n_batches} ({100.0 * i / n_batches:.0f}%)]")
+
+    results = np.concatenate(results)
+    # NaN = zero valid keypoints (the reference also had a -1 sentinel in its
+    # preallocated stats array; pck() here never produces one)
+    good = np.flatnonzero(~np.isnan(results))
+    return {
+        "pck": float(np.mean(results[good])) if good.size else float("nan"),
+        "total": int(results.size),
+        "valid": int(good.size),
+        "per_pair": results,
+    }

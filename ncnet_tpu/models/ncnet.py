@@ -179,3 +179,8 @@ class NCNet:
 
     def __call__(self, source_images, target_images) -> NCNetOutput:
         return self._jitted(self.params, source_images, target_images)
+
+    def forward_fn(self, params, source_images, target_images) -> NCNetOutput:
+        """Unjitted functional forward with explicit params — compose this
+        inside larger jitted programs (eval steps, train steps)."""
+        return ncnet_forward(self.config, params, source_images, target_images)

@@ -10,6 +10,7 @@ therefore trivially jittable and shardable.  Reference semantics being matched:
 
 from __future__ import annotations
 
+import math
 from typing import NamedTuple
 
 import jax
@@ -166,7 +167,8 @@ def bilinear_interp_point_tnf(matches: Matches, target_points_norm: jnp.ndarray)
       ``(B, 2, N)`` warped points.
     """
     b, _, n = target_points_norm.shape
-    fs = int(round(float(jnp.sqrt(matches.xB.shape[-1]))))
+    # static shape math (math.sqrt, not jnp: must stay concrete under jit)
+    fs = int(round(math.sqrt(matches.xB.shape[-1])))
     grid = jnp.linspace(-1.0, 1.0, fs)
 
     def lower_index(coords):  # (B, N) → index of grid node strictly below
