@@ -1,0 +1,72 @@
+"""CLI for weak-supervision training.
+
+Flag names/defaults mirror the reference (/root/reference/train.py:34-47);
+--backbone/--num_workers/--seed are TPU-native extensions.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Compute PF Pascal matches")
+    p.add_argument("--checkpoint", type=str, default="")
+    p.add_argument("--image_size", type=int, default=400)
+    p.add_argument("--dataset_image_path", type=str, default="datasets/pf-pascal/",
+                   help="path to PF Pascal dataset")
+    p.add_argument("--dataset_csv_path", type=str,
+                   default="datasets/pf-pascal/image_pairs/",
+                   help="path to PF Pascal training csv")
+    p.add_argument("--num_epochs", type=int, default=5)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=0.0005)
+    p.add_argument("--ncons_kernel_sizes", nargs="+", type=int, default=[5, 5, 5],
+                   help="kernels sizes in neigh. cons.")
+    p.add_argument("--ncons_channels", nargs="+", type=int, default=[16, 16, 1],
+                   help="channels in neigh. cons")
+    p.add_argument("--result_model_fn", type=str, default="checkpoint_adam")
+    p.add_argument("--result-model-dir", dest="result_model_dir", type=str,
+                   default="trained_models")
+    p.add_argument("--fe_finetune_params", type=int, default=0,
+                   help="number of backbone blocks to finetune")
+    p.add_argument("--backbone", type=str, default="resnet101")
+    p.add_argument("--num_workers", type=int, default=0)
+    p.add_argument("--seed", type=int, default=1)
+    return p
+
+
+def main(argv=None) -> int:
+    print("ImMatchNet training script")
+    args = build_parser().parse_args(argv)
+    print(args)
+
+    from ncnet_tpu.config import ModelConfig, TrainConfig
+    from ncnet_tpu.training import fit
+
+    config = TrainConfig(
+        model=ModelConfig(
+            backbone=args.backbone,
+            ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
+            ncons_channels=tuple(args.ncons_channels),
+            checkpoint=args.checkpoint,
+        ),
+        image_size=args.image_size,
+        dataset_image_path=args.dataset_image_path,
+        dataset_csv_path=args.dataset_csv_path,
+        num_epochs=args.num_epochs,
+        batch_size=args.batch_size,
+        lr=args.lr,
+        result_model_fn=args.result_model_fn,
+        result_model_dir=args.result_model_dir,
+        fe_finetune_params=args.fe_finetune_params,
+        seed=args.seed,
+        num_workers=args.num_workers,
+    )
+    fit(config)
+    print("Done!")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,23 @@
+"""Distributed execution: device mesh, data-parallel and spatially-sharded paths."""
+
+from ncnet_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SPATIAL_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+    volume_sharding,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "SPATIAL_AXIS",
+    "batch_sharding",
+    "make_mesh",
+    "replicate",
+    "replicated",
+    "shard_batch",
+    "volume_sharding",
+]

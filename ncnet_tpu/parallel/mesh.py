@@ -1,0 +1,74 @@
+"""Device mesh construction + sharding helpers.
+
+The reference has no distributed code at all (SURVEY §2.4); this module is the
+TPU-native foundation: one global mesh with two logical axes —
+
+  * ``data``    — image pairs (data parallelism; gradients psum here)
+  * ``spatial`` — the (hB, wB) dims of the 4D correlation volume
+                  (sequence-parallel analog for high-res matching)
+
+Built on ``jax.sharding.Mesh`` + ``NamedSharding``; jit consumes these
+directly and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    spatial: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh over the available devices.
+
+    ``data=None`` uses every device not consumed by ``spatial``.  The mesh is
+    laid out so ``spatial`` is the minor (fastest-varying) axis: spatial
+    shards of one pair-group sit on adjacent devices, keeping the halo/max
+    collectives of the sharded volume on the shortest ICI paths.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devices) % spatial:
+            raise ValueError(f"{len(devices)} devices not divisible by spatial={spatial}")
+        data = len(devices) // spatial
+    n = data * spatial
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(data, spatial)
+    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (pair) axis over 'data'; everything else replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def volume_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard a ``(B, hA, wA, hB, wB)`` correlation volume: pairs over 'data',
+    hB over 'spatial' (the ring-attention-style layout, SURVEY §5.7)."""
+    return NamedSharding(mesh, P(DATA_AXIS, None, None, SPATIAL_AXIS, None))
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Device-put a host batch (dict of arrays) with the pair axis sharded."""
+    s = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, s), batch)
+
+
+def replicate(mesh: Mesh, tree):
+    """Device-put a pytree fully replicated over the mesh."""
+    s = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, s), tree)

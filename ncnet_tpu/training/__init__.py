@@ -1,0 +1,30 @@
+"""Training: weak-supervision loss, jitted steps, epoch loop, checkpoints."""
+
+from ncnet_tpu.training.loss import match_score, weak_loss
+from ncnet_tpu.training.train import (
+    TrainState,
+    create_train_state,
+    fit,
+    load_train_checkpoint,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    process_epoch,
+    save_train_checkpoint,
+    trainable_labels,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "fit",
+    "load_train_checkpoint",
+    "make_eval_step",
+    "make_optimizer",
+    "make_train_step",
+    "match_score",
+    "process_epoch",
+    "save_train_checkpoint",
+    "trainable_labels",
+    "weak_loss",
+]

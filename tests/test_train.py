@@ -1,0 +1,221 @@
+"""Training tests: weak-loss oracle, feature-roll equivalence, convergence on
+synthetic data, full-state checkpoint resume, CLI smoke."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.config import ModelConfig, TrainConfig
+from ncnet_tpu.data.synthetic import write_pair_dataset
+from ncnet_tpu import models, training
+from ncnet_tpu.models.ncnet import ncnet_forward
+
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+
+def _np_match_score(corr, normalization="softmax"):
+    """Oracle for match_score per reference train.py:125-134."""
+    b, ha, wa, hb, wb = corr.shape
+
+    def norm(x, axis):
+        if normalization == "softmax":
+            e = np.exp(x - x.max(axis=axis, keepdims=True))
+            return e / e.sum(axis=axis, keepdims=True)
+        if normalization == "l1":
+            return x / (x.sum(axis=axis, keepdims=True) + 1e-4)
+        return x
+
+    nc_b = norm(corr.reshape(b, ha * wa, hb, wb), 1)
+    nc_a = norm(corr.reshape(b, ha, wa, hb * wb), 3)
+    return (nc_a.max(axis=3) + nc_b.max(axis=1)).mean() / 2.0
+
+
+@pytest.mark.parametrize("normalization", ["softmax", "l1", "none"])
+def test_match_score_oracle(rng, normalization):
+    corr = rng.standard_normal((2, 3, 3, 3, 3)).astype(np.float32)
+    if normalization == "l1":
+        corr = np.abs(corr)  # reference l1 path assumes non-negative volumes
+    got = float(training.match_score(jnp.asarray(corr), normalization))
+    want = _np_match_score(corr, normalization)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_weak_loss_feature_roll_equals_image_roll(rng):
+    """Our negative (roll features) must equal the reference's negative
+    (roll source images then re-extract): feature extraction is per-image."""
+    params = models.init_ncnet(TINY, jax.random.key(0))
+    src = jnp.asarray(rng.uniform(0, 1, (3, 48, 48, 3)).astype(np.float32))
+    tgt = jnp.asarray(rng.uniform(0, 1, (3, 48, 48, 3)).astype(np.float32))
+
+    loss = training.weak_loss(TINY, params, {"source_image": src, "target_image": tgt})
+
+    # reference-style: full forward on the rolled image batch
+    rolled = jnp.roll(src, -1, axis=0)
+    pos = ncnet_forward(TINY, params, src, tgt).corr
+    neg = ncnet_forward(TINY, params, rolled, tgt).corr
+    want = training.match_score(neg) - training.match_score(pos)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-5, atol=1e-6)
+
+
+def test_train_step_reduces_loss_on_fixed_batch(rng):
+    """A few Adam steps on one batch must reduce the weak loss (the negative
+    is a different pair, so the model can discriminate)."""
+    cfg = TrainConfig(model=TINY, lr=1e-3, batch_size=4)
+    state, optimizer, mc, _ = training.create_train_state(cfg)
+    step = training.make_train_step(mc, optimizer, donate=False)
+    batch = {
+        "source_image": jnp.asarray(rng.uniform(0, 1, (4, 48, 48, 3)).astype(np.float32)),
+        "target_image": jnp.asarray(rng.uniform(0, 1, (4, 48, 48, 3)).astype(np.float32)),
+    }
+    losses = []
+    for _ in range(12):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 12
+
+
+def test_frozen_backbone_unchanged_nc_changes(rng):
+    cfg = TrainConfig(model=TINY, lr=1e-3)
+    state, optimizer, mc, _ = training.create_train_state(cfg)
+    step = training.make_train_step(mc, optimizer, donate=False)
+    batch = {
+        "source_image": jnp.asarray(rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+        "target_image": jnp.asarray(rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+    }
+    bb_before = jax.tree.map(lambda x: np.asarray(x), state.params["backbone"])
+    nc_before = np.asarray(state.params["nc"][0]["w"])
+    state, _ = step(state, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        bb_before, state.params["backbone"],
+    )
+    assert not np.array_equal(nc_before, np.asarray(state.params["nc"][0]["w"]))
+
+
+def test_finetune_updates_last_backbone_block(rng):
+    cfg = TrainConfig(model=TINY, lr=1e-3, fe_finetune_params=1)
+    state, optimizer, mc, _ = training.create_train_state(cfg)
+    step = training.make_train_step(mc, optimizer, donate=False)
+    batch = {
+        "source_image": jnp.asarray(rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+        "target_image": jnp.asarray(rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+    }
+    before = np.asarray(state.params["backbone"]["conv2"]["w"])
+    state, _ = step(state, batch)
+    assert not np.array_equal(before, np.asarray(state.params["backbone"]["conv2"]["w"]))
+
+
+def test_fit_and_resume(tmp_path, capsys):
+    """fit() runs the reference flow end-to-end on synthetic data; the saved
+    checkpoint restores params + optimizer + step exactly."""
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=4, image_hw=(48, 48), shift=(16, 16), seed=1)
+    cfg = TrainConfig(
+        model=TINY,
+        image_size=48,
+        dataset_image_path=root,
+        dataset_csv_path=root + "/image_pairs",
+        num_epochs=2,
+        batch_size=2,
+        lr=1e-3,
+        result_model_dir=str(tmp_path / "ckpts"),
+        log_interval=10,
+    )
+    result = training.fit(cfg)
+    assert result["train_loss"].shape == (2,)
+    assert np.isfinite(result["train_loss"]).all()
+
+    # resume: fresh state restored from disk equals in-memory final state
+    state2, optimizer, mc, _ = training.create_train_state(cfg)
+    restored, epoch, tr, te = training.load_train_checkpoint(
+        result["checkpoint"], state2
+    )
+    assert epoch == 2
+    np.testing.assert_allclose(tr, result["train_loss"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored.params, result["state"].params,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored.opt_state, result["state"].opt_state,
+    )
+    assert int(restored.step) == int(result["state"].step)
+    # best_ copy exists (epoch-2 val loss improved or not; dir must exist
+    # after at least the first epoch which always improves from +inf)
+    import os
+
+    assert any(d.startswith("best_") for d in os.listdir(tmp_path / "ckpts"))
+
+
+def test_train_cli_smoke(tmp_path, capsys):
+    from ncnet_tpu.cli.train import main
+
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=2, image_hw=(48, 48), shift=(16, 16), seed=2)
+    rc = main([
+        "--dataset_image_path", root,
+        "--dataset_csv_path", root + "/image_pairs",
+        "--image_size", "48", "--num_epochs", "1", "--batch_size", "2",
+        "--backbone", "tiny", "--ncons_kernel_sizes", "3",
+        "--ncons_channels", "1",
+        "--result-model-dir", str(tmp_path / "ckpts"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Train set: Average loss" in out and "Done!" in out
+
+
+def test_data_parallel_matches_single_device(tmp_path):
+    """fit() on the 8-virtual-device CPU mesh (data-parallel path) must match
+    the single-device run batch for batch."""
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=8, image_hw=(48, 48), shift=(16, 16), seed=3)
+
+    def run(dp, out):
+        cfg = TrainConfig(
+            model=TINY, image_size=48,
+            dataset_image_path=root, dataset_csv_path=root + "/image_pairs",
+            num_epochs=1, batch_size=8, lr=1e-3,
+            result_model_dir=str(tmp_path / out), log_interval=10,
+            data_parallel=dp,
+        )
+        return training.fit(cfg, progress=False)
+
+    r_dp = run(True, "dp")
+    r_sd = run(False, "sd")
+    np.testing.assert_allclose(r_dp["train_loss"], r_sd["train_loss"], rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        r_dp["state"].params["nc"], r_sd["state"].params["nc"],
+    )
+
+
+def test_train_checkpoint_loadable_by_eval(tmp_path):
+    """The reference workflow train -> eval --checkpoint must work: a fit()
+    checkpoint is readable by models.load_params (arch from checkpoint,
+    runtime flags from caller)."""
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=2, image_hw=(48, 48), shift=(16, 16), seed=4)
+    cfg = TrainConfig(
+        model=TINY, image_size=48,
+        dataset_image_path=root, dataset_csv_path=root + "/image_pairs",
+        num_epochs=1, batch_size=2, lr=1e-3,
+        result_model_dir=str(tmp_path / "ckpts"), log_interval=10,
+    )
+    result = training.fit(cfg, progress=False)
+    mc, params = models.load_params(result["checkpoint"])
+    assert mc.backbone == "tiny" and mc.ncons_kernel_sizes == (3,)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, result["state"].params,
+    )
+    # and NCNet(checkpoint=...) boots straight from it
+    net = models.NCNet(mc.replace(checkpoint=result["checkpoint"]))
+    out = net(jnp.zeros((1, 48, 48, 3)), jnp.zeros((1, 48, 48, 3)))
+    assert out.corr.shape == (1, 3, 3, 3, 3)

@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Entry shim: weak-supervision training (see ncnet_tpu/cli/train.py)."""
+import sys
+
+from ncnet_tpu.cli.train import main
+
+if __name__ == "__main__":
+    sys.exit(main())
