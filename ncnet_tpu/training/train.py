@@ -9,8 +9,10 @@ optionally the last backbone blocks).
 Improvements over the reference, by design:
   * the train step is one jitted program (loss + grads + Adam update) with
     donated state — no Python in the hot loop;
-  * optimizer state IS restored on resume (the reference saves but never
-    loads it, train.py:71);
+  * resume is real: ``fit`` pointed at one of its own checkpoints restores
+    params AND optimizer state AND the epoch counter (the reference saves the
+    optimizer but never loads it and always restarts at epoch 1,
+    train.py:71,190);
   * frozen parameters are handled by ``optax.multi_transform`` with
     ``set_to_zero``, so the update pytree structure is stable and shardable.
 """
@@ -56,8 +58,11 @@ def trainable_labels(config: ModelConfig, params, fe_finetune_params: int = 0):
     }
 
 
-def make_optimizer(labels) -> optax.GradientTransformation:
-    def tx(lr):
+def make_optimizer(labels):
+    """Returns an ``lr → GradientTransformation`` factory bound to the
+    trainable/frozen label tree."""
+
+    def tx(lr: float) -> optax.GradientTransformation:
         return optax.multi_transform(
             {"trainable": optax.adam(lr), "frozen": optax.set_to_zero()}, labels
         )
@@ -67,8 +72,10 @@ def make_optimizer(labels) -> optax.GradientTransformation:
 
 def create_train_state(
     config: TrainConfig, key: Optional[jax.Array] = None
-) -> Tuple[TrainState, optax.GradientTransformation, ModelConfig]:
-    """Init (or load from ``config.model.checkpoint``) params + fresh Adam."""
+) -> Tuple[TrainState, optax.GradientTransformation, ModelConfig, Any]:
+    """Init (or load from ``config.model.checkpoint``) params + fresh Adam.
+
+    Returns ``(state, optimizer, model_config, labels)``."""
     model_config = config.model
     if model_config.checkpoint:
         model_config, params = ckpt_io.load_params(
@@ -237,6 +244,16 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
     on val_pairs.csv each epoch, checkpoint every epoch + best copy."""
     state, optimizer, model_config, labels = create_train_state(config)
 
+    # resume: a checkpoint directory written by fit() carries opt/ — restore
+    # the full train state and continue from the saved epoch
+    start_epoch = 0
+    prev_train = prev_test = None
+    ckpt = config.model.checkpoint
+    if ckpt and os.path.isdir(os.path.join(ckpt, "opt")):
+        state, start_epoch, prev_train, prev_test = load_train_checkpoint(ckpt, state)
+        if progress:
+            print(f"Resumed full train state from {ckpt} at epoch {start_epoch}")
+
     n_trainable = sum(
         int(np.prod(np.asarray(x.shape)))
         for x, lbl in zip(jax.tree.leaves(state.params), jax.tree.leaves(labels))
@@ -249,18 +266,19 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
     # params; jit + shardings make XLA psum the grads and route the
     # negative-roll permute over ICI (loss.py docstring)
     put_batch = None
-    n_dev = math.gcd(len(jax.devices()), config.batch_size)
+    # largest device count that evenly divides the batch (all devices when
+    # batch_size % len(devices) == 0, e.g. the reference's 16 on 8 chips)
+    n_dev = max(
+        d for d in range(1, min(len(jax.devices()), config.batch_size) + 1)
+        if config.batch_size % d == 0
+    )
     if config.data_parallel and n_dev > 1:
         from ncnet_tpu import parallel
 
-        # largest device count that divides the batch (all devices when
-        # batch_size % len(devices) == 0, e.g. the reference's 16 on 8 chips)
         mesh = parallel.make_mesh(data=n_dev, devices=jax.devices()[:n_dev])
-        state = TrainState(
-            parallel.replicate(mesh, state.params),
-            parallel.replicate(mesh, state.opt_state),
-            state.step,
-        )
+        # replicate the WHOLE state (step included): restored checkpoints are
+        # committed to device 0 and would otherwise conflict with the mesh
+        state = TrainState(*parallel.replicate(mesh, tuple(state)))
         sharding = parallel.batch_sharding(mesh)
         put_batch = lambda x: jax.device_put(jnp.asarray(x), sharding)  # noqa: E731
         if progress:
@@ -278,12 +296,15 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         batch_size=config.batch_size, shuffle=True,
         num_workers=config.num_workers, seed=config.seed, drop_last=True,
     )
+    # val: no shuffle — drop_last is needed for static jit shapes, and with a
+    # shuffle each epoch would drop a DIFFERENT random subset, making the
+    # best-checkpoint metric noisy (the reference shuffles but drops nothing)
     val_loader = DataLoader(
         ImagePairDataset(
             config.dataset_csv_path, "val_pairs.csv", config.dataset_image_path,
             output_size=size, seed=config.seed,
         ),
-        batch_size=config.batch_size, shuffle=True,
+        batch_size=config.batch_size, shuffle=False,
         num_workers=config.eval_num_workers, seed=config.seed, drop_last=True,
     )
 
@@ -297,7 +318,13 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
     train_loss = np.zeros(config.num_epochs)
     test_loss = np.zeros(config.num_epochs)
     best = float("inf")
-    for epoch in range(1, config.num_epochs + 1):
+    if prev_train is not None and start_epoch > 0:
+        n_keep = min(start_epoch, config.num_epochs)
+        train_loss[:n_keep] = prev_train[:n_keep]
+        test_loss[:n_keep] = prev_test[:n_keep]
+        if n_keep:
+            best = float(np.min(prev_test[:n_keep]))
+    for epoch in range(start_epoch + 1, config.num_epochs + 1):
         train_loader.set_epoch(epoch)
         val_loader.set_epoch(epoch)
         state, train_loss[epoch - 1] = process_epoch(

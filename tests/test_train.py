@@ -219,3 +219,25 @@ def test_train_checkpoint_loadable_by_eval(tmp_path):
     net = models.NCNet(mc.replace(checkpoint=result["checkpoint"]))
     out = net(jnp.zeros((1, 48, 48, 3)), jnp.zeros((1, 48, 48, 3)))
     assert out.corr.shape == (1, 3, 3, 3, 3)
+
+
+def test_fit_resume_continues_from_saved_epoch(tmp_path, capsys):
+    """fit() on its own checkpoint restores optimizer+epoch and continues."""
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=2, image_hw=(48, 48), shift=(16, 16), seed=5)
+    base = dict(
+        image_size=48, dataset_image_path=root,
+        dataset_csv_path=root + "/image_pairs", batch_size=2, lr=1e-3,
+        result_model_dir=str(tmp_path / "ckpts"), log_interval=10,
+    )
+    r1 = training.fit(TrainConfig(model=TINY, num_epochs=1, **base), progress=False)
+
+    cfg2 = TrainConfig(
+        model=TINY.replace(checkpoint=r1["checkpoint"]), num_epochs=2, **base
+    )
+    r2 = training.fit(cfg2, progress=True)
+    out = capsys.readouterr().out
+    assert "Resumed full train state" in out
+    assert "Epoch: 1 [" not in out.split("Resumed")[1]  # epoch 1 not re-run
+    np.testing.assert_allclose(r2["train_loss"][0], r1["train_loss"][0])
+    assert int(r2["state"].step) == 2  # 1 batch/epoch: one old + one new step
