@@ -28,34 +28,47 @@ def conv4d(
     bias: jnp.ndarray | None = None,
     *,
     precision=None,
+    pad_ha: bool = True,
+    pad_hb: bool = True,
 ) -> jnp.ndarray:
-    """4D "same" convolution over the correlation volume.
+    """4D convolution over the correlation volume ("same" by default).
 
     Args:
       x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume.
       weight: ``(kA, kWA, kB, kWB, C_in, C_out)``.
       bias:   ``(C_out,)`` or None.
+      pad_ha / pad_hb: when False, the hA / hB dim is treated as *valid* —
+        the caller already padded it (the spatially-sharded path pre-pads
+        with halo slabs exchanged between shards, parallel/spatial.py) and
+        the output is ``k//2`` smaller on each side of that dim.
 
     Returns:
-      ``(B, hA, wA, hB, wB, C_out)``.
+      ``(B, hA', wA, hB', wB, C_out)`` (primed dims shrink iff unpadded).
     """
     b, ha, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, wc_in, c_out = weight.shape
     assert wc_in == c_in, f"channel mismatch: {wc_in} vs {c_in}"
 
-    pad_a = ka // 2
-    # Zero-pad the leading spatial dim once; the other three dims are padded
-    # inside the 3D conv below.
-    xp = jnp.pad(x, ((0, 0), (pad_a, pad_a), (0, 0), (0, 0), (0, 0), (0, 0)))
+    if pad_ha:
+        # Zero-pad the leading spatial dim once; the other three dims are
+        # padded inside the 3D conv below.
+        x = jnp.pad(x, ((0, 0), (ka // 2, ka // 2), (0, 0), (0, 0), (0, 0), (0, 0)))
+    xp = x
+    ha = xp.shape[1] - (ka - 1)  # output length of the tap loop
 
-    pads3 = [(kwa // 2, kwa // 2), (kb // 2, kb // 2), (kwb // 2, kwb // 2)]
+    pads3 = [
+        (kwa // 2, kwa // 2),
+        (kb // 2, kb // 2) if pad_hb else (0, 0),
+        (kwb // 2, kwb // 2),
+    ]
+    hb_out = hb if pad_hb else hb - (kb - 1)
     dn = lax.conv_dimension_numbers(
         (b * ha, wa, hb, wb, c_in), (kwa, kb, kwb, c_in, c_out), ("NDHWC", "DHWIO", "NDHWC")
     )
 
     out = None
     for p in range(ka):  # static unroll: ka ≤ 5, traced once under jit
-        # shifted slice s.t. out[i] = Σ_p x[i + p - pad_a] * w[p]  (the same
+        # shifted slice s.t. out[i] = Σ_p x[i + p - k//2] * w[p]  (the same
         # tap alignment as the reference loop, conv4d.py:39-48)
         sl = lax.slice_in_dim(xp, p, p + ha, axis=1)
         o = lax.conv_general_dilated(
@@ -67,7 +80,7 @@ def conv4d(
             precision=precision,
         )
         out = o if out is None else out + o
-    out = out.reshape(b, ha, wa, hb, wb, c_out)
+    out = out.reshape(b, ha, wa, hb_out, wb, c_out)
     if bias is not None:
         out = out + bias
     return out
