@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""Entry shim: InLoc dense-matching evaluation (see ncnet_tpu/cli/eval_inloc.py)."""
+import sys
+
+from ncnet_tpu.cli.eval_inloc import main
+
+if __name__ == "__main__":
+    sys.exit(main())

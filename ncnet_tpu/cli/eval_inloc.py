@@ -1,0 +1,75 @@
+"""CLI for InLoc dense-matching evaluation.
+
+Flag names/defaults mirror the reference (/root/reference/eval_inloc.py:29-40)
+so existing command lines keep working; --output_root and --spatial_shards are
+TPU-native extensions.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def _str_to_bool(v: str) -> bool:
+    # reference lib/torch_util.py:64-70 semantics
+    if v.lower() in ("yes", "true", "t", "y", "1"):
+        return True
+    if v.lower() in ("no", "false", "f", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError("Boolean value expected.")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Compute InLoc matches")
+    p.add_argument("--checkpoint", type=str, default="")
+    p.add_argument("--inloc_shortlist", type=str,
+                   default="datasets/inloc/densePE_top100_shortlist_cvpr18.mat")
+    p.add_argument("--k_size", type=int, default=2)
+    p.add_argument("--image_size", type=int, default=3200)
+    p.add_argument("--n_queries", type=int, default=356)
+    p.add_argument("--n_panos", type=int, default=10)
+    p.add_argument("--softmax", type=_str_to_bool, default=True)
+    p.add_argument("--matching_both_directions", type=_str_to_bool, default=True)
+    p.add_argument("--flip_matching_direction", type=_str_to_bool, default=False)
+    p.add_argument("--pano_path", type=str, default="datasets/inloc/pano/",
+                   help="path to InLoc panos - should contain CSE3,CSE4,CSE5,"
+                        "DUC1 and DUC2 folders")
+    p.add_argument("--query_path", type=str, default="datasets/inloc/query/iphone7/",
+                   help="path to InLoc queries")
+    p.add_argument("--output_root", type=str, default="matches")
+    p.add_argument("--spatial_shards", type=int, default=1,
+                   help="shard the 4D volume over this many devices")
+    return p
+
+
+def main(argv=None) -> int:
+    print("NCNet evaluation script - InLoc dataset")
+    args = build_parser().parse_args(argv)
+    # deferred imports: --help and flag errors shouldn't pay the jax startup
+    from ncnet_tpu.config import EvalInLocConfig
+    from ncnet_tpu.evaluation.inloc import output_folder_name, run_inloc_eval
+
+    config = EvalInLocConfig(
+        checkpoint=args.checkpoint,
+        inloc_shortlist=args.inloc_shortlist,
+        k_size=args.k_size,
+        image_size=args.image_size,
+        n_queries=args.n_queries,
+        n_panos=args.n_panos,
+        softmax=args.softmax,
+        matching_both_directions=args.matching_both_directions,
+        flip_matching_direction=args.flip_matching_direction,
+        pano_path=args.pano_path,
+        query_path=args.query_path,
+        output_root=args.output_root,
+        spatial_shards=args.spatial_shards,
+    )
+    print(args)
+    print("Output matches folder: " + output_folder_name(config))
+    out_dir = run_inloc_eval(config)
+    print("Wrote matches to " + out_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

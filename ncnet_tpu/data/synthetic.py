@@ -110,3 +110,48 @@ def write_pf_pascal_like(
     with open(csv_path, "w") as f:
         f.write("\n".join(rows) + "\n")
     return csv_path
+
+
+def write_inloc_like(
+    root: str,
+    n_queries: int = 2,
+    n_panos: int = 3,
+    image_hw: Tuple[int, int] = (96, 128),
+    seed: int = 0,
+) -> str:
+    """InLoc-shaped layout: ``root/query/iphone7/*.jpg``, ``root/pano/*.jpg``
+    and a densePE-style shortlist .mat whose ``ImgList`` struct array indexes
+    per-query pano shortlists the way the reference reads it
+    (/root/reference/eval_inloc.py:97-101: ``db[q][0].item()`` = query name,
+    ``db[q][1].ravel()[idx].item()`` = pano name).
+
+    Pano 0 of each query IS the query image (re-encoded), so a correct
+    matcher scores near-identity matches on it.  Returns the shortlist path.
+    """
+    from scipy.io import savemat
+
+    rng = np.random.default_rng(seed)
+    h, w = image_hw
+    qdir = os.path.join(root, "query", "iphone7")
+    pdir = os.path.join(root, "pano")
+    os.makedirs(qdir, exist_ok=True)
+    os.makedirs(pdir, exist_ok=True)
+
+    entries = np.zeros(
+        (1, n_queries),
+        dtype=np.dtype([("queryname", object), ("topNname", object)]),
+    )
+    for q in range(n_queries):
+        qimg = _textured_image(rng, h, w)
+        qfn = f"query_{q}.jpg"
+        Image.fromarray(qimg).save(os.path.join(qdir, qfn), quality=95)
+        panos = []
+        for p in range(n_panos):
+            pfn = f"pano_{q}_{p}.jpg"
+            img = qimg if p == 0 else _textured_image(rng, h, w)
+            Image.fromarray(img).save(os.path.join(pdir, pfn), quality=95)
+            panos.append(pfn)
+        entries[0, q] = (np.array([qfn]), np.array(panos, dtype=object)[:, None])
+    shortlist = os.path.join(root, "shortlist.mat")
+    savemat(shortlist, {"ImgList": entries})
+    return shortlist

@@ -1,6 +1,7 @@
 """Metrics and evaluation loops."""
 
+from ncnet_tpu.evaluation.inloc import run_inloc_eval
 from ncnet_tpu.evaluation.pck import pck, pck_metric
 from ncnet_tpu.evaluation.pf_pascal import make_eval_step, run_eval
 
-__all__ = ["make_eval_step", "pck", "pck_metric", "run_eval"]
+__all__ = ["make_eval_step", "pck", "pck_metric", "run_eval", "run_inloc_eval"]

@@ -152,14 +152,20 @@ def nearest_neighbor_point_tnf(matches: Matches, target_points_norm: jnp.ndarray
     return jnp.stack([wx, wy], axis=1)
 
 
-def bilinear_interp_point_tnf(matches: Matches, target_points_norm: jnp.ndarray):
+def bilinear_interp_point_tnf(
+    matches: Matches,
+    target_points_norm: jnp.ndarray,
+    grid_hw: tuple | None = None,
+):
     """Warp normalized target points by inverse-bilinear interpolation of the
     match field at the 4 surrounding B-grid corners (point_tnf.py:96-148).
 
     Assumes matches came from the default (B→A) direction of
-    :func:`corr_to_matches` on a *square* feature grid, so ``(xB, yB)`` is the
-    regular row-major grid — the same assumption the reference bakes in via
-    ``feature_size = sqrt(len(xB))``.
+    :func:`corr_to_matches`, so ``(xB, yB)`` is the regular row-major B grid.
+    ``grid_hw`` gives that grid's ``(hB, wB)`` shape; when None it is inferred
+    as square — the reference bakes the square case in via
+    ``feature_size = sqrt(len(xB))``, which breaks on rectangular (InLoc)
+    grids, so callers with rectangular volumes must pass ``grid_hw``.
 
     Args:
       target_points_norm: ``(B, 2, N)`` in [-1, 1].
@@ -167,20 +173,29 @@ def bilinear_interp_point_tnf(matches: Matches, target_points_norm: jnp.ndarray)
       ``(B, 2, N)`` warped points.
     """
     b, _, n = target_points_norm.shape
-    # static shape math (math.sqrt, not jnp: must stay concrete under jit)
-    fs = int(round(math.sqrt(matches.xB.shape[-1])))
-    grid = jnp.linspace(-1.0, 1.0, fs)
+    if grid_hw is None:
+        # static shape math (math.sqrt, not jnp: must stay concrete under jit)
+        fs = int(round(math.sqrt(matches.xB.shape[-1])))
+        fs_h = fs_w = fs
+    else:
+        fs_h, fs_w = int(grid_hw[0]), int(grid_hw[1])
+    if fs_h * fs_w != matches.xB.shape[-1]:
+        raise ValueError(
+            f"grid {fs_h}x{fs_w} does not tile {matches.xB.shape[-1]} matches"
+        )
+    grid_y = jnp.linspace(-1.0, 1.0, fs_h)
+    grid_x = jnp.linspace(-1.0, 1.0, fs_w)
 
-    def lower_index(coords):  # (B, N) → index of grid node strictly below
+    def lower_index(coords, grid, fs):  # (B, N) → index of grid node strictly below
         cnt = jnp.sum((coords[:, :, None] - grid[None, None, :]) > 0, axis=2) - 1
         return jnp.clip(cnt, 0, fs - 2)
 
-    x_minus = lower_index(target_points_norm[:, 0, :])
-    y_minus = lower_index(target_points_norm[:, 1, :])
+    x_minus = lower_index(target_points_norm[:, 0, :], grid_x, fs_w)
+    y_minus = lower_index(target_points_norm[:, 1, :], grid_y, fs_h)
     x_plus = x_minus + 1
     y_plus = y_minus + 1
 
-    to_idx = lambda x, y: y * fs + x  # noqa: E731 — row-major B grid
+    to_idx = lambda x, y: y * fs_w + x  # noqa: E731 — row-major B grid
     bidx = jnp.arange(b)[:, None]
 
     def at(field_x, field_y, idx):

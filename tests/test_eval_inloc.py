@@ -1,0 +1,189 @@
+"""InLoc evaluation path: quantized resize, dedup, .mat writer, e2e loop.
+
+Oracle: the reference recipe (/root/reference/eval_inloc.py) re-derived in
+plain numpy on tiny synthetic data — see each test's docstring.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+from scipy.io import loadmat
+
+from ncnet_tpu.config import EvalInLocConfig, ModelConfig
+from ncnet_tpu.data.synthetic import write_inloc_like
+from ncnet_tpu.evaluation.inloc import (
+    _as_str,
+    load_shortlist,
+    match_capacity,
+    output_folder_name,
+    quantized_resize_shape,
+    recenter,
+    run_inloc_eval,
+    sort_and_dedup,
+)
+from ncnet_tpu.models.ncnet import init_ncnet
+
+import jax
+
+
+@pytest.mark.parametrize(
+    "h,w,image_size,k",
+    [(3024, 4032, 3200, 2), (4032, 3024, 3200, 2), (480, 640, 3200, 1),
+     (96, 128, 128, 2), (1000, 1500, 1600, 2)],
+)
+def test_quantized_resize_shape_matches_reference_formula(h, w, image_size, k):
+    """Reference formula (eval_inloc.py:83-89): scale the longest side to
+    image_size, then (k>1) floor each dim to a multiple of 16·k."""
+    scale = np.max([h, w]) / image_size
+    if k == 1:
+        expected = (int(h / scale), int(w / scale))
+    else:
+        sf = 0.0625
+        expected = (
+            int(np.floor(h / scale * sf / k) / sf * k),
+            int(np.floor(w / scale * sf / k) / sf * k),
+        )
+    got = quantized_resize_shape(h, w, image_size, k)
+    assert got == expected
+    if k > 1:
+        assert got[0] % (16 * k) == 0 and got[1] % (16 * k) == 0
+
+
+def test_match_capacity_reference_values():
+    """eval_inloc.py:116-118 at the published settings: 3200px, k=2, both
+    directions → 2 · 100 · 75 = 15000 rows."""
+    assert match_capacity(3200, 2, both_directions=True) == 15000
+    assert match_capacity(3200, 2, both_directions=False) == 7500
+    assert match_capacity(3200, 1, both_directions=True) == 2 * 200 * 150
+
+
+def test_recenter_maps_endpoints_to_cell_centers():
+    """x·(n−1)/n + 0.5/n sends 0 → half-cell and 1 → 1 − half-cell
+    (eval_inloc.py:179-189)."""
+    import jax.numpy as jnp
+
+    n = 8
+    ends = recenter(jnp.asarray([0.0, 1.0]), n)
+    np.testing.assert_allclose(np.asarray(ends), [0.5 / n, 1 - 0.5 / n], atol=1e-6)
+
+
+def test_sort_and_dedup_keeps_max_score_instance():
+    """Duplicates of a coordinate row must collapse to the highest-scoring
+    copy; output follows np.unique's lexicographic column order
+    (eval_inloc.py:159-173)."""
+    xa = np.array([0.1, 0.5, 0.1, 0.9], dtype=np.float32)
+    ya = np.array([0.2, 0.5, 0.2, 0.9], dtype=np.float32)
+    xb = np.array([0.3, 0.5, 0.3, 0.9], dtype=np.float32)
+    yb = np.array([0.4, 0.5, 0.4, 0.9], dtype=np.float32)
+    score = np.array([0.7, 0.2, 0.9, 0.5], dtype=np.float32)
+    oxa, oya, oxb, oyb, oscore = sort_and_dedup(xa, ya, xb, yb, score)
+    assert len(oxa) == 3
+    # the duplicated (0.1,0.2,0.3,0.4) row keeps score 0.9 (not 0.7)
+    i = int(np.argmin(np.abs(oxa - 0.1)))
+    assert oscore[i] == pytest.approx(0.9)
+    # no duplicate coordinate rows remain
+    coords = np.stack([oxa, oya, oxb, oyb])
+    assert np.unique(coords, axis=1).shape[1] == coords.shape[1]
+
+
+def test_shortlist_roundtrip(tmp_path):
+    shortlist = write_inloc_like(str(tmp_path), n_queries=2, n_panos=3)
+    query_fns, pano_fns = load_shortlist(shortlist)
+    assert query_fns == ["query_0.jpg", "query_1.jpg"]
+    assert [len(p) for p in pano_fns] == [3, 3]
+    assert str(np.asarray(pano_fns[0]).ravel()[0].item()
+               if hasattr(pano_fns[0][0], "item") else pano_fns[0][0])
+
+
+def test_output_folder_name_encodes_settings():
+    cfg = EvalInLocConfig(inloc_shortlist="x/shortlist.mat", image_size=3200,
+                          k_size=2)
+    name = output_folder_name(cfg)
+    assert name == "shortlist_SZ_NEW_3200_K_2_BOTHDIRS_SOFTMAX"
+    cfg2 = EvalInLocConfig(inloc_shortlist="shortlist.mat", softmax=False,
+                           matching_both_directions=False,
+                           flip_matching_direction=True,
+                           image_size=1600, k_size=1, checkpoint="m/best.pth.tar")
+    assert output_folder_name(cfg2) == "shortlist_SZ_NEW_1600_K_1_AtoB_CHECKPOINT_best"
+
+
+def _identity_nc_params(model_config, key):
+    """Params whose single NC layer is an identity-peaked 3⁴ kernel, so the
+    filtered volume preserves the raw correlation's argmax structure."""
+    params = init_ncnet(model_config, key)
+    w = np.zeros_like(np.asarray(params["nc"][0]["w"]))
+    w[1, 1, 1, 1, 0, 0] = 1.0
+    params["nc"][0]["w"] = w
+    params["nc"][0]["b"] = np.zeros_like(np.asarray(params["nc"][0]["b"]))
+    return params
+
+
+def test_run_inloc_eval_end_to_end(tmp_path):
+    """Full loop on a synthetic shortlist: per-query .mat files appear with
+    the reference's fixed-capacity layout; the self-match pano (pano 0 is the
+    query image itself) yields near-identity correspondences."""
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=2, n_panos=2, image_hw=(96, 128))
+    model_config = ModelConfig(
+        backbone="tiny",
+        ncons_kernel_sizes=(3,),
+        ncons_channels=(1,),
+        half_precision=True,
+        relocalization_k_size=2,
+    )
+    params = _identity_nc_params(model_config, jax.random.key(0))
+    config = EvalInLocConfig(
+        inloc_shortlist=shortlist,
+        k_size=2,
+        image_size=128,
+        n_queries=2,
+        n_panos=2,
+        pano_path=os.path.join(root, "pano"),
+        query_path=os.path.join(root, "query", "iphone7"),
+        output_root=os.path.join(root, "matches"),
+    )
+    out_dir = run_inloc_eval(config, model_config=model_config, params=params,
+                             progress=False)
+
+    n_cap = match_capacity(128, 2, both_directions=True)
+    for q in (1, 2):
+        path = os.path.join(out_dir, f"{q}.mat")
+        assert os.path.exists(path)
+        mat = loadmat(path)
+        assert mat["matches"].shape == (1, 2, n_cap, 5)
+        m = mat["matches"][0, 0]  # self-match pano
+        valid = m[m[:, 4] > 0]
+        assert len(valid) > 0
+        # coords are recentered into (0, 1)
+        assert np.all(valid[:, :4] > 0) and np.all(valid[:, :4] < 1)
+        # self-match: best-scoring rows map each cell ~onto itself.  96×128 →
+        # fine grid 6×8, pooled 3×4; one fine cell pitch is 1/8 ≤ axis.
+        top = valid[np.argsort(-valid[:, 4])][: len(valid) // 2]
+        assert np.all(np.abs(top[:, 0] - top[:, 2]) <= 1 / 8 + 1e-6)
+        assert np.all(np.abs(top[:, 1] - top[:, 3]) <= 1 / 6 + 1e-6)
+        assert _as_str(mat["query_fn"]) == f"query_{q - 1}.jpg"
+
+
+def test_run_inloc_eval_single_direction(tmp_path):
+    """flip/single-direction modes produce half-capacity tables."""
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=1, n_panos=1, image_hw=(96, 128))
+    model_config = ModelConfig(
+        backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
+        relocalization_k_size=2,
+    )
+    params = init_ncnet(model_config, jax.random.key(0))
+    config = EvalInLocConfig(
+        inloc_shortlist=shortlist, k_size=2, image_size=128,
+        n_queries=1, n_panos=1,
+        matching_both_directions=False, flip_matching_direction=True,
+        pano_path=os.path.join(root, "pano"),
+        query_path=os.path.join(root, "query", "iphone7"),
+        output_root=os.path.join(root, "matches"),
+    )
+    out_dir = run_inloc_eval(config, model_config=model_config, params=params,
+                             progress=False)
+    mat = loadmat(os.path.join(out_dir, "1.mat"))
+    assert mat["matches"].shape == (1, 1, match_capacity(128, 2, False), 5)

@@ -141,3 +141,25 @@ def test_bilinear_interp_affine_field():
     warped = np.asarray(ops.bilinear_interp_point_tnf(m, jnp.asarray(pts)))
     np.testing.assert_allclose(warped[:, 0], 0.5 * pts[:, 0] + 0.1, atol=1e-5)
     np.testing.assert_allclose(warped[:, 1], -0.25 * pts[:, 1] - 0.05, atol=1e-5)
+
+
+def test_bilinear_interp_rectangular_grid():
+    """grid_hw unlocks rectangular B grids (InLoc): a linear match field on a
+    4×7 grid must still be reproduced exactly."""
+    fh, fw = 4, 7
+    gx = np.linspace(-1, 1, fw).astype(np.float32)
+    gy = np.linspace(-1, 1, fh).astype(np.float32)
+    xb, yb = np.meshgrid(gx, gy)  # (fh, fw) row-major
+    xa = 0.5 * xb + 0.1
+    ya = -0.25 * yb - 0.05
+    m = ops.Matches(*(jnp.asarray(v.reshape(1, -1)) for v in (xa, ya, xb, yb)),
+                    jnp.ones((1, fh * fw)))
+    pts = np.array([[[-0.5, 0.3, 0.9], [0.7, -0.2, -0.9]]], dtype=np.float32)
+    warped = np.asarray(
+        ops.bilinear_interp_point_tnf(m, jnp.asarray(pts), grid_hw=(fh, fw))
+    )
+    np.testing.assert_allclose(warped[:, 0], 0.5 * pts[:, 0] + 0.1, atol=1e-5)
+    np.testing.assert_allclose(warped[:, 1], -0.25 * pts[:, 1] - 0.05, atol=1e-5)
+    # square-default inference must reject a non-square match count
+    with np.testing.assert_raises(ValueError):
+        ops.bilinear_interp_point_tnf(m, jnp.asarray(pts))
