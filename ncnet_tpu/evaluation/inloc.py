@@ -89,7 +89,8 @@ def recenter(coord: jnp.ndarray, n: int) -> jnp.ndarray:
 
 
 def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
-                      both_directions: bool, flip_direction: bool):
+                      both_directions: bool, flip_direction: bool,
+                      mesh=None):
     """Returns ``matcher(src, tgt) -> (xA, yA, xB, yB, score)`` numpy arrays.
 
     One jitted program per (src_shape, tgt_shape) bucket — jit's native
@@ -98,11 +99,22 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
     match extraction in the requested direction(s), and cell-center
     recentering all fused; results land on host for the numpy sort/dedup
     stage.
+
+    ``mesh`` (with a >1 'spatial' axis) switches the forward to the
+    hB-sharded path (parallel/spatial.py); pairs whose pooled hB does not
+    divide over the shards fall back to the single-device forward.
     """
     k = max(config.relocalization_k_size, 1)
 
-    def run(p, src, tgt):
-        out = ncnet_forward(config, p, src, tgt)
+    def forward(p, src, tgt, sharded: bool):
+        if sharded:
+            from ncnet_tpu.parallel import spatial_forward
+
+            return spatial_forward(config, p, src, tgt, mesh)
+        return ncnet_forward(config, p, src, tgt)
+
+    def run(p, src, tgt, sharded=False):
+        out = forward(p, src, tgt, sharded)
         corr, delta4d = out.corr.astype(jnp.float32), out.delta4d
         fs1, fs2, fs3, fs4 = corr.shape[1:]
         ms = []
@@ -132,10 +144,34 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         xb = recenter(xb, fs4 * k)
         return xa, ya, xb, yb, score
 
-    jitted = jax.jit(run)
+    jitted = jax.jit(run, static_argnames=("sharded",))
+
+    warned_shapes = set()
+
+    def can_shard(tgt_shape) -> bool:
+        if mesh is None:
+            return False
+        from ncnet_tpu.parallel import SPATIAL_AXIS
+        from ncnet_tpu.parallel.spatial import shardable_hb
+
+        n = mesh.shape[SPATIAL_AXIS]
+        if n <= 1:
+            return False
+        hb = tgt_shape[1] // FEATURE_STRIDE  # fine-grid rows of the target
+        ok = shardable_hb(hb, config.relocalization_k_size, n,
+                          config.ncons_kernel_sizes)
+        if not ok and tgt_shape not in warned_shapes:
+            warned_shapes.add(tgt_shape)
+            print(f"warning: target shape {tuple(tgt_shape)} (fine hB={hb}) "
+                  f"does not shard over {n} devices; falling back to the "
+                  "single-device forward for this shape bucket")
+        return ok
 
     def matcher(src: np.ndarray, tgt: np.ndarray):
-        xa, ya, xb, yb, score = jitted(params, jnp.asarray(src), jnp.asarray(tgt))
+        xa, ya, xb, yb, score = jitted(
+            params, jnp.asarray(src), jnp.asarray(tgt),
+            sharded=can_shard(tgt.shape),
+        )
         return tuple(np.asarray(v, dtype=np.float32).ravel()
                      for v in (xa, ya, xb, yb, score))
 
@@ -229,14 +265,14 @@ def run_inloc_eval(
             params = init_ncnet(model_config, jax.random.key(1))
     assert model_config is not None
 
+    mesh = None
     if config.spatial_shards > 1:
-        raise NotImplementedError(
-            "spatial_shards > 1: the spatially-sharded volume forward is wired "
-            "in ncnet_tpu/parallel/spatial.py; hook-up lands with it"
-        )
+        from ncnet_tpu.parallel import make_mesh
+
+        mesh = make_mesh(data=1, spatial=config.spatial_shards)
 
     query_fns, pano_fns = load_shortlist(config.inloc_shortlist)
-    pano_fn_all = np.vstack([p[:, None] if p.ndim == 1 else p for p in pano_fns])
+    pano_fn_all = np.vstack([p[:, None] for p in pano_fns])
 
     out_dir = os.path.join(config.output_root, output_folder_name(config))
     os.makedirs(out_dir, exist_ok=True)
@@ -246,6 +282,7 @@ def run_inloc_eval(
         do_softmax=config.softmax,
         both_directions=config.matching_both_directions,
         flip_direction=config.flip_matching_direction,
+        mesh=mesh,
     )
     n_cap = match_capacity(
         config.image_size, config.k_size, config.matching_both_directions

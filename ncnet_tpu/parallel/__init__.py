@@ -10,6 +10,11 @@ from ncnet_tpu.parallel.mesh import (
     shard_batch,
     volume_sharding,
 )
+from ncnet_tpu.parallel.spatial import (
+    spatial_correlation,
+    spatial_filter,
+    spatial_forward,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -19,5 +24,8 @@ __all__ = [
     "replicate",
     "replicated",
     "shard_batch",
+    "spatial_correlation",
+    "spatial_filter",
+    "spatial_forward",
     "volume_sharding",
 ]

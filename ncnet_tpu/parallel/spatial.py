@@ -1,0 +1,228 @@
+"""Spatially-sharded 4D-volume forward: the sequence-parallel analog.
+
+NCNet's memory wall is the correlation volume — ``(B, hA, wA, hB, wB)`` is
+quadratic in resolution (~56M cells/pair at InLoc settings, SURVEY §5.7).
+The reference's only mitigations are single-device (fp16, maxpool4d,
+resolution caps).  Here the volume is sharded over its ``hB`` dim across the
+mesh's ``spatial`` axis, ring-attention style, and every stage of the
+post-correlation pipeline runs shard-local with explicit collectives:
+
+  * correlation     — local einsum against an hB-sharded feature map
+  * maxpool4d       — shard-local (shard boundaries are multiples of k)
+  * MutualMatching  — max over A dims is local; max over B dims is a
+                      shard-local max + ``lax.pmax`` over 'spatial'
+                      (reference semantics: lib/model.py:155-175)
+  * conv4d          — halo exchange of k//2 hB-slabs via ``lax.ppermute``
+                      (neighbor ICI links), then a *valid* conv along hB
+                      (``conv4d(pad_hb=False)``); the symmetric pass
+                      transposes A↔B, exchanges halos along the volume's
+                      leading dim instead (``pad_ha=False``), and transposes
+                      back — reference semantics: lib/model.py:122-153
+  * match extraction— runs downstream on the shard_map output; XLA/GSPMD
+                      inserts the gather/reductions it needs
+
+Built on ``jax.experimental.shard_map`` over the global mesh
+(parallel/mesh.py); global-edge shards receive zeros from ppermute's
+non-wraparound permutation, which reproduces 'same' zero padding exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax ≥ 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.models.ncnet import NCNetOutput, extract_features
+from ncnet_tpu.ops.conv4d import conv4d
+from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
+from ncnet_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+
+# (B, hA, wA, hB, wB) volume: pairs over 'data', hB over 'spatial'
+VOLUME_SPEC = P(DATA_AXIS, None, None, SPATIAL_AXIS, None)
+FEATURE_B_SPEC = P(DATA_AXIS, SPATIAL_AXIS, None, None)  # (B, hB, wB, C)
+FEATURE_A_SPEC = P(DATA_AXIS, None, None, None)
+
+
+def shardable_hb(
+    hb_fine: int, k_size: int, n_shards: int, kernel_sizes
+) -> bool:
+    """Whether a volume whose fine-grid hB is ``hb_fine`` can shard over
+    ``n_shards``: the (post-pooling) dim must split evenly and each local
+    shard must be at least one conv halo tall.  The single source of truth
+    for the gating policy — :func:`spatial_filter` enforces it and callers
+    (e.g. the InLoc matcher's fallback) pre-check it."""
+    k = max(k_size, 1)
+    if hb_fine % (n_shards * k) != 0:
+        return False
+    max_halo = max(ks // 2 for ks in kernel_sizes)
+    return hb_fine // n_shards // k >= max_halo
+
+
+def _halo_pad(x: jnp.ndarray, axis: int, halo: int, n_shards: int) -> jnp.ndarray:
+    """Concatenate each shard's boundary slabs onto its neighbors along the
+    sharded ``axis``: shard i prepends shard i−1's trailing ``halo`` slices
+    and appends shard i+1's leading ones.  The permutation does not wrap, so
+    edge shards receive zeros — exactly the 'same'-conv zero padding of the
+    unsharded path."""
+    if halo == 0:
+        return x
+    size = x.shape[axis]
+    assert size >= halo, f"shard dim {size} smaller than halo {halo}"
+    send_right = lax.slice_in_dim(x, size - halo, size, axis=axis)
+    send_left = lax.slice_in_dim(x, 0, halo, axis=axis)
+    from_left = lax.ppermute(
+        send_right, SPATIAL_AXIS, [(i, i + 1) for i in range(n_shards - 1)]
+    )
+    from_right = lax.ppermute(
+        send_left, SPATIAL_AXIS, [(i, i - 1) for i in range(1, n_shards)]
+    )
+    return jnp.concatenate([from_left, x, from_right], axis=axis)
+
+
+def _mutual_matching_sharded(corr: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Shard-local body of :func:`ncnet_tpu.ops.matching.mutual_matching`:
+    the per-B-cell max over A dims sees full A locally; the per-A-cell max
+    over B dims needs a pmax across the hB shards."""
+    max_over_a = jnp.max(corr, axis=(1, 2), keepdims=True)
+    max_over_b = lax.pmax(
+        jnp.max(corr, axis=(3, 4), keepdims=True), SPATIAL_AXIS
+    )
+    ratio_b = corr / (max_over_a + eps)
+    ratio_a = corr / (max_over_b + eps)
+    return corr * (ratio_a * ratio_b)
+
+
+def _nc_stack_sharded(
+    nc_params: List[dict], x: jnp.ndarray, sharded_axis: int, n_shards: int
+) -> jnp.ndarray:
+    """[Conv4d+ReLU]×N with per-layer halo exchange along ``sharded_axis``
+    (1 = the volume's leading spatial dim, 3 = hB)."""
+    assert sharded_axis in (1, 3)
+    for layer in nc_params:
+        halo = layer["w"].shape[0] // 2
+        x = _halo_pad(x, sharded_axis, halo, n_shards)
+        x = conv4d(
+            x, layer["w"], layer["b"],
+            pad_ha=sharded_axis != 1, pad_hb=sharded_axis != 3,
+        )
+        x = jax.nn.relu(x)
+    return x
+
+
+def _neigh_consensus_sharded(
+    nc_params: List[dict], corr: jnp.ndarray, n_shards: int, symmetric: bool
+) -> jnp.ndarray:
+    """Stack-level symmetric NC filtering on an hB-sharded volume.  The
+    transposed pass swaps (hA,wA)↔(hB,wB), which moves the sharded dim to
+    position 1 — halos are exchanged there instead (model.py:144-150
+    semantics, sharded)."""
+    x = corr[..., None]
+    out = _nc_stack_sharded(nc_params, x, 3, n_shards)
+    if symmetric:
+        xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
+        yt = _nc_stack_sharded(nc_params, xt, 1, n_shards)
+        out = out + jnp.transpose(yt, (0, 3, 4, 1, 2, 5))
+    return out[..., 0]
+
+
+def spatial_filter(
+    config: ModelConfig, params, corr: jnp.ndarray, mesh: Mesh
+) -> NCNetOutput:
+    """The post-correlation pipeline ([maxpool4d] → MutualMatching →
+    NeighConsensus → MutualMatching) with the volume sharded over hB.
+
+    Drop-in parallel twin of :func:`ncnet_tpu.models.ncnet.ncnet_filter`
+    (parity-tested against it); call under ``jit`` with ``mesh`` holding a
+    ``spatial`` axis of size > 1.
+    """
+    n_shards = mesh.shape[SPATIAL_AXIS]
+    k = config.relocalization_k_size
+    hb = corr.shape[3]
+    if not shardable_hb(hb, k, n_shards, config.ncons_kernel_sizes):
+        raise ValueError(
+            f"hB={hb} cannot shard over {n_shards} spatial shards (needs "
+            f"k={max(k, 1)}-aligned even split with each shard ≥ the conv "
+            "halo); use fewer shards for this volume"
+        )
+
+    nc_params = params["nc"]
+    if config.half_precision:
+        nc_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), nc_params)
+        corr = corr.astype(jnp.bfloat16)
+
+    delta_spec = (VOLUME_SPEC,) * 4
+    out_specs = (VOLUME_SPEC, delta_spec) if k > 1 else VOLUME_SPEC
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), VOLUME_SPEC), out_specs=out_specs,
+    )
+    def run(nc, corr_loc):
+        delta = None
+        if k > 1:
+            corr_loc, delta = maxpool4d_with_argmax(corr_loc, k)
+        corr_loc = _mutual_matching_sharded(corr_loc)
+        corr_loc = _neigh_consensus_sharded(
+            nc, corr_loc, n_shards, config.symmetric_mode
+        )
+        corr_loc = _mutual_matching_sharded(corr_loc)
+        return (corr_loc, delta) if k > 1 else corr_loc
+
+    result = run(nc_params, corr)
+    if k > 1:
+        return NCNetOutput(*result)
+    return NCNetOutput(result, None)
+
+
+def spatial_correlation(
+    fa: jnp.ndarray, fb: jnp.ndarray, mesh: Mesh
+) -> jnp.ndarray:
+    """4D correlation with the output sharded over hB: each shard contracts
+    the full (replicated) source features against its local hB feature rows —
+    no communication at all (the all-to-all structure lives in the volume's
+    sharding, not in collectives)."""
+
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(FEATURE_A_SPEC, FEATURE_B_SPEC), out_specs=VOLUME_SPEC,
+    )
+    def run(fa_loc, fb_loc):
+        # f32 accumulation on the MXU regardless of feature dtype
+        # (ops/correlation.py semantics)
+        out = jnp.einsum(
+            "bijc,bklc->bijkl", fa_loc, fb_loc,
+            preferred_element_type=jnp.float32,
+        )
+        return out.astype(fa_loc.dtype)
+
+    return run(fa, fb)
+
+
+def spatial_forward(
+    config: ModelConfig,
+    params,
+    source_images: jnp.ndarray,
+    target_images: jnp.ndarray,
+    mesh: Mesh,
+) -> NCNetOutput:
+    """Full forward with an hB-sharded volume: backbone features run
+    replicated (they are ~3 orders of magnitude smaller than the volume),
+    correlation + filtering run sharded.  Twin of
+    :func:`ncnet_tpu.models.ncnet.ncnet_forward`."""
+    fa = extract_features(config, params, source_images)
+    fb = extract_features(config, params, target_images)
+    if config.half_precision:
+        fa = fa.astype(jnp.bfloat16)
+        fb = fb.astype(jnp.bfloat16)
+    corr = spatial_correlation(fa, fb, mesh)
+    return spatial_filter(config, params, corr, mesh)

@@ -1,0 +1,118 @@
+"""Spatially-sharded volume forward vs. the unsharded path (8-device CPU mesh).
+
+The parity bar: every sharded stage must reproduce the single-device program
+bit-for-bit up to float-reduction tolerance — halo exchange must equal 'same'
+zero padding at the global edges, pmax must equal the full-B max, and the
+relocalization delta bookkeeping must survive sharding.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu import parallel
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.models.ncnet import init_ncnet, ncnet_filter, ncnet_forward
+from ncnet_tpu.ops import correlation_4d
+
+
+def _mesh(data, spatial):
+    return parallel.make_mesh(data=data, spatial=spatial,
+                              devices=jax.devices()[: data * spatial])
+
+
+def _volume_cfg(**kw):
+    defaults = dict(backbone="tiny", ncons_kernel_sizes=(5, 3),
+                    ncons_channels=(6, 1))
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+@pytest.mark.parametrize("data,spatial", [(1, 8), (2, 4)])
+def test_spatial_filter_parity_rectangular(rng, data, spatial):
+    """Rectangular InLoc-like volume, no relocalization: sharded filter ==
+    unsharded filter.  hB=16 → local shards of 2 (spatial=8) or 4 (spatial=4),
+    both ≥ the kernel-5 halo of 2."""
+    cfg = _volume_cfg()
+    params = init_ncnet(cfg, jax.random.key(0))
+    corr = jnp.asarray(rng.standard_normal((data, 5, 7, 16, 6)).astype(np.float32))
+    mesh = _mesh(data, spatial)
+    ref = ncnet_filter(cfg, params, corr).corr
+    got = jax.jit(
+        lambda p, c: parallel.spatial_filter(cfg, p, c, mesh).corr
+    )(params, corr)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_spatial_filter_parity_with_relocalization(rng):
+    """k=2 maxpool4d relocalization under sharding: pooled volume AND all
+    four delta offset grids must match the unsharded path exactly."""
+    cfg = _volume_cfg(relocalization_k_size=2)
+    params = init_ncnet(cfg, jax.random.key(1))
+    # fine grid hB=16 → pooled 8 → 4 shards of 2
+    corr = jnp.asarray(rng.standard_normal((1, 6, 8, 16, 12)).astype(np.float32))
+    mesh = _mesh(1, 4)
+    ref = ncnet_filter(cfg, params, corr)
+    got = jax.jit(
+        lambda p, c: parallel.spatial_filter(cfg, p, c, mesh)
+    )(params, corr)
+    np.testing.assert_allclose(np.asarray(got.corr), np.asarray(ref.corr),
+                               rtol=2e-5, atol=2e-5)
+    for g, r in zip(got.delta4d, ref.delta4d):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_spatial_filter_parity_asymmetric(rng):
+    """symmetric_mode=False exercises the hB-only halo path."""
+    cfg = _volume_cfg(symmetric_mode=False)
+    params = init_ncnet(cfg, jax.random.key(2))
+    corr = jnp.asarray(rng.standard_normal((1, 4, 5, 16, 7)).astype(np.float32))
+    mesh = _mesh(1, 8)
+    ref = ncnet_filter(cfg, params, corr).corr
+    got = jax.jit(
+        lambda p, c: parallel.spatial_filter(cfg, p, c, mesh).corr
+    )(params, corr)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_spatial_correlation_parity(rng):
+    fa = jnp.asarray(rng.standard_normal((2, 5, 7, 16)).astype(np.float32))
+    fb = jnp.asarray(rng.standard_normal((2, 8, 6, 16)).astype(np.float32))
+    mesh = _mesh(2, 4)
+    ref = correlation_4d(fa, fb)
+    got = jax.jit(lambda a, b: parallel.spatial_correlation(a, b, mesh))(fa, fb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_forward_parity_end_to_end(rng):
+    """Images → features → sharded correlation → sharded filter must equal
+    the plain ncnet_forward, including bf16 half-precision handling."""
+    cfg = _volume_cfg(half_precision=True, relocalization_k_size=2)
+    params = init_ncnet(cfg, jax.random.key(3))
+    src = jnp.asarray(rng.uniform(-1, 1, (1, 96, 128, 3)).astype(np.float32))
+    tgt = jnp.asarray(rng.uniform(-1, 1, (1, 256, 128, 3)).astype(np.float32))
+    mesh = _mesh(1, 4)
+    ref = ncnet_forward(cfg, params, src, tgt)
+    got = jax.jit(
+        lambda p, s, t: parallel.spatial_forward(cfg, p, s, t, mesh)
+    )(params, src, tgt)
+    np.testing.assert_allclose(
+        np.asarray(got.corr, dtype=np.float32),
+        np.asarray(ref.corr, dtype=np.float32),
+        rtol=2e-2, atol=2e-2,  # bf16 volume
+    )
+    for g, r in zip(got.delta4d, ref.delta4d):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_spatial_filter_rejects_indivisible_hb(rng):
+    cfg = _volume_cfg()
+    params = init_ncnet(cfg, jax.random.key(0))
+    corr = jnp.asarray(rng.standard_normal((1, 4, 4, 6, 4)).astype(np.float32))
+    with pytest.raises(ValueError, match="spatial shards"):
+        parallel.spatial_filter(cfg, params, corr, _mesh(1, 4))
