@@ -32,7 +32,10 @@ class ModelConfig:
     normalize_features: bool = True
     relocalization_k_size: int = 0       # >1 enables maxpool4d relocalization
     half_precision: bool = False         # bf16 volume + NC weights (TPU-native fp16 analog)
-    train_backbone: bool = False
+    backbone_bf16: bool = False          # run the (frozen) trunk in bfloat16 —
+                                         # TPU-native fast path with no reference
+                                         # analog (the reference keeps the trunk
+                                         # fp32 even in half mode, model.py:265)
     checkpoint: str = ""                 # path to orbax dir or torch .pth.tar
 
     def replace(self, **kw) -> "ModelConfig":

@@ -154,7 +154,7 @@ def load_params(path: str, base_config: ModelConfig = ModelConfig()):
         cfg_dict[key] = tuple(cfg_dict[key])
     # same policy as the torch path (and the reference, model.py:215-220):
     # architecture comes from the checkpoint, runtime flags (half_precision,
-    # relocalization_k_size, train_backbone, ...) from the caller's config.
+    # relocalization_k_size, backbone_bf16, ...) from the caller's config.
     config = base_config.replace(
         **{k: cfg_dict[k] for k in _ARCH_FIELDS if k in cfg_dict}
     )

@@ -59,6 +59,7 @@ def weak_loss(
     params,
     batch: Dict[str, jnp.ndarray],
     normalization: str = "softmax",
+    stop_backbone_grad: bool = False,
 ) -> jnp.ndarray:
     """score(negative) − score(positive) on an image-pair batch.
 
@@ -69,17 +70,28 @@ def weak_loss(
     roll is a global permute — XLA lowers it to a collective, so negatives
     cross shard boundaries exactly like the reference's single-device
     global-batch roll.
+
+    ``stop_backbone_grad``: detach the features (the reference's frozen-FE
+    ``requires_grad=False`` semantics, model.py:75-78) — set when no backbone
+    blocks are being finetuned so the backward pass neither recomputes nor
+    stores the trunk, which is what lets the reference batch size 16 fit at
+    400² on one chip.  The NC filter is rematerialized (``jax.checkpoint``)
+    so the huge 16-channel volume activations are recomputed, not stored.
     """
     fa = extract_features(config, params, batch["source_image"])
     fb = extract_features(config, params, batch["target_image"])
+    if stop_backbone_grad:
+        fa = jax.lax.stop_gradient(fa)
+        fb = jax.lax.stop_gradient(fb)
     if config.half_precision:
         fa = fa.astype(jnp.bfloat16)
         fb = fb.astype(jnp.bfloat16)
 
-    corr_pos = ncnet_filter(config, params, correlation_4d(fa, fb)).corr
-    corr_neg = ncnet_filter(
-        config, params, correlation_4d(jnp.roll(fa, -1, axis=0), fb)
-    ).corr
+    filt = jax.checkpoint(
+        lambda p, corr: ncnet_filter(config, p, corr).corr
+    )
+    corr_pos = filt(params, correlation_4d(fa, fb))
+    corr_neg = filt(params, correlation_4d(jnp.roll(fa, -1, axis=0), fb))
 
     score_pos = match_score(corr_pos, normalization)
     score_neg = match_score(corr_neg, normalization)

@@ -89,12 +89,27 @@ def create_train_state(
     return state, optimizer, model_config, labels
 
 
-def make_train_step(model_config: ModelConfig, optimizer, donate: bool = True):
-    """Jitted (state, batch) → (state, loss)."""
+def make_train_step(
+    model_config: ModelConfig,
+    optimizer,
+    donate: bool = True,
+    stop_backbone_grad: bool = False,
+):
+    """Jitted (state, batch) → (state, loss).
+
+    Pass ``stop_backbone_grad=True`` when no backbone blocks are being
+    finetuned (``fe_finetune_params == 0``, the reference default): the trunk
+    is detached, matching the reference's frozen-FE training and keeping the
+    backward pass off the trunk activations entirely — required to fit the
+    reference batch sizes at 400² on one chip.  It must stay False when
+    finetuning, so False is the (safe) default; ``fit`` derives it from the
+    config."""
 
     def step(state: TrainState, batch):
         loss, grads = jax.value_and_grad(
-            lambda p: weak_loss(model_config, p, batch)
+            lambda p: weak_loss(
+                model_config, p, batch, stop_backbone_grad=stop_backbone_grad
+            )
         )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -284,7 +299,10 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         if progress:
             print(f"Data parallel over {n_dev} devices (mesh {mesh.shape})")
 
-    train_step = make_train_step(model_config, optimizer, donate=config.donate_state)
+    train_step = make_train_step(
+        model_config, optimizer, donate=config.donate_state,
+        stop_backbone_grad=config.fe_finetune_params == 0,
+    )
     eval_step = make_eval_step(model_config)
 
     size = (config.image_size, config.image_size)

@@ -1,6 +1,7 @@
 """Unit tests for norm / correlation / pooling / mutual matching against
 numpy brute-force oracles."""
 
+import pytest
 import numpy as np
 import jax.numpy as jnp
 
@@ -110,3 +111,45 @@ def test_conv4d_kernel5(rng):
                     expected[:, i, j, m, n, :] = np.tensordot(
                         patch, w, axes=([1, 2, 3, 4, 5], [0, 1, 2, 3, 4]))
     np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("variant", ["unroll", "tapfold", "coutfold"])
+@pytest.mark.parametrize("pad_ha,pad_hb",
+                         [(True, True), (False, True), (True, False), (False, False)])
+def test_conv4d_variants_and_pad_modes_agree(rng, variant, pad_ha, pad_hb):
+    """All three MXU formulations must agree with each other under every
+    halo/pad mode (the spatially-sharded path feeds pre-padded volumes with
+    pad_ha/pad_hb=False and expects a k//2-per-side shrink on that dim)."""
+    b, ha, wa, hb, wb, cin, cout, k = 1, 6, 4, 7, 3, 2, 3, 3
+    x = jnp.asarray(rng.standard_normal((b, ha, wa, hb, wb, cin)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((k, k, k, k, cin, cout)).astype(np.float32))
+    bias = jnp.asarray(rng.standard_normal((cout,)).astype(np.float32))
+
+    got = ops.conv4d(x, w, bias, pad_ha=pad_ha, pad_hb=pad_hb, variant=variant)
+    # oracle: run the 'same' conv on a manually pre-padded volume and crop —
+    # valid-mode output on padded input IS same-mode output on the original
+    pad = k // 2
+    exp_ha = ha if pad_ha else ha - 2 * pad
+    exp_hb = hb if pad_hb else hb - 2 * pad
+    assert got.shape == (b, exp_ha, wa, exp_hb, wb, cout)
+    full = ops.conv4d(x, w, bias)  # same-padded reference (unroll/auto)
+    sl_ha = slice(pad, -pad) if not pad_ha else slice(None)
+    sl_hb = slice(pad, -pad) if not pad_hb else slice(None)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full)[:, sl_ha, :, sl_hb], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_conv4d_auto_variant_matches_unroll(rng):
+    """'auto' picks tapfold for 1-channel input and coutfold for 1-channel
+    output; both must match the unroll formulation on NC-shaped layers."""
+    b = 2
+    x1 = jnp.asarray(rng.standard_normal((b, 5, 5, 5, 5, 1)).astype(np.float32))
+    w1 = jnp.asarray(rng.standard_normal((5, 5, 5, 5, 1, 16)).astype(np.float32) * 0.1)
+    x16 = jnp.asarray(rng.standard_normal((b, 5, 5, 5, 5, 16)).astype(np.float32))
+    w3 = jnp.asarray(rng.standard_normal((3, 3, 3, 3, 16, 1)).astype(np.float32) * 0.1)
+    for x, w in [(x1, w1), (x16, w3)]:
+        auto = ops.conv4d(x, w)
+        unroll = ops.conv4d(x, w, variant="unroll")
+        np.testing.assert_allclose(np.asarray(auto), np.asarray(unroll),
+                                   rtol=2e-4, atol=2e-4)

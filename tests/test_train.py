@@ -241,3 +241,24 @@ def test_fit_resume_continues_from_saved_epoch(tmp_path, capsys):
     assert "Epoch: 1 [" not in out.split("Resumed")[1]  # epoch 1 not re-run
     np.testing.assert_allclose(r2["train_loss"][0], r1["train_loss"][0])
     assert int(r2["state"].step) == 2  # 1 batch/epoch: one old + one new step
+
+
+def test_stop_backbone_grad_preserves_nc_updates(tmp_path):
+    """With a frozen trunk, detaching features (the memory-saving path fit()
+    uses when fe_finetune_params == 0) must not change the NC update at all."""
+    mc = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,))
+    cfg = TrainConfig(model=mc, batch_size=2, lr=1e-3)
+    rng = np.random.default_rng(0)
+    batch = {
+        "source_image": jnp.asarray(rng.uniform(-1, 1, (2, 32, 32, 3)).astype(np.float32)),
+        "target_image": jnp.asarray(rng.uniform(-1, 1, (2, 32, 32, 3)).astype(np.float32)),
+    }
+    outs = {}
+    for flag in (False, True):
+        state, optimizer, mcfg, _ = training.create_train_state(cfg)
+        step = training.make_train_step(mcfg, optimizer, donate=False,
+                                        stop_backbone_grad=flag)
+        new_state, loss = step(state, batch)
+        outs[flag] = (np.asarray(new_state.params["nc"][0]["w"]), float(loss))
+    np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-6, atol=1e-7)
+    assert outs[True][1] == pytest.approx(outs[False][1], rel=1e-6)
