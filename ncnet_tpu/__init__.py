@@ -1,20 +1,25 @@
 """ncnet_tpu — a TPU-native dense-correspondence framework.
 
-A from-scratch JAX/XLA/Pallas re-design of the capabilities of NCNet
+A from-scratch JAX/XLA re-design of the capabilities of NCNet
 ("Neighbourhood Consensus Networks", Rocco et al., NeurIPS 2018; reference
 implementation studied at /root/reference — see SURVEY.md).  Nothing here is a
-port: the compute path is functional JAX (einsum correlation, single-op 4D
-convolution, pjit/shard_map parallelism) rather than the reference's
-PyTorch-0.3 module graph.
+port: the compute path is functional JAX (einsum correlation, whole-volume 4D
+convolution with MXU-aware formulations, jit + shard_map parallelism) rather
+than the reference's PyTorch-0.3 module graph.
 
 Layout:
-    ops/       pure-function compute kernels (correlation, conv4d, matching)
-    models/    Flax modules (backbones, NCNet assembly)
-    parallel/  device-mesh, data-parallel and spatially-sharded execution
-    data/      host-side input pipeline (CSV pair datasets, loader)
-    training/  weak-supervision loss + train loop
-    utils/     checkpointing (orbax + torch import), seeding, profiling, .mat IO
-    cli/       entry points mirroring the reference CLIs
+    ops/        pure-function compute kernels (correlation, conv4d, matching,
+                pooling, image resize/normalization)
+    models/     functional backbones + NCNet assembly (params are plain
+                pytrees), orbax/torch checkpoint I/O
+    parallel/   device mesh, data-parallel helpers, spatially-sharded
+                (hB-sharded, halo-exchange) volume forward
+    data/       host-side input pipeline (CSV pair datasets, loader,
+                synthetic fixtures)
+    training/   weak-supervision loss + jitted train loop
+    evaluation/ PF-Pascal PCK + InLoc dense-matching (.mat writer)
+    utils/      seeding
+    cli/        entry points mirroring the reference CLIs
 """
 
 __version__ = "0.1.0"

@@ -20,6 +20,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_workers", type=int, default=0)
     p.add_argument("--backbone", type=str, default="resnet101",
                    help="used only when no checkpoint is given")
+    p.add_argument("--backbone_weights", type=str, default="",
+                   help="torchvision state_dict (.pth) for the trunk when no "
+                        "checkpoint is given")
     return p
 
 
@@ -37,7 +40,8 @@ def main(argv=None) -> int:
     )
     stats = run_eval(
         config,
-        model_config=ModelConfig(backbone=args.backbone),
+        model_config=ModelConfig(backbone=args.backbone,
+                                 backbone_weights=args.backbone_weights),
         batch_size=args.batch_size,
         num_workers=args.num_workers,
     )

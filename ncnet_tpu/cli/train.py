@@ -31,6 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fe_finetune_params", type=int, default=0,
                    help="number of backbone blocks to finetune")
     p.add_argument("--backbone", type=str, default="resnet101")
+    p.add_argument("--backbone_weights", type=str, default="",
+                   help="torchvision state_dict (.pth) to initialize the trunk "
+                        "(the reference always starts from ImageNet weights)")
     p.add_argument("--num_workers", type=int, default=0)
     p.add_argument("--seed", type=int, default=1)
     return p
@@ -47,6 +50,7 @@ def main(argv=None) -> int:
     config = TrainConfig(
         model=ModelConfig(
             backbone=args.backbone,
+            backbone_weights=args.backbone_weights,
             ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
             ncons_channels=tuple(args.ncons_channels),
             checkpoint=args.checkpoint,

@@ -36,6 +36,9 @@ class ModelConfig:
                                          # TPU-native fast path with no reference
                                          # analog (the reference keeps the trunk
                                          # fp32 even in half mode, model.py:265)
+    backbone_weights: str = ""           # torchvision state_dict (.pth) for the
+                                         # trunk; the reference always starts
+                                         # from ImageNet weights (model.py:25,39)
     checkpoint: str = ""                 # path to orbax dir or torch .pth.tar
 
     def replace(self, **kw) -> "ModelConfig":
@@ -65,6 +68,11 @@ class TrainConfig:
     # TPU-native additions (no reference analog):
     data_parallel: bool = True           # shard the pair batch over the mesh 'data' axis
     donate_state: bool = True
+    # static jit shapes need whole batches; dropping the val remainder (4 of
+    # 308 PF-Pascal pairs at bs=16) makes best-checkpoint selection score a
+    # fixed subset each epoch.  A documented deviation: the reference scores
+    # all pairs (but shuffles val, so its per-epoch val sets differ anyway).
+    val_drop_last: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -63,12 +63,20 @@ def run_eval(
     n_batches = len(loader)
     for i, batch in enumerate(loader):
         jb = {
-            k: jnp.asarray(v)
+            k: np.asarray(v)
             for k, v in batch.items()
             if k in ("source_image", "target_image", "source_points",
                      "target_points", "source_im_size", "target_im_size", "L_pck")
         }
-        results.append(np.asarray(step(net.params, jb)))
+        # pad a trailing partial batch up to batch_size (repeating the last
+        # sample) so every step reuses the one compiled program, then crop
+        n_real = jb["source_image"].shape[0]
+        if n_real < batch_size:
+            reps = [1] * batch_size
+            reps[n_real - 1] = batch_size - n_real + 1
+            jb = {k: np.repeat(v, reps[: n_real], axis=0) for k, v in jb.items()}
+        jb = {k: jnp.asarray(v) for k, v in jb.items()}
+        results.append(np.asarray(step(net.params, jb))[:n_real])
         if progress:
             print(f"Batch: [{i}/{n_batches} ({100.0 * i / n_batches:.0f}%)]")
 

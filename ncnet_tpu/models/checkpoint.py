@@ -32,7 +32,10 @@ from ncnet_tpu.models import backbone as bb
 # reference FeatureExtraction wraps the trunk in nn.Sequential, so resnet
 # children are addressed by index (model.py:38-44): 0=conv1 1=bn1 2=relu
 # 3=maxpool 4=layer1 5=layer2 6=layer3.
-_RESNET_SEQ_TO_NAME = {"0": "conv1", "1": "bn1", "4": "layer1", "5": "layer2", "6": "layer3"}
+_RESNET_SEQ_TO_NAME = {
+    "0": "conv1", "1": "bn1", "4": "layer1", "5": "layer2", "6": "layer3",
+    "7": "layer4",  # checkpoints trained with feature_extraction_last_layer='layer4'
+}
 
 # fields that describe the trained network (restored from checkpoints); all
 # other ModelConfig fields are runtime flags owned by the caller.

@@ -45,18 +45,41 @@ class NCNetOutput(NamedTuple):
 
 
 def init_ncnet(config: ModelConfig, key: jax.Array) -> Dict[str, Any]:
-    """Random-init parameters for the full model."""
+    """Init parameters for the full model: random NC stack + a trunk from
+    ``config.backbone_weights`` (torchvision state_dict) when given, else
+    random.
+
+    The reference *always* starts its trunk from ImageNet-pretrained
+    torchvision weights (model.py:25,39); a randomly-initialized frozen trunk
+    trains but cannot approach reference quality, so that case warns loudly.
+    """
     if len(config.ncons_kernel_sizes) != len(config.ncons_channels):
         raise ValueError(
             "ncons_kernel_sizes and ncons_channels must have equal length, got "
             f"{config.ncons_kernel_sizes} vs {config.ncons_channels}"
         )
     k_bb, k_nc = jax.random.split(key)
-    params: Dict[str, Any] = {
-        "backbone": bb.backbone_init(
+    if config.backbone_weights:
+        trunk = bb.import_torch_backbone(
+            _load_torch_state_dict(config.backbone_weights, config.backbone),
+            config.backbone,
+            last_layer=config.backbone_last_layer,
+        )
+    else:
+        if config.backbone in ("resnet101", "vgg"):
+            import warnings
+
+            warnings.warn(
+                f"initializing a '{config.backbone}' trunk with RANDOM weights "
+                "— the reference always uses ImageNet-pretrained weights; pass "
+                "backbone_weights=<torchvision .pth> (or a checkpoint) for "
+                "meaningful features",
+                stacklevel=2,
+            )
+        trunk = bb.backbone_init(
             config.backbone, k_bb, last_layer=config.backbone_last_layer
         )
-    }
+    params: Dict[str, Any] = {"backbone": trunk}
     nc: List[Dict[str, jnp.ndarray]] = []
     c_in = 1
     for k_size, c_out in zip(config.ncons_kernel_sizes, config.ncons_channels):
@@ -66,6 +89,19 @@ def init_ncnet(config: ModelConfig, key: jax.Array) -> Dict[str, Any]:
         c_in = c_out
     params["nc"] = nc
     return params
+
+
+def _load_torch_state_dict(path: str, backbone: str):
+    """Load a torchvision ``.pth`` state_dict for the trunk importer; a full
+    vgg16 checkpoint nests convs under ``features.``, which the importer
+    expects stripped."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if backbone == "vgg" and any(k.startswith("features.") for k in sd):
+        sd = {k[len("features."):]: v for k, v in sd.items()
+              if k.startswith("features.")}
+    return sd
 
 
 # ---------------------------------------------------------------------------

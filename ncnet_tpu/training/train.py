@@ -288,6 +288,14 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         if config.batch_size % d == 0
     )
     if config.data_parallel and n_dev > 1:
+        if not config.val_drop_last:
+            # a partial trailing val batch cannot be device_put with the
+            # pair-axis sharding (batch size must divide the device count),
+            # and padding it would perturb the in-batch negative roll
+            raise ValueError(
+                "val_drop_last=False is incompatible with data_parallel "
+                "across multiple devices; disable one of the two"
+            )
         from ncnet_tpu import parallel
 
         mesh = parallel.make_mesh(data=n_dev, devices=jax.devices()[:n_dev])
@@ -314,8 +322,8 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         batch_size=config.batch_size, shuffle=True,
         num_workers=config.num_workers, seed=config.seed, drop_last=True,
     )
-    # val: no shuffle — drop_last is needed for static jit shapes, and with a
-    # shuffle each epoch would drop a DIFFERENT random subset, making the
+    # val: no shuffle — with drop_last (config.val_drop_last), a shuffle
+    # would drop a DIFFERENT random subset each epoch, making the
     # best-checkpoint metric noisy (the reference shuffles but drops nothing)
     val_loader = DataLoader(
         ImagePairDataset(
@@ -323,7 +331,8 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
             output_size=size, seed=config.seed,
         ),
         batch_size=config.batch_size, shuffle=False,
-        num_workers=config.eval_num_workers, seed=config.seed, drop_last=True,
+        num_workers=config.eval_num_workers, seed=config.seed,
+        drop_last=config.val_drop_last,
     )
 
     ckpt_name = os.path.join(

@@ -215,3 +215,42 @@ def test_deep_cuts_layer4_and_pool5():
     assert len(pv["convs"]) == 13
     out = bb.backbone_apply("vgg", pv, jnp.zeros((1, 64, 64, 3)), last_layer="pool5")
     assert out.shape == (1, 2, 2, 512)  # stride 32
+
+
+def test_backbone_weights_config_loads_torch_state_dict(tmp_path):
+    """ModelConfig.backbone_weights → init_ncnet builds the trunk from a
+    torchvision .pth instead of random init (and does not warn)."""
+    import warnings
+    import torch
+    import jax
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models.ncnet import init_ncnet
+
+    sd = make_resnet101_state_dict()
+    path = tmp_path / "resnet101.pth"
+    torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()}, path)
+
+    cfg = ModelConfig(backbone="resnet101", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,), backbone_weights=str(path))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the random-trunk warning must NOT fire
+        params = init_ncnet(cfg, jax.random.key(0))
+    np.testing.assert_allclose(
+        np.asarray(params["backbone"]["conv1"]["w"]).transpose(3, 2, 0, 1),
+        sd["conv1.weight"], rtol=1e-6)
+
+
+def test_random_pretrained_trunk_warns():
+    import warnings
+    import jax
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models.ncnet import init_ncnet
+
+    cfg = ModelConfig(backbone="resnet101", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        init_ncnet(cfg, jax.random.key(0))
+    assert any("RANDOM weights" in str(x.message) for x in w)
