@@ -73,6 +73,10 @@ class TrainConfig:
     # fixed subset each epoch.  A documented deviation: the reference scores
     # all pairs (but shuffles val, so its per-epoch val sets differ anyway).
     val_drop_last: bool = True
+    distributed: bool = False            # jax.distributed multi-host init +
+                                         # per-host input sharding
+    profile_dir: str = ""                # capture a jax profiler trace here
+                                         # (also honours $NCNET_TPU_PROFILE_DIR)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -168,10 +168,13 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         return ok
 
     def matcher(src: np.ndarray, tgt: np.ndarray):
-        xa, ya, xb, yb, score = jitted(
-            params, jnp.asarray(src), jnp.asarray(tgt),
-            sharded=can_shard(tgt.shape),
-        )
+        from ncnet_tpu.utils.profiling import annotate
+
+        with annotate("inloc_pair_matcher"):
+            xa, ya, xb, yb, score = jitted(
+                params, jnp.asarray(src), jnp.asarray(tgt),
+                sharded=can_shard(tgt.shape),
+            )
         return tuple(np.asarray(v, dtype=np.float32).ravel()
                      for v in (xa, ya, xb, yb, score))
 

@@ -19,6 +19,7 @@ from ncnet_tpu.data import DataLoader, PFPascalDataset
 from ncnet_tpu.evaluation.pck import pck_metric
 from ncnet_tpu.models import NCNet
 from ncnet_tpu.ops import corr_to_matches
+from ncnet_tpu.utils.profiling import annotate
 
 
 def make_eval_step(net: NCNet, alpha: float):
@@ -29,7 +30,13 @@ def make_eval_step(net: NCNet, alpha: float):
         matches = corr_to_matches(out.corr, do_softmax=True)
         return pck_metric(batch, matches, alpha)
 
-    return jax.jit(step)
+    jitted = jax.jit(step)
+
+    def annotated(params, batch):
+        with annotate("pf_pascal_eval_step"):
+            return jitted(params, batch)
+
+    return annotated
 
 
 def run_eval(

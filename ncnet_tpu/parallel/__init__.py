@@ -10,6 +10,7 @@ from ncnet_tpu.parallel.mesh import (
     shard_batch,
     volume_sharding,
 )
+from ncnet_tpu.parallel.distributed import host_shard, initialize_distributed
 from ncnet_tpu.parallel.spatial import (
     spatial_correlation,
     spatial_filter,
@@ -20,6 +21,8 @@ __all__ = [
     "DATA_AXIS",
     "SPATIAL_AXIS",
     "batch_sharding",
+    "host_shard",
+    "initialize_distributed",
     "make_mesh",
     "replicate",
     "replicated",

@@ -1,0 +1,56 @@
+"""Multi-host initialization: ``jax.distributed`` over ICI/DCN.
+
+The reference has no distributed backend at all (SURVEY §5.8 — no NCCL/MPI
+anywhere); this is the TPU-native equivalent: one ``jax.distributed``
+initialization per process, after which ``jax.devices()`` spans every host,
+the global mesh covers the pod slice, and XLA routes collectives over
+ICI within a slice / DCN across slices.
+
+Usage (behind flags — single-host runs never touch this):
+
+    from ncnet_tpu.parallel import initialize_distributed, host_shard
+    initialize_distributed()            # env-driven (TPU pods auto-detect)
+    loader = DataLoader(..., **host_shard())   # per-host input sharding
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the jax distributed runtime (idempotent).
+
+    With no arguments, jax auto-detects the topology from the TPU pod
+    environment; the explicit arguments serve CPU/GPU fleets or tests.
+    """
+    global _initialized
+    if _initialized:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def host_shard() -> Dict[str, int]:
+    """This process's slice of the input pipeline:
+    ``DataLoader(..., **host_shard())`` gives each host a disjoint shard of
+    every (globally-seeded, identically-shuffled) epoch."""
+    return {
+        "num_shards": jax.process_count(),
+        "shard_index": jax.process_index(),
+    }

@@ -38,6 +38,7 @@ from ncnet_tpu.models import backbone as bb
 from ncnet_tpu.models import checkpoint as ckpt_io
 from ncnet_tpu.models.ncnet import init_ncnet
 from ncnet_tpu.training.loss import weak_loss
+from ncnet_tpu.utils.profiling import annotate, maybe_trace
 
 
 class TrainState(NamedTuple):
@@ -148,10 +149,11 @@ def process_epoch(
             "source_image": put_batch(batch["source_image"]),
             "target_image": put_batch(batch["target_image"]),
         }
-        if mode == "train":
-            state, loss = step_fn(state, images)
-        else:
-            loss = step_fn(state.params, images)
+        with annotate(f"{mode}_step"):
+            if mode == "train":
+                state, loss = step_fn(state, images)
+            else:
+                loss = step_fn(state.params, images)
         losses.append(loss)
         if batch_idx % log_interval == 0:
             print(
@@ -257,6 +259,16 @@ def load_train_checkpoint(path: str, state_like: TrainState):
 def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
     """Train per the reference recipe: epochs over train_pairs.csv, val loss
     on val_pairs.csv each epoch, checkpoint every epoch + best copy."""
+    shard_kwargs = {}
+    if config.distributed:
+        from ncnet_tpu.parallel import host_shard, initialize_distributed
+
+        initialize_distributed()
+        shard_kwargs = host_shard()
+        if progress:
+            print(f"Distributed: process {shard_kwargs['shard_index']} of "
+                  f"{shard_kwargs['num_shards']}")
+
     state, optimizer, model_config, labels = create_train_state(config)
 
     # resume: a checkpoint directory written by fit() carries opt/ — restore
@@ -321,6 +333,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         ),
         batch_size=config.batch_size, shuffle=True,
         num_workers=config.num_workers, seed=config.seed, drop_last=True,
+        **shard_kwargs,
     )
     # val: no shuffle — with drop_last (config.val_drop_last), a shuffle
     # would drop a DIFFERENT random subset each epoch, making the
@@ -333,6 +346,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         batch_size=config.batch_size, shuffle=False,
         num_workers=config.eval_num_workers, seed=config.seed,
         drop_last=config.val_drop_last,
+        **shard_kwargs,
     )
 
     ckpt_name = os.path.join(
@@ -354,10 +368,13 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
     for epoch in range(start_epoch + 1, config.num_epochs + 1):
         train_loader.set_epoch(epoch)
         val_loader.set_epoch(epoch)
-        state, train_loss[epoch - 1] = process_epoch(
-            "train", epoch, state, train_step, train_loader,
-            config.log_interval, put_batch,
-        )
+        # trace only the first post-resume epoch: a bounded, representative
+        # capture (compile + steady-state steps) instead of a runaway file
+        with maybe_trace(config.profile_dir, enabled=epoch == start_epoch + 1):
+            state, train_loss[epoch - 1] = process_epoch(
+                "train", epoch, state, train_step, train_loader,
+                config.log_interval, put_batch,
+            )
         _, test_loss[epoch - 1] = process_epoch(
             "test", epoch, state, eval_step, val_loader,
             config.log_interval, put_batch,

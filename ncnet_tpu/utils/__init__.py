@@ -1,0 +1,6 @@
+"""Utilities: seeding, profiling."""
+
+from ncnet_tpu.utils.profiling import annotate, maybe_trace
+from ncnet_tpu.utils.seeding import global_seed, worker_rng
+
+__all__ = ["annotate", "maybe_trace", "global_seed", "worker_rng"]

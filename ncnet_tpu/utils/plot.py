@@ -1,0 +1,47 @@
+"""Plot helpers: de-normalized image display + marginless figure saving.
+
+Parity with the reference's ``lib/plot.py`` (plot_image :6-19, save_plot
+:21-29), channels-last and matplotlib-Agg-safe for headless use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ncnet_tpu.ops.image import IMAGENET_MEAN, IMAGENET_STD
+
+
+def denormalize_image(image: np.ndarray) -> np.ndarray:
+    """Invert ImageNet normalization → [0,1] float image (H, W, 3)."""
+    img = np.asarray(image)
+    if img.ndim == 4:
+        img = img[0]
+    return np.clip(img * IMAGENET_STD + IMAGENET_MEAN, 0.0, 1.0)
+
+
+def plot_image(image, return_im: bool = False, ax=None):
+    """De-normalize and imshow (reference plot_image, lib/plot.py:6-19).
+
+    ``image``: (H, W, 3) or (1, H, W, 3) ImageNet-normalized array.
+    ``return_im=True`` returns the displayable array without plotting.
+    """
+    im = denormalize_image(image)
+    if return_im:
+        return im
+    import matplotlib.pyplot as plt
+
+    ax = ax or plt.gca()
+    ax.imshow(im)
+    ax.set_axis_off()
+    return ax
+
+
+def save_plot(filename: str, fig=None) -> None:
+    """Save the current figure without margins (lib/plot.py:21-29)."""
+    import matplotlib.pyplot as plt
+
+    fig = fig or plt.gcf()
+    fig.subplots_adjust(left=0, right=1, top=1, bottom=0)
+    for ax in fig.axes:
+        ax.set_axis_off()
+    fig.savefig(filename, bbox_inches="tight", pad_inches=0)

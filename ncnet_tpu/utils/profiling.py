@@ -1,0 +1,49 @@
+"""Profiling hooks: trace annotations + on-demand profiler capture.
+
+The reference has no tracing at all (SURVEY §5.1 — print() only); this is new
+TPU-native surface.  Two layers:
+
+  * :func:`annotate` — a ``jax.profiler.TraceAnnotation`` context manager
+    used around the train/eval steps and the eval forward, so xprof/
+    TensorBoard traces show framework-level phases, not just XLA ops.
+  * :func:`maybe_trace` — capture a profiler trace for a whole block when a
+    directory is given (or the ``NCNET_TPU_PROFILE_DIR`` env var is set);
+    no-ops otherwise, so production paths carry zero overhead.
+
+View captures with TensorBoard's profile plugin or xprof
+(``tensorboard --logdir <dir>``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+PROFILE_DIR_ENV = "NCNET_TPU_PROFILE_DIR"
+
+
+def annotate(name: str):
+    """Named region in the device trace (cheap; always on)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def maybe_trace(
+    log_dir: Optional[str] = None, enabled: bool = True
+) -> Iterator[bool]:
+    """Capture a jax profiler trace into ``log_dir`` (or $NCNET_TPU_PROFILE_DIR)
+    for the duration of the block; yields whether tracing is active.
+    ``enabled=False`` forces a no-op regardless of the env var (callers use it
+    to bound the capture to one representative phase)."""
+    log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV) or None
+    if not log_dir or not enabled:
+        yield False
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield True
+    finally:
+        jax.profiler.stop_trace()

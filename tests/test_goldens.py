@@ -1,0 +1,76 @@
+"""Frozen-weight activation goldens: cross-round numerical drift detection.
+
+The released reference checkpoints are unreachable (zero egress), so these
+are *self-goldens* recorded by tools/make_goldens.py: deterministic weights +
+fixed inputs → stored outputs.  A failure here means the numerics of the
+backbone / correlation / mutual-matching / conv4d / match-extraction stack
+changed since the golden was recorded — either fix the regression or, if the
+change is intentional, regenerate via ``python tools/make_goldens.py`` and
+say so in the commit message (SURVEY §4 "Golden").
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.models.ncnet import extract_features, ncnet_forward
+from ncnet_tpu.ops import corr_to_matches
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "goldens", "activations.npz")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not os.path.exists(GOLDEN):
+        pytest.skip("goldens not generated (run tools/make_goldens.py)")
+    g = np.load(GOLDEN)
+    # assert_allclose treats NaN==NaN as equal; a NaN golden would wave
+    # everything through, so reject it outright
+    bad = [k for k in g.files if not np.isfinite(g[k]).all()]
+    assert not bad, f"golden arrays contain non-finite values: {bad}"
+    return g
+
+
+def _params(cfg):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from make_goldens import deterministic_params
+
+    return deterministic_params(cfg)
+
+
+def test_tiny_forward_matches_golden(golden):
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3, 3),
+                      ncons_channels=(8, 1), relocalization_k_size=2)
+    params = _params(cfg)
+    out = ncnet_forward(cfg, params, jnp.asarray(golden["tiny_src"]),
+                        jnp.asarray(golden["tiny_tgt"]))
+    np.testing.assert_allclose(np.asarray(out.corr), golden["tiny_corr"],
+                               rtol=1e-5, atol=1e-6)
+    for i, d in enumerate(out.delta4d):
+        np.testing.assert_array_equal(np.asarray(d), golden[f"tiny_delta{i}"])
+    m = corr_to_matches(out.corr, delta4d=out.delta4d, k_size=2,
+                        do_softmax=True, scale="positive")
+    got = np.stack([np.asarray(v) for v in (m.xA, m.yA, m.xB, m.yB, m.score)])
+    np.testing.assert_allclose(got, golden["tiny_matches"], rtol=1e-5, atol=1e-6)
+
+
+def test_resnet_features_match_golden(golden):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # deterministic random trunk
+        cfg = ModelConfig(backbone="resnet101", ncons_kernel_sizes=(3,),
+                          ncons_channels=(1,))
+        params = _params(cfg)
+    feats = np.asarray(
+        extract_features(cfg, params, jnp.asarray(golden["resnet_img"]))
+    )
+    np.testing.assert_allclose(feats.mean(axis=-1), golden["resnet_feat_mean"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(feats[0, :, :, :8], golden["resnet_feat_slice"],
+                               rtol=1e-4, atol=1e-5)

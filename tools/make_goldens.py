@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""(Re)generate the frozen-weight activation goldens in tests/goldens/.
+
+The released reference weights are unreachable in this environment (zero
+egress — datasets/trained_models download URLs resolve nowhere), so drift
+detection uses *self-goldens*: fixed deterministic weights + fixed inputs →
+recorded outputs.  Any change to backbone/conv4d/correlation/mutual-matching
+numerics across commits shows up as a golden mismatch (SURVEY §4 "Golden").
+
+Run from the repo root ON CPU (the CI platform):
+    JAX_PLATFORM_NAME=cpu python tools/make_goldens.py
+Regenerate ONLY when a numerics change is intended, and say so in the commit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def deterministic_params(cfg):
+    """Params from a numpy RNG (stable across jax versions, unlike jax PRNG)."""
+    import jax
+    from ncnet_tpu.models.ncnet import init_ncnet
+
+    shapes = jax.eval_shape(lambda: init_ncnet(cfg, jax.random.key(0)))
+    rng = np.random.default_rng(1234)
+
+    def fill(path, leaf):
+        vals = (rng.standard_normal(leaf.shape) * 0.05).astype(leaf.dtype)
+        # BN running variance must stay positive or sqrt(var + eps) NaNs out
+        if any(getattr(p, "key", None) == "var" for p in path):
+            vals = np.abs(vals) + 0.1
+        return vals
+
+    return jax.tree_util.tree_map_with_path(fill, shapes)
+
+
+def main():
+    import warnings
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models.ncnet import ncnet_forward
+    from ncnet_tpu.ops import corr_to_matches
+
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "goldens")
+    os.makedirs(out_dir, exist_ok=True)
+
+    rng = np.random.default_rng(7)
+    record = {}
+
+    # 1. full forward, tiny trunk, rectangular pair, relocalization k=2
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3, 3),
+                      ncons_channels=(8, 1), relocalization_k_size=2)
+    params = deterministic_params(cfg)
+    src = rng.uniform(-1, 1, (1, 64, 96, 3)).astype(np.float32)
+    tgt = rng.uniform(-1, 1, (1, 96, 64, 3)).astype(np.float32)
+    out = ncnet_forward(cfg, params, jnp.asarray(src), jnp.asarray(tgt))
+    record["tiny_src"] = src
+    record["tiny_tgt"] = tgt
+    record["tiny_corr"] = np.asarray(out.corr)
+    for i, d in enumerate(out.delta4d):
+        record[f"tiny_delta{i}"] = np.asarray(d)
+    m = corr_to_matches(out.corr, delta4d=out.delta4d, k_size=2,
+                        do_softmax=True, scale="positive")
+    record["tiny_matches"] = np.stack(
+        [np.asarray(v) for v in (m.xA, m.yA, m.xB, m.yB, m.score)])
+
+    # 2. resnet101 trunk features (random but deterministic weights):
+    #    catches drift in the conv/BN/L2-norm stack
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # intentional random trunk
+        cfg_r = ModelConfig(backbone="resnet101", ncons_kernel_sizes=(3,),
+                            ncons_channels=(1,))
+        params_r = deterministic_params(cfg_r)
+    from ncnet_tpu.models.ncnet import extract_features
+
+    img = rng.uniform(-1, 1, (1, 96, 96, 3)).astype(np.float32)
+    feats = np.asarray(extract_features(cfg_r, params_r, jnp.asarray(img)))
+    record["resnet_img"] = img
+    record["resnet_feat_mean"] = feats.mean(axis=-1)        # (1, 6, 6)
+    record["resnet_feat_slice"] = feats[0, :, :, :8]        # (6, 6, 8)
+
+    path = os.path.join(out_dir, "activations.npz")
+    np.savez_compressed(path, **record)
+    print(f"wrote {path} ({os.path.getsize(path) / 1024:.0f} KiB)")
+
+
+if __name__ == "__main__":
+    main()
