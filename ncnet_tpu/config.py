@@ -24,7 +24,7 @@ class ModelConfig:
     from /root/reference/train.py:42-43 left to the train config.
     """
 
-    backbone: str = "resnet101"          # 'resnet101' | 'vgg' | identity variants for tests
+    backbone: str = "resnet101"          # 'resnet101' | 'vgg' | 'densenet201' | 'tiny'
     backbone_last_layer: str = ""        # '' → layer3 (resnet) / pool4 (vgg)
     ncons_kernel_sizes: Sequence[int] = (3, 3, 3)
     ncons_channels: Sequence[int] = (10, 10, 1)

@@ -89,8 +89,15 @@ VGG16_PLAN = (
     512, 512, 512, -1, 512, 512, 512, -1,
 )
 
-OUTPUT_CHANNELS = {"resnet101": 1024, "vgg": 512, "tiny": 32}
-OUTPUT_STRIDE = {"resnet101": 16, "vgg": 16, "tiny": 16}
+# DenseNet-201 (reference cut: features[:-4] ⇒ conv0..transition2 inclusive,
+# /root/reference/lib/model.py:69-74): growth 32, bn_size 4; only the first
+# two dense blocks fall inside the cut.
+DENSENET201_BLOCKS = {"denseblock1": 6, "denseblock2": 12}
+DENSENET_GROWTH = 32
+DENSENET_BN_SIZE = 4
+
+OUTPUT_CHANNELS = {"resnet101": 1024, "vgg": 512, "tiny": 32, "densenet201": 256}
+OUTPUT_STRIDE = {"resnet101": 16, "vgg": 16, "tiny": 16, "densenet201": 16}
 
 
 # ---------------------------------------------------------------------------
@@ -125,6 +132,16 @@ def _maxpool(x, window=3, stride=2, padding=1):
         window_strides=(1, stride, stride, 1),
         padding=((0, 0), (padding, padding), (padding, padding), (0, 0)),
     )
+
+
+def _avgpool2(x):
+    """torch AvgPool2d(2, 2) (the DenseNet transition pool)."""
+    return lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding="VALID",
+    ) / 4.0
 
 
 # ---------------------------------------------------------------------------
@@ -198,6 +215,52 @@ def init_vgg16(key: jax.Array, dtype=jnp.float32, last_layer: str = "") -> Dict[
     return {"convs": convs}
 
 
+def _densenet_channel_plan():
+    """Yields (block_name, n_layers, c_in_of_block) under the reference cut;
+    transitions halve channels."""
+    c = 64
+    plan = []
+    for name, n in DENSENET201_BLOCKS.items():
+        plan.append((name, n, c))
+        c = (c + n * DENSENET_GROWTH) // 2  # transition conv halves
+    return plan, c
+
+
+def init_densenet201(
+    key: jax.Array, dtype=jnp.float32, last_layer: str = ""
+) -> Dict[str, Any]:
+    """Random-init DenseNet-201 trunk (conv0..transition2, torchvision
+    layout).  ``last_layer`` must be '' or 'transition2' — the reference
+    offers no other cut (model.py:69-74)."""
+    if last_layer not in ("", "transition2"):
+        raise ValueError(
+            f"unsupported densenet201 cut {last_layer!r}; only 'transition2'"
+        )
+    keys = iter(jax.random.split(key, 64))
+    params: Dict[str, Any] = {
+        "conv0": {"w": _he_conv(next(keys), 7, 7, 3, 64, dtype)},
+        "norm0": _bn_init(64, dtype),
+    }
+    plan, _ = _densenet_channel_plan()
+    for bi, (name, n_layers, c) in enumerate(plan, start=1):
+        layers = []
+        for _i in range(n_layers):
+            mid = DENSENET_BN_SIZE * DENSENET_GROWTH
+            layers.append({
+                "norm1": _bn_init(c, dtype),
+                "conv1": {"w": _he_conv(next(keys), 1, 1, c, mid, dtype)},
+                "norm2": _bn_init(mid, dtype),
+                "conv2": {"w": _he_conv(next(keys), 3, 3, mid, DENSENET_GROWTH, dtype)},
+            })
+            c += DENSENET_GROWTH
+        params[name] = layers
+        params[f"transition{bi}"] = {
+            "norm": _bn_init(c, dtype),
+            "conv": {"w": _he_conv(next(keys), 1, 1, c, c // 2, dtype)},
+        }
+    return params
+
+
 def init_tiny(key: jax.Array, dtype=jnp.float32, last_layer: str = "") -> Dict[str, Any]:
     """Tiny 2-conv stride-16 trunk for tests/dry-runs (no reference analog)."""
     k1, k2 = jax.random.split(key)
@@ -251,6 +314,28 @@ def vgg16_features(
     return x
 
 
+def densenet201_features(
+    params: Dict[str, Any], images: jnp.ndarray, last_layer: str = ""
+) -> jnp.ndarray:
+    """``(B, H, W, 3)`` → ``(B, H/16, W/16, 256)`` at the reference's
+    transition2 cut (torchvision DenseNet: each dense layer concatenates its
+    32 new features onto the running stack)."""
+    x = jax.nn.relu(
+        _bn(_conv(images, params["conv0"]["w"], stride=2, padding=3), params["norm0"])
+    )
+    x = _maxpool(x)
+    for bi, name in enumerate(DENSENET201_BLOCKS, start=1):
+        for layer in params[name]:
+            y = jax.nn.relu(_bn(x, layer["norm1"]))
+            y = _conv(y, layer["conv1"]["w"])
+            y = jax.nn.relu(_bn(y, layer["norm2"]))
+            y = _conv(y, layer["conv2"]["w"], padding=1)
+            x = jnp.concatenate([x, y], axis=-1)
+        tr = params[f"transition{bi}"]
+        x = _avgpool2(_conv(jax.nn.relu(_bn(x, tr["norm"])), tr["conv"]["w"]))
+    return x
+
+
 def tiny_features(
     params: Dict[str, Any], images: jnp.ndarray, last_layer: str = ""
 ) -> jnp.ndarray:
@@ -258,8 +343,18 @@ def tiny_features(
     return jax.nn.relu(_conv(x, params["conv2"]["w"], stride=4, padding=2) + params["conv2"]["b"])
 
 
-_INITS = {"resnet101": init_resnet101, "vgg": init_vgg16, "tiny": init_tiny}
-_APPLYS = {"resnet101": resnet101_features, "vgg": vgg16_features, "tiny": tiny_features}
+_INITS = {
+    "resnet101": init_resnet101,
+    "vgg": init_vgg16,
+    "tiny": init_tiny,
+    "densenet201": init_densenet201,
+}
+_APPLYS = {
+    "resnet101": resnet101_features,
+    "vgg": vgg16_features,
+    "tiny": tiny_features,
+    "densenet201": densenet201_features,
+}
 
 
 def backbone_init(name: str, key: jax.Array, dtype=jnp.float32, last_layer: str = ""):
@@ -312,6 +407,19 @@ def finetune_labels(name: str, params, n_finetune_blocks: int):
     elif name == "vgg":
         for i in range(len(params["convs"]))[-n_finetune_blocks:]:
             labels["convs"][i] = _unfreeze(labels["convs"][i])
+    elif name == "densenet201":
+        # deepest-last unit order: transition2, then denseblock2's layers
+        # (the reference's model[-1][-(i+1)] indexes sub-children of the last
+        # Sequential child; the dense-layer granularity is the useful analog)
+        units = [("transition2", None)] + [
+            ("denseblock2", i)
+            for i in reversed(range(len(params["denseblock2"])))
+        ]
+        for name_, i in units[:n_finetune_blocks]:
+            if i is None:
+                labels[name_] = _unfreeze(labels[name_])
+            else:
+                labels[name_][i] = _unfreeze(labels[name_][i])
     else:  # tiny: the whole (non-pretrained) trunk trains
         labels = _unfreeze(params)
     return labels
@@ -403,5 +511,27 @@ def import_torch_backbone(
                 }
             )
         return {"convs": convs}
+
+    if name == "densenet201":
+        params = {
+            "conv0": {"w": _t2j_conv(sd["conv0.weight"])},
+            "norm0": _t2j_bn(sd, "norm0"),
+        }
+        for bi, (bname, n_layers) in enumerate(DENSENET201_BLOCKS.items(), start=1):
+            layers = []
+            for i in range(1, n_layers + 1):
+                p = f"{bname}.denselayer{i}"
+                layers.append({
+                    "norm1": _t2j_bn(sd, f"{p}.norm1"),
+                    "conv1": {"w": _t2j_conv(sd[f"{p}.conv1.weight"])},
+                    "norm2": _t2j_bn(sd, f"{p}.norm2"),
+                    "conv2": {"w": _t2j_conv(sd[f"{p}.conv2.weight"])},
+                })
+            params[bname] = layers
+            params[f"transition{bi}"] = {
+                "norm": _t2j_bn(sd, f"transition{bi}.norm"),
+                "conv": {"w": _t2j_conv(sd[f"transition{bi}.conv.weight"])},
+            }
+        return params
 
     raise ValueError(f"no torch importer for backbone {name!r}")

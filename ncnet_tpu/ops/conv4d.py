@@ -7,29 +7,41 @@ decomposition becomes whole-volume ``lax.conv_general_dilated`` programs, with
 three MXU-aware formulations selected per layer (measured on TPU v5e at the
 PF-Pascal 25⁴ workload):
 
-  * ``unroll``   — statically-unrolled sum of kA 3D convs over shifted views;
-                   the balanced default for fat in/out channels.
+  * ``unroll``   — statically-unrolled sum of kA 3D convs over shifted views.
   * ``tapfold``  — folds the kA taps into *input* channels (one 3D conv with
                    kA·C_in inputs); wins when C_in is tiny (the 1-channel
                    first NC layer), where the plain conv's reduction dim
                    underfills the MXU.
   * ``coutfold`` — folds the kA taps into *output* channels (one 3D conv
-                   producing kA·C_out channels + a cheap shifted sum); ~2.6×
-                   faster when C_out is tiny (the 1-channel last NC layer),
-                   where 128-wide MXU output lanes would sit 99% idle.
+                   producing kA·C_out channels + a cheap shifted sum); the
+                   best conv formulation for the fat 16→16 middle layer,
+                   where plain convs leave 112 of 128 MXU output lanes idle.
+  * ``toeplitz_b`` — expresses the whole B-side (kB,kWB) stencil as a dense
+                   banded matrix over the flattened hB·wB lane dim, turning
+                   the layer into kA·kWA big matmuls of shape
+                   (B·hA·wA, C_in·hB·wB) × (C_in·hB·wB, hB·wB·C_out).  This
+                   spends kB·kWB× the true FLOPs but runs at near-peak MXU
+                   utilization, which is the only way to make a 1-output-
+                   channel layer (the last NC layer: 1 of 128 lanes useful
+                   in any conv formulation) fast.  Only viable while the
+                   (hB·wB)² mask fits comfortably (PF-Pascal's 625², not
+                   InLoc's 7500²) — ``auto`` gates on that.
 
-``variant='auto'`` picks per-layer by channel shape.  All variants share the
-reference's semantics: cross-correlation (like torch convNd), "same" zero
+``variant='auto'`` picks per-layer by channel shape (measured on TPU v5e at
+the PF-Pascal 25⁴ workload with device-side scan timing).  All variants share
+the reference's semantics: cross-correlation (like torch convNd), "same" zero
 padding of ``k//2`` per spatial dim, stride/dilation/groups fixed at 1 —
 exactly the envelope the reference supports (conv4d.py:59-62).
 """
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -69,8 +81,11 @@ def _conv4d_unroll(x, weight, *, precision, pad_ha, pad_hb):
     return out.reshape(b, ha, wa, hb_out, wb, c_out)
 
 
-def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb):
-    """One 3D conv with the kA taps folded into input channels."""
+def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb, out_cn=False):
+    """One 3D conv with the kA taps folded into input channels.
+
+    ``out_cn=True`` emits the CN seam format ``(B, hA, wA, C_out, hB·wB)``
+    (see ``_conv4d_coutfold``)."""
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     if pad_ha:
@@ -83,7 +98,10 @@ def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb):
     wf = jnp.transpose(weight, (1, 2, 3, 0, 4, 5)).reshape(
         kwa, kb, kwb, ka * c_in, c_out
     )
-    dn = _dn3((b * ha, wa, hb, wb, ka * c_in), wf.shape)
+    dn = lax.conv_dimension_numbers(
+        (b * ha, wa, hb, wb, ka * c_in), wf.shape,
+        ("NDHWC", "DHWIO", "NDCHW" if out_cn else "NDHWC"),
+    )
     o = lax.conv_general_dilated(
         shifts.reshape(b * ha, wa, hb, wb, ka * c_in),
         wf,
@@ -92,18 +110,31 @@ def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb):
         dimension_numbers=dn,
         precision=precision,
     )
+    if out_cn:
+        return o.reshape(b, ha, wa, c_out, hb_out * wb)
     return o.reshape(b, ha, wa, hb_out, wb, c_out)
 
 
-def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
-    """One 3D conv producing kA·C_out channels + shifted sum over hA."""
+def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb, out_cn=False):
+    """One 3D conv producing kA·C_out channels + shifted sum over hA.
+
+    ``out_cn=True`` returns the "CN" seam format ``(B, hA, wA, C_out, hB·wB)``
+    instead of the volume: the conv is asked for channels *ahead of* the B
+    dims (``NDCHW`` output spec), so channels land on the sublane dim (16 =
+    exact) and hB·wB on the lane dim (625→640) — ~1× padding, where the
+    volume form's 16-wide minor dim pads 8× and costs ~20ms of relayout per
+    layer at the PF-Pascal workload when the next layer is a toeplitz matmul.
+    """
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     hb_out = hb if pad_hb else hb - (kb - 1)
     wf = jnp.transpose(weight, (1, 2, 3, 4, 0, 5)).reshape(
         kwa, kb, kwb, c_in, ka * c_out
     )
-    dn = _dn3((b * ha_in, wa, hb, wb, c_in), wf.shape)
+    dn = lax.conv_dimension_numbers(
+        (b * ha_in, wa, hb, wb, c_in), wf.shape,
+        ("NDHWC", "DHWIO", "NDCHW" if out_cn else "NDHWC"),
+    )
     y = lax.conv_general_dilated(
         x.reshape(b * ha_in, wa, hb, wb, c_in),
         wf,
@@ -112,23 +143,110 @@ def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
         dimension_numbers=dn,
         precision=precision,
     )
-    y = y.reshape(b, ha_in, wa, hb_out, wb, ka, c_out)
-    # out[i] = Σ_p y[i + p − (pad: ka//2 / valid: 0), …, tap p]
+    # out[i] = Σ_p y[i + p − (pad: ka//2 / valid: 0), …, tap-p channel block].
+    # The tap is selected by slicing the fused (ka·C_out) channel dim —
+    # splitting it into a (…, ka, C_out) axis pair makes XLA materialize a
+    # relayout of the whole volume (~30ms at the PF-Pascal workload).
+    if out_cn:
+        y = y.reshape(b, ha_in, wa, ka * c_out, hb_out * wb)
+        if pad_ha:
+            y = jnp.pad(y, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 3)
+        ha = y.shape[1] - (ka - 1)
+        out = None
+        for p in range(ka):
+            o = lax.slice_in_dim(y, p, p + ha, axis=1)[
+                :, :, :, p * c_out:(p + 1) * c_out, :
+            ]
+            out = o if out is None else out + o
+        return out
+    y = y.reshape(b, ha_in, wa, hb_out, wb, ka * c_out)
     if pad_ha:
-        y = jnp.pad(y, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 5)
+        y = jnp.pad(y, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 4)
     ha = y.shape[1] - (ka - 1)
     out = None
     for p in range(ka):
-        o = lax.slice_in_dim(y, p, p + ha, axis=1)[..., p, :]
+        o = lax.slice_in_dim(y, p, p + ha, axis=1)[..., p * c_out:(p + 1) * c_out]
         out = o if out is None else out + o
     return out
+
+
+@functools.lru_cache(maxsize=32)
+def _shift_masks(hb_in: int, wb_in: int, hb_out: int, wb_out: int,
+                 kb: int, kwb: int, pad_hb: bool):
+    """One-hot banded shift masks ``(kB·kWB, hb_in·wb_in, hb_out·wb_out)``:
+    ``M[(r,s), n_src, n_out] = 1`` iff source cell ``n_src`` sits at stencil
+    offset ``(r,s)`` of output cell ``n_out`` (zero padding ⇒ missing rows)."""
+    ms = []
+    for r in range(kb):
+        for s in range(kwb):
+            sh = np.eye(hb_in, hb_out, k=(kb // 2 if pad_hb else 0) - r)
+            sw = np.eye(wb_in, wb_out, k=kwb // 2 - s)
+            ms.append(np.kron(sh, sw))
+    return np.stack(ms).astype(np.float32)
+
+
+def _conv4d_toeplitz_b(x, weight, *, precision, pad_ha, pad_hb, cn_dims=None):
+    """kA·kWA shifted matmuls against a dense banded B-stencil matrix.
+
+    ``cn_dims=(hb, wb)`` takes the "CN" seam format
+    ``(B, hA, wA, C_in, hB·wB)`` (what ``_conv4d_coutfold(out_cn=True)``
+    emits); the matmul's K dim is then ordered ``(c, n_src)`` and the volume
+    feeds in as a pure reshape.  Default takes the 6D volume.
+    """
+    if cn_dims is not None:
+        b, ha_in, wa, c_in, _ = x.shape
+        hb, wb = cn_dims
+    else:
+        b, ha_in, wa, hb, wb, c_in = x.shape
+    ka, kwa, kb, kwb, _, c_out = weight.shape
+    hb_out = hb if pad_hb else hb - (kb - 1)
+    n_in, n_out = hb * wb, hb_out * wb
+    masks = jnp.asarray(
+        _shift_masks(hb, wb, hb_out, wb, kb, kwb, pad_hb), dtype=weight.dtype
+    )
+    wv = weight.reshape(ka, kwa, kb * kwb, c_in, c_out)
+    # T[p, q, K, (n_out, c_out)] — K ordered to match the input flattening:
+    # (n_src, c_in) for the 6D volume (pure minor-dims reshape), (c_in, n_src)
+    # for the CN seam.  Either avoids a ~10ms whole-volume transpose.
+    if cn_dims is not None:
+        t = jnp.einsum("pquio,unm->pqinmo", wv, masks, precision=precision)
+    else:
+        t = jnp.einsum("pquio,unm->pqnimo", wv, masks, precision=precision)
+    t = t.reshape(ka, kwa, n_in * c_in, n_out * c_out)
+    xf = x.reshape(b, ha_in, wa, n_in * c_in)
+    if pad_ha:
+        xf = jnp.pad(xf, ((0, 0), (ka // 2,) * 2, (0, 0), (0, 0)))
+    xf = jnp.pad(xf, ((0, 0), (0, 0), (kwa // 2,) * 2, (0, 0)))
+    ha = xf.shape[1] - (ka - 1)
+    out = None
+    for p in range(ka):
+        for q in range(kwa):
+            xs = xf[:, p:p + ha, q:q + wa, :]
+            o = jnp.einsum("bijk,kn->bijn", xs, t[p, q], precision=precision)
+            out = o if out is None else out + o
+    return out.reshape(b, ha, wa, hb_out, wb, c_out)
 
 
 _VARIANTS = {
     "unroll": _conv4d_unroll,
     "tapfold": _conv4d_tapfold,
     "coutfold": _conv4d_coutfold,
+    "toeplitz_b": _conv4d_toeplitz_b,
 }
+
+
+def choose_conv4d_variant(c_in: int, c_out: int, hb: int, wb: int) -> str:
+    """Per-layer formulation choice, measured on v5e (25⁴ volume, device-side
+    scan timing): tapfold 3.3ms for 1→16, coutfold 24ms for 16→16 (unroll 35,
+    tapfold 61), toeplitz_b 28ms for 16→1 (coutfold 76, unroll 308 — a
+    1-output-channel conv uses 1 of 128 MXU lanes)."""
+    if c_in <= 4:
+        return "tapfold"
+    if c_out <= 4 and hb * wb <= 1300:
+        # the dense B-stencil masks are (kB·kWB)·(hB·wB)² — fine at
+        # PF-Pascal's 625² (~40MB), ruinous at InLoc's 7500²
+        return "toeplitz_b"
+    return "coutfold"
 
 
 def conv4d(
@@ -140,38 +258,60 @@ def conv4d(
     pad_ha: bool = True,
     pad_hb: bool = True,
     variant: str = "auto",
+    out_cn: bool = False,
+    in_cn_dims: tuple | None = None,
 ) -> jnp.ndarray:
     """4D convolution over the correlation volume ("same" by default).
 
     Args:
-      x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume.
+      x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume — or, with
+        ``in_cn_dims``, the CN seam format ``(B, hA, wA, C_in, hB·wB)``.
       weight: ``(kA, kWA, kB, kWB, C_in, C_out)``.
       bias:   ``(C_out,)`` or None.
       pad_ha / pad_hb: when False, the hA / hB dim is treated as *valid* —
         the caller already padded it (the spatially-sharded path pre-pads
         with halo slabs exchanged between shards, parallel/spatial.py) and
         the output is ``k//2`` smaller on each side of that dim.
-      variant: 'auto' (per-layer MXU heuristic), or an explicit formulation
-        from 'unroll' / 'tapfold' / 'coutfold' (see module docstring).  All
-        variants are numerically equivalent up to fp32 reassociation.
+      variant: 'auto' (per-layer MXU heuristic, `choose_conv4d_variant`), or
+        an explicit formulation from 'unroll' / 'tapfold' / 'coutfold' /
+        'toeplitz_b' (see module docstring).  All variants are numerically
+        equivalent up to float reassociation.
+      out_cn: emit ``(B, hA', wA, C_out, hB'·wB)`` instead of the volume
+        (coutfold only) — the layout-friendly seam format for feeding a
+        following toeplitz_b layer (16 channels on the sublane dim instead of
+        an 8×-padded minor dim).
+      in_cn_dims: ``(hB, wB)`` when ``x`` is in the CN seam format
+        (toeplitz_b only).
 
     Returns:
-      ``(B, hA', wA, hB', wB, C_out)`` (primed dims shrink iff unpadded).
+      ``(B, hA', wA, hB', wB, C_out)`` (primed dims shrink iff unpadded),
+      or the CN form when ``out_cn``.
     """
     c_in, c_out = weight.shape[4], weight.shape[5]
-    assert x.shape[5] == c_in, f"channel mismatch: {x.shape[5]} vs {c_in}"
+    if in_cn_dims is not None:
+        hb, wb = in_cn_dims
+        assert x.ndim == 5 and x.shape[3] == c_in, (
+            f"CN input mismatch: {x.shape} vs c_in={c_in}"
+        )
+    else:
+        hb, wb = x.shape[3], x.shape[4]
+        assert x.shape[5] == c_in, f"channel mismatch: {x.shape[5]} vs {c_in}"
     if variant == "auto":
-        if c_in <= 4:
-            variant = "tapfold"
-        elif c_out <= 4:
-            variant = "coutfold"
-        else:
-            variant = "unroll"
+        variant = choose_conv4d_variant(c_in, c_out, hb, wb)
+    kwargs = {}
+    if out_cn:
+        assert variant in ("coutfold", "tapfold"), (
+            f"out_cn unsupported for {variant}"
+        )
+        kwargs["out_cn"] = True
+    if in_cn_dims is not None:
+        assert variant == "toeplitz_b", f"in_cn_dims unsupported for {variant}"
+        kwargs["cn_dims"] = in_cn_dims
     out = _VARIANTS[variant](
-        x, weight, precision=precision, pad_ha=pad_ha, pad_hb=pad_hb
+        x, weight, precision=precision, pad_ha=pad_ha, pad_hb=pad_hb, **kwargs
     )
     if bias is not None:
-        out = out + bias
+        out = out + (bias[:, None] if out_cn else bias)
     return out
 
 

@@ -260,14 +260,31 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
     """Train per the reference recipe: epochs over train_pairs.csv, val loss
     on val_pairs.csv each epoch, checkpoint every epoch + best copy."""
     shard_kwargs = {}
+    local_batch = config.batch_size
     if config.distributed:
         from ncnet_tpu.parallel import host_shard, initialize_distributed
 
         initialize_distributed()
         shard_kwargs = host_shard()
+        n_procs = shard_kwargs["num_shards"]
+        if n_procs > 1:
+            if not config.data_parallel:
+                # each host would silently train its own diverging model
+                raise ValueError(
+                    "distributed=True across multiple processes requires "
+                    "data_parallel=True (there is no gradient sync otherwise)"
+                )
+            if config.batch_size % n_procs:
+                raise ValueError(
+                    f"batch_size {config.batch_size} must divide evenly over "
+                    f"{n_procs} processes"
+                )
+            # batch_size stays the reference's GLOBAL batch; each host loads
+            # its slice and the global array is assembled across processes
+            local_batch = config.batch_size // n_procs
         if progress:
             print(f"Distributed: process {shard_kwargs['shard_index']} of "
-                  f"{shard_kwargs['num_shards']}")
+                  f"{n_procs}")
 
     state, optimizer, model_config, labels = create_train_state(config)
 
@@ -315,7 +332,15 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         # committed to device 0 and would otherwise conflict with the mesh
         state = TrainState(*parallel.replicate(mesh, tuple(state)))
         sharding = parallel.batch_sharding(mesh)
-        put_batch = lambda x: jax.device_put(jnp.asarray(x), sharding)  # noqa: E731
+        if jax.process_count() > 1:
+            # each process holds only its host-local rows; assemble the
+            # global batch array from per-process slices (device_put would
+            # treat the local slice as the global value and drop data)
+            put_batch = lambda x: jax.make_array_from_process_local_data(  # noqa: E731
+                sharding, np.asarray(x)
+            )
+        else:
+            put_batch = lambda x: jax.device_put(jnp.asarray(x), sharding)  # noqa: E731
         if progress:
             print(f"Data parallel over {n_dev} devices (mesh {mesh.shape})")
 
@@ -331,7 +356,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
             config.dataset_csv_path, "train_pairs.csv", config.dataset_image_path,
             output_size=size, seed=config.seed,
         ),
-        batch_size=config.batch_size, shuffle=True,
+        batch_size=local_batch, shuffle=True,
         num_workers=config.num_workers, seed=config.seed, drop_last=True,
         **shard_kwargs,
     )
@@ -343,7 +368,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
             config.dataset_csv_path, "val_pairs.csv", config.dataset_image_path,
             output_size=size, seed=config.seed,
         ),
-        batch_size=config.batch_size, shuffle=False,
+        batch_size=local_batch, shuffle=False,
         num_workers=config.eval_num_workers, seed=config.seed,
         drop_last=config.val_drop_last,
         **shard_kwargs,
@@ -381,10 +406,14 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         )
         is_best = test_loss[epoch - 1] < best
         best = min(test_loss[epoch - 1], best)
-        save_train_checkpoint(
-            ckpt_name, config, model_config, state, epoch, train_loss, test_loss,
-            is_best,
-        )
+        # multi-host: losses are computed on the global batch (replicated to
+        # every process), so is_best agrees everywhere; only process 0 writes
+        # to avoid races on a shared filesystem
+        if jax.process_index() == 0:
+            save_train_checkpoint(
+                ckpt_name, config, model_config, state, epoch, train_loss,
+                test_loss, is_best,
+            )
     return {
         "state": state,
         "model_config": model_config,

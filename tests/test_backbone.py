@@ -254,3 +254,81 @@ def test_random_pretrained_trunk_warns():
         warnings.simplefilter("always")
         init_ncnet(cfg, jax.random.key(0))
     assert any("RANDOM weights" in str(x.message) for x in w)
+
+
+def make_densenet201_state_dict():
+    sd = {}
+    sd["conv0.weight"] = _conv_w(64, 3, 7)
+    _bn_sd(sd, "norm0", 64)
+    c = 64
+    for bi, (bname, n) in enumerate(bb.DENSENET201_BLOCKS.items(), start=1):
+        for i in range(1, n + 1):
+            p = f"{bname}.denselayer{i}"
+            mid = bb.DENSENET_BN_SIZE * bb.DENSENET_GROWTH
+            _bn_sd(sd, p + ".norm1", c)
+            sd[p + ".conv1.weight"] = _conv_w(mid, c, 1)
+            _bn_sd(sd, p + ".norm2", mid)
+            sd[p + ".conv2.weight"] = _conv_w(bb.DENSENET_GROWTH, mid, 3)
+            c += bb.DENSENET_GROWTH
+        _bn_sd(sd, f"transition{bi}.norm", c)
+        sd[f"transition{bi}.conv.weight"] = _conv_w(c // 2, c, 1)
+        c //= 2
+    return sd
+
+
+def torch_densenet201_features(sd, x):
+    t = {k: torch.from_numpy(v) for k, v in sd.items()}
+
+    def bn(y, p):
+        return F.batch_norm(
+            y, t[p + ".running_mean"], t[p + ".running_var"],
+            t[p + ".weight"], t[p + ".bias"], training=False, eps=1e-5,
+        )
+
+    x = F.relu(bn(F.conv2d(x, t["conv0.weight"], stride=2, padding=3), "norm0"))
+    x = F.max_pool2d(x, 3, 2, 1)
+    for bi, (bname, n) in enumerate(bb.DENSENET201_BLOCKS.items(), start=1):
+        for i in range(1, n + 1):
+            p = f"{bname}.denselayer{i}"
+            y = F.conv2d(F.relu(bn(x, p + ".norm1")), t[p + ".conv1.weight"])
+            y = F.conv2d(F.relu(bn(y, p + ".norm2")), t[p + ".conv2.weight"], padding=1)
+            x = torch.cat([x, y], dim=1)
+        x = F.conv2d(F.relu(bn(x, f"transition{bi}.norm")),
+                     t[f"transition{bi}.conv.weight"])
+        x = F.avg_pool2d(x, 2, 2)
+    return x
+
+
+def test_densenet201_matches_torch():
+    """Reference cut = features[:-4] ⇒ conv0..transition2 inclusive, stride 16,
+    256 channels (/root/reference/lib/model.py:69-74)."""
+    sd = make_densenet201_state_dict()
+    x = RNG.normal(0, 1, (1, 3, 64, 48)).astype(np.float32)
+    want = torch_densenet201_features(sd, torch.from_numpy(x)).numpy()
+
+    params = bb.import_torch_backbone(sd, "densenet201")
+    got = bb.densenet201_features(params, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+    got = np.transpose(np.asarray(got), (0, 3, 1, 2))
+
+    assert got.shape == want.shape == (1, 256, 4, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_densenet201_random_init_matches_import_shapes():
+    sd = make_densenet201_state_dict()
+    imported = bb.import_torch_backbone(sd, "densenet201")
+    random = bb.backbone_init("densenet201", jax.random.key(0))
+    assert jax.tree.map(lambda a: a.shape, imported) == jax.tree.map(
+        lambda a: a.shape, random
+    )
+
+
+def test_densenet201_finetune_labels():
+    params = bb.backbone_init("densenet201", jax.random.key(0))
+    labels = bb.finetune_labels("densenet201", params, 2)
+    flat = labels["transition2"]
+    assert all(v == "trainable" for k, v in flat["conv"].items())
+    assert labels["transition2"]["norm"]["mean"] == "frozen"
+    assert labels["denseblock2"][-1]["conv1"]["w"] == "trainable"
+    assert labels["denseblock2"][0]["conv1"]["w"] == "frozen"
+    assert labels["conv0"]["w"] == "frozen"

@@ -73,6 +73,27 @@ def test_filter_pipeline_matches_numpy_oracle(tiny_cfg, rng):
     np.testing.assert_allclose(np.asarray(out.corr), want, rtol=1e-4, atol=1e-5)
 
 
+def test_filter_pipeline_cn_seam_matches_numpy_oracle(rng):
+    """A c_in>4 layer feeding a 1-channel layer triggers the CN-format seam
+    (coutfold out_cn → toeplitz_b in_cn_dims, models/ncnet.py stack) — cover
+    that fast path against the independent numpy oracle, both for the square
+    (batch-folded symmetric) and rectangular volume shapes."""
+    cfg = ModelConfig(
+        backbone="tiny", ncons_kernel_sizes=(3, 3), ncons_channels=(8, 1)
+    )
+    params = models.init_ncnet(cfg, jax.random.key(2))
+    from ncnet_tpu.ops import choose_conv4d_variant
+
+    assert choose_conv4d_variant(8, 1, 3, 4) == "toeplitz_b"
+    for shape in [(2, 3, 4, 3, 4), (1, 3, 3, 2, 4)]:
+        corr = rng.standard_normal(shape).astype(np.float32)
+        out = models.ncnet_filter(cfg, params, jnp.asarray(corr))
+        want = _np_filter_pipeline(corr, params["nc"], symmetric=True)
+        np.testing.assert_allclose(
+            np.asarray(out.corr), want, rtol=1e-4, atol=1e-5
+        )
+
+
 def test_filter_pipeline_asymmetric(tiny_cfg, rng):
     cfg = tiny_cfg.replace(symmetric_mode=False)
     params = models.init_ncnet(cfg, jax.random.key(1))
