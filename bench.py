@@ -26,7 +26,6 @@ BATCH = 4
 IMAGE = 400
 KERNELS = (5, 5, 5)
 CHANNELS = (16, 16, 1)
-ITERS = 10
 
 # bf16 peak TFLOP/s by device kind, for the MFU estimate (public specs)
 _PEAK_TFLOPS = {
@@ -37,19 +36,58 @@ _PEAK_TFLOPS = {
 }
 
 
-def _timeit(fn, args, iters=ITERS, per=1):
-    import jax.numpy as jnp
+def _timeit_scan(step_fn, make_input, per=1, n_long=6, reps=3):
+    """Steady-state ms/iteration via scan-length differencing.
 
-    float(jnp.sum(fn(*args)))  # compile + settle
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    out.block_until_ready()
-    return (time.perf_counter() - t0) / iters / per * 1e3
+    The device tunnel in this rig both caches repeated identical executions
+    and charges host→device upload to the first execution that touches a
+    fresh buffer — a naive repeat-same-args loop measures either ~0 or the
+    transfer, not the compute.  Instead: jit a program that generates its
+    input ON DEVICE from a PRNG key and runs the op ``n`` times inside a
+    ``lax.scan`` (serialized by a data dependence), then report
+    ``(t[n_long] − t[1]) / (n_long − 1)`` with a fresh key per call so no
+    call repeats.
+
+    ``step_fn(x) -> x_next`` must keep the carry shape; ``make_input(key)``
+    builds the initial carry on device.  Sub-ms ops need a long scan to rise
+    above host-dispatch jitter — pick ``n_long`` so the long run spans ≥10ms.
+    """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    @partial(jax.jit, static_argnums=(1,))
+    def run(key, n):
+        def body(x, _):
+            return step_fn(x), ()
+
+        x, _ = lax.scan(body, make_input(key), None, length=n)
+        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
+
+    key = jax.random.key
+    float(run(key(0), 1))
+    float(run(key(1), n_long))  # compile both lengths
+    diffs = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(run(key(100 + i), 1))
+        t1 = time.perf_counter()
+        float(run(key(200 + i), n_long))
+        t2 = time.perf_counter()
+        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
+    # a dispatch hiccup during a short run can push a diff negative; clamp
+    # each rep so the median rejects corrupted samples instead of averaging
+    # them in (reps should stay ≥3 for the median to actually reject one)
+    return float(np.median([max(d, 0.0) for d in diffs])) / per
 
 
 def bench_jax():
     """All JAX-side numbers on jax's default backend."""
+    import warnings
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -60,28 +98,51 @@ def bench_jax():
     from ncnet_tpu.ops import correlation_4d
 
     cfg = ModelConfig(ncons_kernel_sizes=KERNELS, ncons_channels=CHANNELS)
-    params = models.init_ncnet(cfg, jax.random.key(0))
-    rng = np.random.default_rng(0)
-
-    def images(b):
-        return (
-            jnp.asarray(rng.uniform(-1, 1, (b, IMAGE, IMAGE, 3)).astype(np.float32)),
-            jnp.asarray(rng.uniform(-1, 1, (b, IMAGE, IMAGE, 3)).astype(np.float32)),
-        )
-
-    src, tgt = images(BATCH)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # random-trunk warning: timing only
+        params = models.init_ncnet(cfg, jax.random.key(0))
     res = {}
 
-    fwd = jax.jit(lambda p, s, t: models.ncnet_forward(cfg, p, s, t).corr)
-    res["forward_ms_per_pair_fp32"] = _timeit(fwd, (params, src, tgt), per=BATCH)
+    def image_pair_input(b):
+        def make(key):
+            k1, k2 = jax.random.split(key)
+            return (
+                jax.random.uniform(k1, (b, IMAGE, IMAGE, 3), jnp.float32, -1, 1),
+                jax.random.uniform(k2, (b, IMAGE, IMAGE, 3), jnp.float32, -1, 1),
+            )
+        return make
+
+    def chain_step(op):
+        """Carry-preserving scan body: fold a negligible function of ``op``'s
+        output back into the (a, b) carry so iterations form a data-dependent
+        chain the compiler cannot collapse or the tunnel cache reuse."""
+        def step(carry):
+            a, b = carry
+            out = op(a, b)
+            eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(a.dtype)
+            return a + eps, b - eps
+        return step
+
+    def fwd_step(model_cfg):
+        return chain_step(
+            lambda src, tgt: models.ncnet_forward(model_cfg, params, src, tgt).corr
+        )
+
+    res["forward_ms_per_pair_fp32"] = _timeit_scan(
+        fwd_step(cfg), image_pair_input(BATCH), per=BATCH, n_long=12
+    )
 
     cfg16 = cfg.replace(half_precision=True, backbone_bf16=True)
-    fwd16 = jax.jit(lambda p, s, t: models.ncnet_forward(cfg16, p, s, t).corr)
-    res["forward_ms_per_pair_bf16"] = _timeit(fwd16, (params, src, tgt), per=BATCH)
+    res["forward_ms_per_pair_bf16"] = _timeit_scan(
+        fwd_step(cfg16), image_pair_input(BATCH), per=BATCH, n_long=12
+    )
 
     # MFU of the bf16 path from XLA's own FLOP count
     try:
-        cost = fwd16.lower(params, src, tgt).compile().cost_analysis()
+        rng = np.random.default_rng(0)
+        src = jnp.asarray(rng.uniform(-1, 1, (BATCH, IMAGE, IMAGE, 3)).astype(np.float32))
+        fwd16 = jax.jit(lambda p, s, t: models.ncnet_forward(cfg16, p, s, t).corr)
+        cost = fwd16.lower(params, src, src).compile().cost_analysis()
         flops = float(cost.get("flops", 0.0))
         kind = jax.devices()[0].device_kind
         peak = _PEAK_TFLOPS.get(kind)
@@ -93,15 +154,34 @@ def bench_jax():
     except Exception:
         pass
 
-    # correlation-only (BASELINE north-star: ms/pair 4D-corr fwd)
-    feat = jax.jit(lambda p, x: extract_features(cfg, p, x))
-    fa, fb = feat(params, src), feat(params, tgt)
-    corr_fn = jax.jit(correlation_4d)
-    res["corr_ms_per_pair"] = _timeit(corr_fn, (fa, fb), per=BATCH)
+    # correlation-only (BASELINE north-star: ms/pair 4D-corr fwd) — feature
+    # shape derived from the configured backbone via eval_shape (free), so a
+    # config change cannot silently decouple this metric from the model
+    feat_shape = jax.eval_shape(
+        lambda p, x: extract_features(cfg, p, x),
+        params,
+        jax.ShapeDtypeStruct((BATCH, IMAGE, IMAGE, 3), jnp.float32),
+    ).shape
+
+    corr_step = chain_step(correlation_4d)
+
+    def corr_input(key):
+        k1, k2 = jax.random.split(key)
+        return (
+            jax.random.normal(k1, feat_shape, jnp.float32) * 0.03,
+            jax.random.normal(k2, feat_shape, jnp.float32) * 0.03,
+        )
+
+    # the einsum correlation is ~0.1ms for the whole batch where the tunnel's
+    # dispatch jitter is ±40ms: scan 2048 deep so compute dominates the span
+    res["corr_ms_per_pair"] = _timeit_scan(
+        corr_step, corr_input, per=BATCH, n_long=2048
+    )
 
     # batch-1 forward for the matched-batch baseline comparison
-    s1, t1 = images(1)
-    res["forward_ms_per_pair_bs1"] = _timeit(fwd, (params, s1, t1), per=1)
+    res["forward_ms_per_pair_bs1"] = _timeit_scan(
+        fwd_step(cfg), image_pair_input(1), per=1, n_long=24
+    )
 
     # train step (BASELINE north-star: image-pairs/sec; reference bs=16 —
     # on a single 16G chip the largest fitting batch is used and reported,
@@ -109,14 +189,34 @@ def bench_jax():
     for bs_try in (16, 8, 4):
         try:
             tcfg = TrainConfig(model=cfg, batch_size=bs_try, data_parallel=False)
-            state, optimizer, mcfg, _ = training.create_train_state(tcfg)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                state, optimizer, mcfg, _ = training.create_train_state(tcfg)
             step = training.make_train_step(
                 mcfg, optimizer, donate=False, stop_backbone_grad=True
             )
-            bs_im, bt_im = images(bs_try)
-            batch = {"source_image": bs_im, "target_image": bt_im}
 
-            ms = _timeit(lambda b: step(state, b)[1], (batch,), iters=5)
+            def train_out(src, tgt):
+                new_state, loss = step(
+                    state, {"source_image": src, "target_image": tgt}
+                )
+                # consume the UPDATED trainable params, not just the loss —
+                # otherwise XLA dead-code-eliminates the whole backward pass
+                # + optimizer update and this measures a forward-only step
+                nc_dep = sum(
+                    jnp.sum(leaf.astype(jnp.float32))
+                    for layer in new_state.params["nc"]
+                    for leaf in layer.values()
+                )
+                return loss.astype(jnp.float32) + nc_dep * 1e-6
+
+            train_tick = chain_step(train_out)
+
+            ms = _timeit_scan(
+                train_tick, image_pair_input(bs_try), n_long=4, reps=3
+            )
+            if ms <= 0:  # all reps jitter-corrupted: don't emit garbage
+                raise RuntimeError(f"non-positive train timing {ms}")
             res["train_pairs_per_sec"] = bs_try / (ms * 1e-3)
             res["train_step_ms"] = ms
             res["train_batch_size"] = bs_try

@@ -134,8 +134,18 @@ def neigh_consensus(
         # 8×-padded minor dim, saving ~20ms/layer of relayout on v5e at the
         # PF-Pascal workload (ops/conv4d.py docstring)
         hb, wb = x.shape[3], x.shape[4]
+        # the planner passes the full shape context so its per-layer choice
+        # agrees with the choice conv4d's own 'auto' path will make (the
+        # small-C_out layer may upgrade to the Pallas kernel where Mosaic
+        # accepts it, in which case no CN seam must be planned around it)
         variants = [
-            choose_conv4d_variant(l["w"].shape[4], l["w"].shape[5], hb, wb)
+            choose_conv4d_variant(
+                l["w"].shape[4], l["w"].shape[5], hb, wb,
+                shape_a=(x.shape[1], x.shape[2]),
+                kernel=tuple(l["w"].shape[:4]),
+                same_pad=True,
+                dtype=x.dtype,
+            )
             for l in nc_params
         ]
         cn = False
@@ -146,8 +156,13 @@ def neigh_consensus(
                 and i + 1 < len(nc_params)
                 and variants[i + 1] == "toeplitz_b"
             )
+            # pass the planned variant explicitly — the seam plan and the
+            # executed formulation come from ONE chooser call, so they
+            # cannot drift apart (a CN-receiving layer is always planned
+            # toeplitz_b: that is the only plan that emits the seam)
             x = conv4d(
                 x, layer["w"], layer["b"],
+                variant=variants[i],
                 out_cn=emit_cn,
                 in_cn_dims=(hb, wb) if cn else None,
             )

@@ -235,18 +235,65 @@ _VARIANTS = {
 }
 
 
-def choose_conv4d_variant(c_in: int, c_out: int, hb: int, wb: int) -> str:
+def choose_conv4d_variant(
+    c_in: int,
+    c_out: int,
+    hb: int,
+    wb: int,
+    *,
+    shape_a: tuple | None = None,
+    kernel: tuple | None = None,
+    same_pad: bool = True,
+    dtype=None,
+) -> str:
     """Per-layer formulation choice, measured on v5e (25⁴ volume, device-side
     scan timing): tapfold 3.3ms for 1→16, coutfold 24ms for 16→16 (unroll 35,
     tapfold 61), toeplitz_b 28ms for 16→1 (coutfold 76, unroll 308 — a
-    1-output-channel conv uses 1 of 128 MXU lanes)."""
+    1-output-channel conv uses 1 of 128 MXU lanes).  With the full shape
+    context (``shape_a=(ha, wa)``, ``kernel``) the small-C_out case upgrades
+    to the Pallas tap-folding kernel on TPU — true FLOPs at full lanes, vs.
+    toeplitz_b's kB·kWB× FLOP overhead."""
     if c_in <= 4:
         return "tapfold"
-    if c_out <= 4 and hb * wb <= 1300:
-        # the dense B-stencil masks are (kB·kWB)·(hB·wB)² — fine at
-        # PF-Pascal's 625² (~40MB), ruinous at InLoc's 7500²
-        return "toeplitz_b"
+    if c_out <= 4:
+        if (
+            same_pad
+            and shape_a is not None
+            and kernel is not None
+            and dtype is not None
+            and len(set(kernel)) == 1
+            and kernel[0] % 2 == 1
+            and _pallas_available()
+        ):
+            from ncnet_tpu.ops.conv4d_pallas import (
+                pallas_compiles,
+                pallas_feasible,
+            )
+
+            itemsize = jnp.dtype(dtype).itemsize
+            if pallas_feasible(
+                shape_a[0], shape_a[1], hb, wb, c_in, c_out, kernel[0],
+                itemsize=itemsize,
+            ) and pallas_compiles(
+                shape_a[0], shape_a[1], hb, wb, c_in, c_out, kernel[0],
+                dtype_name=jnp.dtype(dtype).name,
+            ):
+                return "pallas"
+        if hb * wb <= 1300:
+            # the dense B-stencil masks are (kB·kWB)·(hB·wB)² — fine at
+            # PF-Pascal's 625² (~40MB), ruinous at InLoc's 7500²
+            return "toeplitz_b"
     return "coutfold"
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_available() -> bool:
+    """Mosaic kernels need a real TPU backend (the CPU path uses the XLA
+    formulations; tests drive the kernel via interpret mode explicitly)."""
+    try:
+        return "TPU" in jax.devices()[0].device_kind
+    except Exception:
+        return False
 
 
 def conv4d(
@@ -297,7 +344,32 @@ def conv4d(
         hb, wb = x.shape[3], x.shape[4]
         assert x.shape[5] == c_in, f"channel mismatch: {x.shape[5]} vs {c_in}"
     if variant == "auto":
-        variant = choose_conv4d_variant(c_in, c_out, hb, wb)
+        variant = choose_conv4d_variant(
+            c_in, c_out, hb, wb,
+            shape_a=None if in_cn_dims is not None else (x.shape[1], x.shape[2]),
+            kernel=tuple(weight.shape[:4]),
+            # the pallas kernel runs its dot at default MXU precision: keep
+            # explicit-precision calls on the XLA variants, which honor it
+            same_pad=(
+                pad_ha and pad_hb and not out_cn and in_cn_dims is None
+                and precision is None
+            ),
+            dtype=x.dtype,
+        )
+    if variant == "pallas":
+        from ncnet_tpu.ops.conv4d_pallas import conv4d_small_cout
+
+        assert pad_ha and pad_hb and not out_cn and in_cn_dims is None, (
+            "the pallas variant supports only the same-padded volume form"
+        )
+        assert precision is None, (
+            "the pallas variant does not honor an explicit precision; use an "
+            "XLA variant"
+        )
+        out = conv4d_small_cout(x, weight)
+        if bias is not None:
+            out = out + bias
+        return out
     kwargs = {}
     if out_cn:
         assert variant in ("coutfold", "tapfold"), (

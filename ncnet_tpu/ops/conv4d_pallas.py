@@ -1,0 +1,195 @@
+"""Pallas TPU kernel for small-C_out 4D convolution.
+
+The last NC layer (C_out=1) is the one conv4d shape XLA cannot make fast: any
+conv formulation leaves it with one useful MXU output lane in 128, and the
+dense-Toeplitz rewrite (ops/conv4d.py `toeplitz_b`) buys utilization with a
+kB·kWB× FLOP overhead and an O((hB·wB)²) mask.  This kernel gets full lanes
+at TRUE FLOPs by folding the ``(kA, kB, kWB)`` taps into the matmul's N
+dimension — N = k³·C_out = 125 for the PF-Pascal 5⁴ kernel — and resolving
+the tap shifts in a VMEM epilogue, where the partial-product tensor that
+dooms the same idea in XLA HBM (125× volume materialization) never leaves
+the chip.
+
+Shape/grid design:
+  * the volume rides as ``(B, hA, wA, hB, (wB+halo)·C_in)`` — fusing the
+    minor pair keeps VMEM tiles ~1× padded where a 16-channel minor dim
+    pads 8×;
+  * grid = (B, hA); the kA input rows an output row needs arrive as kA
+    separate BlockSpecs with hA-block-size 1, whose index maps select rows
+    ``i..i+kA-1`` of the halo-padded volume (block-unit maps cannot express
+    overlapping windows, row-granular specs can);
+  * per wA slab: one MXU dot
+      P[(p, j, k', l'), (q, c)] @ W[(q, c), (p, r, s, o)] → Y
+    then the VPU epilogue  out[j,k,l,o] = Σ_{p,r,s} Y[p, j, k+r, l+s, (p,r,s,o)].
+
+Applicability: needs ``kA·(wA+h)·(hB+h)·(wB+h)·C_in`` to fit VMEM — the
+PF-Pascal regime (hB·wB ≈ 625).  The InLoc-resolution volume stays on the
+XLA formulations.  Forward-only: the ``jax.custom_vjp`` backward falls back
+to the XLA path (training uses it anyway; this kernel serves eval/bench).
+
+Status: the current Mosaic compiler REJECTS this kernel ("unsupported shape
+cast") — the in-kernel reshapes that split/merge the minor (lane) dim
+(``(l'·c) → (l', c)`` and the ``(q,c)`` tap fusion) are relayouts Mosaic
+does not implement, per probing on v5e: lane-dim splits/merges fail while
+leading-dim merges/splits around a fixed minor dim compile.  The variant
+chooser therefore gates on ``pallas_compiles`` (a cached real-compile
+probe) and falls back to the XLA formulations, so the kernel activates
+automatically on toolchains that accept it.  Numerics are locked down by
+interpret-mode tests (tests/test_ops_basic.py) either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# VMEM working-set budget for the feasibility gate (v5e has 16MB more or less
+# fully available to one Pallas program)
+_VMEM_BUDGET = 12 * 2 ** 20
+
+
+def pallas_feasible(ha, wa, hb, wb, c_in, c_out, k, itemsize=4) -> bool:
+    """True when the per-step tile + dot working set fits the VMEM budget."""
+    h = k - 1
+    xt = k * (wa + h) * (hb + h) * (wb + h) * c_in * itemsize
+    m = k * (hb + h) * (wb + h)  # js=1 slab rows
+    work = xt + m * k * c_in * itemsize + m * k ** 3 * c_out * 4
+    return work <= _VMEM_BUDGET
+
+
+@functools.lru_cache(maxsize=16)
+def pallas_compiles(ha, wa, hb, wb, c_in, c_out, k, dtype_name="float32") -> bool:
+    """True iff Mosaic actually compiles the kernel for this shape class.
+
+    Lowering Pallas TPU kernels can fail on layout constraints that depend on
+    the concrete shape AND dtype (16-bit types pack sublanes differently, so
+    bf16 legality is independent of f32 legality — e.g. 'unsupported shape
+    cast'), so the variant chooser probes a real compile at the execution
+    dtype (batch 1 — the grid batch dim cannot change layout legality) and
+    falls back to the XLA formulations on any failure.  Cached per
+    (shape, dtype) class; a probe costs one ahead-of-time compile."""
+    try:
+        dtype = jnp.dtype(dtype_name)
+        x = jax.ShapeDtypeStruct((1, ha, wa, hb, wb, c_in), dtype)
+        w = jax.ShapeDtypeStruct((k,) * 4 + (c_in, c_out), dtype)
+        jax.jit(_fwd_impl).lower(x, w).compile()
+        return True
+    except Exception:
+        return False
+
+
+def _kernel(*refs, k, c_in, c_out, wa, hb, wb, js):
+    """One (b, i) step: refs = (x_0..x_{k-1}, w, out).
+
+    x_p: VMEM (1, 1, wa+h, hb+h, (wb+h)*c_in) — input row i+p of the padded
+         volume.
+    w:   VMEM (k*c_in, k**3*c_out) ordered (q,c) × (p,r,s,o).
+    out: VMEM (1, 1, wa, hb, wb*c_out).
+    """
+    x_refs, w_ref, out_ref = refs[:k], refs[k], refs[k + 1]
+    h = k - 1
+    k_n, l_n = hb + h, wb + h
+    w = w_ref[:]
+    # xt[p, j'', k', l', c]
+    xt = jnp.stack(
+        [x_refs[p][0, 0].reshape(wa + h, k_n, l_n, c_in) for p in range(k)],
+        axis=0,
+    )
+    for j0 in range(0, wa, js):
+        je = min(js, wa - j0)
+        # P[(p, j, k', l'), (q, c)]: q-shifts gathered over the wa halo
+        p_mat = jnp.stack(
+            [xt[:, j0 + q:j0 + q + je] for q in range(k)], axis=4
+        ).reshape(k * je * k_n * l_n, k * c_in)
+        y = jax.lax.dot_general(
+            p_mat, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(k, je, k_n, l_n, k ** 3 * c_out)
+        # out[j,k,l,o] = Σ_{p,r,s} Y[p, j, k+r, l+s, (p,r,s,o)]
+        acc = jnp.zeros((je, hb, wb * c_out), jnp.float32)
+        for p in range(k):
+            for r in range(k):
+                for s in range(k):
+                    lane0 = ((p * k + r) * k + s) * c_out
+                    term = y[p, :, r:r + hb, s:s + wb, lane0:lane0 + c_out]
+                    acc = acc + term.reshape(je, hb, wb * c_out)
+        out_ref[0, 0, j0:j0 + je] = acc.astype(out_ref.dtype)
+
+
+@jax.custom_vjp
+def conv4d_small_cout(x, weight):
+    """'Same'-padded 4D conv via the Pallas tap-folding kernel.
+
+    Args:
+      x: ``(B, hA, wA, hB, wB, C_in)`` volume.
+      weight: ``(k, k, k, k, C_in, C_out)`` — one kernel size on all four
+        dims (the only case the reference uses per layer).
+
+    Returns ``(B, hA, wA, hB, wB, C_out)``.
+    """
+    return _fwd_impl(x, weight)
+
+
+def _fwd_impl(x, weight, js: int = 1, interpret: bool = False):
+    b, ha, wa, hb, wb, c_in = x.shape
+    k = weight.shape[0]
+    assert weight.shape[:4] == (k,) * 4, "kernel must be cubic (k,k,k,k)"
+    assert k % 2 == 1, "same-padding requires an odd kernel size"
+    c_out = weight.shape[5]
+    h = k - 1
+
+    # halo-pad every spatial dim; fuse (wb+h, c) as the minor dim
+    xp = jnp.pad(
+        x, ((0, 0),) + ((h // 2, h // 2),) * 4 + ((0, 0),)
+    ).reshape(b, ha + h, wa + h, hb + h, (wb + h) * c_in)
+    # W[(q, c), (p, r, s, o)]
+    wf = jnp.transpose(weight, (1, 4, 0, 2, 3, 5)).reshape(
+        k * c_in, k ** 3 * c_out
+    )
+
+    kern = functools.partial(
+        _kernel, k=k, c_in=c_in, c_out=c_out, wa=wa, hb=hb, wb=wb, js=js,
+    )
+    row_spec = lambda p: pl.BlockSpec(  # noqa: E731
+        (1, 1, wa + h, hb + h, (wb + h) * c_in),
+        lambda bi, ii, p=p: (bi, ii + p, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out = pl.pallas_call(
+        kern,
+        grid=(b, ha),
+        in_specs=[row_spec(p) for p in range(k)]
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(
+            (1, 1, wa, hb, wb * c_out),
+            lambda bi, ii: (bi, ii, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, ha, wa, hb, wb * c_out), x.dtype),
+        interpret=interpret,
+    )(*([xp] * k), wf.astype(x.dtype))
+    return out.reshape(b, ha, wa, hb, wb, c_out)
+
+
+def _fwd_rule(x, weight):
+    return _fwd_impl(x, weight), (x, weight)
+
+
+def _bwd_rule(res, g):
+    """XLA fallback backward (the kernel is an eval/bench fast path; training
+    gradients flow through the equivalent ops/conv4d.py formulations)."""
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    x, weight = res
+    _, vjp = jax.vjp(
+        lambda xx, ww: conv4d(xx, ww, variant="coutfold"), x, weight
+    )
+    return vjp(g)
+
+
+conv4d_small_cout.defvjp(_fwd_rule, _bwd_rule)

@@ -153,3 +153,46 @@ def test_conv4d_auto_variant_matches_unroll(rng):
         unroll = ops.conv4d(x, w, variant="unroll")
         np.testing.assert_allclose(np.asarray(auto), np.asarray(unroll),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_conv4d_pallas_kernel_matches_oracle(rng):
+    """The Pallas tap-folding kernel (interpret mode on CPU) must match the
+    XLA formulations for the small-C_out shapes it serves, including the
+    PF-Pascal last-layer shape class (k=5, 16ch) and the IVD k=3 kernel."""
+    from ncnet_tpu.ops import conv4d_pallas as cp
+
+    for (b, ha, wa, hb, wb, cin, cout, k) in [
+        (1, 5, 5, 5, 5, 16, 1, 5),
+        (2, 4, 6, 5, 3, 8, 1, 3),
+        (1, 6, 4, 4, 6, 16, 2, 3),
+    ]:
+        x = jnp.asarray(
+            rng.standard_normal((b, ha, wa, hb, wb, cin)).astype(np.float32))
+        w = jnp.asarray(
+            rng.standard_normal((k,) * 4 + (cin, cout)).astype(np.float32) * 0.1)
+        want = ops.conv4d(x, w, variant="tapfold")
+        got = cp._fwd_impl(x, w, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_conv4d_pallas_backward_fallback(rng):
+    """The custom_vjp backward (XLA fallback) must match grads of the plain
+    formulation.  The bwd rule is exercised directly: on CPU the custom_vjp
+    forward would hit Mosaic, and training never routes through the kernel."""
+    import jax
+
+    from ncnet_tpu.ops import conv4d_pallas as cp
+
+    x = jnp.asarray(rng.standard_normal((1, 4, 4, 4, 4, 8)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3,) * 4 + (8, 1)).astype(np.float32) * 0.1)
+    g = jnp.asarray(rng.standard_normal((1, 4, 4, 4, 4, 1)).astype(np.float32))
+
+    gx, gw = cp._bwd_rule((x, w), g)
+    want_gx, want_gw = jax.vjp(
+        lambda xx, ww: ops.conv4d(xx, ww, variant="unroll"), x, w
+    )[1](g)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(want_gx),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(want_gw),
+                               rtol=2e-4, atol=2e-4)
