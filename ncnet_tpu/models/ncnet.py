@@ -23,7 +23,6 @@ import jax.numpy as jnp
 from ncnet_tpu.config import ModelConfig
 from ncnet_tpu.models import backbone as bb
 from ncnet_tpu.ops import (
-    choose_conv4d_variant,
     conv4d,
     conv4d_init,
     correlation_4d,
@@ -128,46 +127,11 @@ def neigh_consensus(
     """
 
     def stack(x: jnp.ndarray) -> jnp.ndarray:
-        # negotiate the layer seams: when a tapfold/coutfold layer feeds a
-        # toeplitz_b layer, hand the intermediate over in the "CN" format
-        # (B, hA, wA, C, hB·wB) — C=16 rides the sublane dim instead of an
-        # 8×-padded minor dim, saving ~20ms/layer of relayout on v5e at the
-        # PF-Pascal workload (ops/conv4d.py docstring)
-        hb, wb = x.shape[3], x.shape[4]
-        # the planner passes the full shape context so its per-layer choice
-        # agrees with the choice conv4d's own 'auto' path will make (the
-        # small-C_out layer may upgrade to the Pallas kernel where Mosaic
-        # accepts it, in which case no CN seam must be planned around it)
-        variants = [
-            choose_conv4d_variant(
-                l["w"].shape[4], l["w"].shape[5], hb, wb,
-                shape_a=(x.shape[1], x.shape[2]),
-                kernel=tuple(l["w"].shape[:4]),
-                same_pad=True,
-                dtype=x.dtype,
-            )
-            for l in nc_params
-        ]
-        cn = False
-        for i, layer in enumerate(nc_params):
-            emit_cn = (
-                not cn
-                and variants[i] in ("tapfold", "coutfold")
-                and i + 1 < len(nc_params)
-                and variants[i + 1] == "toeplitz_b"
-            )
-            # pass the planned variant explicitly — the seam plan and the
-            # executed formulation come from ONE chooser call, so they
-            # cannot drift apart (a CN-receiving layer is always planned
-            # toeplitz_b: that is the only plan that emits the seam)
-            x = conv4d(
-                x, layer["w"], layer["b"],
-                variant=variants[i],
-                out_cn=emit_cn,
-                in_cn_dims=(hb, wb) if cn else None,
-            )
-            x = jax.nn.relu(x)
-            cn = emit_cn
+        # every layer takes and emits the plain channels-last volume;
+        # conv4d's 'auto' chooser (ops/conv4d.py) is the single authority
+        # for the per-layer MXU formulation
+        for layer in nc_params:
+            x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
         return x
 
     x = corr[..., None]  # (B, hA, wA, hB, wB, 1)

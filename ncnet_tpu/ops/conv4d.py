@@ -19,17 +19,18 @@ PF-Pascal 25⁴ workload):
   * ``toeplitz_b`` — expresses the whole B-side (kB,kWB) stencil as a dense
                    banded matrix over the flattened hB·wB lane dim, turning
                    the layer into kA·kWA big matmuls of shape
-                   (B·hA·wA, C_in·hB·wB) × (C_in·hB·wB, hB·wB·C_out).  This
-                   spends kB·kWB× the true FLOPs but runs at near-peak MXU
-                   utilization, which is the only way to make a 1-output-
-                   channel layer (the last NC layer: 1 of 128 lanes useful
-                   in any conv formulation) fast.  Only viable while the
-                   (hB·wB)² mask fits comfortably (PF-Pascal's 625², not
-                   InLoc's 7500²) — ``auto`` gates on that.
+                   (B·hA·wA, C_in·hB·wB) × (C_in·hB·wB, hB·wB·C_out) — near-
+                   peak MXU utilization bought with kB·kWB× the true FLOPs
+                   and an O((hB·wB)²) mask.  NOT selected by ``auto``:
+                   honest scan-differenced timing shows ``coutfold`` beats it
+                   ~8× standalone forward and ~4× under autodiff (its XLA
+                   transpose materializes the full dense weight-grad tensor);
+                   it stays available as an explicitly-selectable formulation
+                   and as a structurally-independent test oracle.
 
-``variant='auto'`` picks per-layer by channel shape (measured on TPU v5e at
-the PF-Pascal 25⁴ workload with device-side scan timing).  All variants share
-the reference's semantics: cross-correlation (like torch convNd), "same" zero
+``variant='auto'`` picks per-layer by channel shape (see
+``choose_conv4d_variant`` for the measurements).  All variants share the
+reference's semantics: cross-correlation (like torch convNd), "same" zero
 padding of ``k//2`` per spatial dim, stride/dilation/groups fixed at 1 —
 exactly the envelope the reference supports (conv4d.py:59-62).
 """
@@ -81,11 +82,8 @@ def _conv4d_unroll(x, weight, *, precision, pad_ha, pad_hb):
     return out.reshape(b, ha, wa, hb_out, wb, c_out)
 
 
-def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb, out_cn=False):
-    """One 3D conv with the kA taps folded into input channels.
-
-    ``out_cn=True`` emits the CN seam format ``(B, hA, wA, C_out, hB·wB)``
-    (see ``_conv4d_coutfold``)."""
+def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb):
+    """One 3D conv with the kA taps folded into input channels."""
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     if pad_ha:
@@ -98,10 +96,7 @@ def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb, out_cn=False):
     wf = jnp.transpose(weight, (1, 2, 3, 0, 4, 5)).reshape(
         kwa, kb, kwb, ka * c_in, c_out
     )
-    dn = lax.conv_dimension_numbers(
-        (b * ha, wa, hb, wb, ka * c_in), wf.shape,
-        ("NDHWC", "DHWIO", "NDCHW" if out_cn else "NDHWC"),
-    )
+    dn = _dn3((b * ha, wa, hb, wb, ka * c_in), wf.shape)
     o = lax.conv_general_dilated(
         shifts.reshape(b * ha, wa, hb, wb, ka * c_in),
         wf,
@@ -110,31 +105,18 @@ def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb, out_cn=False):
         dimension_numbers=dn,
         precision=precision,
     )
-    if out_cn:
-        return o.reshape(b, ha, wa, c_out, hb_out * wb)
     return o.reshape(b, ha, wa, hb_out, wb, c_out)
 
 
-def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb, out_cn=False):
-    """One 3D conv producing kA·C_out channels + shifted sum over hA.
-
-    ``out_cn=True`` returns the "CN" seam format ``(B, hA, wA, C_out, hB·wB)``
-    instead of the volume: the conv is asked for channels *ahead of* the B
-    dims (``NDCHW`` output spec), so channels land on the sublane dim (16 =
-    exact) and hB·wB on the lane dim (625→640) — ~1× padding, where the
-    volume form's 16-wide minor dim pads 8× and costs ~20ms of relayout per
-    layer at the PF-Pascal workload when the next layer is a toeplitz matmul.
-    """
+def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
+    """One 3D conv producing kA·C_out channels + shifted sum over hA."""
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     hb_out = hb if pad_hb else hb - (kb - 1)
     wf = jnp.transpose(weight, (1, 2, 3, 4, 0, 5)).reshape(
         kwa, kb, kwb, c_in, ka * c_out
     )
-    dn = lax.conv_dimension_numbers(
-        (b * ha_in, wa, hb, wb, c_in), wf.shape,
-        ("NDHWC", "DHWIO", "NDCHW" if out_cn else "NDHWC"),
-    )
+    dn = _dn3((b * ha_in, wa, hb, wb, c_in), wf.shape)
     y = lax.conv_general_dilated(
         x.reshape(b * ha_in, wa, hb, wb, c_in),
         wf,
@@ -147,18 +129,6 @@ def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb, out_cn=False):
     # The tap is selected by slicing the fused (ka·C_out) channel dim —
     # splitting it into a (…, ka, C_out) axis pair makes XLA materialize a
     # relayout of the whole volume (~30ms at the PF-Pascal workload).
-    if out_cn:
-        y = y.reshape(b, ha_in, wa, ka * c_out, hb_out * wb)
-        if pad_ha:
-            y = jnp.pad(y, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 3)
-        ha = y.shape[1] - (ka - 1)
-        out = None
-        for p in range(ka):
-            o = lax.slice_in_dim(y, p, p + ha, axis=1)[
-                :, :, :, p * c_out:(p + 1) * c_out, :
-            ]
-            out = o if out is None else out + o
-        return out
     y = y.reshape(b, ha_in, wa, hb_out, wb, ka * c_out)
     if pad_ha:
         y = jnp.pad(y, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 4)
@@ -185,19 +155,9 @@ def _shift_masks(hb_in: int, wb_in: int, hb_out: int, wb_out: int,
     return np.stack(ms).astype(np.float32)
 
 
-def _conv4d_toeplitz_b(x, weight, *, precision, pad_ha, pad_hb, cn_dims=None):
-    """kA·kWA shifted matmuls against a dense banded B-stencil matrix.
-
-    ``cn_dims=(hb, wb)`` takes the "CN" seam format
-    ``(B, hA, wA, C_in, hB·wB)`` (what ``_conv4d_coutfold(out_cn=True)``
-    emits); the matmul's K dim is then ordered ``(c, n_src)`` and the volume
-    feeds in as a pure reshape.  Default takes the 6D volume.
-    """
-    if cn_dims is not None:
-        b, ha_in, wa, c_in, _ = x.shape
-        hb, wb = cn_dims
-    else:
-        b, ha_in, wa, hb, wb, c_in = x.shape
+def _conv4d_toeplitz_b(x, weight, *, precision, pad_ha, pad_hb):
+    """kA·kWA shifted matmuls against a dense banded B-stencil matrix."""
+    b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     hb_out = hb if pad_hb else hb - (kb - 1)
     n_in, n_out = hb * wb, hb_out * wb
@@ -205,13 +165,10 @@ def _conv4d_toeplitz_b(x, weight, *, precision, pad_ha, pad_hb, cn_dims=None):
         _shift_masks(hb, wb, hb_out, wb, kb, kwb, pad_hb), dtype=weight.dtype
     )
     wv = weight.reshape(ka, kwa, kb * kwb, c_in, c_out)
-    # T[p, q, K, (n_out, c_out)] — K ordered to match the input flattening:
-    # (n_src, c_in) for the 6D volume (pure minor-dims reshape), (c_in, n_src)
-    # for the CN seam.  Either avoids a ~10ms whole-volume transpose.
-    if cn_dims is not None:
-        t = jnp.einsum("pquio,unm->pqinmo", wv, masks, precision=precision)
-    else:
-        t = jnp.einsum("pquio,unm->pqnimo", wv, masks, precision=precision)
+    # T[p, q, K, (n_out, c_out)] — K ordered (n_src, c_in) to match the
+    # input flattening (a pure minor-dims reshape of the 6D volume), which
+    # avoids a ~10ms whole-volume transpose.
+    t = jnp.einsum("pquio,unm->pqnimo", wv, masks, precision=precision)
     t = t.reshape(ka, kwa, n_in * c_in, n_out * c_out)
     xf = x.reshape(b, ha_in, wa, n_in * c_in)
     if pad_ha:
@@ -246,13 +203,23 @@ def choose_conv4d_variant(
     same_pad: bool = True,
     dtype=None,
 ) -> str:
-    """Per-layer formulation choice, measured on v5e (25⁴ volume, device-side
-    scan timing): tapfold 3.3ms for 1→16, coutfold 24ms for 16→16 (unroll 35,
-    tapfold 61), toeplitz_b 28ms for 16→1 (coutfold 76, unroll 308 — a
-    1-output-channel conv uses 1 of 128 MXU lanes).  With the full shape
-    context (``shape_a=(ha, wa)``, ``kernel``) the small-C_out case upgrades
-    to the Pallas tap-folding kernel on TPU — true FLOPs at full lanes, vs.
-    toeplitz_b's kB·kWB× FLOP overhead."""
+    """Per-layer formulation choice, measured on v5e at the PF-Pascal 25⁴
+    volume (batch 8, fp32, device-side scan-differenced timing — the honest
+    harness; earlier numbers from the cached-execution loop were wrong):
+
+      forward-only:  1→16 tapfold 3.3ms;  16→16 coutfold 24ms;
+                     16→1 coutfold 1.9ms (toeplitz_b 15.4ms standalone,
+                     ~equal inside the stack behind a CN seam)
+      fwd+bwd (AD):  1→16 tapfold 12.5ms; 16→16 coutfold 69ms;
+                     16→1 coutfold 13.5ms vs toeplitz_b 54ms — the
+                     XLA transpose of the dense-mask einsums materializes a
+                     (kA·kWA, hB·wB·C_in, hB·wB·C_out) weight-gradient tensor
+
+    So coutfold wins the small-C_out case both ways and ``auto`` never picks
+    ``toeplitz_b`` anymore (the variant remains selectable explicitly).  With the full shape context (``shape_a=(ha, wa)``,
+    ``kernel``, ``dtype``) the small-C_out case upgrades to the Pallas
+    tap-folding kernel where Mosaic accepts it — true FLOPs at full MXU
+    lanes (see ops/conv4d_pallas.py for its current status)."""
     if c_in <= 4:
         return "tapfold"
     if c_out <= 4:
@@ -279,10 +246,6 @@ def choose_conv4d_variant(
                 dtype_name=jnp.dtype(dtype).name,
             ):
                 return "pallas"
-        if hb * wb <= 1300:
-            # the dense B-stencil masks are (kB·kWB)·(hB·wB)² — fine at
-            # PF-Pascal's 625² (~40MB), ruinous at InLoc's 7500²
-            return "toeplitz_b"
     return "coutfold"
 
 
@@ -305,14 +268,11 @@ def conv4d(
     pad_ha: bool = True,
     pad_hb: bool = True,
     variant: str = "auto",
-    out_cn: bool = False,
-    in_cn_dims: tuple | None = None,
 ) -> jnp.ndarray:
     """4D convolution over the correlation volume ("same" by default).
 
     Args:
-      x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume — or, with
-        ``in_cn_dims``, the CN seam format ``(B, hA, wA, C_in, hB·wB)``.
+      x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume.
       weight: ``(kA, kWA, kB, kWB, C_in, C_out)``.
       bias:   ``(C_out,)`` or None.
       pad_ha / pad_hb: when False, the hA / hB dim is treated as *valid* —
@@ -323,43 +283,27 @@ def conv4d(
         an explicit formulation from 'unroll' / 'tapfold' / 'coutfold' /
         'toeplitz_b' (see module docstring).  All variants are numerically
         equivalent up to float reassociation.
-      out_cn: emit ``(B, hA', wA, C_out, hB'·wB)`` instead of the volume
-        (coutfold only) — the layout-friendly seam format for feeding a
-        following toeplitz_b layer (16 channels on the sublane dim instead of
-        an 8×-padded minor dim).
-      in_cn_dims: ``(hB, wB)`` when ``x`` is in the CN seam format
-        (toeplitz_b only).
 
     Returns:
-      ``(B, hA', wA, hB', wB, C_out)`` (primed dims shrink iff unpadded),
-      or the CN form when ``out_cn``.
+      ``(B, hA', wA, hB', wB, C_out)`` (primed dims shrink iff unpadded).
     """
     c_in, c_out = weight.shape[4], weight.shape[5]
-    if in_cn_dims is not None:
-        hb, wb = in_cn_dims
-        assert x.ndim == 5 and x.shape[3] == c_in, (
-            f"CN input mismatch: {x.shape} vs c_in={c_in}"
-        )
-    else:
-        hb, wb = x.shape[3], x.shape[4]
-        assert x.shape[5] == c_in, f"channel mismatch: {x.shape[5]} vs {c_in}"
+    hb, wb = x.shape[3], x.shape[4]
+    assert x.shape[5] == c_in, f"channel mismatch: {x.shape[5]} vs {c_in}"
     if variant == "auto":
         variant = choose_conv4d_variant(
             c_in, c_out, hb, wb,
-            shape_a=None if in_cn_dims is not None else (x.shape[1], x.shape[2]),
+            shape_a=(x.shape[1], x.shape[2]),
             kernel=tuple(weight.shape[:4]),
             # the pallas kernel runs its dot at default MXU precision: keep
             # explicit-precision calls on the XLA variants, which honor it
-            same_pad=(
-                pad_ha and pad_hb and not out_cn and in_cn_dims is None
-                and precision is None
-            ),
+            same_pad=pad_ha and pad_hb and precision is None,
             dtype=x.dtype,
         )
     if variant == "pallas":
         from ncnet_tpu.ops.conv4d_pallas import conv4d_small_cout
 
-        assert pad_ha and pad_hb and not out_cn and in_cn_dims is None, (
+        assert pad_ha and pad_hb, (
             "the pallas variant supports only the same-padded volume form"
         )
         assert precision is None, (
@@ -367,23 +311,12 @@ def conv4d(
             "XLA variant"
         )
         out = conv4d_small_cout(x, weight)
-        if bias is not None:
-            out = out + bias
-        return out
-    kwargs = {}
-    if out_cn:
-        assert variant in ("coutfold", "tapfold"), (
-            f"out_cn unsupported for {variant}"
+    else:
+        out = _VARIANTS[variant](
+            x, weight, precision=precision, pad_ha=pad_ha, pad_hb=pad_hb
         )
-        kwargs["out_cn"] = True
-    if in_cn_dims is not None:
-        assert variant == "toeplitz_b", f"in_cn_dims unsupported for {variant}"
-        kwargs["cn_dims"] = in_cn_dims
-    out = _VARIANTS[variant](
-        x, weight, precision=precision, pad_ha=pad_ha, pad_hb=pad_hb, **kwargs
-    )
     if bias is not None:
-        out = out + (bias[:, None] if out_cn else bias)
+        out = out + bias
     return out
 
 
