@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from ncnet_tpu.config import ModelConfig
 from ncnet_tpu.models import backbone as bb
 from ncnet_tpu.ops import (
+    choose_conv4d_variant,
     conv4d,
     conv4d_init,
     correlation_4d,
@@ -137,7 +138,23 @@ def neigh_consensus(
     x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
     if symmetric:
         xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))  # swap (hA,wA) ↔ (hB,wB)
-        if x.shape[1:3] == x.shape[3:5]:
+        # folding the two passes into the batch dim doubles every NC
+        # intermediate's live footprint — an OOM at the InLoc volume, and a
+        # formulation downgrade (conv4d's auto gate demotes the folded batch
+        # to 'unroll') at large training batches — so ask the one authority,
+        # the variant chooser itself, whether every layer keeps a channel-
+        # folding formulation at the doubled batch; otherwise run the two
+        # passes sequentially (their buffer lifetimes then barely overlap)
+        b, ha, wa, hb, wb = corr.shape
+        fold_ok = all(
+            choose_conv4d_variant(
+                layer["w"].shape[4], layer["w"].shape[5], hb, wb,
+                shape_a=(ha, wa), kernel=tuple(layer["w"].shape[:4]),
+                dtype=x.dtype, batch=2 * b,
+            ) != "unroll"
+            for layer in nc_params
+        )
+        if x.shape[1:3] == x.shape[3:5] and fold_ok:
             # square volume (hA,wA)==(hB,wB): fold the two passes into the
             # batch dim — one stack over 2B volumes fills the MXU better than
             # two B-sized passes (~12% at the PF-Pascal workload on v5e) and

@@ -11,7 +11,12 @@ The reference keeps PyTorch NCHW / (B,1,hA,wA,hB,wB) layouts
 
 from ncnet_tpu.ops.norm import feature_l2_norm
 from ncnet_tpu.ops.correlation import correlation_4d, correlation_3d
-from ncnet_tpu.ops.conv4d import choose_conv4d_variant, conv4d, conv4d_init
+from ncnet_tpu.ops.conv4d import (
+    choose_conv4d_variant,
+    conv4d,
+    conv4d_fold_fits,
+    conv4d_init,
+)
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
 from ncnet_tpu.ops.matching import (
     Matches,
@@ -39,6 +44,7 @@ __all__ = [
     "correlation_3d",
     "choose_conv4d_variant",
     "conv4d",
+    "conv4d_fold_fits",
     "conv4d_init",
     "maxpool4d_with_argmax",
     "mutual_matching",

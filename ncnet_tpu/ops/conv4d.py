@@ -192,6 +192,27 @@ _VARIANTS = {
 }
 
 
+# The channel-folding formulations materialize a kA·C-channel copy of the
+# whole volume (coutfold: kA·C_out; tapfold: kA·C_in).  At the PF-Pascal
+# training workload that copy is ~2GB and is the price of the fastest
+# formulation; at InLoc resolution (56M cells) it is tens of GB and a
+# guaranteed OOM on a 16GB chip.  Above this bound 'auto' falls back to the
+# tap-unrolled formulation, whose intermediates stay at 1× the volume.
+_FOLD_BYTES_LIMIT = 4 * 2**30
+
+
+def conv4d_fold_fits(
+    batch: int, ha: int, wa: int, hb: int, wb: int, k: int, ch: int, dtype
+) -> bool:
+    """True when the channel-folding formulations' kA·ch whole-volume copy
+    stays under ``_FOLD_BYTES_LIMIT`` — the same bound ``auto`` uses to
+    demote to ``unroll``.  Exposed so callers planning batch layouts (the
+    symmetric fold in models/ncnet.py) can consult the one authority instead
+    of duplicating the threshold."""
+    cells = batch * ha * wa * hb * wb
+    return cells * k * ch * jnp.dtype(dtype).itemsize <= _FOLD_BYTES_LIMIT
+
+
 def choose_conv4d_variant(
     c_in: int,
     c_out: int,
@@ -202,6 +223,7 @@ def choose_conv4d_variant(
     kernel: tuple | None = None,
     same_pad: bool = True,
     dtype=None,
+    batch: int | None = None,
 ) -> str:
     """Per-layer formulation choice, measured on v5e at the PF-Pascal 25⁴
     volume (batch 8, fp32, device-side scan-differenced timing — the honest
@@ -216,12 +238,23 @@ def choose_conv4d_variant(
                      (kA·kWA, hB·wB·C_in, hB·wB·C_out) weight-gradient tensor
 
     So coutfold wins the small-C_out case both ways and ``auto`` never picks
-    ``toeplitz_b`` anymore (the variant remains selectable explicitly).  With the full shape context (``shape_a=(ha, wa)``,
-    ``kernel``, ``dtype``) the small-C_out case upgrades to the Pallas
-    tap-folding kernel where Mosaic accepts it — true FLOPs at full MXU
-    lanes (see ops/conv4d_pallas.py for its current status)."""
+    ``toeplitz_b`` anymore (the variant remains selectable explicitly).  With
+    the full shape context (``shape_a=(ha, wa)``, ``kernel``, ``dtype``) the
+    small-C_out case upgrades to the Pallas tap-folding kernel where Mosaic
+    accepts it — true FLOPs at full MXU lanes (see ops/conv4d_pallas.py for
+    its current status) — and the channel-folding formulations are gated on
+    their ``_FOLD_BYTES_LIMIT`` memory blowup (InLoc-scale volumes use
+    ``unroll``)."""
+
+    def fold_fits(ch: int) -> bool:
+        if batch is None or shape_a is None or kernel is None or dtype is None:
+            return True  # shape context unknown: legacy small-volume callers
+        return conv4d_fold_fits(
+            batch, shape_a[0], shape_a[1], hb, wb, kernel[0], ch, dtype
+        )
+
     if c_in <= 4:
-        return "tapfold"
+        return "tapfold" if fold_fits(c_in) else "unroll"
     if c_out <= 4:
         if (
             same_pad
@@ -246,7 +279,7 @@ def choose_conv4d_variant(
                 dtype_name=jnp.dtype(dtype).name,
             ):
                 return "pallas"
-    return "coutfold"
+    return "coutfold" if fold_fits(c_out) else "unroll"
 
 
 @functools.lru_cache(maxsize=1)
@@ -299,6 +332,7 @@ def conv4d(
             # explicit-precision calls on the XLA variants, which honor it
             same_pad=pad_ha and pad_hb and precision is None,
             dtype=x.dtype,
+            batch=x.shape[0],
         )
     if variant == "pallas":
         from ncnet_tpu.ops.conv4d_pallas import conv4d_small_cout

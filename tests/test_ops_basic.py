@@ -196,3 +196,28 @@ def test_conv4d_pallas_backward_fallback(rng):
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(gw), np.asarray(want_gw),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_conv4d_auto_demotes_folding_at_inloc_scale():
+    """The channel-folding formulations materialize a kA·C whole-volume copy
+    — tens of GB at the InLoc volume.  'auto' must demote to the 1×-volume
+    unroll formulation there, and keep the folds at the PF-Pascal scale."""
+    from ncnet_tpu.ops import choose_conv4d_variant, conv4d_fold_fits
+    import jax.numpy as jnp
+
+    inloc = dict(shape_a=(75, 100), hb=75, wb=100)
+    pf = dict(shape_a=(25, 25), hb=25, wb=25)
+
+    # 16->16 middle layer, bf16, sequential symmetric passes (batch 1)
+    assert choose_conv4d_variant(
+        16, 16, inloc["hb"], inloc["wb"], shape_a=inloc["shape_a"],
+        kernel=(5,) * 4, dtype=jnp.bfloat16, batch=1,
+    ) == "unroll"
+    # PF-Pascal training at the folded batch keeps coutfold
+    assert choose_conv4d_variant(
+        16, 16, pf["hb"], pf["wb"], shape_a=pf["shape_a"],
+        kernel=(5,) * 4, dtype=jnp.float32, batch=16,
+    ) == "coutfold"
+    # the shared gate agrees with both decisions
+    assert not conv4d_fold_fits(1, 75, 100, 75, 100, 5, 16, jnp.bfloat16)
+    assert conv4d_fold_fits(16, 25, 25, 25, 25, 5, 16, jnp.float32)
