@@ -7,6 +7,7 @@ plain numpy on tiny synthetic data — see each test's docstring.
 import math
 import os
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from scipy.io import loadmat
@@ -216,3 +217,31 @@ def test_run_inloc_eval_spatial_shards_parity(tmp_path):
     m1 = loadmat(os.path.join(out_plain, "1.mat"))["matches"]
     m2 = loadmat(os.path.join(out_sharded, "1.mat"))["matches"]
     np.testing.assert_allclose(m2, m1, rtol=1e-5, atol=1e-6)
+
+
+def test_device_preprocess_matches_host_path(tmp_path):
+    """The jitted uint8→normalize→resize path must reproduce the host-side
+    load_and_preprocess (same normalize-then-resize order, same align-corners
+    resize) — it replaces it in the eval loop to cut host→device traffic."""
+    from PIL import Image
+
+    from ncnet_tpu.evaluation.inloc import (
+        device_preprocess,
+        load_and_preprocess,
+        load_raw,
+    )
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (60, 80, 3), dtype=np.uint8)
+    path = os.path.join(str(tmp_path), "img.png")  # lossless: exact parity
+    Image.fromarray(img).save(path)
+
+    host = load_and_preprocess(path, image_size=64, k_size=2)
+    dev = np.asarray(device_preprocess(
+        jnp.asarray(load_raw(path)), image_size=64, k_size=2))
+    assert host.shape == dev.shape
+    # the two paths round differently (independent compilations; numpy scalar
+    # promotion in the host normalize): ~3e-5 skew through the 1/std scaling
+    # is expected, while a formula or resize-order error would be orders of
+    # magnitude larger
+    np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-4)
