@@ -1,0 +1,8 @@
+#!/usr/bin/env python
+"""InLoc localization from NCNet matches — the reference's MATLAB stage
+(compute_densePE_NCNet.m) as a self-contained Python pipeline."""
+
+from ncnet_tpu.cli.compute_localization import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,16 @@
+#!/bin/bash
+# InLoc evaluation assets: database cutouts (+ XYZcut depth .mat) and iPhone 7
+# query images.  ~100 GB total.  Run from this directory: bash download.sh
+#
+# Also needed, from the InLoc project page (http://www.ok.sc.e.titech.ac.jp/INLOC/):
+#   densePE_top100_shortlist_cvpr18.mat   (per-query retrieval shortlist)
+#   scans/ + <floor>/transformations/     (for pose verification)
+#   DUC_refposes_all.mat                  (ground-truth poses, for the curves)
+set -e
+
+wget -c http://www.ok.sc.e.titech.ac.jp/INLOC/materials/cutouts.tar.gz
+wget -c http://www.ok.sc.e.titech.ac.jp/INLOC/materials/iphone7.tar.gz
+
+mkdir -p pano query
+tar -xzf cutouts.tar.gz -C pano
+tar -xzf iphone7.tar.gz -C query

@@ -18,7 +18,10 @@ Layout:
                 synthetic fixtures)
     training/   weak-supervision loss + jitted train loop
     evaluation/ PF-Pascal PCK + InLoc dense-matching (.mat writer)
-    utils/      seeding
+    localization/ the InLoc downstream stage (the reference's MATLAB L6):
+                batched P3P LO-RANSAC PnP, synthetic-view pose verification,
+                localization curves
+    utils/      seeding, profiling, plot helpers
     cli/        entry points mirroring the reference CLIs
 """
 

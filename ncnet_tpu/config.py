@@ -111,6 +111,33 @@ class EvalInLocConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LocalizationConfig:
+    """InLoc downstream localization (the reference's MATLAB L6 stage,
+    compute_densePE_NCNet.m: thresholds at :33-34, pnp_topN at :31)."""
+
+    matches_dir: str = ""                # matches/<experiment> from eval_inloc
+    shortlist: str = "datasets/inloc/densePE_top100_shortlist_cvpr18.mat"
+    query_path: str = "datasets/inloc/query/iphone7/"
+    cutout_path: str = "datasets/inloc/pano/"     # cutout images + XYZcut .mat
+    cutout_mat_suffix: str = ".mat"      # appended to the cutout name
+    scan_path: str = "datasets/inloc/scans/"      # *_scan_*.ptx.mat
+    scan_suffix: str = ".ptx.mat"
+    transformation_path: str = "datasets/inloc/"  # <floor>/transformations/
+    refposes: str = "datasets/inloc/DUC_refposes_all.mat"
+    output_dir: str = "outputs_localization"
+    pnp_topN: int = 10                   # candidates per query
+    match_score_thr: float = 0.75        # params.ncnet.thr
+    pnp_inlier_thr_deg: float = 0.2      # params.ncnet.pnp_thr (degrees)
+    ransac_iters: int = 10000
+    max_tentatives: int = 0              # params.ncnet.N_subsample; 0 = all
+    do_pose_verification: bool = True    # the densePV rerank stage
+    query_focal_length: float = 0.0      # pixels; 0 → iPhone 7 EXIF default
+    n_queries: int = 0                   # 0 = all queries in the shortlist
+    seed: int = 0
+    progress: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout.  axes: data-parallel pairs × spatial volume shards."""
 

@@ -1,0 +1,281 @@
+"""The InLoc localization driver (compute_densePE_NCNet.m, end to end).
+
+Consumes the ``matches/<experiment>/<q>.mat`` tables written by
+``eval_inloc``, estimates a pose per (query, top-10 cutout) with the batched
+LO-RANSAC P3P, optionally re-ranks the candidates by synthetic-view pose
+verification, and emits the localization-rate curves against the reference
+poses.  Every stage persists .mat artifacts and resumes from them — the
+reference's resume-by-artifact failure story (SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ncnet_tpu.config import LocalizationConfig
+from ncnet_tpu.localization import geometry
+from ncnet_tpu.localization.curves import (
+    MethodResult,
+    load_reference_poses,
+    plot_localization_curves,
+)
+from ncnet_tpu.localization.pnp import run_pair_pnp
+from ncnet_tpu.localization.scan import (
+    load_transformation,
+    load_xyzcut,
+    transformation_path,
+)
+from ncnet_tpu.localization.verification import (
+    PVItem,
+    rerank_by_scores,
+    run_pose_verification,
+)
+
+
+def image_size(path: str) -> Tuple[int, int]:
+    """(height, width) from the image header, without decoding pixels."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        w, h = im.size
+    return h, w
+
+
+def query_focal(config: LocalizationConfig, width: int) -> float:
+    """Configured query focal length, or the iPhone 7 EXIF-derived default
+    (the reference reads ``params.data.q.fl`` from its external project
+    setup)."""
+    if config.query_focal_length > 0:
+        return config.query_focal_length
+    return geometry.iphone7_focal(width)
+
+
+def _cell_row(items) -> np.ndarray:
+    """(1, N) object array — a MATLAB cell row.  Built element-wise because
+    ``np.array(list_of_equal_shape_arrays, dtype=object)`` would broadcast
+    into one numeric block instead of N cells."""
+    out = np.empty((1, len(items)), dtype=object)
+    for i, v in enumerate(items):
+        out[0, i] = v
+    return out
+
+
+def _save_imglist(path: str, imglist: List[dict]) -> None:
+    from scipy.io import savemat
+
+    savemat(
+        path,
+        {
+            "ImgList": np.array(
+                [
+                    (
+                        e["queryname"],
+                        _cell_row(e["topNname"]),
+                        np.asarray(e.get("topNscore", []), dtype=np.float64
+                                   ).reshape(1, -1),
+                        _cell_row(e["P"]),
+                    )
+                    for e in imglist
+                ],
+                dtype=[
+                    ("queryname", object),
+                    ("topNname", object),
+                    ("topNscore", object),
+                    ("P", object),
+                ],
+            ).reshape(1, -1)
+        },
+        do_compression=True,
+    )
+
+
+def _load_imglist(path: str) -> List[dict]:
+    from scipy.io import loadmat
+
+    mat = loadmat(path, simplify_cells=True)
+    entries = mat["ImgList"]
+    if isinstance(entries, dict):
+        entries = [entries]
+    out = []
+    for e in entries:
+        names = e["topNname"]
+        if isinstance(names, str):
+            names = [names]
+        poses = e["P"]
+        if isinstance(poses, np.ndarray) and poses.ndim == 2:
+            poses = [poses]
+        scores = e.get("topNscore", [])
+        if isinstance(scores, (int, float)):
+            scores = [scores]
+        out.append(
+            {
+                "queryname": str(e["queryname"]),
+                "topNname": [str(n) for n in names],
+                "topNscore": list(np.asarray(scores, dtype=np.float64).ravel()),
+                "P": [np.asarray(p, dtype=np.float64) for p in poses],
+            }
+        )
+    return out
+
+
+def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
+    """Pose per (query, top-N cutout) from the dense matches
+    (ir_top100_NC4D_localization_pnponly.m).  Returns the ImgList and writes
+    ``top_<N>_thr..._rthr....mat``; reloads it when it already exists."""
+    from scipy.io import loadmat
+
+    from ncnet_tpu.evaluation.inloc import _as_str, load_shortlist
+
+    out_path = os.path.join(config.output_dir, _pnp_matname(config))
+    if os.path.exists(out_path):
+        return _load_imglist(out_path)
+
+    query_fns, pano_fns = load_shortlist(config.shortlist)
+    n_queries = len(query_fns)
+    if config.n_queries > 0:
+        n_queries = min(n_queries, config.n_queries)
+    pnp_dir = os.path.join(config.output_dir, _pnp_dirname(config))
+
+    imglist: List[dict] = []
+    for qi in range(n_queries):
+        qname = query_fns[qi]
+        qpath = os.path.join(config.query_path, qname)
+        qsize = image_size(qpath)
+        focal = query_focal(config, qsize[1])
+        match_mat = loadmat(
+            os.path.join(config.matches_dir, f"{qi + 1}.mat")
+        )["matches"]
+        top_names = [_as_str(n) for n in np.asarray(pano_fns[qi]).ravel()]
+        # the match table's pano depth bounds how many candidates exist
+        top_names = top_names[: min(config.pnp_topN, match_mat.shape[1])]
+        poses: List[np.ndarray] = []
+        for jj, db_fn in enumerate(top_names):
+            xyzcut = load_xyzcut(
+                os.path.join(
+                    config.cutout_path, db_fn + config.cutout_mat_suffix
+                )
+            )
+            P_after = load_transformation(
+                transformation_path(config.transformation_path, db_fn)
+            )
+            P, _ = run_pair_pnp(
+                pnp_dir,
+                qname,
+                db_fn,
+                match_mat[0, jj],
+                qsize,
+                xyzcut,
+                P_after,
+                focal,
+                score_thr=config.match_score_thr,
+                inlier_thr_deg=config.pnp_inlier_thr_deg,
+                ransac_iters=config.ransac_iters,
+                seed=config.seed,
+                max_tentatives=config.max_tentatives,
+            )
+            poses.append(P)
+            if config.progress:
+                print(f"nc4dPE: {qname} vs {db_fn} DONE.")
+        imglist.append(
+            {"queryname": qname, "topNname": top_names, "P": poses}
+        )
+    os.makedirs(config.output_dir, exist_ok=True)
+    _save_imglist(out_path, imglist)
+    return imglist
+
+
+def run_pv_stage(
+    config: LocalizationConfig, imglist: List[dict]
+) -> List[dict]:
+    """Pose-verification rerank of each query's candidates
+    (ht_top10_NC4D_PV_localization.m); writes/reloads the densePV ImgList."""
+    out_path = os.path.join(config.output_dir, _pv_matname(config))
+    if os.path.exists(out_path):
+        return _load_imglist(out_path)
+
+    items = [
+        PVItem(e["queryname"], db_fn, P)
+        for e in imglist
+        for db_fn, P in zip(e["topNname"], e["P"])
+    ]
+
+    def query_loader(fn: str) -> np.ndarray:
+        from ncnet_tpu.data.datasets import load_image
+
+        return load_image(os.path.join(config.query_path, fn))
+
+    scores = run_pose_verification(
+        items,
+        query_loader,
+        scan_dir=config.scan_path,
+        trans_dir=config.transformation_path,
+        focal_fn=lambda fn, img: query_focal(config, img.shape[1]),
+        out_dir=os.path.join(config.output_dir, _pv_dirname(config)),
+        scan_suffix=config.scan_suffix,
+        progress=config.progress,
+    )
+
+    reranked = []
+    for e in imglist:
+        s = [scores[(e["queryname"], n)] for n in e["topNname"]]
+        names, poses, s = rerank_by_scores(e["topNname"], e["P"], s)
+        reranked.append(
+            {
+                "queryname": e["queryname"],
+                "topNname": names,
+                "topNscore": s,
+                "P": poses,
+            }
+        )
+    _save_imglist(out_path, reranked)
+    return reranked
+
+
+def run_localization(config: LocalizationConfig) -> Dict[str, np.ndarray]:
+    """The full L6 pipeline; returns ``{method description: curve}`` and
+    writes curves/figures/error txts into ``config.output_dir``."""
+    imglist = run_pnp_stage(config)
+    methods = [
+        MethodResult(
+            "DensePE + NCNet",
+            {e["queryname"]: (e["topNname"][0], e["P"][0]) for e in imglist},
+        )
+    ]
+    if config.do_pose_verification:
+        reranked = run_pv_stage(config, imglist)
+        methods.append(
+            MethodResult(
+                "InLoc + NCNet",
+                {
+                    e["queryname"]: (e["topNname"][0], e["P"][0])
+                    for e in reranked
+                },
+            )
+        )
+    refposes = load_reference_poses(config.refposes)
+    return plot_localization_curves(methods, refposes, config.output_dir)
+
+
+def _pnp_dirname(config: LocalizationConfig) -> str:
+    return (
+        f"top_{config.pnp_topN}_PnP_thr{int(config.match_score_thr * 100):03d}"
+        f"_rthr{int(config.pnp_inlier_thr_deg * 100):03d}"
+    )
+
+
+def _pnp_matname(config: LocalizationConfig) -> str:
+    return (
+        f"top_{config.pnp_topN}_thr{int(config.match_score_thr * 100):03d}"
+        f"_rthr{int(config.pnp_inlier_thr_deg * 100):03d}.mat"
+    )
+
+
+def _pv_dirname(config: LocalizationConfig) -> str:
+    return _pnp_matname(config)[:-4] + "_densePV"
+
+
+def _pv_matname(config: LocalizationConfig) -> str:
+    return _pnp_matname(config)[:-4] + "_densePV.mat"

@@ -1,0 +1,626 @@
+"""Tests for the localization stage (the reference's MATLAB L6 pipeline).
+
+Oracles are synthetic scenes with known geometry: poses are drawn at random,
+3D points projected exactly, and every estimator must recover what generated
+its input — the strategy SURVEY §4 prescribes for reference-free components.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.localization import geometry
+from ncnet_tpu.localization.p3p import (
+    lo_ransac_p3p,
+    p3p_solve,
+    refine_pose_object_space,
+)
+
+
+def random_pose(rng, depth=4.0):
+    A = rng.normal(size=(3, 3))
+    Q, _ = np.linalg.qr(A)
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    t = rng.normal(size=3) * 0.3 + np.array([0.0, 0.0, depth])
+    return np.concatenate([Q, t[:, None]], axis=1)
+
+
+def rays_for(P, X):
+    xc = X @ P[:, :3].T + P[:, 3]
+    return xc / np.linalg.norm(xc, axis=1, keepdims=True)
+
+
+class TestGeometry:
+    def test_camera_center_roundtrip(self, rng):
+        P = random_pose(rng)
+        C = geometry.camera_center(P)
+        # projecting the center must give the zero vector in camera frame
+        np.testing.assert_allclose(P[:, :3] @ C + P[:, 3], 0.0, atol=1e-12)
+
+    def test_pose_distance_identity(self, rng):
+        P = random_pose(rng)
+        dp, do = geometry.pose_distance(P, P)
+        assert dp == pytest.approx(0.0, abs=1e-12)
+        assert do == pytest.approx(0.0, abs=1e-6)
+
+    def test_pose_distance_known_rotation(self):
+        P1 = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        ang = 0.3
+        R = np.array(
+            [
+                [np.cos(ang), -np.sin(ang), 0.0],
+                [np.sin(ang), np.cos(ang), 0.0],
+                [0.0, 0.0, 1.0],
+            ]
+        )
+        P2 = np.concatenate([R, np.zeros((3, 1))], axis=1)
+        dp, do = geometry.pose_distance(P1, P2)
+        assert dp == pytest.approx(0.0, abs=1e-12)
+        assert do == pytest.approx(ang, abs=1e-9)
+
+    def test_project_pixel_rays_roundtrip(self, rng):
+        P = random_pose(rng)
+        K = geometry.intrinsics(500.0, 480, 640)
+        X = rng.uniform(-1, 1, (50, 3))
+        xy, depth = geometry.project_points(P, K, X)
+        assert np.all(depth > 0)
+        rays = geometry.pixel_rays(K, xy)
+        xc = X @ P[:, :3].T + P[:, 3]
+        cos = np.sum(rays * xc / np.linalg.norm(xc, axis=1, keepdims=True), 1)
+        np.testing.assert_allclose(cos, 1.0, atol=1e-12)
+
+    def test_cap_longest_side(self):
+        # at_imageresize_nc4d.m: cap 1920, aspect preserved, never upscale
+        assert geometry.cap_longest_side_shape(3840, 2880) == (1920, 1440)
+        assert geometry.cap_longest_side_shape(2880, 3840) == (1440, 1920)
+        assert geometry.cap_longest_side_shape(1000, 800) == (1000, 800)
+
+
+class TestP3P:
+    def test_minimal_recovers_pose(self, rng):
+        for _ in range(20):
+            P = random_pose(rng)
+            X = rng.uniform(-1, 1, (3, 3))
+            sols = p3p_solve(rays_for(P, X)[None], X[None])[0]
+            errs = [
+                sum(geometry.pose_distance(P, s))
+                for s in sols
+                if np.isfinite(s[0, 0])
+            ]
+            assert errs and min(errs) < 1e-6
+
+    def test_ransac_with_outliers(self, rng):
+        P = random_pose(rng)
+        N = 300
+        X = rng.uniform(-2, 2, (N, 3))
+        rays = rays_for(P, X)
+        out = rng.random(N) < 0.4
+        bad = rng.normal(size=(out.sum(), 3))
+        rays[out] = bad / np.linalg.norm(bad, axis=1, keepdims=True)
+        res = lo_ransac_p3p(rays, X, np.deg2rad(0.2), iters=500, seed=1)
+        dp, do = geometry.pose_distance(P, res.P)
+        assert dp < 1e-6 and do < 1e-8
+        np.testing.assert_array_equal(res.inliers, ~out)
+
+    def test_ransac_with_noise(self, rng):
+        P = random_pose(rng)
+        K = geometry.intrinsics(800.0, 600, 800)
+        X = rng.uniform(-2, 2, (200, 3))
+        xy, _ = geometry.project_points(P, K, X)
+        xy += rng.normal(scale=0.5, size=xy.shape)  # half-pixel noise
+        rays = geometry.pixel_rays(K, xy)
+        res = lo_ransac_p3p(rays, X, np.deg2rad(0.2), iters=500, seed=2)
+        dp, do = geometry.pose_distance(P, res.P)
+        assert dp < 0.05 and np.rad2deg(do) < 0.5
+        assert res.num_inliers > 100
+
+    def test_ransac_degenerate_input(self):
+        res = lo_ransac_p3p(np.zeros((2, 3)), np.zeros((2, 3)), 0.01, iters=10)
+        assert np.all(np.isnan(res.P))
+        assert res.num_inliers == 0
+
+    def test_refinement_improves_noisy_pose(self, rng):
+        P = random_pose(rng)
+        X = rng.uniform(-2, 2, (100, 3))
+        rays = rays_for(P, X)
+        # perturb: small rotation + translation offset
+        d = 0.05
+        Rp = np.array(
+            [[np.cos(d), -np.sin(d), 0], [np.sin(d), np.cos(d), 0], [0, 0, 1]]
+        )
+        P0 = np.concatenate(
+            [Rp @ P[:, :3], P[:, 3:] + rng.normal(scale=0.05, size=(3, 1))], 1
+        )
+        P_ref = refine_pose_object_space(rays, X, P0)
+        dp0, do0 = geometry.pose_distance(P, P0)
+        dp1, do1 = geometry.pose_distance(P, P_ref)
+        assert dp1 < dp0 * 0.01 and do1 < do0 * 0.01
+
+
+class TestScan:
+    def test_parse_cutout_name(self):
+        from ncnet_tpu.localization.scan import parse_cutout_name
+
+        info = parse_cutout_name("DUC1/DUC_cutout_024_30_0.jpg")
+        assert info == ("DUC1", "DUC", "024")
+
+    def test_paths(self):
+        from ncnet_tpu.localization.scan import scan_path, transformation_path
+
+        name = "DUC2/DUC_cutout_005_120_30.jpg"
+        assert transformation_path("/d", name) == (
+            "/d/DUC2/transformations/DUC_trans_005.txt"
+        )
+        assert scan_path("/s", name) == "/s/DUC2/DUC_scan_005.ptx.mat"
+
+    def test_load_transformation(self, tmp_path):
+        from ncnet_tpu.localization.scan import load_transformation
+
+        M1 = np.arange(16, dtype=float).reshape(4, 4)
+        M2 = np.linalg.inv(np.eye(4) + 0.1)
+        path = tmp_path / "t.txt"
+        with open(path, "w") as f:
+            f.write("WUSTL transformation file\nheader line two\n")
+            for row in M1:
+                f.write(" ".join(f"{v:.8f}" for v in row) + "\n")
+            f.write("P_after:\n")
+            for row in M2:
+                f.write(" ".join(f"{v:.8f}" for v in row) + "\n")
+        np.testing.assert_allclose(load_transformation(str(path)), M2, atol=1e-7)
+
+    def test_backproject_semantics(self):
+        from ncnet_tpu.localization.scan import backproject_matches
+
+        H, W = 10, 20
+        gx, gy = np.meshgrid(np.arange(W), np.arange(H), indexing="xy")
+        xyz = np.stack(
+            [gx, gy, np.ones((H, W))], axis=2
+        ).astype(float)  # pixel (r,c) holds [c, r, 1]
+        xyz[0, 0] = np.nan  # a hole
+        P_after = np.eye(4)
+        # the reference gather: 1-based floor(size·coord), zeros bumped to 1
+        xy = np.array(
+            [
+                [0.0, 0.0],          # floor→0, bumped → 0-based pixel (0,0): NaN hole
+                [(5 + 1.2) / W, (3 + 1.2) / H],  # lands on 0-based (3,5)
+                [1.0, 1.0],          # clamps to the last pixel
+            ]
+        )
+        pts, keep, px = backproject_matches(xyz, xy, P_after)
+        np.testing.assert_array_equal(keep, [False, True, True])
+        np.testing.assert_array_equal(px[1], [5, 3])
+        np.testing.assert_allclose(pts[0], [5.0, 3.0, 1.0])
+        np.testing.assert_allclose(pts[1], [W - 1.0, H - 1.0, 1.0])
+
+    def test_transform_points_homogeneous(self, rng):
+        from ncnet_tpu.localization.scan import transform_points
+
+        T = np.eye(4)
+        T[:3, :3] = random_pose(rng)[:, :3]
+        T[:3, 3] = [1.0, -2.0, 0.5]
+        X = rng.normal(size=(20, 3))
+        np.testing.assert_allclose(
+            transform_points(T, X), X @ T[:3, :3].T + T[:3, 3], atol=1e-12
+        )
+
+
+class TestRender:
+    def test_zbuffer_occlusion_and_nan(self):
+        from ncnet_tpu.localization.render import render_points_perspective
+
+        K = geometry.intrinsics(50.0, 40, 60)
+        KP = K @ np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        # two points on the same ray: the nearer must win
+        ray = np.linalg.inv(K) @ np.array([30.0, 20.0, 1.0])
+        pts = np.stack([ray * 5.0, ray * 2.0])
+        rgb = np.array([[10, 10, 10], [200, 0, 0]], dtype=np.uint8)
+        img, xyz = render_points_perspective(rgb, pts, KP, 40, 60)
+        np.testing.assert_array_equal(img[20, 30], [200, 0, 0])
+        assert xyz[20, 30, 2] == pytest.approx(2.0)
+        # everything else is a NaN hole / zero color
+        assert np.isnan(xyz[0, 0]).all() and (img[0, 0] == 0).all()
+
+    def test_behind_camera_ignored(self):
+        from ncnet_tpu.localization.render import render_points_perspective
+
+        K = geometry.intrinsics(50.0, 40, 60)
+        KP = K @ np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        img, xyz = render_points_perspective(
+            np.array([[255, 255, 255]], np.uint8),
+            np.array([[0.0, 0.0, -3.0]]),
+            KP, 40, 60,
+        )
+        assert np.isnan(xyz[..., 0]).all()
+
+
+class TestDSIFT:
+    def test_descriptor_shape_and_norm(self, rng):
+        from ncnet_tpu.localization.dsift import (
+            dense_sift,
+            descriptor_grid,
+            rootsift,
+        )
+
+        img = rng.random((120, 160))
+        d = dense_sift(img)
+        ys, xs = descriptor_grid(120, 160)
+        assert d.shape == (len(ys), len(xs), 128)
+        norms = np.linalg.norm(d, axis=-1)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-4)
+        r = rootsift(d)
+        np.testing.assert_allclose(np.linalg.norm(r, axis=-1), 1.0, atol=1e-4)
+
+    def test_score_prefers_matching_image(self, rng):
+        from ncnet_tpu.localization.dsift import pose_verification_score
+
+        img = rng.random((120, 160))
+        mask = np.ones((120, 160), bool)
+        # photometric affine change must not hurt (masked normalization)
+        s_same = pose_verification_score(img, img * 2.0 + 1.0, mask)
+        s_shift = pose_verification_score(img, np.roll(img, 8, axis=1), mask)
+        assert s_same > s_shift > 0
+
+    def test_score_empty_mask_is_zero(self, rng):
+        from ncnet_tpu.localization.dsift import pose_verification_score
+
+        img = rng.random((64, 64))
+        assert pose_verification_score(img, img, np.zeros((64, 64), bool)) == 0.0
+
+    def test_inpaint_fills_holes(self, rng):
+        from ncnet_tpu.localization.dsift import inpaint_nans
+
+        img = np.ones((30, 30))
+        img[10:15, 10:15] = np.nan
+        out = inpaint_nans(img)
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, 1.0, atol=1e-6)
+
+
+class TestCurves:
+    def test_rates_and_gating(self, tmp_path):
+        from ncnet_tpu.localization.curves import (
+            ERROR_THRESHOLDS,
+            MethodResult,
+            localized_rate_curve,
+            plot_localization_curves,
+            pose_errors,
+        )
+
+        eye = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+
+        def shifted(dx, ang=0.0):
+            R = np.array(
+                [
+                    [np.cos(ang), -np.sin(ang), 0],
+                    [np.sin(ang), np.cos(ang), 0],
+                    [0, 0, 1],
+                ]
+            )
+            return np.concatenate([R, -R @ np.array([[dx], [0], [0]])], axis=1)
+
+        refposes = {
+            "DUC1": {"q1.jpg": eye, "q2.jpg": eye},
+            "DUC2": {"q3.jpg": eye, "q4.jpg": eye},
+        }
+        method = MethodResult(
+            "test",
+            {
+                "q1.jpg": ("DUC1/a.jpg", shifted(0.10)),   # 10 cm error
+                "q2.jpg": ("DUC2/a.jpg", shifted(0.10)),   # wrong floor → inf
+                "q3.jpg": ("DUC2/a.jpg", shifted(0.50)),   # 50 cm error
+                "q4.jpg": ("DUC2/a.jpg", shifted(0.05, np.deg2rad(20))),
+                # orientation 20° > 10° gate → rejected
+            },
+        )
+        poserr, orierr, names = pose_errors(method, refposes)
+        assert len(names) == 4
+        curve = localized_rate_curve(poserr, orierr)
+        # thresholds: at 0.25m only q1 counts (q2 wrong floor, q4 gated)
+        i25 = np.abs(ERROR_THRESHOLDS - 0.25).argmin()
+        i75 = np.abs(ERROR_THRESHOLDS - 0.75).argmin()
+        assert curve[i25] == pytest.approx(0.25)
+        assert curve[i75] == pytest.approx(0.5)  # q1 + q3
+        curves = plot_localization_curves([method], refposes, str(tmp_path))
+        np.testing.assert_allclose(curves["test"], curve)
+        assert os.path.exists(tmp_path / "error_test.txt")
+        assert len(open(tmp_path / "error_test.txt").read().splitlines()) == 4
+        assert any(f.suffix == ".png" for f in tmp_path.iterdir())
+
+
+def make_plane_scene(rng, n=160, m=200):
+    """A textured plane z=5 (global coords): grid points + smooth colors."""
+    xs = np.linspace(-2.4, 2.4, m)
+    ys = np.linspace(-1.8, 1.8, n)
+    gx, gy = np.meshgrid(xs, ys, indexing="xy")
+    pts = np.stack([gx, gy, np.full_like(gx, 5.0)], axis=2).reshape(-1, 3)
+    # smooth random texture (low-frequency so SIFT sees gradients, not noise)
+    base = rng.random((n // 8 + 2, m // 8 + 2, 3))
+    from ncnet_tpu.ops.image import resize_bilinear_align_corners_np
+
+    tex = resize_bilinear_align_corners_np(
+        base.astype(np.float32), n, m
+    ).reshape(-1, 3)
+    rgb = np.clip(tex * 255, 0, 255).astype(np.uint8)
+    return pts, rgb
+
+
+class TestPnPPipeline:
+    def test_estimate_pose_from_matches(self, rng, tmp_path):
+        from ncnet_tpu.localization.pnp import estimate_pose_from_matches
+
+        # db camera at origin; its XYZcut holds plane points per pixel
+        Hdb, Wdb = 60, 80
+        Kdb = geometry.intrinsics(70.0, Hdb, Wdb)
+        inv = np.linalg.inv(Kdb)
+        cols, rows = np.meshgrid(np.arange(Wdb), np.arange(Hdb), indexing="xy")
+        d = 5.0
+        pix = np.stack([cols, rows, np.ones_like(cols)], axis=2).astype(float)
+        xyzcut = (pix @ inv.T) * d  # depth-5 plane in db-local coords
+        T = np.eye(4)  # scan-local == global for this test
+        # query pose: near the db camera, mildly rotated, still facing the
+        # plane (a fully random orientation would see none of it)
+        ang = 0.1
+        Rq = np.array(
+            [
+                [np.cos(ang), 0, np.sin(ang)],
+                [0, 1, 0],
+                [-np.sin(ang), 0, np.cos(ang)],
+            ]
+        )
+        P_q = np.concatenate(
+            [Rq, np.array([[0.2], [-0.15], [0.3]])], axis=1
+        )
+        qh, qw = 300, 400
+        focal = 350.0
+        Kq = geometry.intrinsics(focal, qh, qw)
+        # pick db pixels, build normalized match rows
+        sel = rng.choice(Hdb * Wdb, size=150, replace=False)
+        r_sel, c_sel = sel // Wdb, sel % Wdb
+        X = xyzcut[r_sel, c_sel]
+        xy_q, depth = geometry.project_points(P_q, Kq, X)
+        ok = (
+            (depth > 0)
+            & (xy_q[:, 0] > 0) & (xy_q[:, 0] < qw)
+            & (xy_q[:, 1] > 0) & (xy_q[:, 1] < qh)
+        )
+        X, xy_q, r_sel, c_sel = X[ok], xy_q[ok], r_sel[ok], c_sel[ok]
+        matches = np.zeros((len(X), 5))
+        matches[:, 0] = xy_q[:, 0] / qw
+        matches[:, 1] = xy_q[:, 1] / qh
+        matches[:, 2] = (c_sel + 1.2) / Wdb  # gathers back to (r,c) exactly
+        matches[:, 3] = (r_sel + 1.2) / Hdb
+        matches[:, 4] = 0.9
+        # outliers above threshold + chaff below it
+        n_out = 40
+        junk = rng.random((n_out, 5))
+        junk[:, 4] = 0.8
+        low = rng.random((30, 5))
+        low[:, 4] = 0.1  # must be dropped by the 0.75 threshold
+        res = estimate_pose_from_matches(
+            np.concatenate([matches, junk, low]),
+            (qh, qw), xyzcut, T, focal,
+            ransac_iters=800, seed=3,
+        )
+        dp, do = geometry.pose_distance(P_q, res.P)
+        assert dp < 0.02 and np.rad2deg(do) < 0.2
+        assert res.inliers.sum() >= len(X) * 0.9
+
+    def test_run_pair_pnp_resume(self, rng, tmp_path):
+        from ncnet_tpu.localization.pnp import pnp_artifact_path, run_pair_pnp
+
+        xyzcut = rng.uniform(-1, 1, (8, 8, 3)) + np.array([0, 0, 5.0])
+        args = dict(
+            matches=np.zeros((4, 5)),  # nothing above threshold → NaN pose
+            query_size=(100, 100),
+            xyzcut=xyzcut,
+            P_after=np.eye(4),
+            focal=100.0,
+        )
+        P1, _ = run_pair_pnp(str(tmp_path), "q.jpg", "DUC1/DUC_cutout_001_0_0.jpg", **args)
+        assert np.isnan(P1).all()
+        art = pnp_artifact_path(str(tmp_path), "q.jpg", "DUC1/DUC_cutout_001_0_0.jpg")
+        assert os.path.exists(art)
+        mtime = os.path.getmtime(art)
+        P2, _ = run_pair_pnp(str(tmp_path), "q.jpg", "DUC1/DUC_cutout_001_0_0.jpg", **args)
+        assert os.path.getmtime(art) == mtime  # loaded, not recomputed
+        np.testing.assert_array_equal(np.isnan(P1), np.isnan(P2))
+
+
+class TestVerification:
+    def test_true_pose_scores_higher(self, rng):
+        from ncnet_tpu.localization.render import render_points_perspective
+        from ncnet_tpu.localization.verification import verify_pose
+
+        pts, rgb = make_plane_scene(rng, n=320, m=400)
+        P_true = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        qh, qw = 256, 320
+        focal = 300.0
+        K = geometry.intrinsics(focal, qh, qw)
+        query, _ = render_points_perspective(rgb, pts, K @ P_true, qh, qw)
+        ang = np.deg2rad(10)
+        R = np.array(
+            [
+                [np.cos(ang), 0, np.sin(ang)],
+                [0, 1, 0],
+                [-np.sin(ang), 0, np.cos(ang)],
+            ]
+        )
+        P_wrong = np.concatenate([R, np.array([[0.6], [0.0], [0.0]])], axis=1)
+        s_true, _, _ = verify_pose(query, P_true, pts, rgb, focal)
+        s_wrong, _, _ = verify_pose(query, P_wrong, pts, rgb, focal)
+        s_nan, _, _ = verify_pose(query, np.full((3, 4), np.nan), pts, rgb, focal)
+        assert s_true > s_wrong > 0
+        assert s_nan == 0.0
+
+    def test_group_by_scan(self):
+        from ncnet_tpu.localization.verification import (
+            PVItem,
+            group_items_by_scan,
+        )
+
+        P = np.zeros((3, 4))
+        items = [
+            PVItem("q1", "DUC1/DUC_cutout_001_0_0.jpg", P),
+            PVItem("q2", "DUC1/DUC_cutout_001_30_0.jpg", P),
+            PVItem("q1", "DUC2/DUC_cutout_002_0_0.jpg", P),
+        ]
+        groups = group_items_by_scan(items)
+        assert set(groups) == {"DUC1/DUC_001", "DUC2/DUC_002"}
+        assert len(groups["DUC1/DUC_001"]) == 2
+
+
+class TestDriver:
+    @pytest.mark.slow
+    def test_end_to_end_synthetic_scene(self, rng, tmp_path):
+        """Full L6 on a synthetic scene: shortlist + matches + depth maps +
+        scans + transformations + GT poses on disk → PnP stage recovers the
+        good candidate's pose, densePV reranks it to top-1, and the curves
+        reflect it (PnP-only top-1 is the bad candidate by construction)."""
+        from scipy.io import savemat
+
+        from ncnet_tpu.config import LocalizationConfig
+        from ncnet_tpu.localization.driver import run_localization
+        from ncnet_tpu.localization.render import render_points_perspective
+
+        root = tmp_path
+        pts, rgb = make_plane_scene(rng, n=320, m=400)
+
+        # scan-local coordinates differ from global by a rigid transform
+        T = np.eye(4)
+        ang = 0.4
+        T[:3, :3] = np.array(
+            [
+                [np.cos(ang), -np.sin(ang), 0],
+                [np.sin(ang), np.cos(ang), 0],
+                [0, 0, 1],
+            ]
+        )
+        T[:3, 3] = [0.3, -0.2, 0.1]
+        T_inv = np.linalg.inv(T)
+        pts_local = pts @ T_inv[:3, :3].T + T_inv[:3, 3]
+
+        # --- assets on disk ---
+        (root / "DUC1" / "transformations").mkdir(parents=True)
+        with open(root / "DUC1" / "transformations" / "DUC_trans_001.txt", "w") as f:
+            f.write("synthetic WUSTL transformation\n")
+            for row in T:
+                f.write(" ".join(f"{v:.10f}" for v in row) + "\n")
+        A = np.empty((1, 7), dtype=object)
+        for i, col in enumerate(
+            [pts_local[:, 0], pts_local[:, 1], pts_local[:, 2],
+             np.ones(len(pts_local)), rgb[:, 0], rgb[:, 1], rgb[:, 2]]
+        ):
+            A[0, i] = col.reshape(-1, 1)
+        (root / "scans" / "DUC1").mkdir(parents=True)
+        savemat(root / "scans" / "DUC1" / "DUC_scan_001.ptx.mat", {"A": A})
+
+        # db cutout depth maps (local coords), one good + one decoy cutout
+        Hdb, Wdb = 60, 80
+        Kdb = geometry.intrinsics(70.0, Hdb, Wdb)
+        P_db = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)  # global
+        inv = np.linalg.inv(Kdb)
+        cols, rows = np.meshgrid(np.arange(Wdb), np.arange(Hdb), indexing="xy")
+        pix = np.stack([cols, rows, np.ones_like(cols)], 2).astype(float)
+        xyz_global = (pix @ inv.T) * 5.0  # db camera at origin, plane z=5
+        xyz_local = (
+            xyz_global.reshape(-1, 3) @ T_inv[:3, :3].T + T_inv[:3, 3]
+        ).reshape(Hdb, Wdb, 3)
+        cut_dir = root / "cutouts" / "DUC1"
+        cut_dir.mkdir(parents=True)
+        for name in ("DUC_cutout_001_0_0.jpg", "DUC_cutout_001_30_0.jpg"):
+            savemat(cut_dir / (name + ".mat"), {"XYZcut": xyz_local})
+
+        # query: rendered from the TRUE pose (so densePV can recognize it)
+        P_q = np.concatenate(
+            [np.eye(3), np.array([[0.15], [0.1], [0.2]])], axis=1
+        )
+        qh, qw = 256, 320
+        focal = 300.0
+        Kq = geometry.intrinsics(focal, qh, qw)
+        query_img, _ = render_points_perspective(rgb, pts, Kq @ P_q, qh, qw)
+        from PIL import Image
+
+        (root / "query").mkdir()
+        Image.fromarray(query_img).save(root / "query" / "q1.png")
+
+        # shortlist: decoy cutout FIRST (PnP-only top-1 will be the decoy)
+        good = "DUC1/DUC_cutout_001_0_0.jpg"
+        decoy = "DUC1/DUC_cutout_001_30_0.jpg"
+        imglist_entry = np.zeros((1, 1), dtype=object)
+        entry = np.empty((1,), dtype=[("queryname", object), ("topNname", object)])
+        entry["queryname"][0] = "q1.png"
+        entry["topNname"][0] = np.array([decoy, good], dtype=object)
+        imglist_entry[0, 0] = entry[0]
+        savemat(root / "shortlist.mat", {"ImgList": entry.reshape(1, -1)})
+
+        # matches: good cutout gets exact correspondences, decoy gets junk
+        sel = rng.choice(Hdb * Wdb, size=150, replace=False)
+        r_sel, c_sel = sel // Wdb, sel % Wdb
+        X = xyz_global[r_sel, c_sel]
+        xy_q, depth = geometry.project_points(P_q, Kq, X)
+        ok = (
+            (depth > 0)
+            & (xy_q[:, 0] > 0) & (xy_q[:, 0] < qw)
+            & (xy_q[:, 1] > 0) & (xy_q[:, 1] < qh)
+        )
+        X, xy_q, r_sel, c_sel = X[ok], xy_q[ok], r_sel[ok], c_sel[ok]
+        good_rows = np.zeros((len(X), 5))
+        good_rows[:, 0] = xy_q[:, 0] / qw
+        good_rows[:, 1] = xy_q[:, 1] / qh
+        good_rows[:, 2] = (c_sel + 1.2) / Wdb
+        good_rows[:, 3] = (r_sel + 1.2) / Hdb
+        good_rows[:, 4] = 0.9
+        junk = rng.random((len(X), 5))
+        junk[:, 4] = 0.9
+        cap = len(X)
+        match_table = np.zeros((1, 2, cap, 5))
+        match_table[0, 0] = junk          # decoy is shortlist slot 0
+        match_table[0, 1] = good_rows
+        (root / "matches").mkdir()
+        savemat(root / "matches" / "1.mat", {"matches": match_table})
+
+        # ground truth: the query's true pose
+        ref = np.empty((1,), dtype=[("queryname", object), ("P", object)])
+        ref["queryname"][0] = "q1.png"
+        ref["P"][0] = P_q
+        savemat(
+            root / "refposes.mat",
+            {"DUC1_RefList": ref.reshape(1, -1),
+             "DUC2_RefList": ref[:0].reshape(1, -1)},
+        )
+
+        config = LocalizationConfig(
+            matches_dir=str(root / "matches"),
+            shortlist=str(root / "shortlist.mat"),
+            query_path=str(root / "query"),
+            cutout_path=str(root / "cutouts"),
+            cutout_mat_suffix=".mat",
+            scan_path=str(root / "scans"),
+            transformation_path=str(root),
+            refposes=str(root / "refposes.mat"),
+            output_dir=str(root / "out"),
+            pnp_topN=2,
+            ransac_iters=600,
+            query_focal_length=focal,
+            progress=False,
+        )
+        curves = run_localization(config)
+        from ncnet_tpu.localization.curves import ERROR_THRESHOLDS
+
+        i_half = np.abs(ERROR_THRESHOLDS - 0.5).argmin()
+        # PnP-only follows shortlist order → decoy top-1 → not localized;
+        # densePV reranks the good candidate first → localized well under 0.5m
+        assert curves["DensePE + NCNet"][i_half] == pytest.approx(0.0)
+        assert curves["InLoc + NCNet"][i_half] == pytest.approx(1.0)
+        # artifacts exist: per-pair pnp .mat, ImgLists, curves + error txts
+        assert (root / "out" / "top_2_thr075_rthr020.mat").exists()
+        assert (root / "out" / "top_2_thr075_rthr020_densePV.mat").exists()
+        assert (root / "out" / "error_DensePE + NCNet.txt").exists()
+
+        # resume: a second run must reload artifacts and reproduce the curves
+        curves2 = run_localization(config)
+        np.testing.assert_allclose(
+            curves2["InLoc + NCNet"], curves["InLoc + NCNet"]
+        )

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Released NCNet checkpoints (PyTorch .pth.tar — the torch importer in
+# ncnet_tpu/models/checkpoint.py loads these directly), plus the torchvision
+# ResNet-101 ImageNet weights used to initialize the trunk for training.
+# Run from this directory: bash download.sh
+set -e
+
+wget -c https://www.di.ens.fr/willow/research/ncnet/models/ncnet_pfpascal.pth.tar
+wget -c https://www.di.ens.fr/willow/research/ncnet/models/ncnet_ivd.pth.tar
+
+# trunk weights for --backbone_weights (torchvision's public mirror)
+wget -c https://download.pytorch.org/models/resnet101-63fe2227.pth
