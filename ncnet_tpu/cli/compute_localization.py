@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="query focal in pixels; 0 = iPhone 7 EXIF default")
     p.add_argument("--n_queries", type=int, default=0, help="0 = all")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--num_workers", type=int, default=0,
+                   help="PnP process-pool width (the reference's parfor); "
+                        "0 = in-process")
     return p
 
 
@@ -73,6 +76,7 @@ def main(argv=None) -> int:
         query_focal_length=args.query_focal_length,
         n_queries=args.n_queries,
         seed=args.seed,
+        num_workers=args.num_workers,
     )
     print(args)
     curves = run_localization(config)

@@ -135,6 +135,8 @@ class LocalizationConfig:
     n_queries: int = 0                   # 0 = all queries in the shortlist
     seed: int = 0
     progress: bool = True
+    num_workers: int = 0                 # >0: PnP fans out over a process
+                                         # pool (the reference's parfor)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -121,12 +121,74 @@ def _load_imglist(path: str) -> List[dict]:
     return out
 
 
+def _pnp_worker_init() -> None:
+    """Pin spawned PnP workers to the CPU backend: N workers racing to attach
+    a single tunneled TPU would fail, and the per-pair hypothesis scoring is
+    small enough that host cores win once they run in parallel."""
+    import sys
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # pragma: no cover - depends on jax internals
+        print(f"warning: PnP worker could not pin the CPU backend ({e}); "
+              "workers may contend for the accelerator", file=sys.stderr)
+
+
+def _pnp_one_query(config: LocalizationConfig, qi: int, qname: str,
+                   top_names: List[str]) -> dict:
+    """All top-N pose estimates for one query — the unit of host-side task
+    parallelism (the reference's ``parfor ii = 1:Nq``,
+    ir_top100_NC4D_localization_pnponly.m)."""
+    from scipy.io import loadmat
+
+    pnp_dir = os.path.join(config.output_dir, _pnp_dirname(config))
+    qsize = image_size(os.path.join(config.query_path, qname))
+    focal = query_focal(config, qsize[1])
+    match_mat = loadmat(
+        os.path.join(config.matches_dir, f"{qi + 1}.mat")
+    )["matches"]
+    # the match table's pano depth bounds how many candidates exist
+    top_names = top_names[: min(config.pnp_topN, match_mat.shape[1])]
+    poses: List[np.ndarray] = []
+    for jj, db_fn in enumerate(top_names):
+        xyzcut = load_xyzcut(
+            os.path.join(config.cutout_path, db_fn + config.cutout_mat_suffix)
+        )
+        P_after = load_transformation(
+            transformation_path(config.transformation_path, db_fn)
+        )
+        P, _ = run_pair_pnp(
+            pnp_dir,
+            qname,
+            db_fn,
+            match_mat[0, jj],
+            qsize,
+            xyzcut,
+            P_after,
+            focal,
+            score_thr=config.match_score_thr,
+            inlier_thr_deg=config.pnp_inlier_thr_deg,
+            ransac_iters=config.ransac_iters,
+            seed=config.seed,
+            max_tentatives=config.max_tentatives,
+        )
+        poses.append(P)
+        if config.progress:
+            print(f"nc4dPE: {qname} vs {db_fn} DONE.")
+    return {"queryname": qname, "topNname": top_names, "P": poses}
+
+
 def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
     """Pose per (query, top-N cutout) from the dense matches
     (ir_top100_NC4D_localization_pnponly.m).  Returns the ImgList and writes
-    ``top_<N>_thr..._rthr....mat``; reloads it when it already exists."""
-    from scipy.io import loadmat
+    ``top_<N>_thr..._rthr....mat``; reloads it when it already exists.
 
+    ``config.num_workers > 0`` fans queries out over a spawn-based process
+    pool — the Python equivalent of the reference's MATLAB ``parfor`` over
+    queries; the per-pair artifact files make retries/collisions safe.
+    """
     from ncnet_tpu.evaluation.inloc import _as_str, load_shortlist
 
     out_path = os.path.join(config.output_dir, _pnp_matname(config))
@@ -137,51 +199,23 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
     n_queries = len(query_fns)
     if config.n_queries > 0:
         n_queries = min(n_queries, config.n_queries)
-    pnp_dir = os.path.join(config.output_dir, _pnp_dirname(config))
+    args = [
+        (config, qi, query_fns[qi],
+         [_as_str(n) for n in np.asarray(pano_fns[qi]).ravel()])
+        for qi in range(n_queries)
+    ]
+    if config.num_workers > 0:
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
 
-    imglist: List[dict] = []
-    for qi in range(n_queries):
-        qname = query_fns[qi]
-        qpath = os.path.join(config.query_path, qname)
-        qsize = image_size(qpath)
-        focal = query_focal(config, qsize[1])
-        match_mat = loadmat(
-            os.path.join(config.matches_dir, f"{qi + 1}.mat")
-        )["matches"]
-        top_names = [_as_str(n) for n in np.asarray(pano_fns[qi]).ravel()]
-        # the match table's pano depth bounds how many candidates exist
-        top_names = top_names[: min(config.pnp_topN, match_mat.shape[1])]
-        poses: List[np.ndarray] = []
-        for jj, db_fn in enumerate(top_names):
-            xyzcut = load_xyzcut(
-                os.path.join(
-                    config.cutout_path, db_fn + config.cutout_mat_suffix
-                )
-            )
-            P_after = load_transformation(
-                transformation_path(config.transformation_path, db_fn)
-            )
-            P, _ = run_pair_pnp(
-                pnp_dir,
-                qname,
-                db_fn,
-                match_mat[0, jj],
-                qsize,
-                xyzcut,
-                P_after,
-                focal,
-                score_thr=config.match_score_thr,
-                inlier_thr_deg=config.pnp_inlier_thr_deg,
-                ransac_iters=config.ransac_iters,
-                seed=config.seed,
-                max_tentatives=config.max_tentatives,
-            )
-            poses.append(P)
-            if config.progress:
-                print(f"nc4dPE: {qname} vs {db_fn} DONE.")
-        imglist.append(
-            {"queryname": qname, "topNname": top_names, "P": poses}
-        )
+        with ProcessPoolExecutor(
+            max_workers=config.num_workers,
+            mp_context=mp.get_context("spawn"),
+            initializer=_pnp_worker_init,
+        ) as pool:
+            imglist = list(pool.map(_pnp_one_query, *zip(*args)))
+    else:
+        imglist = [_pnp_one_query(*a) for a in args]
     os.makedirs(config.output_dir, exist_ok=True)
     _save_imglist(out_path, imglist)
     return imglist
@@ -259,17 +293,35 @@ def run_localization(config: LocalizationConfig) -> Dict[str, np.ndarray]:
     return plot_localization_curves(methods, refposes, config.output_dir)
 
 
+def _variant_suffix(config: LocalizationConfig) -> str:
+    """Result-affecting knobs this port adds over the reference (whose
+    artifact names only encode topN/thr/rthr) must key the resume artifacts
+    too, or a rerun with different settings silently reloads stale results."""
+    s = ""
+    if config.n_queries > 0:
+        s += f"_nq{config.n_queries}"
+    if config.seed != 0:
+        s += f"_seed{config.seed}"
+    if config.ransac_iters != 10000:
+        s += f"_it{config.ransac_iters}"
+    if config.max_tentatives:
+        s += f"_sub{config.max_tentatives}"
+    return s
+
+
 def _pnp_dirname(config: LocalizationConfig) -> str:
     return (
         f"top_{config.pnp_topN}_PnP_thr{int(config.match_score_thr * 100):03d}"
         f"_rthr{int(config.pnp_inlier_thr_deg * 100):03d}"
+        + _variant_suffix(config)
     )
 
 
 def _pnp_matname(config: LocalizationConfig) -> str:
     return (
         f"top_{config.pnp_topN}_thr{int(config.match_score_thr * 100):03d}"
-        f"_rthr{int(config.pnp_inlier_thr_deg * 100):03d}.mat"
+        f"_rthr{int(config.pnp_inlier_thr_deg * 100):03d}"
+        + _variant_suffix(config) + ".mat"
     )
 
 

@@ -158,7 +158,13 @@ def refine_pose_object_space(
     X = np.asarray(X, dtype=np.float64)
     V = rays[:, :, None] * rays[:, None, :]  # (N,3,3) line-of-sight projectors
     I = np.eye(3)
-    S = np.linalg.inv((I - V).sum(axis=0))  # (Σ(I−Vᵢ))⁻¹
+    try:
+        S = np.linalg.inv((I - V).sum(axis=0))  # (Σ(I−Vᵢ))⁻¹
+    except np.linalg.LinAlgError:
+        # all rays coincident (e.g. every tentative maps to one query pixel):
+        # translation along the common ray is unobservable — keep the
+        # hypothesis pose rather than aborting the caller's whole run
+        return np.asarray(P0, dtype=np.float64)[:3, :4].copy()
     R = np.asarray(P0[:3, :3], dtype=np.float64).copy()
     t = np.asarray(P0[:3, 3], dtype=np.float64).copy()
     for _ in range(iters):

@@ -473,7 +473,8 @@ class TestVerification:
 
 class TestDriver:
     @pytest.mark.slow
-    def test_end_to_end_synthetic_scene(self, rng, tmp_path):
+    @pytest.mark.parametrize("num_workers", [0, 2])
+    def test_end_to_end_synthetic_scene(self, rng, tmp_path, num_workers):
         """Full L6 on a synthetic scene: shortlist + matches + depth maps +
         scans + transformations + GT poses on disk → PnP stage recovers the
         good candidate's pose, densePV reranks it to top-1, and the curves
@@ -605,6 +606,7 @@ class TestDriver:
             ransac_iters=600,
             query_focal_length=focal,
             progress=False,
+            num_workers=num_workers,  # 2 = the parfor-equivalent pool path
         )
         curves = run_localization(config)
         from ncnet_tpu.localization.curves import ERROR_THRESHOLDS
@@ -615,8 +617,13 @@ class TestDriver:
         assert curves["DensePE + NCNet"][i_half] == pytest.approx(0.0)
         assert curves["InLoc + NCNet"][i_half] == pytest.approx(1.0)
         # artifacts exist: per-pair pnp .mat, ImgLists, curves + error txts
-        assert (root / "out" / "top_2_thr075_rthr020.mat").exists()
-        assert (root / "out" / "top_2_thr075_rthr020_densePV.mat").exists()
+        # (names carry the non-default ransac_iters so reruns with other
+        # settings cannot reload them)
+        from ncnet_tpu.localization.driver import _pnp_matname, _pv_matname
+
+        assert _pnp_matname(config) == "top_2_thr075_rthr020_it600.mat"
+        assert (root / "out" / _pnp_matname(config)).exists()
+        assert (root / "out" / _pv_matname(config)).exists()
         assert (root / "out" / "error_DensePE + NCNet.txt").exists()
 
         # resume: a second run must reload artifacts and reproduce the curves
