@@ -16,6 +16,12 @@ PF-Pascal 25⁴ workload):
                    producing kA·C_out channels + a cheap shifted sum); the
                    best conv formulation for the fat 16→16 middle layer,
                    where plain convs leave 112 of 128 MXU output lanes idle.
+  * ``afold``    — folds the FULL A-side stencil (kA·kWA taps) into output
+                   channels (one 2D conv over (hB,wB) + shifted sums over
+                   both A dims); maximizes MXU output-lane fill but measured
+                   ~2× slower than ``coutfold`` at the 25⁴ workload — the
+                   kA·kWA× intermediate costs more HBM traffic than the fill
+                   buys.  Not selected by ``auto``.
   * ``toeplitz_b`` — expresses the whole B-side (kB,kWB) stencil as a dense
                    banded matrix over the flattened hB·wB lane dim, turning
                    the layer into kA·kWA big matmuls of shape
@@ -140,6 +146,58 @@ def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
     return out
 
 
+def _conv4d_afold(x, weight, *, precision, pad_ha, pad_hb):
+    """One 2D conv over (hB,wB) producing kA·kWA·C_out channels + a shifted
+    sum over BOTH A dims.
+
+    Folding the whole A-side stencil into output channels lifts the matmul's
+    output dim to kA·kWA·C_out (400 for the 5⁴ 16→16 layer) — full 128-lane
+    MXU tiles where ``coutfold``'s kA·C_out=80 underfills — at the cost of a
+    kA·kWA·C_out-channel intermediate and kA·kWA shifted adds.  MEASURED
+    SLOWER than coutfold on v5e at the PF-Pascal 25⁴ shape (bf16 batch 4,
+    scan-differenced: 16→16 6.9 vs 3.5 ms/pair; 1→16 6.3 vs tapfold 1.1;
+    16→1 1.2 vs 1.0): the 25× intermediate's HBM traffic swamps the fill
+    gain, so ``auto`` never picks it.  Kept as an explicitly-selectable
+    formulation and a structurally-independent oracle, like ``toeplitz_b``.
+    """
+    b, ha, wa, hb, wb, c_in = x.shape
+    ka, kwa, kb, kwb, _, c_out = weight.shape
+    hb_out = hb if pad_hb else hb - (kb - 1)
+    wf = jnp.transpose(weight, (2, 3, 4, 0, 1, 5)).reshape(
+        kb, kwb, c_in, ka * kwa * c_out
+    )
+    dn = lax.conv_dimension_numbers(
+        (b * ha * wa, hb, wb, c_in), wf.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    y = lax.conv_general_dilated(
+        x.reshape(b * ha * wa, hb, wb, c_in),
+        wf,
+        window_strides=(1, 1),
+        padding=[
+            (kb // 2, kb // 2) if pad_hb else (0, 0),
+            (kwb // 2, kwb // 2),
+        ],
+        dimension_numbers=dn,
+        precision=precision,
+    )
+    # out[i,j] = Σ_{p,q} y[i+p−padA, j+q−kwa//2, …, tap-(p,q) channel block]
+    # (the same tap-selection-by-channel-slice trick as coutfold: splitting
+    # the fused channel axis would relayout the whole volume)
+    y = y.reshape(b, ha, wa, hb_out, wb, ka * kwa * c_out)
+    pads = ((0, 0), (ka // 2, ka // 2) if pad_ha else (0, 0),
+            (kwa // 2, kwa // 2)) + ((0, 0),) * 3
+    y = jnp.pad(y, pads)
+    ha_out = y.shape[1] - (ka - 1)
+    out = None
+    for p in range(ka):
+        yp = lax.slice_in_dim(y, p, p + ha_out, axis=1)
+        for q in range(kwa):
+            t = (p * kwa + q) * c_out
+            o = lax.slice_in_dim(yp, q, q + wa, axis=2)[..., t:t + c_out]
+            out = o if out is None else out + o
+    return out
+
+
 @functools.lru_cache(maxsize=32)
 def _shift_masks(hb_in: int, wb_in: int, hb_out: int, wb_out: int,
                  kb: int, kwb: int, pad_hb: bool):
@@ -188,6 +246,7 @@ _VARIANTS = {
     "unroll": _conv4d_unroll,
     "tapfold": _conv4d_tapfold,
     "coutfold": _conv4d_coutfold,
+    "afold": _conv4d_afold,
     "toeplitz_b": _conv4d_toeplitz_b,
 }
 

@@ -113,7 +113,9 @@ def test_conv4d_kernel5(rng):
     np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-3)
 
 
-@pytest.mark.parametrize("variant", ["unroll", "tapfold", "coutfold", "toeplitz_b"])
+@pytest.mark.parametrize("variant",
+                         ["unroll", "tapfold", "coutfold", "afold",
+                          "toeplitz_b"])
 @pytest.mark.parametrize("pad_ha,pad_hb",
                          [(True, True), (False, True), (True, False), (False, False)])
 def test_conv4d_variants_and_pad_modes_agree(rng, variant, pad_ha, pad_hb):
