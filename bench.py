@@ -215,6 +215,15 @@ def bench_jax():
         ),
         label="forward_bs1",
     )
+
+    # InLoc-resolution matcher (56M-cell pooled volume, k=2, IVD arch) —
+    # opt-in: its one-off ~50s compile is too slow for the default run
+    import os
+
+    if os.environ.get("NCNET_BENCH_INLOC"):
+        res["inloc_matcher_s_per_pair"] = _with_retries(
+            _bench_inloc_matcher, label="inloc_matcher"
+        )
     res = {k: v for k, v in res.items() if v is not None}
 
     # train step (BASELINE north-star: image-pairs/sec; reference bs=16 —
@@ -265,6 +274,46 @@ def bench_jax():
                   file=sys.stderr)
             continue
     return res
+
+
+def _bench_inloc_matcher():
+    """Warm seconds/pair for the full InLoc-resolution eval unit: raw uint8
+    in, device normalize+quantized-resize, bf16 k=2 forward over the pooled
+    56M-cell volume, both-direction match extraction, host sort/dedup
+    (ncnet_tpu/evaluation/inloc.py make_pair_matcher)."""
+    import time as _time
+    import warnings
+
+    import jax
+    import numpy as np
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu import models
+    from ncnet_tpu.evaluation.inloc import make_pair_matcher
+
+    cfg = ModelConfig(
+        ncons_kernel_sizes=(3, 3), ncons_channels=(16, 1),  # IVD arch
+        half_precision=True, backbone_bf16=True, relocalization_k_size=2,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        params = models.init_ncnet(cfg, jax.random.key(0))
+    matcher = make_pair_matcher(
+        cfg, params, do_softmax=True, both_directions=True,
+        flip_direction=False, preprocess_image_size=3200,
+    )
+    rng = np.random.default_rng(0)
+    q = rng.integers(0, 255, (1, 4032, 3024, 3), dtype=np.uint8)
+    db = rng.integers(0, 255, (1, 1200, 1600, 3), dtype=np.uint8)
+    src = matcher.preprocess(q)
+    matcher(src, db)  # compile + first-touch uploads
+    matcher(src, db)  # settle the shape-bucket caches
+    times = []
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        matcher(src, db)
+        times.append(_time.perf_counter() - t0)
+    return float(np.median(times))
 
 
 def bench_torch_reference_style(iters=3):
