@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output_root", type=str, default="matches")
     p.add_argument("--spatial_shards", type=int, default=1,
                    help="shard the 4D volume over this many devices")
+    p.add_argument("--host_index", type=int, default=-1,
+                   help="stripe queries across hosts: this host's index "
+                        "(-1 = auto from jax.process_index)")
+    p.add_argument("--host_count", type=int, default=0,
+                   help="total hosts striping queries (0 = auto)")
     return p
 
 
@@ -63,6 +68,8 @@ def main(argv=None) -> int:
         query_path=args.query_path,
         output_root=args.output_root,
         spatial_shards=args.spatial_shards,
+        host_index=args.host_index,
+        host_count=args.host_count,
     )
     print(args)
     print("Output matches folder: " + output_folder_name(config))

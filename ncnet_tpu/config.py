@@ -108,6 +108,11 @@ class EvalInLocConfig:
     output_root: str = "matches"
     # TPU-native addition: shard the 4D volume spatially over this many devices.
     spatial_shards: int = 1
+    # TPU-native addition: stripe queries across hosts (each host writes its
+    # own per-query .mat files — the host-parallel eval analog of the
+    # reference's MATLAB parfor).  -1 → auto from jax.process_index/count.
+    host_index: int = -1
+    host_count: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -364,7 +364,14 @@ def run_inloc_eval(
     )
 
     n_queries = min(config.n_queries, len(query_fns))
-    for q in range(n_queries):
+    # multi-host: stripe queries across processes (per-query output files are
+    # independent, so hosts never contend; -1/0 → auto-detect, single-host
+    # runs get the identity stripe)
+    host_count = config.host_count or jax.process_count()
+    host_index = (
+        config.host_index if config.host_index >= 0 else jax.process_index()
+    )
+    for q in range(host_index, n_queries, host_count):
         if progress:
             print(q)
         matches = np.zeros((1, config.n_panos, n_cap, 5))

@@ -167,6 +167,39 @@ def test_run_inloc_eval_end_to_end(tmp_path):
         assert _as_str(mat["query_fn"]) == f"query_{q - 1}.jpg"
 
 
+def test_run_inloc_eval_host_striping(tmp_path):
+    """Multi-host query striping: two 'hosts' over the same output dir write
+    disjoint per-query files whose union is the full set, matching a
+    single-host run byte-for-byte."""
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=3, n_panos=1, image_hw=(96, 128))
+    model_config = ModelConfig(
+        backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
+        half_precision=True, relocalization_k_size=2,
+    )
+    params = _identity_nc_params(model_config, jax.random.key(0))
+    kw = dict(
+        inloc_shortlist=shortlist, k_size=2, image_size=128,
+        n_queries=3, n_panos=1,
+        pano_path=os.path.join(root, "pano"),
+        query_path=os.path.join(root, "query", "iphone7"),
+    )
+    single = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "single"), **kw),
+        model_config=model_config, params=params, progress=False)
+    for host in (0, 1):
+        striped = run_inloc_eval(
+            EvalInLocConfig(output_root=os.path.join(root, "striped"),
+                            host_index=host, host_count=2, **kw),
+            model_config=model_config, params=params, progress=False)
+    names = sorted(os.listdir(striped))
+    assert names == ["1.mat", "2.mat", "3.mat"] == sorted(os.listdir(single))
+    for n in names:
+        a = loadmat(os.path.join(single, n))["matches"]
+        b = loadmat(os.path.join(striped, n))["matches"]
+        np.testing.assert_array_equal(a, b)
+
+
 def test_run_inloc_eval_single_direction(tmp_path):
     """flip/single-direction modes produce half-capacity tables."""
     root = str(tmp_path)
