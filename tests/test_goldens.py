@@ -61,6 +61,27 @@ def test_tiny_forward_matches_golden(golden):
     np.testing.assert_allclose(got, golden["tiny_matches"], rtol=1e-5, atol=1e-6)
 
 
+def test_dsift_matches_golden(golden):
+    from ncnet_tpu.localization.dsift import dense_sift, rootsift
+
+    desc = rootsift(dense_sift(golden["dsift_img"]))
+    np.testing.assert_allclose(desc[::3, ::3, :16],
+                               golden["dsift_desc_sample"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(desc.mean(axis=-1), golden["dsift_desc_mean"],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_p3p_matches_golden(golden):
+    from ncnet_tpu.localization.p3p import p3p_solve
+
+    sols = p3p_solve(golden["p3p_rays"], golden["p3p_pts"])
+    # the golden masks invalid solution slots with -1e9 (NaN would make
+    # assert_allclose vacuous); apply the same mask to the live output
+    np.testing.assert_allclose(np.nan_to_num(sols, nan=-1e9),
+                               golden["p3p_solutions"], rtol=1e-6, atol=1e-8)
+
+
 def test_resnet_features_match_golden(golden):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")  # deterministic random trunk

@@ -89,6 +89,26 @@ def main():
     record["resnet_feat_mean"] = feats.mean(axis=-1)        # (1, 6, 6)
     record["resnet_feat_slice"] = feats[0, :, :, :8]        # (6, 6, 8)
 
+    # 3. localization numerics: dense-SIFT descriptors and a P3P pose on
+    #    fixed inputs — guards the descriptor pipeline and the Grunert
+    #    quartic + Kabsch chain against cross-round drift
+    from ncnet_tpu.localization.dsift import dense_sift, rootsift
+    from ncnet_tpu.localization.p3p import p3p_solve
+
+    img = rng.random((72, 88)).astype(np.float32)
+    desc = rootsift(dense_sift(img))
+    record["dsift_img"] = img
+    record["dsift_desc_sample"] = desc[::3, ::3, :16]
+    record["dsift_desc_mean"] = desc.mean(axis=-1)
+
+    rays = rng.normal(size=(4, 3, 3))
+    rays /= np.linalg.norm(rays, axis=2, keepdims=True)
+    pts = rng.uniform(-1, 1, (4, 3, 3)) + np.array([0.0, 0.0, 4.0])
+    sols = p3p_solve(rays, pts)
+    record["p3p_rays"] = rays
+    record["p3p_pts"] = pts
+    record["p3p_solutions"] = np.nan_to_num(sols, nan=-1e9)  # mask NaN slots
+
     path = os.path.join(out_dir, "activations.npz")
     np.savez_compressed(path, **record)
     print(f"wrote {path} ({os.path.getsize(path) / 1024:.0f} KiB)")
