@@ -126,14 +126,17 @@ def write_inloc_like(
     ``db[q][1].ravel()[idx].item()`` = pano name).
 
     Pano 0 of each query IS the query image (re-encoded), so a correct
-    matcher scores near-identity matches on it.  Returns the shortlist path.
+    matcher scores near-identity matches on it.  Pano names follow the real
+    dataset's cutout pattern (``DUC1/DUC_cutout_<scan>_<pan>_<tilt>.jpg``) so
+    the localization stage's name parsing composes with these fixtures.
+    Returns the shortlist path.
     """
     from scipy.io import savemat
 
     rng = np.random.default_rng(seed)
     h, w = image_hw
     qdir = os.path.join(root, "query", "iphone7")
-    pdir = os.path.join(root, "pano")
+    pdir = os.path.join(root, "pano", "DUC1")
     os.makedirs(qdir, exist_ok=True)
     os.makedirs(pdir, exist_ok=True)
 
@@ -147,9 +150,11 @@ def write_inloc_like(
         Image.fromarray(qimg).save(os.path.join(qdir, qfn), quality=95)
         panos = []
         for p in range(n_panos):
-            pfn = f"pano_{q}_{p}.jpg"
+            pfn = f"DUC1/DUC_cutout_{q:03d}_{p * 30}_0.jpg"
             img = qimg if p == 0 else _textured_image(rng, h, w)
-            Image.fromarray(img).save(os.path.join(pdir, pfn), quality=95)
+            Image.fromarray(img).save(
+                os.path.join(root, "pano", pfn), quality=95
+            )
             panos.append(pfn)
         entries[0, q] = (np.array([qfn]), np.array(panos, dtype=object)[:, None])
     shortlist = os.path.join(root, "shortlist.mat")

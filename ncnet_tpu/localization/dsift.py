@@ -97,8 +97,14 @@ def _dsift_fn(height: int, width: int, bin_size: int, step: int):
 
 
 def dense_sift(img: np.ndarray, bin_size: int = 8, step: int = 4) -> np.ndarray:
-    """Dense SIFT descriptors ``(Ny, Nx, 128)`` for a float grayscale image."""
+    """Dense SIFT descriptors ``(Ny, Nx, 128)`` for a float grayscale image.
+    An image too small to fit one descriptor support yields a (0, 0, 128)
+    array rather than an error."""
     img = np.asarray(img, dtype=np.float32)
+    ys, xs = descriptor_grid(img.shape[0], img.shape[1], bin_size, step)
+    if len(ys) == 0 or len(xs) == 0:
+        return np.zeros((len(ys), len(xs), N_BINS * N_BINS * N_ORIENT),
+                        np.float32)
     fn = _dsift_fn(img.shape[0], img.shape[1], bin_size, step)
     return np.asarray(fn(img))
 
@@ -176,6 +182,8 @@ def pose_verification_score(
     dq = rootsift(dense_sift(q, bin_size, step))
     ds = rootsift(dense_sift(s, bin_size, step))
     ys, xs = descriptor_grid(q.shape[0], q.shape[1], bin_size, step)
+    if len(ys) == 0 or len(xs) == 0:  # image smaller than one descriptor
+        return 0.0
     iseval = mask[ys[:, None], xs[None, :]]
     if not iseval.any():
         return 0.0

@@ -471,6 +471,104 @@ class TestVerification:
         assert len(groups["DUC1/DUC_001"]) == 2
 
 
+class TestComposition:
+    @pytest.mark.slow
+    def test_inloc_eval_feeds_localization(self, rng, tmp_path):
+        """The L5→L6 boundary: matches written by ``run_inloc_eval`` must be
+        directly consumable by ``run_localization`` (schema, folder naming,
+        shortlist format, cutout-name parsing).  Pose quality is not asserted
+        — the matcher here is a random tiny trunk; this test pins the
+        composition contract the reference implements as .mat files handed to
+        MATLAB."""
+        import warnings
+
+        from scipy.io import savemat
+
+        from ncnet_tpu.config import EvalInLocConfig, LocalizationConfig
+        from ncnet_tpu.config import ModelConfig
+        from ncnet_tpu.data.synthetic import write_inloc_like
+        from ncnet_tpu.evaluation.inloc import run_inloc_eval
+        from ncnet_tpu.localization.driver import run_localization
+        from ncnet_tpu.models import init_ncnet
+
+        import jax
+
+        root = str(tmp_path)
+        shortlist = write_inloc_like(root, n_queries=1, n_panos=2,
+                                     image_hw=(96, 128))
+        model_config = ModelConfig(
+            backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
+            half_precision=True, relocalization_k_size=2,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            params = init_ncnet(model_config, jax.random.key(0))
+        eval_cfg = EvalInLocConfig(
+            inloc_shortlist=shortlist, k_size=2, image_size=128,
+            n_queries=1, n_panos=2,
+            pano_path=os.path.join(root, "pano"),
+            query_path=os.path.join(root, "query", "iphone7"),
+            output_root=os.path.join(root, "matches"),
+        )
+        matches_dir = run_inloc_eval(eval_cfg, model_config=model_config,
+                                     params=params, progress=False)
+
+        # localization assets for the fixture's cutouts (both panos of query
+        # 0 share scan id '000'): depth maps, transformation, scan, GT pose
+        H, W = 96, 128
+        gx, gy = np.meshgrid(np.arange(W), np.arange(H), indexing="xy")
+        xyzcut = np.stack(
+            [gx / 40.0, gy / 40.0, np.full((H, W), 5.0)], axis=2
+        )
+        for p in (0, 30):
+            savemat(os.path.join(root, "pano", "DUC1",
+                                 f"DUC_cutout_000_{p}_0.jpg.mat"),
+                    {"XYZcut": xyzcut})
+        os.makedirs(os.path.join(root, "DUC1", "transformations"))
+        with open(os.path.join(root, "DUC1", "transformations",
+                               "DUC_trans_000.txt"), "w") as f:
+            f.write("synthetic\n")
+            for row in np.eye(4):
+                f.write(" ".join(str(v) for v in row) + "\n")
+        pts = xyzcut.reshape(-1, 3)
+        A = np.empty((1, 7), dtype=object)
+        for i, col in enumerate(
+            [pts[:, 0], pts[:, 1], pts[:, 2], np.ones(len(pts)),
+             np.full(len(pts), 100.0), np.full(len(pts), 120.0),
+             np.full(len(pts), 140.0)]
+        ):
+            A[0, i] = col.reshape(-1, 1)
+        os.makedirs(os.path.join(root, "scans", "DUC1"))
+        savemat(os.path.join(root, "scans", "DUC1", "DUC_scan_000.ptx.mat"),
+                {"A": A})
+        ref = np.empty((1,), dtype=[("queryname", object), ("P", object)])
+        ref["queryname"][0] = "query_0.jpg"
+        ref["P"][0] = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
+        savemat(os.path.join(root, "refposes.mat"),
+                {"DUC1_RefList": ref.reshape(1, -1),
+                 "DUC2_RefList": ref[:0].reshape(1, -1)})
+
+        loc_cfg = LocalizationConfig(
+            matches_dir=matches_dir,
+            shortlist=shortlist,
+            query_path=os.path.join(root, "query", "iphone7"),
+            cutout_path=os.path.join(root, "pano"),
+            scan_path=os.path.join(root, "scans"),
+            transformation_path=root,
+            refposes=os.path.join(root, "refposes.mat"),
+            output_dir=os.path.join(root, "out"),
+            pnp_topN=2, ransac_iters=200, query_focal_length=100.0,
+            match_score_thr=0.0,  # random-trunk scores are small
+            progress=False,
+        )
+        curves = run_localization(loc_cfg)
+        assert set(curves) == {"DensePE + NCNet", "InLoc + NCNet"}
+        err_txt = os.path.join(root, "out", "error_DensePE + NCNet.txt")
+        assert os.path.exists(err_txt)
+        lines = open(err_txt).read().splitlines()
+        assert len(lines) == 1 and lines[0].startswith("query_0.jpg ")
+
+
 class TestDriver:
     @pytest.mark.slow
     @pytest.mark.parametrize("num_workers", [0, 2])
