@@ -94,8 +94,8 @@ def test_shortlist_roundtrip(tmp_path):
     query_fns, pano_fns = load_shortlist(shortlist)
     assert query_fns == ["query_0.jpg", "query_1.jpg"]
     assert [len(p) for p in pano_fns] == [3, 3]
-    assert _as_str(pano_fns[0][0]) == "pano_0_0.jpg"
-    assert _as_str(pano_fns[1][2]) == "pano_1_2.jpg"
+    assert _as_str(pano_fns[0][0]) == "DUC1/DUC_cutout_000_0_0.jpg"
+    assert _as_str(pano_fns[1][2]) == "DUC1/DUC_cutout_001_60_0.jpg"
 
 
 def test_output_folder_name_encodes_settings():
