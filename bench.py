@@ -101,7 +101,8 @@ def _with_retries(fn, attempts=3, label=""):
         except Exception as e:
             print(f"bench metric {label or fn} attempt {i + 1}/{attempts} "
                   f"failed: {str(e)[:200]}", file=sys.stderr)
-            _time.sleep(5 * (i + 1))
+            if i < attempts - 1:  # no backoff after the final attempt
+                _time.sleep(5 * (i + 1))
     return None
 
 
@@ -164,24 +165,33 @@ def bench_jax():
         label="forward_bf16",
     )
 
-    # MFU of the bf16 path from XLA's own FLOP count
+    # MFU of the bf16 path from XLA's own FLOP count — skipped entirely when
+    # the bf16 timing failed (its lower+compile would be wasted work)
     if res["forward_ms_per_pair_bf16"] is None:
         res.pop("forward_ms_per_pair_bf16")
-    try:
-        rng = np.random.default_rng(0)
-        src = jnp.asarray(rng.uniform(-1, 1, (BATCH, IMAGE, IMAGE, 3)).astype(np.float32))
-        fwd16 = jax.jit(lambda p, s, t: models.ncnet_forward(cfg16, p, s, t).corr)
-        cost = fwd16.lower(params, src, src).compile().cost_analysis()
-        flops = float(cost.get("flops", 0.0))
-        kind = jax.devices()[0].device_kind
-        peak = _PEAK_TFLOPS.get(kind)
-        if flops > 0 and peak:
-            tflops = flops / (res["forward_ms_per_pair_bf16"] * 1e-3 * BATCH) / 1e12
-            res["forward_bf16_tflops"] = round(tflops, 2)
-            res["forward_bf16_mfu_pct"] = round(100 * tflops / peak, 2)
-            res["device_kind"] = kind
-    except Exception:
-        pass
+    else:
+        try:
+            rng = np.random.default_rng(0)
+            src = jnp.asarray(
+                rng.uniform(-1, 1, (BATCH, IMAGE, IMAGE, 3)).astype(np.float32)
+            )
+            fwd16 = jax.jit(
+                lambda p, s, t: models.ncnet_forward(cfg16, p, s, t).corr
+            )
+            cost = fwd16.lower(params, src, src).compile().cost_analysis()
+            flops = float(cost.get("flops", 0.0))
+            kind = jax.devices()[0].device_kind
+            peak = _PEAK_TFLOPS.get(kind)
+            if flops > 0 and peak:
+                tflops = (
+                    flops / (res["forward_ms_per_pair_bf16"] * 1e-3 * BATCH)
+                    / 1e12
+                )
+                res["forward_bf16_tflops"] = round(tflops, 2)
+                res["forward_bf16_mfu_pct"] = round(100 * tflops / peak, 2)
+                res["device_kind"] = kind
+        except Exception:
+            pass
 
     # correlation-only (BASELINE north-star: ms/pair 4D-corr fwd) — feature
     # shape derived from the configured backbone via eval_shape (free), so a

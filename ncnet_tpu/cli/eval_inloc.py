@@ -44,6 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(-1 = auto from jax.process_index)")
     p.add_argument("--host_count", type=int, default=0,
                    help="total hosts striping queries (0 = auto)")
+    p.add_argument("--skip_existing", type=_str_to_bool, default=True,
+                   help="resume: skip queries whose output .mat exists")
     return p
 
 
@@ -70,6 +72,7 @@ def main(argv=None) -> int:
         spatial_shards=args.spatial_shards,
         host_index=args.host_index,
         host_count=args.host_count,
+        skip_existing=args.skip_existing,
     )
     print(args)
     print("Output matches folder: " + output_folder_name(config))

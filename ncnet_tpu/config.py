@@ -113,6 +113,9 @@ class EvalInLocConfig:
     # reference's MATLAB parfor).  -1 → auto from jax.process_index/count.
     host_index: int = -1
     host_count: int = 0
+    # resume-by-artifact: skip queries whose output .mat already exists (the
+    # folder name encodes checkpoint + settings, so hits cannot be stale)
+    skip_existing: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -341,7 +341,12 @@ def run_inloc_eval(
     if config.spatial_shards > 1:
         from ncnet_tpu.parallel import make_mesh
 
-        mesh = make_mesh(data=1, spatial=config.spatial_shards)
+        # LOCAL devices only: under multi-host striping each process runs a
+        # different query stream, so a mesh spanning processes would need
+        # lockstep execution that striping deliberately gives up
+        mesh = make_mesh(
+            data=1, spatial=config.spatial_shards, devices=jax.local_devices()
+        )
 
     query_fns, pano_fns = load_shortlist(config.inloc_shortlist)
     pano_fn_all = np.vstack([p[:, None] for p in pano_fns])
@@ -366,12 +371,29 @@ def run_inloc_eval(
     n_queries = min(config.n_queries, len(query_fns))
     # multi-host: stripe queries across processes (per-query output files are
     # independent, so hosts never contend; -1/0 → auto-detect, single-host
-    # runs get the identity stripe)
+    # runs get the identity stripe).  Explicit index/count must be coherent,
+    # or a misconfigured stripe silently drops/duplicates queries.
     host_count = config.host_count or jax.process_count()
     host_index = (
         config.host_index if config.host_index >= 0 else jax.process_index()
     )
+    if config.host_index >= 0 and not config.host_count:
+        raise ValueError("host_index given without host_count")
+    if not 0 <= host_index < host_count:
+        raise ValueError(
+            f"host_index {host_index} out of range for host_count {host_count}"
+        )
     for q in range(host_index, n_queries, host_count):
+        out_path = os.path.join(out_dir, f"{q + 1}.mat")
+        if config.skip_existing and os.path.exists(out_path):
+            # resume-by-artifact: the per-query .mat is written atomically at
+            # the end of its pano loop, so its existence means the query is
+            # done.  The folder name encodes checkpoint + settings, making a
+            # stale hit impossible short of swapping checkpoint contents
+            # under an unchanged name.
+            if progress:
+                print(f"{q} (exists, skipped)")
+            continue
         if progress:
             print(q)
         matches = np.zeros((1, config.n_panos, n_cap, 5))
@@ -407,7 +429,7 @@ def run_inloc_eval(
             if progress and idx % 10 == 0:
                 print(">>>" + str(idx))
         savemat(
-            os.path.join(out_dir, f"{q + 1}.mat"),
+            out_path,
             {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
             do_compression=True,
         )

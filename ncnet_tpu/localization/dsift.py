@@ -176,14 +176,15 @@ def pose_verification_score(
     mask = np.asarray(valid_mask, dtype=bool)
     if not mask.any():
         return 0.0
-    q = normalize_image_masked(query_gray, mask)
+    q = np.asarray(query_gray, dtype=np.float64)
+    ys, xs = descriptor_grid(q.shape[0], q.shape[1], bin_size, step)
+    if len(ys) == 0 or len(xs) == 0:  # image smaller than one descriptor
+        return 0.0
+    q = normalize_image_masked(q, mask)
     s = np.where(mask, np.asarray(synth_gray, dtype=np.float64), np.nan)
     s = normalize_image_masked(inpaint_nans(s), mask)
     dq = rootsift(dense_sift(q, bin_size, step))
     ds = rootsift(dense_sift(s, bin_size, step))
-    ys, xs = descriptor_grid(q.shape[0], q.shape[1], bin_size, step)
-    if len(ys) == 0 or len(xs) == 0:  # image smaller than one descriptor
-        return 0.0
     iseval = mask[ys[:, None], xs[None, :]]
     if not iseval.any():
         return 0.0

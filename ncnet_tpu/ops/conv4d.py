@@ -4,8 +4,8 @@ The reference implements conv4d as a *Python loop* over the first spatial dim,
 each iteration dispatching an F.conv3d (/root/reference/lib/conv4d.py:39-48) —
 the single hottest anti-pattern to avoid on TPU.  Here the k_A-tap
 decomposition becomes whole-volume ``lax.conv_general_dilated`` programs, with
-three MXU-aware formulations selected per layer (measured on TPU v5e at the
-PF-Pascal 25⁴ workload):
+five MXU-aware formulations of which ``auto`` selects per layer by
+measurement (TPU v5e at the PF-Pascal 25⁴ workload):
 
   * ``unroll``   — statically-unrolled sum of kA 3D convs over shifted views.
   * ``tapfold``  — folds the kA taps into *input* channels (one 3D conv with
@@ -373,8 +373,8 @@ def conv4d(
         the output is ``k//2`` smaller on each side of that dim.
       variant: 'auto' (per-layer MXU heuristic, `choose_conv4d_variant`), or
         an explicit formulation from 'unroll' / 'tapfold' / 'coutfold' /
-        'toeplitz_b' (see module docstring).  All variants are numerically
-        equivalent up to float reassociation.
+        'afold' / 'toeplitz_b' (see module docstring).  All variants are
+        numerically equivalent up to float reassociation.
 
     Returns:
       ``(B, hA', wA, hB', wB, C_out)`` (primed dims shrink iff unpadded).

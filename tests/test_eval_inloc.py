@@ -200,6 +200,56 @@ def test_run_inloc_eval_host_striping(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+def test_host_striping_validation(tmp_path):
+    """Incoherent stripes (index without count, index ≥ count) must fail loudly
+    instead of silently dropping or duplicating queries."""
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=1, n_panos=1, image_hw=(96, 128))
+    model_config = ModelConfig(
+        backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
+        half_precision=True, relocalization_k_size=2,
+    )
+    params = _identity_nc_params(model_config, jax.random.key(0))
+    kw = dict(
+        inloc_shortlist=shortlist, k_size=2, image_size=128,
+        n_queries=1, n_panos=1,
+        pano_path=os.path.join(root, "pano"),
+        query_path=os.path.join(root, "query", "iphone7"),
+        output_root=os.path.join(root, "m"),
+    )
+    for bad in (dict(host_index=1), dict(host_index=3, host_count=2)):
+        with pytest.raises(ValueError):
+            run_inloc_eval(EvalInLocConfig(**kw, **bad),
+                           model_config=model_config, params=params,
+                           progress=False)
+
+
+def test_skip_existing_resumes(tmp_path):
+    """Resume-by-artifact: a second run leaves existing per-query .mat files
+    untouched (their mtime does not change)."""
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=1, n_panos=1, image_hw=(96, 128))
+    model_config = ModelConfig(
+        backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
+        half_precision=True, relocalization_k_size=2,
+    )
+    params = _identity_nc_params(model_config, jax.random.key(0))
+    config = EvalInLocConfig(
+        inloc_shortlist=shortlist, k_size=2, image_size=128,
+        n_queries=1, n_panos=1,
+        pano_path=os.path.join(root, "pano"),
+        query_path=os.path.join(root, "query", "iphone7"),
+        output_root=os.path.join(root, "m"),
+    )
+    out_dir = run_inloc_eval(config, model_config=model_config, params=params,
+                             progress=False)
+    path = os.path.join(out_dir, "1.mat")
+    mtime = os.path.getmtime(path)
+    run_inloc_eval(config, model_config=model_config, params=params,
+                   progress=False)
+    assert os.path.getmtime(path) == mtime
+
+
 def test_run_inloc_eval_single_direction(tmp_path):
     """flip/single-direction modes produce half-capacity tables."""
     root = str(tmp_path)

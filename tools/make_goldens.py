@@ -38,6 +38,21 @@ def deterministic_params(cfg):
     return jax.tree_util.tree_map_with_path(fill, shapes)
 
 
+def canonical_p3p_order(sols: np.ndarray) -> np.ndarray:
+    """NaN-mask and lexicographically sort each sample's ≤4 candidate poses.
+
+    p3p_solve fills its solution slots in companion-matrix ``eigvals`` order,
+    which is LAPACK-implementation-defined — comparing slots positionally
+    would raise false drift alarms across BLAS builds.  Shared by the golden
+    generator and the golden test."""
+    masked = np.nan_to_num(np.asarray(sols, dtype=np.float64), nan=-1e9)
+    out = []
+    for sample in masked:
+        rows = sorted(sample.reshape(sample.shape[0], -1).tolist())
+        out.append(np.asarray(rows).reshape(sample.shape))
+    return np.stack(out)
+
+
 def main():
     import warnings
 
@@ -107,7 +122,9 @@ def main():
     sols = p3p_solve(rays, pts)
     record["p3p_rays"] = rays
     record["p3p_pts"] = pts
-    record["p3p_solutions"] = np.nan_to_num(sols, nan=-1e9)  # mask NaN slots
+    # NaN slots masked + slots canonically ordered (eigvals order is
+    # LAPACK-implementation-defined)
+    record["p3p_solutions"] = canonical_p3p_order(sols)
 
     path = os.path.join(out_dir, "activations.npz")
     np.savez_compressed(path, **record)
