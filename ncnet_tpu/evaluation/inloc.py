@@ -383,7 +383,20 @@ def run_inloc_eval(
         raise ValueError(
             f"host_index {host_index} out of range for host_count {host_count}"
         )
-    for q in range(host_index, n_queries, host_count):
+    # one decode-ahead worker: the next pano decodes (and the next query
+    # loads) while the device chews on the current pair — the eval twin of
+    # the training loader's prefetch (the reference decodes serially,
+    # eval_inloc.py:129)
+    from concurrent.futures import ThreadPoolExecutor
+
+    def pano_jobs(q):
+        n_panos = min(config.n_panos, len(pano_fns[q]))
+        return [
+            os.path.join(config.pano_path, _as_str(pano_fns[q][idx]))
+            for idx in range(n_panos)
+        ]
+
+    def process_query(q, io_pool):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
         if config.skip_existing and os.path.exists(out_path):
             # resume-by-artifact: the per-query .mat is written atomically at
@@ -393,7 +406,7 @@ def run_inloc_eval(
             # under an unchanged name.
             if progress:
                 print(f"{q} (exists, skipped)")
-            continue
+            return
         if progress:
             print(q)
         matches = np.zeros((1, config.n_panos, n_cap, 5))
@@ -401,11 +414,12 @@ def run_inloc_eval(
         src = matcher.preprocess(
             load_raw(os.path.join(config.query_path, query_fns[q]))
         )
-        n_panos = min(config.n_panos, len(pano_fns[q]))
-        for idx in range(n_panos):
-            tgt = load_raw(
-                os.path.join(config.pano_path, _as_str(pano_fns[q][idx]))
-            )
+        jobs = pano_jobs(q)
+        pending = io_pool.submit(load_raw, jobs[0])
+        for idx in range(len(jobs)):
+            tgt = pending.result()
+            if idx + 1 < len(jobs):
+                pending = io_pool.submit(load_raw, jobs[idx + 1])
             xa, ya, xb, yb, score = matcher(src, tgt)
             if config.matching_both_directions:
                 # single-direction outputs stay in grid order, as in the
@@ -433,4 +447,8 @@ def run_inloc_eval(
             {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
             do_compression=True,
         )
+
+    with ThreadPoolExecutor(max_workers=1) as io_pool:
+        for q in range(host_index, n_queries, host_count):
+            process_query(q, io_pool)
     return out_dir
