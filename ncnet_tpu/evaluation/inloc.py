@@ -241,42 +241,7 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         return tuple(np.asarray(v, dtype=np.float32).ravel()
                      for v in (xa, ya, xb, yb, score))
 
-    def run_group(p, src, tgts):
-        """lax.map over N same-shape raw targets: ONE dispatch matches a
-        query against its whole shortlist group.  map (not vmap) keeps one
-        fine-grid volume live at a time — the batch dim would multiply the
-        ~GB intermediate."""
-        def one(tgt):
-            t = device_preprocess(
-                tgt[None], image_size=preprocess_image_size, k_size=k
-            )
-            return run(p, src, t)
-
-        return jax.lax.map(one, tgts)
-
-    jitted_group = jax.jit(run_group)
-
-    def match_group(src, tgts_raw):
-        """Match ``src`` (preprocessed, from ``matcher.preprocess``) against
-        a list of same-shape RAW uint8 panos in one device dispatch.
-        Requires ``preprocess_image_size``; returns a list of per-pano
-        ``(xa, ya, xb, yb, score)`` numpy tuples in input order."""
-        from ncnet_tpu.utils.profiling import annotate
-
-        assert preprocess_image_size is not None
-        shapes = {t.shape for t in tgts_raw}
-        assert len(shapes) == 1, f"group must share one shape, got {shapes}"
-        with annotate("inloc_group_matcher"):
-            stacked = jnp.asarray(
-                np.concatenate([np.asarray(t) for t in tgts_raw], axis=0)
-            )
-            outs = jitted_group(params, jnp.asarray(src), stacked)
-            outs = [np.asarray(v, dtype=np.float32) for v in outs]
-        return [tuple(v[i].ravel() for v in outs)
-                for i in range(len(tgts_raw))]
-
     matcher.preprocess = preprocess
-    matcher.match_group = match_group
     return matcher
 
 
@@ -431,34 +396,17 @@ def run_inloc_eval(
             for idx in range(n_panos)
         ]
 
-    def store_result(matches, idx, result):
-        xa, ya, xb, yb, score = result
-        if config.matching_both_directions:
-            # single-direction outputs stay in grid order, as in the
-            # reference (sort/dedup only happens in both-dirs mode,
-            # eval_inloc.py:151-177)
-            xa, ya, xb, yb, score = sort_and_dedup(xa, ya, xb, yb, score)
-        if len(xa) > n_cap:
-            # non-3:4-aspect pano overflowing the nominal table (the
-            # reference would crash here): keep the n_cap highest-scoring
-            # rows, preserving their current order
-            print(f"warning: {len(xa)} matches exceed capacity {n_cap}; "
-                  "keeping highest-scoring rows")
-            sel = np.sort(np.argsort(-score, kind="stable")[:n_cap])
-            xa, ya, xb, yb, score = (v[sel] for v in (xa, ya, xb, yb, score))
-        npts = len(xa)
-        matches[0, idx, :npts, 0] = xa[:npts]
-        matches[0, idx, :npts, 1] = ya[:npts]
-        matches[0, idx, :npts, 2] = xb[:npts]
-        matches[0, idx, :npts, 3] = yb[:npts]
-        matches[0, idx, :npts, 4] = score[:npts]
-
-    # a query's panos can be matched as same-shape GROUPS in one device
-    # dispatch (lax.map inside jit — matcher.match_group); dispatch/transfer
-    # round trips, not device FLOPs, dominate per-pair wall time on tunneled
-    # rigs.  The sharded forward stays per-pair.
-    def process_query(q, raws):
+    def process_query(q, io_pool):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
+        if config.skip_existing and os.path.exists(out_path):
+            # resume-by-artifact: the per-query .mat is written atomically at
+            # the end of its pano loop, so its existence means the query is
+            # done.  The folder name encodes checkpoint + settings, making a
+            # stale hit impossible short of swapping checkpoint contents
+            # under an unchanged name.
+            if progress:
+                print(f"{q} (exists, skipped)")
+            return
         if progress:
             print(q)
         matches = np.zeros((1, config.n_panos, n_cap, 5))
@@ -466,50 +414,41 @@ def run_inloc_eval(
         src = matcher.preprocess(
             load_raw(os.path.join(config.query_path, query_fns[q]))
         )
-        by_shape: dict = {}
-        for idx, raw in enumerate(raws):
-            by_shape.setdefault(raw.shape, []).append((idx, raw))
-        for shape, items in by_shape.items():
-            # with a spatial mesh the per-pair path decides shard-vs-not per
-            # shape bucket; grouping only applies to the plain forward
-            groupable = len(items) > 1 and mesh is None
-            if groupable:
-                results = matcher.match_group(src, [r for _, r in items])
-                for (idx, _), result in zip(items, results):
-                    store_result(matches, idx, result)
-            else:
-                for idx, raw in items:
-                    store_result(matches, idx, matcher(src, raw))
-        if progress:
-            print(">>>done")
+        jobs = pano_jobs(q)
+        pending = io_pool.submit(load_raw, jobs[0])
+        for idx in range(len(jobs)):
+            tgt = pending.result()
+            if idx + 1 < len(jobs):
+                pending = io_pool.submit(load_raw, jobs[idx + 1])
+            xa, ya, xb, yb, score = matcher(src, tgt)
+            if config.matching_both_directions:
+                # single-direction outputs stay in grid order, as in the
+                # reference (sort/dedup only happens in both-dirs mode,
+                # eval_inloc.py:151-177)
+                xa, ya, xb, yb, score = sort_and_dedup(xa, ya, xb, yb, score)
+            if len(xa) > n_cap:
+                # non-3:4-aspect pano overflowing the nominal table (the
+                # reference would crash here): keep the n_cap highest-scoring
+                # rows, preserving their current order
+                print(f"warning: {len(xa)} matches exceed capacity {n_cap}; "
+                      "keeping highest-scoring rows")
+                sel = np.sort(np.argsort(-score, kind="stable")[:n_cap])
+                xa, ya, xb, yb, score = (v[sel] for v in (xa, ya, xb, yb, score))
+            npts = len(xa)
+            matches[0, idx, :npts, 0] = xa[:npts]
+            matches[0, idx, :npts, 1] = ya[:npts]
+            matches[0, idx, :npts, 2] = xb[:npts]
+            matches[0, idx, :npts, 3] = yb[:npts]
+            matches[0, idx, :npts, 4] = score[:npts]
+            if progress and idx % 10 == 0:
+                print(">>>" + str(idx))
         savemat(
             out_path,
             {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
             do_compression=True,
         )
 
-    todo = [
-        q for q in range(host_index, n_queries, host_count)
-        if not (config.skip_existing
-                and os.path.exists(os.path.join(out_dir, f"{q + 1}.mat")))
-        # resume-by-artifact: a query's .mat is written atomically at the end
-        # of its pano loop, so its existence means the query is done.  The
-        # folder name encodes checkpoint + settings, making a stale hit
-        # impossible short of swapping checkpoint contents under an
-        # unchanged name.
-    ]
-    if progress and len(todo) < len(range(host_index, n_queries, host_count)):
-        print(f"resuming: {len(todo)} queries left in this host's stripe")
-
-    def decode_all(q):
-        return [load_raw(p) for p in pano_jobs(q)]
-
-    # decode the NEXT query's panos while the device matches the current one
     with ThreadPoolExecutor(max_workers=1) as io_pool:
-        pending = io_pool.submit(decode_all, todo[0]) if todo else None
-        for i, q in enumerate(todo):
-            raws = pending.result()
-            if i + 1 < len(todo):
-                pending = io_pool.submit(decode_all, todo[i + 1])
-            process_query(q, raws)
+        for q in range(host_index, n_queries, host_count):
+            process_query(q, io_pool)
     return out_dir

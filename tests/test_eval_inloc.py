@@ -250,34 +250,6 @@ def test_skip_existing_resumes(tmp_path):
     assert os.path.getmtime(path) == mtime
 
 
-def test_match_group_equals_per_pair(tmp_path):
-    """The one-dispatch group matcher (lax.map over stacked panos) must
-    reproduce the per-pair matcher's outputs."""
-    from ncnet_tpu.evaluation.inloc import load_raw, make_pair_matcher
-
-    root = str(tmp_path)
-    write_inloc_like(root, n_queries=1, n_panos=3, image_hw=(96, 128))
-    model_config = ModelConfig(
-        backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
-        half_precision=True, relocalization_k_size=2,
-    )
-    params = _identity_nc_params(model_config, jax.random.key(0))
-    matcher = make_pair_matcher(
-        model_config, params, do_softmax=True, both_directions=True,
-        flip_direction=False, preprocess_image_size=128,
-    )
-    qdir = os.path.join(root, "query", "iphone7")
-    src = matcher.preprocess(load_raw(os.path.join(qdir, "query_0.jpg")))
-    pano_dir = os.path.join(root, "pano", "DUC1")
-    raws = [load_raw(os.path.join(pano_dir, f"DUC_cutout_000_{p * 30}_0.jpg"))
-            for p in range(3)]
-    grouped = matcher.match_group(src, raws)
-    for raw, g in zip(raws, grouped):
-        single = matcher(src, raw)
-        for a, b in zip(single, g):
-            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
-
-
 def test_run_inloc_eval_single_direction(tmp_path):
     """flip/single-direction modes produce half-capacity tables."""
     root = str(tmp_path)
