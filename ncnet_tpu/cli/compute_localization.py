@@ -48,8 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n_queries", type=int, default=0, help="0 = all")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--num_workers", type=int, default=0,
-                   help="PnP process-pool width (the reference's parfor); "
-                        "0 = in-process")
+                   help="process-pool width for the PnP (per-query) and "
+                        "pose-verification (per-scan) stages — the "
+                        "reference's two parfor loops; 0 = in-process")
     return p
 
 

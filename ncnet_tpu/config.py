@@ -143,8 +143,10 @@ class LocalizationConfig:
     n_queries: int = 0                   # 0 = all queries in the shortlist
     seed: int = 0
     progress: bool = True
-    num_workers: int = 0                 # >0: PnP fans out over a process
-                                         # pool (the reference's parfor)
+    num_workers: int = 0                 # >0: PnP (per query) and pose
+                                         # verification (per scan) fan out
+                                         # over spawn process pools — the
+                                         # reference's two parfor loops
 
 
 @dataclasses.dataclass(frozen=True)

@@ -221,11 +221,40 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
     return imglist
 
 
+def _pv_run_items(config: LocalizationConfig, items_ser) -> Dict:
+    """Score a batch of PV items (one scan group when pooled).  Module-level
+    and plain-data-argumented so spawn workers can run it."""
+    items = [PVItem(q, d, np.asarray(P)) for q, d, P in items_ser]
+
+    def query_loader(fn: str) -> np.ndarray:
+        from ncnet_tpu.data.datasets import load_image
+
+        return load_image(os.path.join(config.query_path, fn))
+
+    return run_pose_verification(
+        items,
+        query_loader,
+        scan_dir=config.scan_path,
+        trans_dir=config.transformation_path,
+        focal_fn=lambda fn, img: query_focal(config, img.shape[1]),
+        out_dir=os.path.join(config.output_dir, _pv_dirname(config)),
+        scan_suffix=config.scan_suffix,
+        progress=config.progress,
+    )
+
+
 def run_pv_stage(
     config: LocalizationConfig, imglist: List[dict]
 ) -> List[dict]:
     """Pose-verification rerank of each query's candidates
-    (ht_top10_NC4D_PV_localization.m); writes/reloads the densePV ImgList."""
+    (ht_top10_NC4D_PV_localization.m); writes/reloads the densePV ImgList.
+
+    ``config.num_workers > 0`` fans the unique-scan groups out over a spawn
+    process pool — the reference's ``parfor`` over scans; per-item .pv.mat
+    artifacts keep pooled reruns collision-safe.
+    """
+    from ncnet_tpu.localization.verification import group_items_by_scan
+
     out_path = os.path.join(config.output_dir, _pv_matname(config))
     if os.path.exists(out_path):
         return _load_imglist(out_path)
@@ -236,21 +265,28 @@ def run_pv_stage(
         for db_fn, P in zip(e["topNname"], e["P"])
     ]
 
-    def query_loader(fn: str) -> np.ndarray:
-        from ncnet_tpu.data.datasets import load_image
+    if config.num_workers > 0:
+        import multiprocessing as mp
+        from concurrent.futures import ProcessPoolExecutor
 
-        return load_image(os.path.join(config.query_path, fn))
-
-    scores = run_pose_verification(
-        items,
-        query_loader,
-        scan_dir=config.scan_path,
-        trans_dir=config.transformation_path,
-        focal_fn=lambda fn, img: query_focal(config, img.shape[1]),
-        out_dir=os.path.join(config.output_dir, _pv_dirname(config)),
-        scan_suffix=config.scan_suffix,
-        progress=config.progress,
-    )
+        groups = [
+            [(it.query_fn, it.db_fn, np.asarray(it.P)) for it in group]
+            for _, group in sorted(group_items_by_scan(items).items())
+        ]
+        scores: Dict = {}
+        with ProcessPoolExecutor(
+            max_workers=config.num_workers,
+            mp_context=mp.get_context("spawn"),
+            initializer=_pnp_worker_init,
+        ) as pool:
+            for part in pool.map(
+                _pv_run_items, [config] * len(groups), groups
+            ):
+                scores.update(part)
+    else:
+        scores = _pv_run_items(
+            config, [(it.query_fn, it.db_fn, it.P) for it in items]
+        )
 
     reranked = []
     for e in imglist:
