@@ -36,6 +36,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the reference always starts from ImageNet weights)")
     p.add_argument("--num_workers", type=int, default=0)
     p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--half_precision", action="store_true",
+                   help="bf16 volume + NC weights during training")
+    p.add_argument("--remat_nc_layers", action="store_true",
+                   help="rematerialize each NC layer in the backward — "
+                        "fits batch 16 (with --half_precision) on one 16G "
+                        "chip at ~30%% step-time cost")
     return p
 
 
@@ -54,6 +60,7 @@ def main(argv=None) -> int:
             ncons_kernel_sizes=tuple(args.ncons_kernel_sizes),
             ncons_channels=tuple(args.ncons_channels),
             checkpoint=args.checkpoint,
+            half_precision=args.half_precision,
         ),
         image_size=args.image_size,
         dataset_image_path=args.dataset_image_path,
@@ -66,6 +73,7 @@ def main(argv=None) -> int:
         fe_finetune_params=args.fe_finetune_params,
         seed=args.seed,
         num_workers=args.num_workers,
+        remat_nc_layers=args.remat_nc_layers,
     )
     fit(config)
     print("Done!")

@@ -68,6 +68,10 @@ class TrainConfig:
     # TPU-native additions (no reference analog):
     data_parallel: bool = True           # shard the pair batch over the mesh 'data' axis
     donate_state: bool = True
+    remat_nc_layers: bool = False        # rematerialize each NC layer in the
+                                         # backward: fits bs16 (bf16) on one
+                                         # 16G chip at ~30% step-time cost —
+                                         # see training/loss.py measurements
     # static jit shapes need whole batches; dropping the val remainder (4 of
     # 308 PF-Pascal pairs at bs=16) makes best-checkpoint selection score a
     # fixed subset each epoch.  A documented deviation: the reference scores

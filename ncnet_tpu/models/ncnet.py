@@ -117,6 +117,7 @@ def neigh_consensus(
     corr: jnp.ndarray,
     *,
     symmetric: bool = True,
+    remat_layers: bool = False,
 ) -> jnp.ndarray:
     """Neighbourhood-consensus filtering of the 4D volume.
 
@@ -125,14 +126,25 @@ def neigh_consensus(
     to its A↔B transpose, transposing back and summing — exactly the
     reference's stack-level symmetry (model.py:144-150), which is NOT the same
     as symmetrizing each layer because of the interleaved ReLUs.
+
+    ``remat_layers``: rematerialize each conv+ReLU separately under autodiff,
+    so the backward pass holds one layer's folded-conv intermediates at a
+    time instead of the whole stack's (training memory knob; a forward-only
+    jit is unaffected).
     """
+
+    def one_layer(w, b, x):
+        return jax.nn.relu(conv4d(x, w, b))
+
+    if remat_layers:
+        one_layer = jax.checkpoint(one_layer)
 
     def stack(x: jnp.ndarray) -> jnp.ndarray:
         # every layer takes and emits the plain channels-last volume;
         # conv4d's 'auto' chooser (ops/conv4d.py) is the single authority
         # for the per-layer MXU formulation
         for layer in nc_params:
-            x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
+            x = one_layer(layer["w"], layer["b"], x)
         return x
 
     x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
@@ -218,10 +230,12 @@ def ncnet_forward(
     return ncnet_filter(config, params, corr)
 
 
-def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray) -> NCNetOutput:
+def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
+                 remat_nc_layers: bool = False) -> NCNetOutput:
     """The post-correlation half of the forward pass: [maxpool4d] →
     MutualMatching → NeighConsensus → MutualMatching.  Split out so the
-    high-res/sharded paths can feed their own correlation volume."""
+    high-res/sharded paths can feed their own correlation volume.
+    ``remat_nc_layers``: see :func:`neigh_consensus` (training memory knob)."""
     nc_params = params["nc"]
     if config.half_precision:
         nc_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), nc_params)
@@ -230,7 +244,8 @@ def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray) -> NCNetOutput:
     if config.relocalization_k_size > 1:
         corr, delta4d = maxpool4d_with_argmax(corr, config.relocalization_k_size)
     corr = mutual_matching(corr)
-    corr = neigh_consensus(nc_params, corr, symmetric=config.symmetric_mode)
+    corr = neigh_consensus(nc_params, corr, symmetric=config.symmetric_mode,
+                           remat_layers=remat_nc_layers)
     corr = mutual_matching(corr)
     return NCNetOutput(corr, delta4d)
 

@@ -60,6 +60,7 @@ def weak_loss(
     batch: Dict[str, jnp.ndarray],
     normalization: str = "softmax",
     stop_backbone_grad: bool = False,
+    remat_nc_layers: bool = False,
 ) -> jnp.ndarray:
     """score(negative) − score(positive) on an image-pair batch.
 
@@ -74,9 +75,16 @@ def weak_loss(
     ``stop_backbone_grad``: detach the features (the reference's frozen-FE
     ``requires_grad=False`` semantics, model.py:75-78) — set when no backbone
     blocks are being finetuned so the backward pass neither recomputes nor
-    stores the trunk, which is what lets the reference batch size 16 fit at
-    400² on one chip.  The NC filter is rematerialized (``jax.checkpoint``)
+    stores the trunk.  The NC filter is rematerialized (``jax.checkpoint``)
     so the huge 16-channel volume activations are recomputed, not stored.
+
+    ``remat_nc_layers``: additionally rematerialize each NC layer separately,
+    shrinking the backward's concurrent folded-conv intermediates at the cost
+    of recompute.  Measured on a 16G v5e at 400² (frozen trunk, donated
+    state): OFF → bs8 fp32 at ~9.8 pairs/s, bs16 OOMs (20.8G fp32 / 15.8G
+    bf16); ON → bs16 bf16 FITS at ~8.9 pairs/s, but bs8 fp32 drops to ~6.7
+    pairs/s — so it is a flag (``TrainConfig.remat_nc_layers``), not a
+    default.
     """
     fa = extract_features(config, params, batch["source_image"])
     fb = extract_features(config, params, batch["target_image"])
@@ -88,7 +96,9 @@ def weak_loss(
         fb = fb.astype(jnp.bfloat16)
 
     filt = jax.checkpoint(
-        lambda p, corr: ncnet_filter(config, p, corr).corr
+        lambda p, corr: ncnet_filter(
+            config, p, corr, remat_nc_layers=remat_nc_layers
+        ).corr
     )
     corr_pos = filt(params, correlation_4d(fa, fb))
     corr_neg = filt(params, correlation_4d(jnp.roll(fa, -1, axis=0), fb))

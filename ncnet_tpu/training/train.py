@@ -95,6 +95,7 @@ def make_train_step(
     optimizer,
     donate: bool = True,
     stop_backbone_grad: bool = False,
+    remat_nc_layers: bool = False,
 ):
     """Jitted (state, batch) → (state, loss).
 
@@ -109,7 +110,9 @@ def make_train_step(
     def step(state: TrainState, batch):
         loss, grads = jax.value_and_grad(
             lambda p: weak_loss(
-                model_config, p, batch, stop_backbone_grad=stop_backbone_grad
+                model_config, p, batch,
+                stop_backbone_grad=stop_backbone_grad,
+                remat_nc_layers=remat_nc_layers,
             )
         )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -347,6 +350,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
     train_step = make_train_step(
         model_config, optimizer, donate=config.donate_state,
         stop_backbone_grad=config.fe_finetune_params == 0,
+        remat_nc_layers=config.remat_nc_layers,
     )
     eval_step = make_eval_step(model_config)
 

@@ -59,6 +59,31 @@ def test_weak_loss_feature_roll_equals_image_roll(rng):
     np.testing.assert_allclose(float(loss), float(want), rtol=1e-5, atol=1e-6)
 
 
+def test_weak_loss_remat_layers_is_semantics_preserving(rng):
+    """remat_nc_layers is a memory knob: loss AND gradients must be
+    unchanged (jax.checkpoint only changes what the backward stores)."""
+    params = models.init_ncnet(TINY, jax.random.key(0))
+    batch = {
+        "source_image": jnp.asarray(
+            rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+        "target_image": jnp.asarray(
+            rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+    }
+
+    def loss_and_grad(remat):
+        return jax.value_and_grad(
+            lambda p: training.weak_loss(TINY, p, batch,
+                                         remat_nc_layers=remat)
+        )(params)
+
+    l0, g0 = loss_and_grad(False)
+    l1, g1 = loss_and_grad(True)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_train_step_reduces_loss_on_fixed_batch(rng):
     """A few Adam steps on one batch must reduce the weak loss (the negative
     is a different pair, so the model can discriminate)."""
