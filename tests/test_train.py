@@ -84,12 +84,17 @@ def test_weak_loss_remat_layers_is_semantics_preserving(rng):
                                    rtol=1e-5, atol=1e-6)
 
 
-def test_train_step_reduces_loss_on_fixed_batch(rng):
+@pytest.mark.parametrize("half,remat", [(False, False), (True, True)])
+def test_train_step_reduces_loss_on_fixed_batch(rng, half, remat):
     """A few Adam steps on one batch must reduce the weak loss (the negative
-    is a different pair, so the model can discriminate)."""
-    cfg = TrainConfig(model=TINY, lr=1e-3, batch_size=4)
+    is a different pair, so the model can discriminate).  The (True, True)
+    case backs the documented single-chip bs16 recipe: bf16 volume +
+    per-layer remat must still learn."""
+    cfg = TrainConfig(model=TINY.replace(half_precision=half), lr=1e-3,
+                      batch_size=4)
     state, optimizer, mc, _ = training.create_train_state(cfg)
-    step = training.make_train_step(mc, optimizer, donate=False)
+    step = training.make_train_step(mc, optimizer, donate=False,
+                                    remat_nc_layers=remat)
     batch = {
         "source_image": jnp.asarray(rng.uniform(0, 1, (4, 48, 48, 3)).astype(np.float32)),
         "target_image": jnp.asarray(rng.uniform(0, 1, (4, 48, 48, 3)).astype(np.float32)),
