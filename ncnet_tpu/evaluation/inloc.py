@@ -390,10 +390,10 @@ def run_inloc_eval(
         raise ValueError(
             f"host_index {host_index} out of range for host_count {host_count}"
         )
-    # one decode-ahead worker: the next pano decodes (and the next query
-    # loads) while the device chews on the current pair — the eval twin of
-    # the training loader's prefetch (the reference decodes serially,
-    # eval_inloc.py:129)
+    # one decode-ahead worker: the next pano decodes while the device chews
+    # on the current pair (and the first pano while the query preprocesses)
+    # — the eval twin of the training loader's prefetch (the reference
+    # decodes serially, eval_inloc.py:129)
     from concurrent.futures import ThreadPoolExecutor
 
     def pano_jobs(q):
@@ -417,12 +417,13 @@ def run_inloc_eval(
         if progress:
             print(q)
         matches = np.zeros((1, config.n_panos, n_cap, 5))
+        jobs = pano_jobs(q)
+        # an empty shortlist row still writes its all-zeros table
+        pending = io_pool.submit(load_raw, jobs[0]) if jobs else None
         # preprocess the query ONCE; it is reused across its ~10 pano pairs
         src = matcher.preprocess(
             load_raw(os.path.join(config.query_path, query_fns[q]))
         )
-        jobs = pano_jobs(q)
-        pending = io_pool.submit(load_raw, jobs[0])
         for idx in range(len(jobs)):
             tgt = pending.result()
             if idx + 1 < len(jobs):

@@ -121,10 +121,10 @@ def _load_imglist(path: str) -> List[dict]:
     return out
 
 
-def _pnp_worker_init() -> None:
-    """Pin spawned PnP workers to the CPU backend: N workers racing to attach
-    a single tunneled TPU would fail, and the per-pair hypothesis scoring is
-    small enough that host cores win once they run in parallel."""
+def _worker_init() -> None:
+    """Pin spawned workers (PnP and PV pools) to the CPU backend: N workers
+    racing to attach a single tunneled TPU would fail, and the per-item work
+    is small enough that host cores win once they run in parallel."""
     import sys
 
     import jax
@@ -132,8 +132,21 @@ def _pnp_worker_init() -> None:
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception as e:  # pragma: no cover - depends on jax internals
-        print(f"warning: PnP worker could not pin the CPU backend ({e}); "
+        print(f"warning: pool worker could not pin the CPU backend ({e}); "
               "workers may contend for the accelerator", file=sys.stderr)
+
+
+def _spawn_pool(num_workers: int):
+    """Spawn-based process pool with the CPU-pinning initializer — shared by
+    the PnP (per-query) and PV (per-scan-group) stages."""
+    import multiprocessing as mp
+    from concurrent.futures import ProcessPoolExecutor
+
+    return ProcessPoolExecutor(
+        max_workers=num_workers,
+        mp_context=mp.get_context("spawn"),
+        initializer=_worker_init,
+    )
 
 
 def _pnp_one_query(config: LocalizationConfig, qi: int, qname: str,
@@ -205,14 +218,7 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
         for qi in range(n_queries)
     ]
     if config.num_workers > 0:
-        import multiprocessing as mp
-        from concurrent.futures import ProcessPoolExecutor
-
-        with ProcessPoolExecutor(
-            max_workers=config.num_workers,
-            mp_context=mp.get_context("spawn"),
-            initializer=_pnp_worker_init,
-        ) as pool:
+        with _spawn_pool(config.num_workers) as pool:
             imglist = list(pool.map(_pnp_one_query, *zip(*args)))
     else:
         imglist = [_pnp_one_query(*a) for a in args]
@@ -221,7 +227,8 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
     return imglist
 
 
-def _pv_run_items(config: LocalizationConfig, items_ser) -> Dict:
+def _pv_run_items(config: LocalizationConfig, items_ser,
+                  prepared_queries=None, progress=None) -> Dict:
     """Score a batch of PV items (one scan group when pooled).  Module-level
     and plain-data-argumented so spawn workers can run it."""
     items = [PVItem(q, d, np.asarray(P)) for q, d, P in items_ser]
@@ -239,7 +246,8 @@ def _pv_run_items(config: LocalizationConfig, items_ser) -> Dict:
         focal_fn=lambda fn, img: query_focal(config, img.shape[1]),
         out_dir=os.path.join(config.output_dir, _pv_dirname(config)),
         scan_suffix=config.scan_suffix,
-        progress=config.progress,
+        progress=config.progress if progress is None else progress,
+        prepared_queries=prepared_queries,
     )
 
 
@@ -266,23 +274,44 @@ def run_pv_stage(
     ]
 
     if config.num_workers > 0:
-        import multiprocessing as mp
-        from concurrent.futures import ProcessPoolExecutor
+        from ncnet_tpu.data.datasets import load_image
+        from ncnet_tpu.localization.verification import downsample_image
 
+        group_map = sorted(group_items_by_scan(items).items())
         groups = [
             [(it.query_fn, it.db_fn, np.asarray(it.P)) for it in group]
-            for _, group in sorted(group_items_by_scan(items).items())
+            for _, group in group_map
+        ]
+        # decode + downsample every query ONCE in the parent and ship the
+        # small (H/8) arrays to the workers — a query appears in up to topN
+        # scan groups, so per-worker caches would redo the full-res decode
+        # per group
+        prepared: Dict[str, tuple] = {}
+        for e in imglist:
+            fn = e["queryname"]
+            if fn not in prepared:
+                img = load_image(os.path.join(config.query_path, fn))
+                prepared[fn] = (
+                    downsample_image(img),
+                    query_focal(config, img.shape[1]),
+                )
+        per_group_prepared = [
+            {q: prepared[q] for q, _, _ in group} for group in groups
         ]
         scores: Dict = {}
-        with ProcessPoolExecutor(
-            max_workers=config.num_workers,
-            mp_context=mp.get_context("spawn"),
-            initializer=_pnp_worker_init,
-        ) as pool:
-            for part in pool.map(
-                _pv_run_items, [config] * len(groups), groups
-            ):
+        with _spawn_pool(config.num_workers) as pool:
+            results = pool.map(
+                _pv_run_items,
+                [config] * len(groups),
+                groups,
+                per_group_prepared,
+                [False] * len(groups),  # workers stay quiet; parent reports
+            )
+            for gi, ((key, _), part) in enumerate(zip(group_map, results)):
                 scores.update(part)
+                if config.progress:
+                    print(f"ncnetPV: scan {key} ({gi + 1} / "
+                          f"{len(groups)}) done.")
     else:
         scores = _pv_run_items(
             config, [(it.query_fn, it.db_fn, it.P) for it in items]

@@ -111,6 +111,7 @@ def run_pose_verification(
     out_dir: str = "",
     scan_suffix: str = ".ptx.mat",
     progress: bool = True,
+    prepared_queries: Optional[Dict[str, Tuple[np.ndarray, float]]] = None,
 ) -> Dict[Tuple[str, str], float]:
     """Score every (query, db, P) item, grouped by scan.  Returns
     ``{(query_fn, db_fn): score}``.
@@ -119,13 +120,19 @@ def run_pose_verification(
     focal in pixels at full resolution.  When ``out_dir`` is set, per-item
     ``.pv.mat`` artifacts (score + render) are written and reloaded on rerun
     (resume-by-artifact, parfor_nc4d_PV.m's exist guard).
+
+    ``prepared_queries``: ``{query_fn: (downsampled image, full-res focal)}``
+    — callers that split the work across processes pass these so each query
+    is decoded/downsampled once globally instead of once per scan group.
     """
     from scipy.io import loadmat, savemat
 
     scores: Dict[Tuple[str, str], float] = {}
     # cache the 1/8-downsampled query (+ its full-res focal), not the full
     # image: 356 iPhone7 queries at full resolution would hold ~13 GB
-    query_cache: Dict[str, Tuple[np.ndarray, float]] = {}
+    query_cache: Dict[str, Tuple[np.ndarray, float]] = dict(
+        prepared_queries or {}
+    )
     groups = group_items_by_scan(items)
     for gi, (key, group) in enumerate(sorted(groups.items())):
         scan_loaded = None

@@ -250,6 +250,29 @@ def test_skip_existing_resumes(tmp_path):
     assert os.path.getmtime(path) == mtime
 
 
+def test_run_inloc_eval_zero_panos_writes_empty_table(tmp_path):
+    """n_panos=0 (or an empty shortlist row) must still write the query's
+    all-zeros table instead of crashing the run."""
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=1, n_panos=2, image_hw=(96, 128))
+    model_config = ModelConfig(
+        backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
+        half_precision=True, relocalization_k_size=2,
+    )
+    params = _identity_nc_params(model_config, jax.random.key(0))
+    config = EvalInLocConfig(
+        inloc_shortlist=shortlist, k_size=2, image_size=128,
+        n_queries=1, n_panos=0,
+        pano_path=os.path.join(root, "pano"),
+        query_path=os.path.join(root, "query", "iphone7"),
+        output_root=os.path.join(root, "matches"),
+    )
+    out_dir = run_inloc_eval(config, model_config=model_config, params=params,
+                             progress=False)
+    mat = loadmat(os.path.join(out_dir, "1.mat"))
+    assert mat["matches"].shape[1] == 0 or np.all(mat["matches"] == 0)
+
+
 def test_run_inloc_eval_single_direction(tmp_path):
     """flip/single-direction modes produce half-capacity tables."""
     root = str(tmp_path)
