@@ -84,7 +84,9 @@ def weak_loss(
     state): OFF → bs8 fp32 at ~9.8 pairs/s, bs16 OOMs (20.8G fp32 / 15.8G
     bf16); ON → bs16 bf16 FITS at ~8.9 pairs/s, but bs8 fp32 drops to ~6.7
     pairs/s — so it is a flag (``TrainConfig.remat_nc_layers``), not a
-    default.
+    default.  The knob helps ONLY with the bf16 volume: bs16 fp32 WITH it
+    needs 24.4G (XLA schedules more concurrent recompute buffers than the
+    un-rematted 20.8G) — pair it with ``half_precision``.
     """
     fa = extract_features(config, params, batch["source_image"])
     fb = extract_features(config, params, batch["target_image"])
