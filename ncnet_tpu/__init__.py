@@ -25,6 +25,6 @@ Layout:
     cli/        entry points mirroring the reference CLIs
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from ncnet_tpu import ops  # noqa: F401
