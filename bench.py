@@ -106,8 +106,13 @@ def _with_retries(fn, attempts=3, label=""):
     return None
 
 
-def bench_jax():
-    """All JAX-side numbers on jax's default backend."""
+def bench_jax(res=None):
+    """All JAX-side numbers on jax's default backend.
+
+    Mutates (and returns) ``res`` so metrics collected before a mid-function
+    failure survive for main()'s whole-run retry, which also skips metrics a
+    previous attempt already captured.
+    """
     import warnings
 
     import jax
@@ -120,10 +125,24 @@ def bench_jax():
     from ncnet_tpu.ops import correlation_4d
 
     cfg = ModelConfig(ncons_kernel_sizes=KERNELS, ncons_channels=CHANNELS)
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")  # random-trunk warning: timing only
-        params = models.init_ncnet(cfg, jax.random.key(0))
-    res = {}
+
+    def _init():
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # random-trunk warning: timing only
+            return models.init_ncnet(cfg, jax.random.key(0))
+
+    # init touches the device (key split + param upload): the same transient
+    # tunnel failures the per-metric retries guard against can hit here too
+    # (round-2 artifact died exactly at this line on an HTTP 500)
+    params = _with_retries(_init, label="init_ncnet")
+    if params is None:
+        raise RuntimeError("init_ncnet failed after retries")
+    res = {} if res is None else res
+
+    def put(key, fn, label):
+        """Measure into res[key] unless a prior attempt already did."""
+        if res.get(key) is None:
+            res[key] = _with_retries(fn, label=label)
 
     def image_pair_input(b):
         def make(key):
@@ -150,7 +169,8 @@ def bench_jax():
             lambda src, tgt: models.ncnet_forward(model_cfg, params, src, tgt).corr
         )
 
-    res["forward_ms_per_pair_fp32"] = _with_retries(
+    put(
+        "forward_ms_per_pair_fp32",
         lambda: _timeit_scan(
             fwd_step(cfg), image_pair_input(BATCH), per=BATCH, n_long=12
         ),
@@ -158,7 +178,8 @@ def bench_jax():
     )
 
     cfg16 = cfg.replace(half_precision=True, backbone_bf16=True)
-    res["forward_ms_per_pair_bf16"] = _with_retries(
+    put(
+        "forward_ms_per_pair_bf16",
         lambda: _timeit_scan(
             fwd_step(cfg16), image_pair_input(BATCH), per=BATCH, n_long=12
         ),
@@ -167,9 +188,8 @@ def bench_jax():
 
     # MFU of the bf16 path from XLA's own FLOP count — skipped entirely when
     # the bf16 timing failed (its lower+compile would be wasted work)
-    if res["forward_ms_per_pair_bf16"] is None:
-        res.pop("forward_ms_per_pair_bf16")
-    else:
+    if res.get("forward_ms_per_pair_bf16") is not None and \
+            res.get("forward_bf16_mfu_pct") is None:
         try:
             rng = np.random.default_rng(0)
             src = jnp.asarray(
@@ -196,30 +216,31 @@ def bench_jax():
     # correlation-only (BASELINE north-star: ms/pair 4D-corr fwd) — feature
     # shape derived from the configured backbone via eval_shape (free), so a
     # config change cannot silently decouple this metric from the model
-    feat_shape = jax.eval_shape(
-        lambda p, x: extract_features(cfg, p, x),
-        params,
-        jax.ShapeDtypeStruct((BATCH, IMAGE, IMAGE, 3), jnp.float32),
-    ).shape
+    def _corr_metric():
+        feat_shape = jax.eval_shape(
+            lambda p, x: extract_features(cfg, p, x),
+            params,
+            jax.ShapeDtypeStruct((BATCH, IMAGE, IMAGE, 3), jnp.float32),
+        ).shape
 
-    corr_step = chain_step(correlation_4d)
+        corr_step = chain_step(correlation_4d)
 
-    def corr_input(key):
-        k1, k2 = jax.random.split(key)
-        return (
-            jax.random.normal(k1, feat_shape, jnp.float32) * 0.03,
-            jax.random.normal(k2, feat_shape, jnp.float32) * 0.03,
-        )
+        def corr_input(key):
+            k1, k2 = jax.random.split(key)
+            return (
+                jax.random.normal(k1, feat_shape, jnp.float32) * 0.03,
+                jax.random.normal(k2, feat_shape, jnp.float32) * 0.03,
+            )
 
-    # the einsum correlation is ~0.1ms for the whole batch where the tunnel's
-    # dispatch jitter is ±40ms: scan 2048 deep so compute dominates the span
-    res["corr_ms_per_pair"] = _with_retries(
-        lambda: _timeit_scan(corr_step, corr_input, per=BATCH, n_long=2048),
-        label="corr",
-    )
+        # the einsum correlation is ~0.1ms/batch where the tunnel's dispatch
+        # jitter is ±40ms: scan 2048 deep so compute dominates the span
+        return _timeit_scan(corr_step, corr_input, per=BATCH, n_long=2048)
+
+    put("corr_ms_per_pair", _corr_metric, label="corr")
 
     # batch-1 forward for the matched-batch baseline comparison
-    res["forward_ms_per_pair_bs1"] = _with_retries(
+    put(
+        "forward_ms_per_pair_bs1",
         lambda: _timeit_scan(
             fwd_step(cfg), image_pair_input(1), per=1, n_long=24
         ),
@@ -231,10 +252,10 @@ def bench_jax():
     import os
 
     if os.environ.get("NCNET_BENCH_INLOC"):
-        res["inloc_matcher_s_per_pair"] = _with_retries(
-            _bench_inloc_matcher, label="inloc_matcher"
-        )
-    res = {k: v for k, v in res.items() if v is not None}
+        put("inloc_matcher_s_per_pair", _bench_inloc_matcher,
+            label="inloc_matcher")
+    for k in [k for k, v in res.items() if v is None]:  # prune in place so a
+        del res[k]  # shared res dict keeps already-captured metrics on retry
 
     # train step (BASELINE north-star: image-pairs/sec; reference bs=16 —
     # on a single 16G chip the largest fitting batch is used and reported,
@@ -244,6 +265,8 @@ def bench_jax():
     batch_ladder = (16, 8, 4)
     if "lite" in jax.devices()[0].device_kind:  # v5e/v6e: 16G HBM
         batch_ladder = (8, 4)
+    if res.get("train_pairs_per_sec") is not None:
+        batch_ladder = ()  # a prior attempt already captured the train metric
     for bs_try in batch_ladder:
         try:
             tcfg = TrainConfig(model=cfg, batch_size=bs_try, data_parallel=False)
@@ -425,7 +448,25 @@ def bench_torch_reference_style(iters=3):
 
 
 def main():
-    res = bench_jax()
+    """Always print exactly one JSON line and exit 0.
+
+    Per-metric retries live in bench_jax(); this level adds one retry of the
+    whole JAX side (a tunnel failure during init nullified round 2's artifact)
+    and guarantees the artifact carries whatever metrics survived — value is
+    null only if literally everything failed.
+    """
+    import sys
+
+    res = {}
+    for attempt in range(2):
+        try:
+            bench_jax(res)
+            break
+        except Exception as e:
+            print(f"bench_jax attempt {attempt + 1}/2 failed: {str(e)[:300]}",
+                  file=sys.stderr)
+            if attempt == 0:
+                time.sleep(15)
     try:
         baseline_ms = bench_torch_reference_style()
         res["torch_cpu_ms_per_pair_bs1"] = round(baseline_ms, 1)
@@ -433,18 +474,31 @@ def main():
     except Exception:
         vs_baseline = None
     headline = res.pop("forward_ms_per_pair_fp32", None)
+
+    def jsonable(v):
+        """Round floats, coerce numpy scalars; None when unserializable so
+        one stray value drops only itself, never the whole artifact."""
+        try:
+            v = round(float(v), 3) if not isinstance(v, (str, int)) else v
+            json.dumps(v)
+            return v
+        except Exception:
+            return None
+
+    extra = {k: j for k, v in res.items() if (j := jsonable(v)) is not None}
     print(
         json.dumps(
             {
                 "metric": "pf_pascal_forward_ms_per_pair",
-                "value": round(headline, 3) if headline is not None else None,
+                "value": jsonable(headline) if headline is not None else None,
                 "unit": "ms/pair",
-                "vs_baseline": vs_baseline,
-                "extra": {k: round(v, 3) if isinstance(v, float) else v
-                          for k, v in res.items()},
+                "vs_baseline": jsonable(vs_baseline)
+                if vs_baseline is not None else None,
+                "extra": extra,
             }
         )
     )
+    sys.exit(0)
 
 
 if __name__ == "__main__":
