@@ -317,7 +317,7 @@ def run_inloc_eval(
     top-``n_panos`` shortlisted images and write one compressed .mat with the
     fixed-capacity match table.
     """
-    from scipy.io import savemat
+    from ncnet_tpu.utils.io import atomic_savemat
 
     if params is None:
         from ncnet_tpu.models.checkpoint import load_params
@@ -406,11 +406,11 @@ def run_inloc_eval(
     def process_query(q, io_pool):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
         if config.skip_existing and os.path.exists(out_path):
-            # resume-by-artifact: the per-query .mat is written atomically at
-            # the end of its pano loop, so its existence means the query is
-            # done.  The folder name encodes checkpoint + settings, making a
-            # stale hit impossible short of swapping checkpoint contents
-            # under an unchanged name.
+            # resume-by-artifact: the per-query .mat is written via temp-file
+            # + os.replace at the end of its pano loop, so its existence means
+            # the query is done.  The folder name encodes checkpoint +
+            # settings, making a stale hit impossible short of swapping
+            # checkpoint contents under an unchanged name.
             if progress:
                 print(f"{q} (exists, skipped)")
             return
@@ -450,7 +450,7 @@ def run_inloc_eval(
             matches[0, idx, :npts, 4] = score[:npts]
             if progress and idx % 10 == 0:
                 print(">>>" + str(idx))
-        savemat(
+        atomic_savemat(
             out_path,
             {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
             do_compression=True,

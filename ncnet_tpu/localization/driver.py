@@ -44,13 +44,14 @@ def image_size(path: str) -> Tuple[int, int]:
     return h, w
 
 
-def query_focal(config: LocalizationConfig, width: int) -> float:
+def query_focal(config: LocalizationConfig, height: int, width: int) -> float:
     """Configured query focal length, or the iPhone 7 EXIF-derived default
     (the reference reads ``params.data.q.fl`` from its external project
-    setup)."""
+    setup).  Derived from the image's long side — see
+    :func:`geometry.iphone7_focal`."""
     if config.query_focal_length > 0:
         return config.query_focal_length
-    return geometry.iphone7_focal(width)
+    return geometry.iphone7_focal(height, width)
 
 
 def _cell_row(items) -> np.ndarray:
@@ -64,7 +65,7 @@ def _cell_row(items) -> np.ndarray:
 
 
 def _save_imglist(path: str, imglist: List[dict]) -> None:
-    from scipy.io import savemat
+    from ncnet_tpu.utils.io import atomic_savemat as savemat
 
     savemat(
         path,
@@ -158,7 +159,7 @@ def _pnp_one_query(config: LocalizationConfig, qi: int, qname: str,
 
     pnp_dir = os.path.join(config.output_dir, _pnp_dirname(config))
     qsize = image_size(os.path.join(config.query_path, qname))
-    focal = query_focal(config, qsize[1])
+    focal = query_focal(config, qsize[0], qsize[1])
     match_mat = loadmat(
         os.path.join(config.matches_dir, f"{qi + 1}.mat")
     )["matches"]
@@ -243,7 +244,7 @@ def _pv_run_items(config: LocalizationConfig, items_ser,
         query_loader,
         scan_dir=config.scan_path,
         trans_dir=config.transformation_path,
-        focal_fn=lambda fn, img: query_focal(config, img.shape[1]),
+        focal_fn=lambda fn, img: query_focal(config, img.shape[0], img.shape[1]),
         out_dir=os.path.join(config.output_dir, _pv_dirname(config)),
         scan_suffix=config.scan_suffix,
         progress=config.progress if progress is None else progress,
@@ -293,7 +294,7 @@ def run_pv_stage(
                 img = load_image(os.path.join(config.query_path, fn))
                 prepared[fn] = (
                     downsample_image(img),
-                    query_focal(config, img.shape[1]),
+                    query_focal(config, img.shape[0], img.shape[1]),
                 )
         per_group_prepared = [
             {q: prepared[q] for q, _, _ in group} for group in groups

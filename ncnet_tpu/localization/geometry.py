@@ -47,13 +47,16 @@ def intrinsics(focal: float, height: int, width: int) -> np.ndarray:
     )
 
 
-def iphone7_focal(width: int) -> float:
+def iphone7_focal(height: int, width: int) -> float:
     """Default query focal length in pixels from the iPhone 7's 28 mm
-    (35 mm-equivalent) lens: ``width · 28/36``.  The reference reads the value
-    from its external InLoc_demo project setup; this reconstruction from the
-    camera's EXIF spec is exposed as an overridable default
-    (LocalizationConfig.query_focal_length)."""
-    return width * 28.0 / 36.0
+    (35 mm-equivalent) lens: ``long_side · 28/36``.  The 35 mm-equivalence
+    is defined against the sensor's LONG side (36 mm of a 36×24 frame), so
+    portrait-stored queries (4032×3024 H×W) must use the height — keying on
+    width alone would be ~33% low for them.  The reference reads a single
+    constant ``params.data.q.fl`` from its external InLoc_demo setup; this
+    reconstruction from the camera's EXIF spec is exposed as an overridable
+    default (LocalizationConfig.query_focal_length)."""
+    return max(height, width) * 28.0 / 36.0
 
 
 def pixel_rays(K: np.ndarray, xy: np.ndarray) -> np.ndarray:

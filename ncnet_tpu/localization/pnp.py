@@ -86,11 +86,31 @@ def estimate_pose_from_matches(
     return PnPResult(res.P, res.inliers, tent_2d, tent_3d, keep)
 
 
+def artifact_stem(db_fn: str) -> str:
+    """Collision-free flat filename stem for a db cutout path: directory
+    components (floor etc.) joined into the name with ``__``.  The reference
+    keys artifacts on the basename only (params.output.pnp_nc4d.matformat),
+    so ``DUC1/X.jpg`` and ``DUC2/X.jpg`` collide — fatal here because the
+    artifact is also the resume source of truth.  When a path segment itself
+    contains ``__`` the join is ambiguous; a short path hash is appended to
+    keep the mapping injective while leaving InLoc-style names readable."""
+    rel = os.path.splitext(db_fn)[0].replace("\\", "/").strip("/")
+    parts = [p for p in rel.split("/") if p]
+    stem = "__".join(parts)
+    # the join is uniquely decodable iff no part contains "__" and no part
+    # starts/ends with "_" (the latter shows up as a ≥3-underscore run)
+    if any("__" in p for p in parts) or "___" in stem:
+        import hashlib
+
+        stem += "." + hashlib.sha1(rel.encode()).hexdigest()[:8]
+    return stem
+
+
 def pnp_artifact_path(out_dir: str, query_fn: str, db_fn: str) -> str:
-    """``<out_dir>/<query>/<db-basename>.pnp_nc4d_inlier.mat`` — the
-    reference's artifact layout (params.output.pnp_nc4d.matformat)."""
-    base = os.path.splitext(os.path.basename(db_fn))[0]
-    return os.path.join(out_dir, query_fn, base + ".pnp_nc4d_inlier.mat")
+    """``<out_dir>/<query>/<floor>__<db-basename>.pnp_nc4d_inlier.mat``."""
+    return os.path.join(
+        out_dir, query_fn, artifact_stem(db_fn) + ".pnp_nc4d_inlier.mat"
+    )
 
 
 def run_pair_pnp(
@@ -108,7 +128,9 @@ def run_pair_pnp(
     and skips work whose artifact exists — the resume-by-artifact behavior
     the reference uses as failure recovery (SURVEY §5.3).  Returns
     ``(P, inliers)``."""
-    from scipy.io import loadmat, savemat
+    from scipy.io import loadmat
+
+    from ncnet_tpu.utils.io import atomic_savemat
 
     path = pnp_artifact_path(out_dir, query_fn, db_fn)
     if os.path.exists(path):
@@ -118,7 +140,7 @@ def run_pair_pnp(
         matches, query_size, xyzcut, P_after, focal, **kwargs
     )
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    savemat(
+    atomic_savemat(
         path,
         {
             "P": res.P,

@@ -125,7 +125,10 @@ def run_pose_verification(
     — callers that split the work across processes pass these so each query
     is decoded/downsampled once globally instead of once per scan group.
     """
-    from scipy.io import loadmat, savemat
+    from scipy.io import loadmat
+
+    from ncnet_tpu.localization.pnp import artifact_stem
+    from ncnet_tpu.utils.io import atomic_savemat
 
     scores: Dict[Tuple[str, str], float] = {}
     # cache the 1/8-downsampled query (+ its full-res focal), not the full
@@ -139,8 +142,9 @@ def run_pose_verification(
         for it in group:
             art = ""
             if out_dir:
-                base = os.path.splitext(os.path.basename(it.db_fn))[0]
-                art = os.path.join(out_dir, it.query_fn, base + ".pv.mat")
+                art = os.path.join(
+                    out_dir, it.query_fn, artifact_stem(it.db_fn) + ".pv.mat"
+                )
                 if os.path.exists(art):
                     scores[(it.query_fn, it.db_fn)] = float(
                         loadmat(art)["score"].ravel()[0]
@@ -167,7 +171,7 @@ def run_pose_verification(
             scores[(it.query_fn, it.db_fn)] = score
             if art:
                 os.makedirs(os.path.dirname(art), exist_ok=True)
-                savemat(
+                atomic_savemat(
                     art,
                     {"score": score, "RGBpersp": rgb_persp, "RGB_flag": valid},
                     do_compression=True,

@@ -1,6 +1,13 @@
-"""Utilities: seeding, profiling."""
+"""Utilities: seeding, profiling, atomic artifact I/O."""
 
+from ncnet_tpu.utils.io import atomic_savemat
 from ncnet_tpu.utils.profiling import annotate, maybe_trace
 from ncnet_tpu.utils.seeding import global_seed, worker_rng
 
-__all__ = ["annotate", "maybe_trace", "global_seed", "worker_rng"]
+__all__ = [
+    "annotate",
+    "atomic_savemat",
+    "maybe_trace",
+    "global_seed",
+    "worker_rng",
+]

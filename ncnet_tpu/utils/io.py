@@ -1,0 +1,28 @@
+"""Filesystem helpers for resume-by-artifact outputs.
+
+The eval/localization stages treat an artifact's *existence* as proof its
+work unit completed (the reference's ``exist(...)~=2`` guards, SURVEY §5.3).
+That contract only holds if artifacts appear atomically — a process killed
+mid-``savemat`` must not leave a truncated file that a rerun then skips.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def atomic_savemat(path: str, mdict: dict, **kwargs) -> None:
+    """``scipy.io.savemat`` to ``path`` via a same-directory temp file +
+    ``os.replace``, so the file exists only once fully written."""
+    from scipy.io import savemat
+
+    tmp = path + ".tmp"
+    try:
+        savemat(tmp, mdict, **kwargs)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise

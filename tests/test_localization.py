@@ -45,6 +45,13 @@ class TestGeometry:
         assert dp == pytest.approx(0.0, abs=1e-12)
         assert do == pytest.approx(0.0, abs=1e-6)
 
+    def test_iphone7_focal_orientation_invariant(self):
+        # the 35mm-equivalence is against the sensor LONG side: portrait- and
+        # landscape-stored copies of the same photo share one focal length
+        f_port = geometry.iphone7_focal(4032, 3024)
+        f_land = geometry.iphone7_focal(3024, 4032)
+        assert f_port == f_land == pytest.approx(4032 * 28.0 / 36.0)
+
     def test_pose_distance_known_rotation(self):
         P1 = np.concatenate([np.eye(3), np.zeros((3, 1))], axis=1)
         ang = 0.3
@@ -426,6 +433,44 @@ class TestPnPPipeline:
         P2, _ = run_pair_pnp(str(tmp_path), "q.jpg", "DUC1/DUC_cutout_001_0_0.jpg", **args)
         assert os.path.getmtime(art) == mtime  # loaded, not recomputed
         np.testing.assert_array_equal(np.isnan(P1), np.isnan(P2))
+
+    def test_artifact_paths_distinguish_floors(self, tmp_path):
+        # same basename on two floors must map to two artifacts: the artifact
+        # is the resume source of truth, so a collision silently reuses the
+        # wrong floor's pose
+        from ncnet_tpu.localization.pnp import pnp_artifact_path
+
+        a = pnp_artifact_path(str(tmp_path), "q.jpg", "DUC1/DUC_cutout_024_30_0.jpg")
+        b = pnp_artifact_path(str(tmp_path), "q.jpg", "DUC2/DUC_cutout_024_30_0.jpg")
+        assert a != b
+        assert os.path.dirname(a) == os.path.dirname(b)  # still flat per query
+
+    def test_atomic_savemat(self, tmp_path, monkeypatch):
+        from scipy.io import loadmat
+        import scipy.io
+
+        from ncnet_tpu.utils.io import atomic_savemat
+
+        path = str(tmp_path / "out.mat")
+        atomic_savemat(path, {"x": np.arange(3.0)})
+        np.testing.assert_array_equal(
+            loadmat(path)["x"].ravel(), np.arange(3.0)
+        )
+        assert not os.path.exists(path + ".tmp")
+
+        # a crash mid-write must leave neither the target nor the temp file —
+        # existence of the artifact is what resume trusts
+        def boom(p, *a, **k):
+            with open(p, "wb") as f:
+                f.write(b"truncated")
+            raise KeyboardInterrupt  # the kill-mid-write scenario
+
+        monkeypatch.setattr(scipy.io, "savemat", boom)
+        path2 = str(tmp_path / "crash.mat")
+        with pytest.raises(KeyboardInterrupt):
+            atomic_savemat(path2, {"x": np.arange(3.0)})
+        assert not os.path.exists(path2)
+        assert not os.path.exists(path2 + ".tmp")
 
 
 class TestVerification:
