@@ -1,23 +1,12 @@
 #!/bin/bash
-# Indoor venues dataset (IVD): 1,854 Google-Maps photos of 89 venues, plus the
-# NCNet pair lists.  Run from this directory: bash download.sh
+# Indoor venues dataset (IVD): 3,708 Google-Maps photos of 89 venues.  The
+# pair lists (image_pairs/), directory tree (dirs.txt) and image URL list
+# (urls.txt) are vendored — only the images themselves need fetching.
+# Run from this directory: bash download.sh
 set -e
 
-BASE=https://raw.githubusercontent.com/ignacio-rocco/ncnet/master/datasets/ivd
-
-# directory tree + image URL list (data files maintained upstream)
-wget -c -O dirs.txt $BASE/dirs.txt
-wget -c -O urls.txt $BASE/urls.txt
-
-while read -r path _; do
-  mkdir -p "$path"
-done < dirs.txt
+bash make_dirs.sh
 
 # urls.txt rows are "<relative path> <url>"; fetch 8-wide, tolerate misses
 # (venue photos occasionally disappear from Google Maps)
 <urls.txt xargs -n2 -P8 wget -nc -O || true
-
-mkdir -p image_pairs
-for f in train_pairs.csv val_pairs.csv; do
-  wget -c -O image_pairs/$f $BASE/image_pairs/$f
-done

@@ -1,0 +1,7 @@
+#!/bin/bash
+# Create the 89-venue directory tree listed in dirs.txt (reference
+# datasets/ivd/make_dirs.sh).
+set -e
+while read -r path _; do
+  mkdir -p "$path"
+done < dirs.txt

@@ -1,15 +1,9 @@
 #!/bin/bash
-# PF-Pascal images (Proposal Flow, Ham et al.) + the NCNet pair lists.
+# PF-Pascal images (Proposal Flow, Ham et al.).  The curated pair lists are
+# vendored in image_pairs/ — only the images need fetching.
 # Run from this directory: bash download.sh
 set -e
 
 # images (same public source the reference uses)
 wget -c https://www.di.ens.fr/willow/research/proposalflow/dataset/PF-dataset-PASCAL.zip
 unzip -n PF-dataset-PASCAL.zip 'PF-dataset-PASCAL/JPEGImages/*'
-
-# curated pair lists, fetched from the upstream NCNet repository
-mkdir -p image_pairs
-BASE=https://raw.githubusercontent.com/ignacio-rocco/ncnet/master/datasets/pf-pascal/image_pairs
-for f in train_pairs.csv val_pairs.csv test_pairs.csv; do
-  wget -c -O image_pairs/$f $BASE/$f
-done
