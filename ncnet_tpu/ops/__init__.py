@@ -16,6 +16,8 @@ from ncnet_tpu.ops.conv4d import (
     conv4d,
     conv4d_fold_fits,
     conv4d_init,
+    conv4d_same,
+    conv4d_transpose_weights,
 )
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
 from ncnet_tpu.ops.matching import (
@@ -46,6 +48,8 @@ __all__ = [
     "conv4d",
     "conv4d_fold_fits",
     "conv4d_init",
+    "conv4d_same",
+    "conv4d_transpose_weights",
     "maxpool4d_with_argmax",
     "mutual_matching",
     "corr_to_matches",

@@ -18,10 +18,13 @@ measurement (TPU v5e at the PF-Pascal 25⁴ workload):
                    where plain convs leave 112 of 128 MXU output lanes idle.
   * ``afold``    — folds the FULL A-side stencil (kA·kWA taps) into output
                    channels (one 2D conv over (hB,wB) + shifted sums over
-                   both A dims); maximizes MXU output-lane fill but measured
-                   ~2× slower than ``coutfold`` at the 25⁴ workload — the
-                   kA·kWA× intermediate costs more HBM traffic than the fill
-                   buys.  Not selected by ``auto``.
+                   both A dims); maximizes MXU output-lane fill.  For fat
+                   C_out the kA·kWA·C_out× intermediate costs more HBM
+                   traffic than the fill buys (~2-3× slower than coutfold at
+                   25⁴ 16→16), but for SMALL C_out the intermediate shrinks
+                   to ~k²·C_out/C_in× and afold wins (0.84 vs coutfold
+                   1.69 ms/pair, 16→1 bf16 bs4 v5e) — ``auto`` selects it
+                   there.
   * ``toeplitz_b`` — expresses the whole B-side (kB,kWB) stencil as a dense
                    banded matrix over the flattened hB·wB lane dim, turning
                    the layer into kA·kWA big matmuls of shape
@@ -153,12 +156,13 @@ def _conv4d_afold(x, weight, *, precision, pad_ha, pad_hb):
     Folding the whole A-side stencil into output channels lifts the matmul's
     output dim to kA·kWA·C_out (400 for the 5⁴ 16→16 layer) — full 128-lane
     MXU tiles where ``coutfold``'s kA·C_out=80 underfills — at the cost of a
-    kA·kWA·C_out-channel intermediate and kA·kWA shifted adds.  MEASURED
-    SLOWER than coutfold on v5e at the PF-Pascal 25⁴ shape (bf16 batch 4,
-    scan-differenced: 16→16 6.9 vs 3.5 ms/pair; 1→16 6.3 vs tapfold 1.1;
-    16→1 1.2 vs 1.0): the 25× intermediate's HBM traffic swamps the fill
-    gain, so ``auto`` never picks it.  Kept as an explicitly-selectable
-    formulation and a structurally-independent oracle, like ``toeplitz_b``.
+    kA·kWA·C_out-channel intermediate and kA·kWA shifted adds.  The
+    intermediate's traffic decides the contest (v5e, 25⁴ volume, bf16 bs4,
+    scan-differenced, tools/xla_layer_probe.py): at 16→16 the 25×
+    intermediate swamps the fill gain (7.1 vs coutfold 2.7 ms/pair), but at
+    16→1 the intermediate is only ~1.6× the input volume and afold WINS
+    (0.84 vs 1.69) — ``auto`` picks it for small C_out behind the memory
+    gate.
     """
     b, ha, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
@@ -296,10 +300,12 @@ def choose_conv4d_variant(
                      XLA transpose of the dense-mask einsums materializes a
                      (kA·kWA, hB·wB·C_in, hB·wB·C_out) weight-gradient tensor
 
-    So coutfold wins the small-C_out case both ways and ``auto`` never picks
-    ``toeplitz_b`` anymore (the variant remains selectable explicitly).  With
-    the full shape context (``shape_a=(ha, wa)``, ``kernel``, ``dtype``) the
-    small-C_out case upgrades to the Pallas tap-folding kernel where Mosaic
+    ``auto`` never picks ``toeplitz_b`` (the variant remains selectable
+    explicitly).  A later bf16 bs4 pass (tools/xla_layer_probe.py) found
+    ``afold`` beats coutfold for small C_out (0.84 vs 1.69 ms/pair at 16→1)
+    — auto now prefers it there, behind the memory gate.  With the full
+    shape context (``shape_a=(ha, wa)``, ``kernel``, ``dtype``) the
+    small-C_out case first tries the Pallas tap-folding kernel where Mosaic
     accepts it — true FLOPs at full MXU lanes (see ops/conv4d_pallas.py for
     its current status) — and the channel-folding formulations are gated on
     their ``_FOLD_BYTES_LIMIT`` memory blowup (InLoc-scale volumes use
@@ -338,6 +344,15 @@ def choose_conv4d_variant(
                 dtype_name=jnp.dtype(dtype).name,
             ):
                 return "pallas"
+        # small C_out defuses afold's one weakness — its kA·kWA·C_out-channel
+        # intermediate is only ~k²·C_out/C_in× the input volume (≈1.6× for
+        # the 16→1 k=5 layer) — while its full-stencil output-lane fill
+        # stands: measured 0.84 vs coutfold 1.69 ms/pair (bf16 bs4, 25⁴
+        # volume, v5e, tools/xla_layer_probe.py)
+        # (fold_fits multiplies by kernel[0] itself: ch=kWA·C_out models the
+        # kA·kWA·C_out-channel intermediate)
+        if kernel is not None and fold_fits(kernel[1] * c_out):
+            return "afold"
     return "coutfold" if fold_fits(c_out) else "unroll"
 
 
@@ -411,6 +426,79 @@ def conv4d(
     if bias is not None:
         out = out + bias
     return out
+
+
+def conv4d_transpose_weights(weight: jnp.ndarray) -> jnp.ndarray:
+    """Weights of the transposed conv4d: all four spatial dims flipped,
+    channel roles swapped — ``(kA,kWA,kB,kWB,C_in,C_out) →
+    (kA,kWA,kB,kWB,C_out,C_in)``.  For odd kernels the cotangent of a
+    same-padded stride-1 cross-correlation is the same-padded
+    cross-correlation with these weights."""
+    return jnp.transpose(weight[::-1, ::-1, ::-1, ::-1], (0, 1, 2, 3, 5, 4))
+
+
+@jax.custom_vjp
+def conv4d_same(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray):
+    """Same-padded ``conv4d`` with an optimized backward pass.
+
+    Forward is exactly ``conv4d(x, weight, bias)`` (auto variant).  The
+    difference is under autodiff: XLA's mechanical transpose of the fastest
+    forward formulation (``coutfold``) is pathological — measured 69 ms for
+    the 16→16 layer's backward vs 24 ms forward (fp32 bs8 v5e; VERDICT r2) —
+    so each gradient is routed through its own explicitly-chosen
+    formulation instead:
+
+      * ``dx``  — itself a same-padded conv4d: ``conv4d(g, flipped/swapped
+        weights)``, which re-enters the auto variant chooser with the
+        *gradient's* channel shape (a 16→1 layer's dx is a 1→16 conv →
+        tapfold, etc.).
+      * ``dw``  — AD of the ``_DW_VARIANT`` formulation (measured choice,
+        see tools/vjp_probe.py; demoted to ``unroll`` past the
+        channel-folding memory gate).
+      * ``db``  — a plain sum reduction.
+
+    Odd kernel sizes only (the reference's only case) — asserted, because
+    the dx identity above needs them.
+    """
+    return conv4d(x, weight, bias)
+
+
+def _conv4d_same_fwd(x, weight, bias):
+    assert all(k % 2 == 1 for k in weight.shape[:4]), (
+        "conv4d_same requires odd kernel sizes (same-padding transpose)"
+    )
+    return conv4d(x, weight, bias), (x, weight)
+
+
+# Formulation whose XLA transpose computes the weight gradient.  Measured on
+# v5e at the 25⁴ symmetric stack (tools/vjp_probe.py, bs8 fp32, ms/pair /
+# XLA temp): coutfold 55.8 / 12.4G beats tapfold 73.4 / 13.7G and unroll
+# 89.0 / 13.3G — unroll additionally makes XLA pick channel-minor layouts
+# padded 8-10x for whole-volume relu/copy temporaries.
+_DW_VARIANT = "coutfold"
+
+
+def _conv4d_same_bwd(res, g):
+    x, weight = res
+    dx = conv4d(g, conv4d_transpose_weights(weight))
+    dw_variant = _DW_VARIANT
+    # honor the same channel-folding memory gate as the forward auto-chooser:
+    # at volumes where the kA·ch whole-volume copy cannot fit, demote to the
+    # 1x-footprint unroll formulation
+    fold_ch = {"coutfold": weight.shape[5], "tapfold": weight.shape[4],
+               "afold": weight.shape[1] * weight.shape[5]}.get(dw_variant)
+    if fold_ch is not None and not conv4d_fold_fits(
+        x.shape[0], x.shape[1], x.shape[2], x.shape[3], x.shape[4],
+        weight.shape[0], fold_ch, x.dtype,
+    ):
+        dw_variant = "unroll"
+    _, w_vjp = jax.vjp(lambda ww: conv4d(x, ww, variant=dw_variant), weight)
+    (dw,) = w_vjp(g)
+    db = jnp.sum(g, axis=(0, 1, 2, 3, 4))
+    return dx, dw, db
+
+
+conv4d_same.defvjp(_conv4d_same_fwd, _conv4d_same_bwd)
 
 
 def conv4d_init(

@@ -3,6 +3,7 @@ numpy brute-force oracles."""
 
 import pytest
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ncnet_tpu import ops
@@ -155,6 +156,59 @@ def test_conv4d_auto_variant_matches_unroll(rng):
         unroll = ops.conv4d(x, w, variant="unroll")
         np.testing.assert_allclose(np.asarray(auto), np.asarray(unroll),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("cin,cout", [(1, 4), (4, 4), (4, 1)])
+def test_conv4d_same_gradient_parity(rng, cin, cout):
+    """conv4d_same's custom VJP (dx as an explicit transposed conv4d, dw via
+    the measured _DW_VARIANT formulation) must match jax.grad of the plain
+    path on every NC channel pattern, on a rectangular volume."""
+    b, ha, wa, hb, wb, k = 2, 5, 4, 6, 3, 3
+    x = jnp.asarray(rng.standard_normal((b, ha, wa, hb, wb, cin)).astype(np.float32))
+    w = jnp.asarray(
+        rng.standard_normal((k, k, k, k, cin, cout)).astype(np.float32) * 0.2
+    )
+    bias = jnp.asarray(rng.standard_normal((cout,)).astype(np.float32))
+    r = jnp.asarray(rng.standard_normal((b, ha, wa, hb, wb, cout)).astype(np.float32))
+
+    def loss_custom(x, w, bias):
+        return jnp.sum(ops.conv4d_same(x, w, bias) * r)
+
+    def loss_plain(x, w, bias):
+        return jnp.sum(ops.conv4d(x, w, bias, variant="unroll") * r)
+
+    g_custom = jax.grad(loss_custom, argnums=(0, 1, 2))(x, w, bias)
+    g_plain = jax.grad(loss_plain, argnums=(0, 1, 2))(x, w, bias)
+    for gc, gp, name in zip(g_custom, g_plain, ("dx", "dw", "db")):
+        np.testing.assert_allclose(
+            np.asarray(gc), np.asarray(gp), rtol=1e-4, atol=1e-4, err_msg=name
+        )
+
+
+def test_conv4d_same_forward_identity(rng):
+    """The custom-VJP wrapper must be exactly the auto-variant forward."""
+    b = 1
+    x = jnp.asarray(rng.standard_normal((b, 5, 5, 5, 5, 1)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 3, 3, 3, 1, 4)).astype(np.float32))
+    bias = jnp.asarray(rng.standard_normal((4,)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(ops.conv4d_same(x, w, bias)),
+        np.asarray(ops.conv4d(x, w, bias)),
+    )
+
+
+def test_conv4d_transpose_weights_is_vjp(rng):
+    """conv4d(g, transposed weights) == the x-cotangent of conv4d(x, w)."""
+    b, s, cin, cout, k = 1, 5, 2, 3, 3
+    x = jnp.asarray(rng.standard_normal((b, s, s, s, s, cin)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((k, k, k, k, cin, cout)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal((b, s, s, s, s, cout)).astype(np.float32))
+    _, vjp = jax.vjp(lambda xx: ops.conv4d(xx, w, variant="unroll"), x)
+    (dx_ad,) = vjp(g)
+    dx_explicit = ops.conv4d(g, ops.conv4d_transpose_weights(w), variant="unroll")
+    np.testing.assert_allclose(
+        np.asarray(dx_explicit), np.asarray(dx_ad), rtol=1e-4, atol=1e-4
+    )
 
 
 def test_conv4d_pallas_kernel_matches_oracle(rng):
