@@ -342,16 +342,25 @@ def _bench_inloc_matcher():
     )
     rng = np.random.default_rng(0)
     q = rng.integers(0, 255, (1, 4032, 3024, 3), dtype=np.uint8)
-    db = rng.integers(0, 255, (1, 1200, 1600, 3), dtype=np.uint8)
+    dbs = [
+        rng.integers(0, 255, (1, 1200, 1600, 3), dtype=np.uint8)
+        for _ in range(6)
+    ]
     src = matcher.preprocess(q)
-    matcher(src, db)  # compile + first-touch uploads
-    matcher(src, db)  # settle the shape-bucket caches
-    times = []
-    for _ in range(3):
-        t0 = _time.perf_counter()
-        matcher(src, db)
-        times.append(_time.perf_counter() - t0)
-    return float(np.median(times))
+    matcher(src, dbs[0])  # compile + first-touch uploads
+    matcher(src, dbs[0])  # settle the shape-bucket caches
+    # steady-state pairs/s of the depth-2 pipeline the eval loop runs
+    # (run_inloc_eval): dispatch pair i+1 before fetching pair i, so upload
+    # and dispatch latency hide behind device compute
+    t0 = _time.perf_counter()
+    in_flight = []
+    for db in dbs:
+        in_flight.append(matcher.dispatch(src, db))
+        if len(in_flight) > 1:
+            matcher.fetch(in_flight.pop(0))
+    while in_flight:
+        matcher.fetch(in_flight.pop(0))
+    return (_time.perf_counter() - t0) / len(dbs)
 
 
 def bench_torch_reference_style(iters=3):
