@@ -192,28 +192,37 @@ def save_train_checkpoint(
     ``params/`` subtree — so ``load_params`` (and therefore eval/finetune
     ``--checkpoint``) reads a training checkpoint directly.  Optimizer state
     + step go in a separate ``opt/`` subtree for :func:`load_train_checkpoint`.
+
+    Multi-process: EVERY process must call this — the orbax saves are
+    collective (``sync_global_processes`` inside ``save``; gating them on
+    process 0 deadlocks the job, caught by the two-process smoke test).
+    Orbax itself writes array data from the primary host only; the
+    non-collective extras (config.json, the ``best_`` copy) are primary-only
+    here.
     """
     import orbax.checkpoint as ocp
 
+    primary = jax.process_index() == 0
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(
-            {
-                **dataclasses.asdict(model_config),
-                "_train": {
-                    k: v
-                    for k, v in dataclasses.asdict(config).items()
-                    if k != "model"
+    if primary:
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(
+                {
+                    **dataclasses.asdict(model_config),
+                    "_train": {
+                        k: v
+                        for k, v in dataclasses.asdict(config).items()
+                        if k != "model"
+                    },
+                    "_epoch": epoch,
+                    "_train_loss": list(map(float, train_loss)),
+                    "_test_loss": list(map(float, test_loss)),
                 },
-                "_epoch": epoch,
-                "_train_loss": list(map(float, train_loss)),
-                "_test_loss": list(map(float, test_loss)),
-            },
-            f,
-            indent=2,
-            default=list,
-        )
+                f,
+                indent=2,
+                default=list,
+            )
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(path, "params"), state.params, force=True)
     ckptr.save(
@@ -222,7 +231,7 @@ def save_train_checkpoint(
         force=True,
     )
     ckptr.wait_until_finished()
-    if is_best:
+    if is_best and primary:
         best = os.path.join(os.path.dirname(path), "best_" + os.path.basename(path))
         if os.path.isdir(best):
             shutil.rmtree(best)
@@ -378,9 +387,22 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         **shard_kwargs,
     )
 
+    # the checkpoint path must agree across processes (orbax saves are
+    # collective): stamp from process 0's clock, broadcast to the others.
+    # Broadcast as (days, seconds-of-day) int32s — with x64 disabled a float
+    # timestamp would be quantized to ~128 s and an int64 silently truncated.
+    stamp = time.time()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        parts = multihost_utils.broadcast_one_to_all(
+            np.asarray([int(stamp) // 86400, int(stamp) % 86400], np.int32)
+        )
+        stamp = float(int(parts[0]) * 86400 + int(parts[1]))
     ckpt_name = os.path.join(
         config.result_model_dir,
-        time.strftime("%Y-%m-%d_%H:%M") + "_" + config.result_model_fn,
+        time.strftime("%Y-%m-%d_%H:%M", time.localtime(stamp))
+        + "_" + config.result_model_fn,
     )
     if progress:
         print(f"Checkpoint name: {ckpt_name}")
@@ -411,13 +433,12 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         is_best = test_loss[epoch - 1] < best
         best = min(test_loss[epoch - 1], best)
         # multi-host: losses are computed on the global batch (replicated to
-        # every process), so is_best agrees everywhere; only process 0 writes
-        # to avoid races on a shared filesystem
-        if jax.process_index() == 0:
-            save_train_checkpoint(
-                ckpt_name, config, model_config, state, epoch, train_loss,
-                test_loss, is_best,
-            )
+        # every process), so is_best agrees everywhere.  Every process calls
+        # the (collective) save; orbax writes from the primary host only.
+        save_train_checkpoint(
+            ckpt_name, config, model_config, state, epoch, train_loss,
+            test_loss, is_best,
+        )
     return {
         "state": state,
         "model_config": model_config,

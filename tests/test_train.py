@@ -292,3 +292,85 @@ def test_stop_backbone_grad_preserves_nc_updates(tmp_path):
         outs[flag] = (np.asarray(new_state.params["nc"][0]["w"]), float(loss))
     np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-6, atol=1e-7)
     assert outs[True][1] == pytest.approx(outs[False][1], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_fit(tmp_path):
+    """Real multi-process coverage for fit()'s distributed branch: two CPU
+    processes under jax.distributed (local TCP coordinator), one device each,
+    training on synthetic pairs.  Virtual-device tests cannot catch wiring
+    mistakes in per-process batch assembly
+    (make_array_from_process_local_data), is_best agreement, or the
+    process-0-only checkpoint write — this does."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=4, image_hw=(48, 48), shift=(16, 16), seed=5)
+
+    with socket.socket() as s:  # free TCP port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {str(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
+from ncnet_tpu.config import ModelConfig, TrainConfig
+from ncnet_tpu import training
+from ncnet_tpu.parallel import initialize_distributed
+
+pid = int(sys.argv[1])
+initialize_distributed("127.0.0.1:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2 and jax.device_count() == 2
+
+cfg = TrainConfig(
+    model=ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,)),
+    image_size=48,
+    dataset_image_path={root!r},
+    dataset_csv_path={root + "/image_pairs"!r},
+    num_epochs=2, batch_size=2, lr=1e-3,
+    result_model_dir={str(tmp_path / "ckpts")!r},
+    log_interval=10,
+    data_parallel=True, distributed=True,
+)
+res = training.fit(cfg, progress=pid == 0)
+leaves = [np.asarray(x) for x in jax.tree.leaves(res["state"].params)]
+np.savez({str(tmp_path)!r} + f"/params_{{pid}}.npz", *leaves)
+with open({str(tmp_path)!r} + f"/ckptname_{{pid}}.txt", "w") as f:
+    f.write(res["checkpoint"])
+""")
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i)],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    # both processes must end with bit-identical parameters
+    p0 = np.load(tmp_path / "params_0.npz")
+    p1 = np.load(tmp_path / "params_1.npz")
+    assert list(p0.files) == list(p1.files) and len(p0.files) > 0
+    for k in p0.files:
+        np.testing.assert_array_equal(p0[k], p1[k])
+
+    # only process 0 wrote the checkpoint (same name computed on both)
+    names = {(tmp_path / f"ckptname_{i}.txt").read_text() for i in range(2)}
+    assert len(names) == 1
+    ckpt = names.pop()
+    assert os.path.isdir(ckpt) and os.path.isdir(os.path.join(ckpt, "params"))
