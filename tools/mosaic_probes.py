@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Probe which Mosaic lowerings the installed toolchain accepts.
+
+The round-2 Pallas conv4d kernel was rejected with "unsupported shape cast"
+on lane-dim splits/merges.  Before redesigning the kernel, compile a battery
+of minimal kernels that each exercise ONE layout-sensitive operation, so the
+redesign composes only known-good primitives.  Run on the real TPU:
+
+    python tools/mosaic_probes.py            # all probes
+    python tools/mosaic_probes.py lane_merge # one probe
+
+Prints one PASS/FAIL line per probe (+ first error line on FAIL).
+"""
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DT = jnp.bfloat16
+
+
+def _compile(kernel, out_shape, *in_shapes):
+    def run(*xs):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(out_shape, DT),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM) for _ in in_shapes],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(*xs)
+
+    args = [jax.ShapeDtypeStruct(s, DT) for s in in_shapes]
+    jax.jit(run).lower(*args).compile()
+
+
+# Shapes chosen to mirror the conv4d kernel's regime: c=16 channels,
+# l=29 B-columns (25 + halo), fused minor (l*c)=464.
+C, L, ROWS = 16, 29, 32
+
+
+def probe_lane_merge():
+    """reshape (rows, L, C) -> (rows, L*C): merge into the lane dim."""
+    def k(x_ref, o_ref):
+        o_ref[:] = x_ref[:].reshape(ROWS, L * C)
+    _compile(k, (ROWS, L * C), (ROWS, L, C))
+
+
+def probe_lane_split():
+    """reshape (rows, L*C) -> (rows, L, C): split the lane dim."""
+    def k(x_ref, o_ref):
+        o_ref[:] = x_ref[:].reshape(ROWS, L, C)
+    _compile(k, (ROWS, L, C), (ROWS, L * C))
+
+
+def probe_lane_concat():
+    """concatenate two C-lane tensors along lanes."""
+    def k(x_ref, y_ref, o_ref):
+        o_ref[:] = jnp.concatenate([x_ref[:], y_ref[:]], axis=-1)
+    _compile(k, (ROWS, 2 * C), (ROWS, C), (ROWS, C))
+
+
+def probe_lane_concat_wide():
+    """concatenate five 464-lane tensors along lanes (tapfold P build)."""
+    def k(*refs):
+        o_ref = refs[-1]
+        o_ref[:] = jnp.concatenate([r[:] for r in refs[:-1]], axis=-1)
+    _compile(k, (ROWS, 5 * L * C), *([(ROWS, L * C)] * 5))
+
+
+def probe_lane_pad():
+    """pad the lane dim C -> 128."""
+    def k(x_ref, o_ref):
+        o_ref[:] = jnp.pad(x_ref[:], ((0, 0), (0, 128 - C)))
+    _compile(k, (ROWS, 128), (ROWS, C))
+
+
+def probe_lane_slice_offset():
+    """static lane slice at a 16-aligned, non-128-aligned offset."""
+    def k(x_ref, o_ref):
+        o_ref[:] = x_ref[:, C : C + 8 * C]
+    _compile(k, (ROWS, 8 * C), (ROWS, L * C))
+
+
+def probe_lane_slice_unaligned():
+    """static lane slice at an odd offset (epilogue c_out=1 case)."""
+    def k(x_ref, o_ref):
+        o_ref[:] = x_ref[:, 3 : 3 + 128]
+    _compile(k, (ROWS, 128), (ROWS, L * C))
+
+
+def probe_lane_store_offset():
+    """store into a lane sub-range of the output."""
+    def k(x_ref, o_ref):
+        o_ref[:, :] = jnp.zeros((ROWS, L * C), DT)
+        o_ref[:, C : C + C] = x_ref[:]
+    _compile(k, (ROWS, L * C), (ROWS, C))
+
+
+def probe_lane_roll():
+    """pltpu.roll along the lane dim."""
+    def k(x_ref, o_ref):
+        o_ref[:] = pltpu.roll(x_ref[:], 16, 1)
+    _compile(k, (ROWS, 128), (ROWS, 128))
+
+
+def probe_sublane_slice():
+    """slice the sublane dim at an arbitrary offset."""
+    def k(x_ref, o_ref):
+        o_ref[:] = x_ref[3 : 3 + 16, :]
+    _compile(k, (16, L * C), (ROWS, L * C))
+
+
+def probe_sublane_merge():
+    """reshape merging a leading dim into sublanes (5, 8, lanes)->(40, lanes)."""
+    def k(x_ref, o_ref):
+        o_ref[:] = x_ref[:].reshape(5 * ROWS, L * C)
+    _compile(k, (5 * ROWS, L * C), (5, ROWS, L * C))
+
+
+def probe_sublane_split():
+    """reshape splitting sublanes into a leading dim."""
+    def k(x_ref, o_ref):
+        o_ref[:] = x_ref[:].reshape(5, ROWS, L * C)
+    _compile(k, (5, ROWS, L * C), (5 * ROWS, L * C))
+
+
+def probe_leading_stack():
+    """jnp.stack along a new leading axis."""
+    def k(x_ref, y_ref, o_ref):
+        o_ref[:] = jnp.stack([x_ref[:], y_ref[:]], axis=0)
+    _compile(k, (2, ROWS, L * C), (ROWS, L * C), (ROWS, L * C))
+
+
+def probe_dot_contract_sublane():
+    """dot_general contracting dim 0 of both operands: (K,N)x(K,M)->(N,M)."""
+    def k(w_ref, a_ref, o_ref):
+        o_ref[:] = jax.lax.dot_general(
+            w_ref[:], a_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(DT)
+    _compile(k, (400, 512), (2000, 400), (2000, 512))
+
+
+def probe_dot_plain():
+    """plain (M,K)@(K,N) dot at conv4d-like shape."""
+    def k(a_ref, w_ref, o_ref):
+        o_ref[:] = jnp.dot(
+            a_ref[:], w_ref[:], preferred_element_type=jnp.float32
+        ).astype(DT)
+    _compile(k, (512, 400), (512, 2000), (2000, 400))
+
+
+def probe_old_kernel():
+    """the round-2 conv4d kernel itself (did Mosaic move since?)."""
+    from ncnet_tpu.ops.conv4d_pallas import pallas_compiles
+    pallas_compiles.cache_clear()
+    ok = pallas_compiles(25, 25, 25, 25, 16, 1, 5, dtype_name="bfloat16")
+    if not ok:
+        raise RuntimeError("pallas_compiles -> False")
+
+
+PROBES = {
+    n[len("probe_"):]: f
+    for n, f in sorted(globals().items())
+    if n.startswith("probe_")
+}
+
+
+def main():
+    names = sys.argv[1:] or list(PROBES)
+    for n in names:
+        try:
+            PROBES[n]()
+            print(f"PASS {n}")
+        except Exception as e:
+            msg = str(e).split("\n")[0][:160]
+            print(f"FAIL {n}: {msg}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Pick conv4d_same's weight-gradient formulation by measurement.
+
+Times value_and_grad through the production symmetric NC stack (the training
+hot path) for each dw-variant choice, plus the plain-AD baseline, and prints
+XLA's peak-memory estimate — the bs8 fp32 step must FIT 16G (plain AD does;
+dw='unroll' blew it to 20.9G via channel-minor relayouts).
+
+Usage: python tools/vjp_probe.py [batch] [dtype]
+"""
+
+import importlib
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+DT = jnp.bfloat16 if (len(sys.argv) > 2 and sys.argv[2] == "bf16") else jnp.float32
+S = 25
+
+c4mod = importlib.import_module("ncnet_tpu.ops.conv4d")
+ncmod = importlib.import_module("ncnet_tpu.models.ncnet")
+
+
+def timeit(step_fn, make_input, n_long=4, reps=3, per=B):
+    @partial(jax.jit, static_argnums=(1,))
+    def run(key, n):
+        def body(x, _):
+            return step_fn(x), ()
+        x, _ = lax.scan(body, make_input(key), None, length=n)
+        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
+
+    key = jax.random.key
+    float(run(key(0), 1))
+    float(run(key(1), n_long))
+    diffs = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(run(key(100 + i), 1))
+        t1 = time.perf_counter()
+        float(run(key(200 + i), n_long))
+        t2 = time.perf_counter()
+        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
+    import numpy as np
+    return float(np.median([max(d, 0.0) for d in diffs])) / per
+
+
+def stack_input(key):
+    k1, *ks = jax.random.split(key, 4)
+    corr = jax.random.normal(k1, (B, S, S, S, S), DT) * 0.03
+    chans = [(1, 16), (16, 16), (16, 1)]
+    params = []
+    for kk, (ci, co) in zip(ks, chans):
+        params.append({
+            "w": jax.random.normal(kk, (5, 5, 5, 5, ci, co), DT) * 0.05,
+            "b": jnp.zeros((co,), DT),
+        })
+    return corr, params
+
+
+def grad_step(carry):
+    corr, params = carry
+
+    def loss(params, corr):
+        out = ncmod.neigh_consensus(params, corr, symmetric=True)
+        return jnp.mean(jax.nn.softmax(
+            out.reshape(out.shape[0], -1).astype(jnp.float32), axis=-1
+        ).max(axis=-1))
+
+    l, g = jax.value_and_grad(loss, argnums=(0, 1))(params, corr)
+    gp, gc = g
+    eps = (l * 1e-9).astype(corr.dtype)
+    new_params = jax.tree.map(
+        lambda p, gg: p + (jnp.sum(gg.astype(jnp.float32)) * 1e-12).astype(p.dtype),
+        params, gp,
+    )
+    return corr + eps + gc.astype(corr.dtype) * 1e-12, new_params
+
+
+def peak_mem_gb():
+    @jax.jit
+    def one(carry):
+        return grad_step(carry)
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    corr, params = jax.eval_shape(lambda k: stack_input(k), jax.random.key(0)), None
+    c = jax.ShapeDtypeStruct((B, S, S, S, S), DT)
+    chans = [(1, 16), (16, 16), (16, 1)]
+    ps = [
+        {"w": jax.ShapeDtypeStruct((5, 5, 5, 5, ci, co), DT),
+         "b": jax.ShapeDtypeStruct((co,), DT)}
+        for ci, co in chans
+    ]
+    try:
+        mem = one.lower((c, ps)).compile().memory_analysis()
+        return mem.temp_size_in_bytes / 2**30
+    except Exception as e:
+        return float("nan") if "memory" not in str(e).lower() else -1.0
+
+
+def main():
+    print(f"device={jax.devices()[0].device_kind} batch={B} "
+          f"dtype={jnp.dtype(DT).name}")
+    configs = [("plain_ad", None), ("dw_coutfold", "coutfold"),
+               ("dw_tapfold", "tapfold"), ("dw_afold", "afold"),
+               ("dw_unroll", "unroll")]
+    orig_same = ncmod.conv4d_same
+    for name, dwv in configs:
+        if dwv is None:
+            # bypass the custom vjp entirely: XLA transposes the forward
+            ncmod.conv4d_same = c4mod.conv4d
+        else:
+            ncmod.conv4d_same = orig_same
+            c4mod._DW_VARIANT = dwv
+        try:
+            mem = peak_mem_gb()
+            ms = timeit(grad_step, stack_input)
+            print(f"{name:>12}: {ms:7.3f} ms/pair   temp {mem:5.1f} GB")
+        except Exception as e:
+            print(f"{name:>12}: ERR {str(e)[:120]}")
+    ncmod.conv4d_same = orig_same
+
+
+if __name__ == "__main__":
+    main()

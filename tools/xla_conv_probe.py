@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Where does coutfold's 3.5 ms/pair on the 16->16 NC layer actually go?
+
+Times (scan-differenced, like bench.py) a ladder of programs that bracket the
+layer from below:
+
+  1. gemm_coutfold : the bare GEMM XLA's conv should reduce to
+                     (M=b*25^4, K=5^3*16=2000, N=5*16=80)
+  2. gemm_square   : same FLOPs, square-ish shape (K=400, N=400) — what an
+                     explicit rs-im2col/pq-outfold formulation would run
+  3. conv_coutfold : the current production formulation (conv4d coutfold)
+  4. conv_unroll / conv_afold / conv_tapfold: the other formulations
+  5. im2col_gemm   : explicit B-side im2col + square GEMM + pq shifted-sum
+                     (the afold dataflow with the GEMM exposed to XLA)
+
+If (1) ~= (3), the GEMM shape is the wall and only a Pallas N-fold helps;
+if (1) << (3), XLA's conv lowering is the wall and an XLA-level rewrite wins.
+
+Usage: python tools/xla_conv_probe.py [batch]
+"""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+S = 25            # PF-Pascal grid
+K5 = 5            # kernel size
+C = 16            # channels
+DT = jnp.bfloat16
+
+
+def timeit(step_fn, make_input, n_long=8, reps=3, per=B):
+    @partial(jax.jit, static_argnums=(1,))
+    def run(key, n):
+        def body(x, _):
+            return step_fn(x), ()
+        x, _ = lax.scan(body, make_input(key), None, length=n)
+        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
+
+    key = jax.random.key
+    float(run(key(0), 1))
+    float(run(key(1), n_long))
+    diffs = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(run(key(100 + i), 1))
+        t1 = time.perf_counter()
+        float(run(key(200 + i), n_long))
+        t2 = time.perf_counter()
+        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
+    import numpy as np
+    return float(np.median([max(d, 0.0) for d in diffs])) / per
+
+
+def chain(op):
+    def step(carry):
+        x, w = carry
+        out = op(x, w)
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+        return x + eps, w - eps
+    return step
+
+
+def vol_input(key):
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (B, S, S, S, S, C), DT) * 0.03
+    w = jax.random.normal(k2, (K5,) * 4 + (C, C), DT) * 0.05
+    return x, w
+
+
+def gemm_input(m, k, n):
+    def make(key):
+        k1, k2 = jax.random.split(key)
+        return (
+            jax.random.normal(k1, (m, k), DT) * 0.03,
+            jax.random.normal(k2, (k, n), DT) * 0.05,
+        )
+    return make
+
+
+def main():
+    print(f"device={jax.devices()[0].device_kind} batch={B} dtype={DT.__name__}")
+    res = {}
+
+    m = B * S ** 4
+    res["gemm_coutfold_MK2000N80"] = timeit(
+        chain(lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32)
+              .astype(DT)),
+        gemm_input(m, 2000, 80),
+    )
+    res["gemm_square_MK400N400"] = timeit(
+        chain(lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32)
+              .astype(DT)),
+        gemm_input(m, 400, 400),
+    )
+
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    for variant in ("coutfold", "unroll", "tapfold", "afold"):
+        res[f"conv_{variant}"] = timeit(
+            chain(lambda x, w, v=variant: conv4d(x, w, variant=v)),
+            vol_input,
+        )
+
+    def im2col_gemm(x, w):
+        # B-side (r,s,c) im2col -> square GEMM -> (p,q) shifted-sum epilogue
+        b, ha, wa, hb, wb, c = x.shape
+        k = w.shape[0]
+        pad = k // 2
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad, pad), (pad, pad), (0, 0)))
+        patches = jnp.concatenate(
+            [
+                xp[:, :, :, r : r + hb, s : s + wb, :]
+                for r in range(k)
+                for s in range(k)
+            ],
+            axis=-1,
+        )  # (b, ha, wa, hb, wb, k*k*c)
+        w2 = jnp.transpose(w, (2, 3, 4, 0, 1, 5)).reshape(k * k * c, k * k * c)
+        y = jnp.einsum("bjqklr,rn->bjqkln", patches, w2)  # n = (p,q,o)
+        y = jnp.pad(y, ((0, 0), (pad, pad), (pad, pad), (0, 0), (0, 0), (0, 0)))
+        out = None
+        for p in range(k):
+            for q in range(k):
+                t = (p * k + q) * c
+                o = y[:, p : p + ha, q : q + wa, :, :, t : t + c]
+                out = o if out is None else out + o
+        return out
+
+    res["im2col_gemm"] = timeit(chain(im2col_gemm), vol_input)
+
+    for k, v in sorted(res.items()):
+        print(f"{k:>28}: {v:7.3f} ms/pair")
+
+
+if __name__ == "__main__":
+    main()

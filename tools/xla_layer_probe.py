@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Per-layer conv4d variant timings for the production NC stacks.
+
+PF-Pascal arch: kernels (5,5,5), channels 1->16->16->1.
+Measures every (layer, variant) standalone plus the composed symmetric stack,
+to separate per-layer cost from composition (relayout) overhead.
+
+Usage: python tools/xla_layer_probe.py [batch]
+"""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+S = 25
+DT = jnp.bfloat16
+
+
+def timeit(step_fn, make_input, n_long=8, reps=3, per=B):
+    @partial(jax.jit, static_argnums=(1,))
+    def run(key, n):
+        def body(x, _):
+            return step_fn(x), ()
+        x, _ = lax.scan(body, make_input(key), None, length=n)
+        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
+
+    key = jax.random.key
+    float(run(key(0), 1))
+    float(run(key(1), n_long))
+    diffs = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(run(key(100 + i), 1))
+        t1 = time.perf_counter()
+        float(run(key(200 + i), n_long))
+        t2 = time.perf_counter()
+        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
+    import numpy as np
+    return float(np.median([max(d, 0.0) for d in diffs])) / per
+
+
+def chain(op):
+    def step(carry):
+        x, w = carry
+        out = op(x, w)
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+        return x + eps, w - eps
+    return step
+
+
+def layer_input(cin, cout, k):
+    def make(key):
+        k1, k2 = jax.random.split(key)
+        return (
+            jax.random.normal(k1, (B, S, S, S, S, cin), DT) * 0.03,
+            jax.random.normal(k2, (k,) * 4 + (cin, cout), DT) * 0.05,
+        )
+    return make
+
+
+def main():
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    print(f"device={jax.devices()[0].device_kind} batch={B} dtype=bf16")
+    layers = [("1to16", 1, 16, 5), ("16to16", 16, 16, 5), ("16to1", 16, 1, 5)]
+    variants = ("unroll", "tapfold", "coutfold", "afold")
+    for name, cin, cout, k in layers:
+        row = []
+        for v in variants:
+            try:
+                ms = timeit(
+                    chain(lambda x, w, v=v: conv4d(x, w, variant=v)),
+                    layer_input(cin, cout, k),
+                )
+                row.append(f"{v}={ms:6.3f}")
+            except Exception as e:
+                row.append(f"{v}=ERR({str(e)[:40]})")
+        print(f"{name:>7}: " + "  ".join(row))
+
+    # composed stacks: auto per layer, then the production symmetric path
+    from ncnet_tpu.models.ncnet import neigh_consensus
+
+    def stack_input(key):
+        k1, *ks = jax.random.split(key, 4)
+        corr = jax.random.normal(k1, (B, S, S, S, S), DT) * 0.03
+        chans = [(1, 16), (16, 16), (16, 1)]
+        params = []
+        for kk, (ci, co) in zip(ks, chans):
+            params.append({
+                "w": jax.random.normal(kk, (5, 5, 5, 5, ci, co), DT) * 0.05,
+                "b": jnp.zeros((co,), DT),
+            })
+        return corr, params
+
+    def sym_step(carry):
+        corr, params = carry
+        out = neigh_consensus(params, corr, symmetric=True)
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(corr.dtype)
+        return corr + eps, params
+
+    print(f"  stack symmetric (production): "
+          f"{timeit(sym_step, stack_input):6.3f} ms/pair")
+
+    def asym_step(carry):
+        corr, params = carry
+        out = neigh_consensus(params, corr, symmetric=False)
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(corr.dtype)
+        return corr + eps, params
+
+    print(f"  stack one-pass (no symmetry): "
+          f"{timeit(asym_step, stack_input):6.3f} ms/pair")
+
+
+if __name__ == "__main__":
+    main()
