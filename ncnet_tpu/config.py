@@ -72,6 +72,9 @@ class TrainConfig:
                                          # backward: fits bs16 (bf16) on one
                                          # 16G chip at ~30% step-time cost —
                                          # see training/loss.py measurements
+    nc_custom_grad: bool = False         # conv4d custom VJP: ~18% slower but
+                                         # ~45% less backward temp memory
+                                         # than plain AD (models/ncnet.py)
     # static jit shapes need whole batches; dropping the val remainder (4 of
     # 308 PF-Pascal pairs at bs=16) makes best-checkpoint selection score a
     # fixed subset each epoch.  A documented deviation: the reference scores

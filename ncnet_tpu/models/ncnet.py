@@ -119,6 +119,7 @@ def neigh_consensus(
     *,
     symmetric: bool = True,
     remat_layers: bool = False,
+    custom_grad: bool = False,
 ) -> jnp.ndarray:
     """Neighbourhood-consensus filtering of the 4D volume.
 
@@ -132,13 +133,18 @@ def neigh_consensus(
     so the backward pass holds one layer's folded-conv intermediates at a
     time instead of the whole stack's (training memory knob; a forward-only
     jit is unaffected).
+
+    ``custom_grad``: route each layer through :func:`conv4d_same`, whose
+    custom VJP picks its own formulation per gradient.  Measured on v5e
+    (tools/vjp_probe.py, 25⁴ symmetric stack, fp32): ~18% SLOWER than XLA's
+    plain transpose (56.9 vs 48.4 ms/pair at bs4) but ~45% less XLA temp
+    memory (7.2 vs 12.7 GB) — a memory knob, cheaper per saved byte than
+    ``remat_layers``' ~30% step-time cost, not a speed default.
     """
+    conv = conv4d_same if custom_grad else conv4d
 
     def one_layer(w, b, x):
-        # conv4d_same == conv4d forward, but routes each gradient through
-        # its own explicitly-chosen formulation instead of XLA's transpose
-        # of the forward one (2.9× slower measured; ops/conv4d.py)
-        return jax.nn.relu(conv4d_same(x, w, b))
+        return jax.nn.relu(conv(x, w, b))
 
     if remat_layers:
         one_layer = jax.checkpoint(one_layer)
@@ -235,11 +241,13 @@ def ncnet_forward(
 
 
 def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
-                 remat_nc_layers: bool = False) -> NCNetOutput:
+                 remat_nc_layers: bool = False,
+                 nc_custom_grad: bool = False) -> NCNetOutput:
     """The post-correlation half of the forward pass: [maxpool4d] →
     MutualMatching → NeighConsensus → MutualMatching.  Split out so the
     high-res/sharded paths can feed their own correlation volume.
-    ``remat_nc_layers``: see :func:`neigh_consensus` (training memory knob)."""
+    ``remat_nc_layers`` / ``nc_custom_grad``: see :func:`neigh_consensus`
+    (training memory knobs)."""
     nc_params = params["nc"]
     if config.half_precision:
         nc_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), nc_params)
@@ -249,7 +257,8 @@ def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
         corr, delta4d = maxpool4d_with_argmax(corr, config.relocalization_k_size)
     corr = mutual_matching(corr)
     corr = neigh_consensus(nc_params, corr, symmetric=config.symmetric_mode,
-                           remat_layers=remat_nc_layers)
+                           remat_layers=remat_nc_layers,
+                           custom_grad=nc_custom_grad)
     corr = mutual_matching(corr)
     return NCNetOutput(corr, delta4d)
 

@@ -61,6 +61,7 @@ def weak_loss(
     normalization: str = "softmax",
     stop_backbone_grad: bool = False,
     remat_nc_layers: bool = False,
+    nc_custom_grad: bool = False,
 ) -> jnp.ndarray:
     """score(negative) − score(positive) on an image-pair batch.
 
@@ -87,6 +88,10 @@ def weak_loss(
     default.  The knob helps ONLY with the bf16 volume: bs16 fp32 WITH it
     needs 24.4G (XLA schedules more concurrent recompute buffers than the
     un-rematted 20.8G) — pair it with ``half_precision``.
+
+    ``nc_custom_grad``: the other memory knob — conv4d's custom VJP, ~18%
+    slower but ~45% less temp memory than plain AD (see
+    :func:`ncnet_tpu.models.ncnet.neigh_consensus`).
     """
     fa = extract_features(config, params, batch["source_image"])
     fb = extract_features(config, params, batch["target_image"])
@@ -99,7 +104,8 @@ def weak_loss(
 
     filt = jax.checkpoint(
         lambda p, corr: ncnet_filter(
-            config, p, corr, remat_nc_layers=remat_nc_layers
+            config, p, corr, remat_nc_layers=remat_nc_layers,
+            nc_custom_grad=nc_custom_grad,
         ).corr
     )
     corr_pos = filt(params, correlation_4d(fa, fb))

@@ -96,6 +96,7 @@ def make_train_step(
     donate: bool = True,
     stop_backbone_grad: bool = False,
     remat_nc_layers: bool = False,
+    nc_custom_grad: bool = False,
 ):
     """Jitted (state, batch) → (state, loss).
 
@@ -113,6 +114,7 @@ def make_train_step(
                 model_config, p, batch,
                 stop_backbone_grad=stop_backbone_grad,
                 remat_nc_layers=remat_nc_layers,
+                nc_custom_grad=nc_custom_grad,
             )
         )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -360,6 +362,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         model_config, optimizer, donate=config.donate_state,
         stop_backbone_grad=config.fe_finetune_params == 0,
         remat_nc_layers=config.remat_nc_layers,
+        nc_custom_grad=config.nc_custom_grad,
     )
     eval_step = make_eval_step(model_config)
 

@@ -116,3 +116,40 @@ def test_spatial_filter_rejects_indivisible_hb(rng):
     corr = jnp.asarray(rng.standard_normal((1, 4, 4, 6, 4)).astype(np.float32))
     with pytest.raises(ValueError, match="spatial shards"):
         parallel.spatial_filter(cfg, params, corr, _mesh(1, 4))
+
+
+@pytest.mark.slow
+def test_spatial_filter_scaling_sanity(rng):
+    """8-shard vs unsharded wall-clock on the SAME volume, on the virtual CPU
+    mesh.  All 8 virtual devices share one host CPU, so the sharded wall is
+    total-work + collective overhead; this bounds the overhead (halo
+    exchanges, pmax, bookkeeping) at ≤3x total work — a known-good
+    expectation to carry to the first real multi-chip rig, where the work
+    term divides by 8 (VERDICT r2 item 8).  Numerical parity is asserted by
+    the tests above; this one only guards against a pathological collective
+    or relayout explosion in the sharded program.
+    """
+    import time
+
+    cfg = _volume_cfg()
+    params = init_ncnet(cfg, jax.random.key(3))
+    corr = jnp.asarray(rng.standard_normal((1, 12, 12, 32, 24)).astype(np.float32))
+    mesh = _mesh(1, 8)
+
+    ref_fn = jax.jit(lambda p, c: ncnet_filter(cfg, p, c).corr)
+    shard_fn = jax.jit(lambda p, c: parallel.spatial_filter(cfg, p, c, mesh).corr)
+
+    def wall(fn, n=3):
+        fn(params, corr).block_until_ready()  # compile
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn(params, corr).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    t_ref = wall(ref_fn)
+    t_shard = wall(shard_fn)
+    # generous bound: virtual devices serialize the work, so the ratio is
+    # (1x work + overhead) / 1x work; 3x means overhead ≤ 2x compute.
+    assert t_shard < 3.0 * t_ref + 0.05, (t_shard, t_ref)

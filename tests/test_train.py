@@ -84,17 +84,21 @@ def test_weak_loss_remat_layers_is_semantics_preserving(rng):
                                    rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("half,remat", [(False, False), (True, True)])
-def test_train_step_reduces_loss_on_fixed_batch(rng, half, remat):
+@pytest.mark.parametrize("half,remat,custom",
+                         [(False, False, False), (True, True, False),
+                          (False, False, True)])
+def test_train_step_reduces_loss_on_fixed_batch(rng, half, remat, custom):
     """A few Adam steps on one batch must reduce the weak loss (the negative
-    is a different pair, so the model can discriminate).  The (True, True)
-    case backs the documented single-chip bs16 recipe: bf16 volume +
-    per-layer remat must still learn."""
+    is a different pair, so the model can discriminate).  The (True, True, _)
+    case backs the documented single-chip bs16 recipe (bf16 volume +
+    per-layer remat); the custom case backs the conv4d-custom-VJP memory
+    knob — both must still learn."""
     cfg = TrainConfig(model=TINY.replace(half_precision=half), lr=1e-3,
                       batch_size=4)
     state, optimizer, mc, _ = training.create_train_state(cfg)
     step = training.make_train_step(mc, optimizer, donate=False,
-                                    remat_nc_layers=remat)
+                                    remat_nc_layers=remat,
+                                    nc_custom_grad=custom)
     batch = {
         "source_image": jnp.asarray(rng.uniform(0, 1, (4, 48, 48, 3)).astype(np.float32)),
         "target_image": jnp.asarray(rng.uniform(0, 1, (4, 48, 48, 3)).astype(np.float32)),
