@@ -18,13 +18,11 @@ measurement (TPU v5e at the PF-Pascal 25⁴ workload):
                    where plain convs leave 112 of 128 MXU output lanes idle.
   * ``afold``    — folds the FULL A-side stencil (kA·kWA taps) into output
                    channels (one 2D conv over (hB,wB) + shifted sums over
-                   both A dims); maximizes MXU output-lane fill.  For fat
-                   C_out the kA·kWA·C_out× intermediate costs more HBM
-                   traffic than the fill buys (~2-3× slower than coutfold at
-                   25⁴ 16→16), but for SMALL C_out the intermediate shrinks
-                   to ~k²·C_out/C_in× and afold wins (0.84 vs coutfold
-                   1.69 ms/pair, 16→1 bf16 bs4 v5e) — ``auto`` selects it
-                   there.
+                   both A dims); maximizes MXU output-lane fill.  Wins
+                   STANDALONE for small C_out (0.84 vs coutfold 1.69 ms/pair
+                   at 16→1) but loses composed into the stack and breaks
+                   under AD on this toolchain — not selected by ``auto``
+                   (measurement history in choose_conv4d_variant).
   * ``toeplitz_b`` — expresses the whole B-side (kB,kWB) stencil as a dense
                    banded matrix over the flattened hB·wB lane dim, turning
                    the layer into kA·kWA big matmuls of shape
@@ -159,10 +157,11 @@ def _conv4d_afold(x, weight, *, precision, pad_ha, pad_hb):
     kA·kWA·C_out-channel intermediate and kA·kWA shifted adds.  The
     intermediate's traffic decides the contest (v5e, 25⁴ volume, bf16 bs4,
     scan-differenced, tools/xla_layer_probe.py): at 16→16 the 25×
-    intermediate swamps the fill gain (7.1 vs coutfold 2.7 ms/pair), but at
-    16→1 the intermediate is only ~1.6× the input volume and afold WINS
-    (0.84 vs 1.69) — ``auto`` picks it for small C_out behind the memory
-    gate.
+    intermediate swamps the fill gain (7.1 vs coutfold 2.7 ms/pair), while
+    at 16→1 the intermediate is only ~1.6× the input volume and afold wins
+    STANDALONE (0.84 vs 1.69) — but loses composed into the NC stack and
+    its transpose breaks under AD on this toolchain, so ``auto`` still
+    avoids it (see choose_conv4d_variant).
     """
     b, ha, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
@@ -300,15 +299,14 @@ def choose_conv4d_variant(
                      XLA transpose of the dense-mask einsums materializes a
                      (kA·kWA, hB·wB·C_in, hB·wB·C_out) weight-gradient tensor
 
-    ``auto`` never picks ``toeplitz_b`` (the variant remains selectable
-    explicitly).  A later bf16 bs4 pass (tools/xla_layer_probe.py) found
-    ``afold`` beats coutfold for small C_out (0.84 vs 1.69 ms/pair at 16→1)
-    — auto now prefers it there, behind the memory gate.  With the full
-    shape context (``shape_a=(ha, wa)``, ``kernel``, ``dtype``) the
-    small-C_out case first tries the Pallas tap-folding kernel where Mosaic
-    accepts it — true FLOPs at full MXU lanes (see ops/conv4d_pallas.py for
-    its current status) — and the channel-folding formulations are gated on
-    their ``_FOLD_BYTES_LIMIT`` memory blowup (InLoc-scale volumes use
+    ``auto`` never picks ``toeplitz_b`` or ``afold`` (both remain selectable
+    explicitly; afold's standalone small-C_out win did not survive
+    composition — see the in-body comment).  With the full shape context
+    (``shape_a=(ha, wa)``, ``kernel``, ``dtype``) the small-C_out case first
+    tries the Pallas tap-folding kernel where Mosaic accepts it — true FLOPs
+    at full MXU lanes (see ops/conv4d_pallas.py for its current status) —
+    and the channel-folding formulations are gated on their
+    ``_FOLD_BYTES_LIMIT`` memory blowup (InLoc-scale volumes use
     ``unroll``)."""
 
     def fold_fits(ch: int) -> bool:
@@ -344,15 +342,16 @@ def choose_conv4d_variant(
                 dtype_name=jnp.dtype(dtype).name,
             ):
                 return "pallas"
-        # small C_out defuses afold's one weakness — its kA·kWA·C_out-channel
-        # intermediate is only ~k²·C_out/C_in× the input volume (≈1.6× for
-        # the 16→1 k=5 layer) — while its full-stencil output-lane fill
-        # stands: measured 0.84 vs coutfold 1.69 ms/pair (bf16 bs4, 25⁴
-        # volume, v5e, tools/xla_layer_probe.py)
-        # (fold_fits multiplies by kernel[0] itself: ch=kWA·C_out models the
-        # kA·kWA·C_out-channel intermediate)
-        if kernel is not None and fold_fits(kernel[1] * c_out):
-            return "afold"
+        # afold measured FASTER standalone for small C_out (0.84 vs coutfold
+        # 1.69 ms/pair, 16→1 bf16 bs4 25⁴, tools/xla_layer_probe.py) — its
+        # kA·kWA·C_out-channel intermediate is tiny there — but the win did
+        # NOT survive composition: with afold auto-selected the full-model
+        # bench REGRESSED (fp32 11.5→13.0, bf16 9.2→9.9 ms/pair; layout seam
+        # between afold's (b·hA·wA, hB, wB, C) 2D-conv form and its
+        # neighbours' (b·hA, wA, hB, wB, C) 3D form), and differentiating
+        # through afold's XLA transpose hit repeated compile failures on this
+        # toolchain (tools/vjp_probe.py dw_afold, bench train bs8).  So auto
+        # stays on coutfold; afold remains explicitly selectable.
     return "coutfold" if fold_fits(c_out) else "unroll"
 
 

@@ -64,11 +64,16 @@ def stack_input(key):
     return corr, params
 
 
+CUSTOM_GRAD = True  # main() clears this for the plain-AD baseline row
+
+
 def grad_step(carry):
     corr, params = carry
 
     def loss(params, corr):
-        out = ncmod.neigh_consensus(params, corr, symmetric=True)
+        out = ncmod.neigh_consensus(
+            params, corr, symmetric=True, custom_grad=CUSTOM_GRAD
+        )
         return jnp.mean(jax.nn.softmax(
             out.reshape(out.shape[0], -1).astype(jnp.float32), axis=-1
         ).max(axis=-1))
@@ -111,13 +116,11 @@ def main():
     configs = [("plain_ad", None), ("dw_coutfold", "coutfold"),
                ("dw_tapfold", "tapfold"), ("dw_afold", "afold"),
                ("dw_unroll", "unroll")]
-    orig_same = ncmod.conv4d_same
+    global CUSTOM_GRAD
     for name, dwv in configs:
-        if dwv is None:
-            # bypass the custom vjp entirely: XLA transposes the forward
-            ncmod.conv4d_same = c4mod.conv4d
-        else:
-            ncmod.conv4d_same = orig_same
+        # plain_ad row: custom_grad off → XLA transposes the forward itself
+        CUSTOM_GRAD = dwv is not None
+        if dwv is not None:
             c4mod._DW_VARIANT = dwv
         try:
             mem = peak_mem_gb()
@@ -125,7 +128,6 @@ def main():
             print(f"{name:>12}: {ms:7.3f} ms/pair   temp {mem:5.1f} GB")
         except Exception as e:
             print(f"{name:>12}: ERR {str(e)[:120]}")
-    ncmod.conv4d_same = orig_same
 
 
 if __name__ == "__main__":
