@@ -248,10 +248,15 @@ def bench_jax(res=None):
     )
 
     # InLoc-resolution matcher (56M-cell pooled volume, k=2, IVD arch) —
-    # opt-in: its one-off ~50s compile is too slow for the default run
+    # default-on since round 3 on TPU devices (the depth-2 dispatch pipeline
+    # is a headline metric); NCNET_BENCH_INLOC=0 / empty skips its ~1 min
+    # compile+run, and non-TPU backends skip it unless explicitly forced
+    # (the 56M-cell bf16 forward is minutes-to-OOM territory on CPU)
     import os
 
-    if os.environ.get("NCNET_BENCH_INLOC"):
+    flag = os.environ.get("NCNET_BENCH_INLOC")
+    on_tpu = "TPU" in jax.devices()[0].device_kind
+    if (flag not in ("0", "") if flag is not None else on_tpu):
         put("inloc_matcher_s_per_pair", _bench_inloc_matcher,
             label="inloc_matcher")
     for k in [k for k, v in res.items() if v is None]:  # prune in place so a

@@ -27,15 +27,23 @@ PF-Pascal regime (hB·wB ≈ 625).  The InLoc-resolution volume stays on the
 XLA formulations.  Forward-only: the ``jax.custom_vjp`` backward falls back
 to the XLA path (training uses it anyway; this kernel serves eval/bench).
 
-Status: the current Mosaic compiler REJECTS this kernel ("unsupported shape
-cast") — the in-kernel reshapes that split/merge the minor (lane) dim
-(``(l'·c) → (l', c)`` and the ``(q,c)`` tap fusion) are relayouts Mosaic
-does not implement, per probing on v5e: lane-dim splits/merges fail while
-leading-dim merges/splits around a fixed minor dim compile.  The variant
-chooser therefore gates on ``pallas_compiles`` (a cached real-compile
-probe) and falls back to the XLA formulations, so the kernel activates
-automatically on toolchains that accept it.  Numerics are locked down by
-interpret-mode tests (tests/test_ops_basic.py) either way.
+Status (round 3, jax 0.9.0 / v5e): the Mosaic compiler still REJECTS this
+kernel ("unsupported shape cast").  A systematic legality sweep
+(tools/mosaic_probes.py) pinned the boundary: lane-dim reshape splits/merges
+and lane rolls are rejected, while lane CONCAT (any width), lane pads, lane
+slices at ANY offset, lane-offset stores, sublane slices/merges/splits, and
+both dot_general orientations compile.  Redesigns restricted to the legal
+set were costed before building: every arrangement either re-creates the
+lane split (output cells and fused channels cannot share the lane dim), or
+folds taps into the dot's M/N with a 5-10× tap-cross-product FLOP waste, or
+pays a banded-Toeplitz K-overhead of (T+4)/T — and the XLA formulations
+moved: measured coutfold for the 16→16 layer (1.5-2.7 ms/pair bf16 bs4)
+already beats the equivalent bare GEMM shape (4.7, tools/xla_conv_probe.py),
+bounding the best realistic Mosaic kernel at roughly parity.  The kernel
+therefore stays gated on ``pallas_compiles`` (a cached real-compile probe) —
+live automatically the day the toolchain accepts lane reshapes — with
+numerics locked by interpret-mode tests (tests/test_ops_basic.py), and the
+fast-path effort went to the measured XLA formulation choices instead.
 """
 
 from __future__ import annotations
