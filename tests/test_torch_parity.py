@@ -1,0 +1,129 @@
+"""Full-forward cross-framework parity: ncnet_forward vs a torch twin.
+
+The strongest quality evidence available offline (no released weights, no
+torchvision): a functional PyTorch re-statement of the reference's ENTIRE
+forward semantics — resnet101[:layer3] trunk, featureL2Norm (eps inside the
+sqrt, model.py:14-17), bmm 4D correlation (model.py:106-115), MutualMatching
+with eps=1e-5 and the reference parenthesization (model.py:155-175),
+stack-level symmetric NeighConsensus with the conv4d-as-loop-over-conv3d
+kernel (conv4d.py:39-48), final MutualMatching — driven by the SAME weights
+as our jitted forward.  Agreement here means the whole composition (not just
+each op against numpy) matches torch float semantics end to end.
+
+Complements tests/test_backbone.py (trunk-only oracle) and the op-level
+brute-force oracles; see tools/parity_kit.py for the real-weights version of
+this check.
+"""
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.models import backbone as bb
+from ncnet_tpu.models.ncnet import ncnet_forward
+
+from test_backbone import make_resnet101_state_dict, torch_resnet101_features
+
+RNG = np.random.default_rng(7)
+
+
+def torch_l2norm(f):
+    return f / torch.sqrt(torch.sum(f * f, dim=1, keepdim=True) + 1e-6)
+
+
+def torch_mutual(c):
+    # reference model.py:155-175 (eps and parenthesization preserved)
+    b, _, ha, wa, hb, wb = c.shape
+    c3_b = c.view(b, ha * wa, hb, wb)
+    c3_a = c.view(b, ha, wa, hb * wb)
+    max_a, _ = torch.max(c3_b, dim=1, keepdim=True)        # over A for each B
+    max_b, _ = torch.max(c3_a, dim=3, keepdim=True)        # over B for each A
+    eps = 1e-5
+    c_a = c3_a / (max_b + eps)
+    c_b = c3_b / (max_a + eps)
+    c = c * (c_a.view_as(c) * c_b.view_as(c))
+    return c
+
+
+def torch_conv4d_loop(x, w, bias):
+    # the reference's conv4d: python loop over hA, conv3d per kA tap
+    # (conv4d.py:39-48), "same" zero padding on every spatial dim
+    bsz, cin, ha, wa, hb, wb = x.shape
+    cout, _, ka, kwa, kb, kwb = w.shape
+    pad = ka // 2
+    xp = F.pad(x, (0, 0, 0, 0, 0, 0, pad, pad))  # pad hA only; conv3d pads rest
+    out = torch.zeros(bsz, cout, ha, wa, hb, wb)
+    for i in range(ha):
+        acc = None
+        for p in range(ka):
+            o = F.conv3d(xp[:, :, i + p], w[:, :, p], bias=None,
+                         padding=kwa // 2)
+            acc = o if acc is None else acc + o
+        out[:, :, i] = acc + bias.view(1, -1, 1, 1, 1)
+    return out
+
+
+def torch_nc_symmetric(x, layers):
+    # stack-level symmetry: conv(x) + conv(x^T)^T (model.py:144-150)
+    def stack(v):
+        for w, b in layers:
+            v = F.relu(torch_conv4d_loop(v, w, b))
+        return v
+
+    xt = x.permute(0, 1, 4, 5, 2, 3)
+    return stack(x) + stack(xt).permute(0, 1, 4, 5, 2, 3)
+
+
+def torch_full_forward(sd, nc_layers, src, tgt):
+    fa = torch_l2norm(torch_resnet101_features(sd, src))
+    fb = torch_l2norm(torch_resnet101_features(sd, tgt))
+    b, c, ha, wa = fa.shape
+    hb, wb = fb.shape[2:]
+    corr = torch.bmm(
+        fa.view(b, c, ha * wa).transpose(1, 2), fb.view(b, c, hb * wb)
+    ).view(b, 1, ha, wa, hb, wb)
+    corr = torch_mutual(corr)
+    corr = torch_nc_symmetric(corr, nc_layers)
+    corr = torch_mutual(corr)
+    return corr
+
+
+def test_full_forward_matches_torch_twin():
+    sd = make_resnet101_state_dict()
+    k, chans = 3, [(1, 8), (8, 1)]
+    nc_torch, nc_ours = [], []
+    for cin, cout in chans:
+        w = RNG.normal(0, 0.3 / np.sqrt(cin * k**4),
+                       (k, k, k, k, cin, cout)).astype(np.float32)
+        bias = RNG.normal(0, 0.02, cout).astype(np.float32)
+        # torch Conv4d layout (C_out, C_in, kA, kWA, kB, kWB)
+        nc_torch.append((torch.from_numpy(np.transpose(w, (5, 4, 0, 1, 2, 3))),
+                         torch.from_numpy(bias)))
+        nc_ours.append({"w": jnp.asarray(w), "b": jnp.asarray(bias)})
+
+    x = RNG.normal(0, 1, (1, 3, 64, 64)).astype(np.float32)
+    y = RNG.normal(0, 1, (1, 3, 64, 48)).astype(np.float32)
+    with torch.no_grad():
+        want = torch_full_forward(
+            sd, nc_torch, torch.from_numpy(x), torch.from_numpy(y)
+        ).numpy()
+
+    cfg = ModelConfig(backbone="resnet101", ncons_kernel_sizes=(k, k),
+                      ncons_channels=tuple(c for _, c in chans))
+    params = {
+        "backbone": bb.import_torch_backbone(sd, "resnet101"),
+        "nc": nc_ours,
+    }
+    got = ncnet_forward(
+        cfg, params,
+        jnp.asarray(np.transpose(x, (0, 2, 3, 1))),
+        jnp.asarray(np.transpose(y, (0, 2, 3, 1))),
+    ).corr  # (B, hA, wA, hB, wB)
+
+    assert np.asarray(got).shape == tuple(want.shape[i] for i in (0, 2, 3, 4, 5))
+    np.testing.assert_allclose(
+        np.asarray(got), want[:, 0], rtol=2e-4, atol=2e-4
+    )
