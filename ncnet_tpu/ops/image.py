@@ -5,13 +5,19 @@ The reference resizes by sampling an identity affine grid with bilinear
 InLoc images with ``F.upsample(mode='bilinear')`` (eval_inloc.py:84-89) — both
 are *align-corners* bilinear resampling in torch-0.3 semantics.
 ``jax.image.resize`` uses half-pixel centers, which would shift every feature
-half a cell and move PCK; so we implement align-corners bilinear directly
-(a gather + lerp, fully fused by XLA).  A numpy twin serves the host-side
-input pipeline without bouncing images through the device.
+half a cell and move PCK; so we implement align-corners bilinear directly.
+
+The DEVICE path contracts the image against per-axis interpolation matrices
+(each output row/column is a 2-tap combination of input rows/columns) — two
+MXU matmuls instead of the gather+lerp form, whose fancy-index gathers with
+a 3-channel minor dim dominate the InLoc per-pair device time on TPU.  The
+numpy twin (host-side input pipeline, no device bounce) keeps the
+gather+lerp form; both implement the identical sampling weights.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -26,17 +32,24 @@ def _align_corners_coords(out_len: int, in_len: int, xp):
     return xp.linspace(0.0, in_len - 1.0, out_len, dtype=xp.float32)
 
 
+def _tap_weights(out_n: int, in_n: int, xp):
+    """Align-corners 2-tap sampling: ``(y0, y1, f)`` with output sample ``i``
+    = ``(1-f_i)·src[y0_i] + f_i·src[y1_i]``.  The ONE definition of the
+    sampling weights — both the host gather path and the device matmul path
+    derive from it, so they cannot desync."""
+    ys = _align_corners_coords(out_n, in_n, xp)
+    y0 = xp.clip(xp.floor(ys).astype(xp.int32), 0, in_n - 1)
+    y1 = xp.minimum(y0 + 1, in_n - 1)
+    return y0, y1, ys - y0
+
+
 def _resize_bilinear(img, out_h: int, out_w: int, xp):
     """Shared align-corners bilinear body; ``img``: (B, H, W, C)."""
     b, h, w, c = img.shape
-    ys = _align_corners_coords(out_h, h, xp)
-    xs = _align_corners_coords(out_w, w, xp)
-    y0 = xp.clip(xp.floor(ys).astype(xp.int32), 0, h - 1)
-    x0 = xp.clip(xp.floor(xs).astype(xp.int32), 0, w - 1)
-    y1 = xp.minimum(y0 + 1, h - 1)
-    x1 = xp.minimum(x0 + 1, w - 1)
-    wy = (ys - y0)[None, :, None, None]
-    wx = (xs - x0)[None, None, :, None]
+    y0, y1, fy = _tap_weights(out_h, h, xp)
+    x0, x1, fx = _tap_weights(out_w, w, xp)
+    wy = fy[None, :, None, None]
+    wx = fx[None, None, :, None]
     top_rows = img[:, y0]
     bot_rows = img[:, y1]
     top = top_rows[:, :, x0] * (1 - wx) + top_rows[:, :, x1] * wx
@@ -44,8 +57,23 @@ def _resize_bilinear(img, out_h: int, out_w: int, xp):
     return top * (1 - wy) + bot * wy
 
 
+def _interp_matrix(out_n: int, in_n: int) -> jnp.ndarray:
+    """``(in_n, out_n)`` align-corners interpolation matrix: column ``i`` has
+    weight ``1-f`` at row ``y0_i`` and ``f`` at ``y1_i`` (summing to 1 when
+    the taps coincide at the last row) — the matmul form of the exact
+    ``_tap_weights`` sampling.  Built in-graph from iota — cheap on device,
+    and avoids baking multi-MB constants into every InLoc shape bucket's
+    program."""
+    y0, y1, f = _tap_weights(out_n, in_n, jnp)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (in_n, out_n), 0)
+    return jnp.where(rows == y0[None, :], 1.0 - f[None, :], 0.0) + jnp.where(
+        rows == y1[None, :], f[None, :], 0.0
+    )
+
+
 def resize_bilinear_align_corners(img: jnp.ndarray, out_h: int, out_w: int) -> jnp.ndarray:
-    """Bilinear resize with align-corners sampling.
+    """Bilinear resize with align-corners sampling (device path: two MXU
+    contractions against interpolation matrices — see module docstring).
 
     Args:
       img: ``(B, H, W, C)`` or ``(H, W, C)``.
@@ -53,8 +81,21 @@ def resize_bilinear_align_corners(img: jnp.ndarray, out_h: int, out_w: int) -> j
     squeeze = img.ndim == 3
     if squeeze:
         img = img[None]
-    out = _resize_bilinear(img, out_h, out_w, jnp)
-    return out[0] if squeeze else out
+    h, w = img.shape[1], img.shape[2]
+    wy = _interp_matrix(out_h, h)
+    wx = _interp_matrix(out_w, w)
+    # f32 throughout with exact-precision dots: the interp weights are the
+    # same 2-tap lerps as the gather form, so torch-oracle parity holds
+    x = img.astype(jnp.float32)
+    x = jnp.einsum("hH,bhwc->bHwc", wy, x,
+                   precision=jax.lax.Precision.HIGHEST)
+    out = jnp.einsum("wW,bHwc->bHWc", wx, x,
+                     precision=jax.lax.Precision.HIGHEST)
+    if not jnp.issubdtype(img.dtype, jnp.floating):
+        # preserve the op's contract: integer inputs resize to float (the
+        # gather form never truncated back)
+        return out[0] if squeeze else out
+    return (out[0] if squeeze else out).astype(img.dtype)
 
 
 def resize_bilinear_align_corners_np(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
