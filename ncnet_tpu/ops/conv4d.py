@@ -352,6 +352,14 @@ def choose_conv4d_variant(
         # through afold's XLA transpose hit repeated compile failures on this
         # toolchain (tools/vjp_probe.py dw_afold, bench train bs8).  So auto
         # stays on coutfold; afold remains explicitly selectable.
+        #
+        # The same standalone-vs-composed inversion reproduced independently
+        # at the InLoc scale for the c_in≤4 rule: 1→16 k3 on the 56M-cell
+        # volume measures coutfold 3.6 vs tapfold 10.2 ms standalone
+        # (tools/inloc_filter_probe.py), yet swapping it inside the composed
+        # ncnet_filter made the whole filter SLOWER (88.3 → 99.0 ms).  Treat
+        # any future standalone variant probe as a hypothesis only — the
+        # composed program is the unit of measurement.
     return "coutfold" if fold_fits(c_out) else "unroll"
 
 

@@ -86,16 +86,14 @@ def resize_bilinear_align_corners(img: jnp.ndarray, out_h: int, out_w: int) -> j
     wx = _interp_matrix(out_w, w)
     # f32 throughout with exact-precision dots: the interp weights are the
     # same 2-tap lerps as the gather form, so torch-oracle parity holds
+    # float32 result for every input dtype — the gather form's promotion
+    # semantics (uint8/bf16 in → f32 out; f32 weights promote the lerp)
     x = img.astype(jnp.float32)
     x = jnp.einsum("hH,bhwc->bHwc", wy, x,
                    precision=jax.lax.Precision.HIGHEST)
     out = jnp.einsum("wW,bHwc->bHWc", wx, x,
                      precision=jax.lax.Precision.HIGHEST)
-    if not jnp.issubdtype(img.dtype, jnp.floating):
-        # preserve the op's contract: integer inputs resize to float (the
-        # gather form never truncated back)
-        return out[0] if squeeze else out
-    return (out[0] if squeeze else out).astype(img.dtype)
+    return out[0] if squeeze else out
 
 
 def resize_bilinear_align_corners_np(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:

@@ -1,0 +1,56 @@
+"""Shared scan-differenced device-timing harness for the tools/ probes.
+
+The device tunnel both caches repeated identical executions and charges
+host→device upload to the first execution touching a fresh buffer, so naive
+repeat-loops measure either ~0 or the transfer.  ``timeit`` jits a program
+that generates its input ON DEVICE from a PRNG key and runs the step ``n``
+times inside a data-dependent ``lax.scan``, reporting
+``(t[n_long] − t[1]) / (n_long − 1)`` medians over fresh keys.
+
+IMPORTANT probe hygiene, learned the hard way (see the project PARITY notes):
+  * the step function must fold EVERY output it means to measure back into
+    the carry — anything not consumed is dead-code-eliminated, silently
+    excluding its compute from the timing;
+  * correlation volumes must be BORN from an einsum like production (a raw
+    random-normal volume makes XLA pick pathological layouts for the
+    maxpool4d 8D reshape — a 66×-padded 119 GB allocation);
+  * standalone formulation timings are hypotheses only — the composed
+    program is the unit of measurement.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def timeit(step_fn, make_input, n_long=6, reps=3, per=1):
+    """Steady-state ms per ``step_fn`` call (divided by ``per``).
+
+    ``step_fn(carry) -> carry`` must keep the carry's structure/shape;
+    ``make_input(key)`` builds the initial carry on device.
+    """
+
+    @partial(jax.jit, static_argnums=(1,))
+    def run(key, n):
+        def body(x, _):
+            return step_fn(x), ()
+
+        x, _ = lax.scan(body, make_input(key), None, length=n)
+        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
+
+    key = jax.random.key
+    float(run(key(0), 1))
+    float(run(key(1), n_long))  # compile both lengths
+    diffs = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(run(key(100 + i), 1))
+        t1 = time.perf_counter()
+        float(run(key(200 + i), n_long))
+        t2 = time.perf_counter()
+        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
+    return float(np.median([max(d, 0.0) for d in diffs])) / per

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Per-variant conv4d timings at the InLoc volume scale (56M cells, IVD
+arch 1->16 k3 + 16->1 k3, bf16), plus maxpool4d / mutual_matching at scale.
+
+CAUTION (measured, twice): standalone wins here do NOT transfer — swapping
+the 1->16 layer to the standalone-3x-faster coutfold made the COMPOSED
+ncnet_filter slower (88.3 -> 99.0 ms).  Treat these numbers as hypotheses
+for composed A/B runs only (ops/conv4d.py choose_conv4d_variant records the
+history).
+
+Usage: python tools/inloc_filter_probe.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+from ncnet_tpu.ops import maxpool4d_with_argmax, mutual_matching  # noqa: E402
+from ncnet_tpu.ops.conv4d import conv4d  # noqa: E402
+from ncnet_tpu.ops.correlation import correlation_4d  # noqa: E402
+
+# fine and pooled InLoc volumes (query 3200x2400, db 1200x1600 -> 2400x3200)
+FQ = (200, 150)
+FD = (150, 200)
+PQ = (100, 75)
+PD = (75, 100)
+DT = jnp.bfloat16
+
+
+def chain(op):
+    def step(carry):
+        x, w = carry
+        out = op(x, w)
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+        return x + eps, w
+    return step
+
+
+def layer_input(cin, cout, k):
+    def make(key):
+        k1, k2 = jax.random.split(key)
+        return (
+            jax.random.normal(k1, (1, *PQ, *PD, cin), DT) * 0.1,
+            jax.random.normal(k2, (k,) * 4 + (cin, cout), DT) * 0.1,
+        )
+    return make
+
+
+def corr_born_volume(key, fine):
+    """A volume BORN from the correlation einsum — a raw random volume makes
+    XLA pick a pathological 66x-padded layout for maxpool4d's 8D reshape
+    (tools/_timing.py docstring)."""
+    k1, k2 = jax.random.split(key)
+    shape = (FQ, FD) if fine else (PQ, PD)
+    fa = jax.random.normal(k1, (1, *shape[0], 8), DT) * 0.2
+    fb = jax.random.normal(k2, (1, *shape[1], 8), DT) * 0.2
+    return fa, fb
+
+
+def main():
+    print(f"device={jax.devices()[0].device_kind} pooled {PQ}x{PD} bf16")
+    for name, cin, cout in (("1to16_k3", 1, 16), ("16to1_k3", 16, 1)):
+        row = []
+        for v in ("auto", "unroll", "tapfold", "coutfold"):
+            try:
+                ms = timeit(
+                    chain(lambda x, w, v=v: conv4d(x, w, variant=v)),
+                    layer_input(cin, cout, 3), n_long=4,
+                )
+                row.append(f"{v}={ms:6.1f}")
+            except Exception as e:
+                row.append(f"{v}=ERR({str(e)[:30]})")
+        print(f"{name}: " + "  ".join(row))
+
+    def pool_step(carry):
+        fa, fb = carry
+        pooled, delta = maxpool4d_with_argmax(correlation_4d(fa, fb), 2)
+        eps = jnp.sum(pooled.astype(jnp.float32)) * 1e-12
+        for d in delta:
+            eps = eps + jnp.sum(d.astype(jnp.float32)) * 1e-12
+        return fa + eps.astype(fa.dtype), fb
+
+    print("corr+maxpool4d_k2_fine: "
+          f"{timeit(pool_step, lambda k: corr_born_volume(k, True), n_long=4):.1f} ms")
+
+    def mm_step(carry):
+        fa, fb = carry
+        out = mutual_matching(correlation_4d(fa, fb))
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(fa.dtype)
+        return fa + eps, fb
+
+    print("corr+mutual_matching_pooled: "
+          f"{timeit(mm_step, lambda k: corr_born_volume(k, False), n_long=4):.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Stage-isolated timings of the InLoc-resolution matcher on device.
+
+The round-2 cumulative-prefix breakdown proved unreliable (it charged
+warmup/upload to the first stage: "preprocess 119 ms" vs 4 ms measured in
+isolation).  This probe times each stage standalone with the scan-differenced
+harness at the real InLoc db shapes (query 4032x3024 / db 1200x1600 resized
+to max side 3200, k=2, IVD arch 1->16/16->1 k3, bf16), so the per-pair device
+total can be attributed and attacked.
+
+Probe hygiene (see tools/_timing.py): volumes are born from a correlation
+einsum (raw random volumes trigger pathological maxpool4d layouts), and the
+carry consumes the coordinate/delta outputs too, so relocalization work is
+not dead-code-eliminated out of the timings.
+
+Usage: python tools/inloc_stage_probe.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+from ncnet_tpu.config import ModelConfig  # noqa: E402
+from ncnet_tpu import models  # noqa: E402
+from ncnet_tpu.evaluation.inloc import quantized_resize_shape  # noqa: E402
+from ncnet_tpu.models.ncnet import extract_features, ncnet_filter  # noqa: E402
+from ncnet_tpu.ops import corr_to_matches, correlation_4d  # noqa: E402
+from ncnet_tpu.ops.image import (  # noqa: E402
+    normalize_imagenet,
+    resize_bilinear_align_corners,
+)
+
+CFG = ModelConfig(
+    ncons_kernel_sizes=(3, 3), ncons_channels=(16, 1),
+    half_precision=True, backbone_bf16=True, relocalization_k_size=2,
+)
+
+# query 4032x3024 portrait and db 1200x1600, both resized to max side 3200
+# with k*16 quantization — the real eval shapes
+QH, QW = quantized_resize_shape(4032, 3024, 3200, 2)    # (3200, 2400)
+DH, DW = quantized_resize_shape(1200, 1600, 3200, 2)    # (2400, 3200)
+FQ = (QH // 16, QW // 16)   # fine feature grids
+FD = (DH // 16, DW // 16)
+PQ = (FQ[0] // 2, FQ[1] // 2)  # pooled
+PD = (FD[0] // 2, FD[1] // 2)
+
+
+def chain1(op):
+    def step(x):
+        out = op(x)
+        eps = (jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)) * 1e-12)
+        return x + eps.astype(x.dtype)
+    return step
+
+
+def main():
+    import warnings
+
+    print(f"device={jax.devices()[0].device_kind}  "
+          f"query {QH}x{QW} -> fine {FQ} pooled {PQ}; "
+          f"db {DH}x{DW} -> fine {FD} pooled {PD}")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        params = models.init_ncnet(CFG, jax.random.key(0))
+    res = {}
+
+    # 1. db preprocess (uint8 -> normalize -> quantized resize)
+    def prep_in(key):
+        return jax.random.randint(key, (1, 1200, 1600, 3), 0, 255, jnp.uint8)
+
+    def prep(img):
+        x = normalize_imagenet(img.astype(jnp.float32))
+        out = resize_bilinear_align_corners(x, DH, DW)
+        return img + (jnp.sum(out) * 1e-12).astype(jnp.uint8)
+
+    res["preprocess_db"] = timeit(prep, prep_in)
+
+    # 2. backbone on the db image (the per-pair trunk; the query's is
+    # amortized over ~10 pairs)
+    def bb_in(key):
+        return jax.random.uniform(key, (1, DH, DW, 3), jnp.float32, -1, 1)
+
+    res["backbone_db"] = timeit(
+        chain1(lambda x: extract_features(CFG, params, x)), bb_in
+    )
+
+    # 3. fine correlation (bf16 features)
+    def corr_in(key):
+        k1, k2 = jax.random.split(key)
+        return (
+            jax.random.normal(k1, (1, *FQ, 1024), jnp.bfloat16) * 0.03,
+            jax.random.normal(k2, (1, *FD, 1024), jnp.bfloat16) * 0.03,
+        )
+
+    def corr_step(carry):
+        a, b = carry
+        out = correlation_4d(a, b)
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(a.dtype)
+        return a + eps, b
+
+    res["correlation_fine"] = timeit(corr_step, corr_in)
+
+    # 4. filter: maxpool4d(k=2) + mutual + NC + mutual on the fine volume.
+    # Born from a correlation einsum (8 anchor channels, ~0.5 ms) and the
+    # carry consumes BOTH the filtered volume and the delta4d offsets so the
+    # argmax bookkeeping is measured, not DCE'd.
+    def vol_in(key):
+        k1, k2 = jax.random.split(key)
+        return (
+            jax.random.normal(k1, (1, *FQ, 8), jnp.bfloat16) * 0.2,
+            jax.random.normal(k2, (1, *FD, 8), jnp.bfloat16) * 0.2,
+        )
+
+    def filter_step(carry):
+        fa, fb = carry
+        out = ncnet_filter(CFG, params, correlation_4d(fa, fb))
+        eps = jnp.sum(out.corr.astype(jnp.float32)) * 1e-12
+        for d in out.delta4d:
+            eps = eps + jnp.sum(d.astype(jnp.float32)) * 1e-12
+        return fa + eps.astype(fa.dtype), fb
+
+    res["filter_pool_mm_nc"] = timeit(filter_step, vol_in)
+
+    # 5. match extraction, both directions, softmax, on the pooled volume —
+    # every output column consumed so the relocalization gathers survive DCE
+    def pooled_in(key):
+        k1, k2 = jax.random.split(key)
+        corr = jax.random.normal(k1, (1, *PQ, *PD), jnp.float32) * 0.03
+        delta = tuple(
+            jax.random.randint(k2, (1, *PQ, *PD), 0, 2, jnp.int32)
+            for _ in range(4)
+        )
+        return corr, delta
+
+    def extract_step(carry):
+        corr, delta = carry
+        eps = 0.0
+        for inv in (False, True):
+            m = corr_to_matches(corr, delta4d=delta, k_size=2,
+                                do_softmax=True, scale="positive",
+                                invert_matching_direction=inv)
+            eps = eps + sum(
+                jnp.sum(v) for v in (m.xA, m.yA, m.xB, m.yB, m.score)
+            ) * 1e-12
+        return corr + eps.astype(corr.dtype), delta
+
+    res["extract_both_dirs"] = timeit(extract_step, pooled_in)
+
+    total = sum(res.values())
+    for k, v in res.items():
+        print(f"{k:>20}: {v:7.1f} ms")
+    print(f"{'sum of stages':>20}: {total:7.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,14 +11,13 @@ Usage: python tools/vjp_probe.py [batch] [dtype]
 
 import importlib
 import sys
-import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 DT = jnp.bfloat16 if (len(sys.argv) > 2 and sys.argv[2] == "bf16") else jnp.float32
@@ -28,27 +27,6 @@ c4mod = importlib.import_module("ncnet_tpu.ops.conv4d")
 ncmod = importlib.import_module("ncnet_tpu.models.ncnet")
 
 
-def timeit(step_fn, make_input, n_long=4, reps=3, per=B):
-    @partial(jax.jit, static_argnums=(1,))
-    def run(key, n):
-        def body(x, _):
-            return step_fn(x), ()
-        x, _ = lax.scan(body, make_input(key), None, length=n)
-        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
-
-    key = jax.random.key
-    float(run(key(0), 1))
-    float(run(key(1), n_long))
-    diffs = []
-    for i in range(reps):
-        t0 = time.perf_counter()
-        float(run(key(100 + i), 1))
-        t1 = time.perf_counter()
-        float(run(key(200 + i), n_long))
-        t2 = time.perf_counter()
-        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
-    import numpy as np
-    return float(np.median([max(d, 0.0) for d in diffs])) / per
 
 
 def stack_input(key):
@@ -124,7 +102,7 @@ def main():
             c4mod._DW_VARIANT = dwv
         try:
             mem = peak_mem_gb()
-            ms = timeit(grad_step, stack_input)
+            ms = timeit(grad_step, stack_input, n_long=4, per=B)
             print(f"{name:>12}: {ms:7.3f} ms/pair   temp {mem:5.1f} GB")
         except Exception as e:
             print(f"{name:>12}: ERR {str(e)[:120]}")

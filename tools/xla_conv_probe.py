@@ -20,14 +20,13 @@ Usage: python tools/xla_conv_probe.py [batch]
 """
 
 import sys
-import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 4
 S = 25            # PF-Pascal grid
@@ -36,27 +35,6 @@ C = 16            # channels
 DT = jnp.bfloat16
 
 
-def timeit(step_fn, make_input, n_long=8, reps=3, per=B):
-    @partial(jax.jit, static_argnums=(1,))
-    def run(key, n):
-        def body(x, _):
-            return step_fn(x), ()
-        x, _ = lax.scan(body, make_input(key), None, length=n)
-        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
-
-    key = jax.random.key
-    float(run(key(0), 1))
-    float(run(key(1), n_long))
-    diffs = []
-    for i in range(reps):
-        t0 = time.perf_counter()
-        float(run(key(100 + i), 1))
-        t1 = time.perf_counter()
-        float(run(key(200 + i), n_long))
-        t2 = time.perf_counter()
-        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
-    import numpy as np
-    return float(np.median([max(d, 0.0) for d in diffs])) / per
 
 
 def chain(op):
@@ -93,12 +71,12 @@ def main():
     res["gemm_coutfold_MK2000N80"] = timeit(
         chain(lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32)
               .astype(DT)),
-        gemm_input(m, 2000, 80),
+        gemm_input(m, 2000, 80), per=B,
     )
     res["gemm_square_MK400N400"] = timeit(
         chain(lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32)
               .astype(DT)),
-        gemm_input(m, 400, 400),
+        gemm_input(m, 400, 400), per=B,
     )
 
     from ncnet_tpu.ops.conv4d import conv4d
@@ -106,7 +84,7 @@ def main():
     for variant in ("coutfold", "unroll", "tapfold", "afold"):
         res[f"conv_{variant}"] = timeit(
             chain(lambda x, w, v=variant: conv4d(x, w, variant=v)),
-            vol_input,
+            vol_input, per=B,
         )
 
     def im2col_gemm(x, w):
@@ -134,7 +112,7 @@ def main():
                 out = o if out is None else out + o
         return out
 
-    res["im2col_gemm"] = timeit(chain(im2col_gemm), vol_input)
+    res["im2col_gemm"] = timeit(chain(im2col_gemm), vol_input, per=B)
 
     for k, v in sorted(res.items()):
         print(f"{k:>28}: {v:7.3f} ms/pair")

@@ -9,41 +9,19 @@ Usage: python tools/xla_layer_probe.py [batch]
 """
 
 import sys
-import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 4
 S = 25
 DT = jnp.bfloat16
 
 
-def timeit(step_fn, make_input, n_long=8, reps=3, per=B):
-    @partial(jax.jit, static_argnums=(1,))
-    def run(key, n):
-        def body(x, _):
-            return step_fn(x), ()
-        x, _ = lax.scan(body, make_input(key), None, length=n)
-        return jnp.sum(jax.tree.leaves(x)[0].astype(jnp.float32))
-
-    key = jax.random.key
-    float(run(key(0), 1))
-    float(run(key(1), n_long))
-    diffs = []
-    for i in range(reps):
-        t0 = time.perf_counter()
-        float(run(key(100 + i), 1))
-        t1 = time.perf_counter()
-        float(run(key(200 + i), n_long))
-        t2 = time.perf_counter()
-        diffs.append(((t2 - t1) - (t1 - t0)) / (n_long - 1) * 1e3)
-    import numpy as np
-    return float(np.median([max(d, 0.0) for d in diffs])) / per
 
 
 def chain(op):
@@ -77,7 +55,7 @@ def main():
             try:
                 ms = timeit(
                     chain(lambda x, w, v=v: conv4d(x, w, variant=v)),
-                    layer_input(cin, cout, k),
+                    layer_input(cin, cout, k), per=B,
                 )
                 row.append(f"{v}={ms:6.3f}")
             except Exception as e:
@@ -106,7 +84,7 @@ def main():
         return corr + eps, params
 
     print(f"  stack symmetric (production): "
-          f"{timeit(sym_step, stack_input):6.3f} ms/pair")
+          f"{timeit(sym_step, stack_input, per=B):6.3f} ms/pair")
 
     def asym_step(carry):
         corr, params = carry
@@ -115,7 +93,7 @@ def main():
         return corr + eps, params
 
     print(f"  stack one-pass (no symmetry): "
-          f"{timeit(asym_step, stack_input):6.3f} ms/pair")
+          f"{timeit(asym_step, stack_input, per=B):6.3f} ms/pair")
 
 
 if __name__ == "__main__":
