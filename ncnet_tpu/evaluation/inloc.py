@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import math
 import os
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,11 @@ import numpy as np
 
 from ncnet_tpu.config import EvalInLocConfig, ModelConfig
 from ncnet_tpu.data.datasets import load_image
-from ncnet_tpu.models.ncnet import ncnet_forward
+from ncnet_tpu.models.ncnet import (
+    extract_features,
+    ncnet_forward,
+    ncnet_forward_from_features,
+)
 from ncnet_tpu.ops.image import normalize_imagenet, resize_bilinear_align_corners_np
 from ncnet_tpu.ops.matching import corr_to_matches
 
@@ -118,6 +122,17 @@ def recenter(coord: jnp.ndarray, n: int) -> jnp.ndarray:
     return coord * (n - 1) / n + 0.5 / n
 
 
+class PreparedQuery(NamedTuple):
+    """A query readied by ``matcher.preprocess``: the preprocessed image
+    (kept for the sharded-forward fallback) plus its backbone features,
+    computed ONCE and reused across the query's ~10 pano pairs — the
+    reference recomputes the query trunk per pair (eval_inloc.py:124-132),
+    ~30 ms/pair of redundant device work at 3200 px."""
+
+    image: jnp.ndarray
+    features: jnp.ndarray
+
+
 def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
                       both_directions: bool, flip_direction: bool,
                       mesh=None, preprocess_image_size: Optional[int] = None):
@@ -159,18 +174,31 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         device_preprocess, static_argnames=("image_size", "k_size")
     )
 
-    def preprocess(img: np.ndarray) -> jnp.ndarray:
-        """Raw uint8 ``(1, H, W, 3)`` → preprocessed device tensor.  Exposed
-        as ``matcher.preprocess`` so the eval loop can preprocess a query
-        ONCE and reuse it across its ~10 pano pairs (the matcher accepts the
-        returned array directly)."""
-        assert preprocess_image_size is not None
+    feats = jax.jit(lambda p, x: extract_features(config, p, x))
+
+    def prep_input(img) -> jnp.ndarray:
+        """The ONE preprocessing call both input paths share — a divergence
+        here would desync the PreparedQuery path from the in-dispatch path
+        bit-for-bit."""
         return prep(
             jnp.asarray(img), image_size=preprocess_image_size, k_size=k
         )
 
-    def run(p, src, tgt, sharded=False):
-        out = forward(p, src, tgt, sharded)
+    def preprocess(img: np.ndarray) -> "PreparedQuery":
+        """Raw uint8 ``(1, H, W, 3)`` → :class:`PreparedQuery` (preprocessed
+        device tensor + backbone features).  Exposed as
+        ``matcher.preprocess`` so the eval loop preprocesses AND trunks a
+        query ONCE, reused across its ~10 pano pairs (the matcher accepts
+        the returned object directly)."""
+        assert preprocess_image_size is not None
+        x = prep_input(img)
+        return PreparedQuery(x, feats(params, x))
+
+    def run(p, src, tgt, sharded=False, src_is_features=False):
+        if src_is_features:
+            out = ncnet_forward_from_features(config, p, src, tgt)
+        else:
+            out = forward(p, src, tgt, sharded)
         corr, delta4d = out.corr.astype(jnp.float32), out.delta4d
         fs1, fs2, fs3, fs4 = corr.shape[1:]
         ms = []
@@ -204,7 +232,7 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
             [v.astype(jnp.float32).ravel() for v in (xa, ya, xb, yb, score)]
         )
 
-    jitted = jax.jit(run, static_argnames=("sharded",))
+    jitted = jax.jit(run, static_argnames=("sharded", "src_is_features"))
 
     warned_shapes = set()
 
@@ -234,8 +262,10 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         return ok
 
     def to_model_input(x):
+        if isinstance(x, PreparedQuery):
+            return x.image  # accepted in either argument position
         if preprocess_image_size is not None and x.dtype == np.uint8:
-            return preprocess(x)
+            return prep_input(x)
         return jnp.asarray(x)
 
     def dispatch(src, tgt):
@@ -247,8 +277,20 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         from ncnet_tpu.utils.profiling import annotate
 
         with annotate("inloc_pair_dispatch"):
-            sharded = can_shard(tgt.shape, raw=tgt.dtype == np.uint8)
-            src, tgt = to_model_input(src), to_model_input(tgt)
+            if isinstance(tgt, PreparedQuery):  # either position accepted
+                tgt_shape, tgt_raw = tgt.image.shape, False
+            else:
+                tgt_shape, tgt_raw = tgt.shape, tgt.dtype == np.uint8
+            sharded = can_shard(tgt_shape, raw=tgt_raw)
+            tgt = to_model_input(tgt)
+            if isinstance(src, PreparedQuery):
+                if not sharded:
+                    # fast path: the query's trunk ran once in preprocess
+                    return jitted(params, src.features, tgt,
+                                  src_is_features=True)
+                src = src.image  # sharded forward replicates the trunk itself
+            else:
+                src = to_model_input(src)
             return jitted(params, src, tgt, sharded=sharded)
 
     def fetch(handle):

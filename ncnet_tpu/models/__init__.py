@@ -13,6 +13,7 @@ from ncnet_tpu.models.ncnet import (
     init_ncnet,
     ncnet_filter,
     ncnet_forward,
+    ncnet_forward_from_features,
     neigh_consensus,
 )
 from ncnet_tpu.models.checkpoint import (
@@ -34,6 +35,7 @@ __all__ = [
     "load_params",
     "ncnet_filter",
     "ncnet_forward",
+    "ncnet_forward_from_features",
     "neigh_consensus",
     "save_params",
 ]

@@ -351,3 +351,32 @@ def test_device_preprocess_matches_host_path(tmp_path):
     # is expected, while a formula or resize-order error would be orders of
     # magnitude larger
     np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-4)
+
+
+def test_prepared_query_features_path_bit_identical():
+    """matcher.preprocess returns a PreparedQuery whose cached-trunk fast
+    path must produce BIT-identical match tables to the image path (the
+    query features are the same extract_features output either way)."""
+    from ncnet_tpu.evaluation.inloc import PreparedQuery, make_pair_matcher
+    from ncnet_tpu.models.ncnet import init_ncnet
+
+    cfg = ModelConfig(
+        backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,),
+        half_precision=True, relocalization_k_size=2,
+    )
+    params = init_ncnet(cfg, jax.random.key(0))
+    matcher = make_pair_matcher(
+        cfg, params, do_softmax=True, both_directions=True,
+        flip_direction=False, preprocess_image_size=128,
+    )
+    rng = np.random.default_rng(3)
+    q = rng.integers(0, 255, (1, 96, 128, 3), dtype=np.uint8)
+    db = rng.integers(0, 255, (1, 128, 96, 3), dtype=np.uint8)
+
+    prepared = matcher.preprocess(q)
+    assert isinstance(prepared, PreparedQuery)
+    fast = matcher(prepared, db)
+    # image path: hand the preprocessed image (trunk recomputed in-program)
+    slow = matcher(np.asarray(prepared.image), db)
+    for a, b in zip(fast, slow):
+        np.testing.assert_array_equal(a, b)

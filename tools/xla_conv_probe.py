@@ -71,12 +71,12 @@ def main():
     res["gemm_coutfold_MK2000N80"] = timeit(
         chain(lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32)
               .astype(DT)),
-        gemm_input(m, 2000, 80), per=B,
+        gemm_input(m, 2000, 80), per=B, n_long=8,
     )
     res["gemm_square_MK400N400"] = timeit(
         chain(lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32)
               .astype(DT)),
-        gemm_input(m, 400, 400), per=B,
+        gemm_input(m, 400, 400), per=B, n_long=8,
     )
 
     from ncnet_tpu.ops.conv4d import conv4d
@@ -84,7 +84,7 @@ def main():
     for variant in ("coutfold", "unroll", "tapfold", "afold"):
         res[f"conv_{variant}"] = timeit(
             chain(lambda x, w, v=variant: conv4d(x, w, variant=v)),
-            vol_input, per=B,
+            vol_input, per=B, n_long=8,
         )
 
     def im2col_gemm(x, w):
@@ -112,7 +112,7 @@ def main():
                 out = o if out is None else out + o
         return out
 
-    res["im2col_gemm"] = timeit(chain(im2col_gemm), vol_input, per=B)
+    res["im2col_gemm"] = timeit(chain(im2col_gemm), vol_input, per=B, n_long=8)
 
     for k, v in sorted(res.items()):
         print(f"{k:>28}: {v:7.3f} ms/pair")
