@@ -74,7 +74,11 @@ class TrainConfig:
                                          # see training/loss.py measurements
     nc_custom_grad: bool = False         # conv4d custom VJP: ~18% slower but
                                          # ~45% less backward temp memory
-                                         # than plain AD (models/ncnet.py)
+                                         # than plain AD (models/ncnet.py).
+                                         # Does NOT rescue bs16 fp32 on one
+                                         # 16G chip (compile still fails,
+                                         # tried r3); the bs16 recipe stays
+                                         # remat_nc_layers + half_precision
     # static jit shapes need whole batches; dropping the val remainder (4 of
     # 308 PF-Pascal pairs at bs=16) makes best-checkpoint selection score a
     # fixed subset each epoch.  A documented deviation: the reference scores

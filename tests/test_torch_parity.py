@@ -91,6 +91,61 @@ def torch_full_forward(sd, nc_layers, src, tgt):
     return corr
 
 
+def torch_weak_loss(sd, nc_layers, src_batch, tgt_batch):
+    """The reference's training objective (train.py:110-156): full forward
+    for the positive pairs and for negatives built by rolling the SOURCES by
+    −1 within the batch (train.py:137); score = mean over cells and both
+    directions of the max softmax-normalized match value; loss =
+    score(neg) − score(pos)."""
+
+    def score(src, tgt):
+        c = torch_full_forward(sd, nc_layers, src, tgt)
+        b, _, ha, wa, hb, wb = c.shape
+        nc_b = torch.softmax(c.view(b, ha * wa, hb, wb), dim=1)
+        nc_a = torch.softmax(c.view(b, ha, wa, hb * wb), dim=3)
+        s_b, _ = torch.max(nc_b, dim=1)
+        s_a, _ = torch.max(nc_a, dim=3)
+        return (torch.mean(s_a) + torch.mean(s_b)) / 2.0
+
+    pos = score(src_batch, tgt_batch)
+    neg = score(torch.roll(src_batch, -1, dims=0), tgt_batch)
+    return neg - pos
+
+
+def test_weak_loss_matches_torch_twin():
+    """The training objective agrees cross-framework end to end (forward ×2
+    + roll negatives + softmax scoring) — and so does its sign structure:
+    the same-weights loss value is what training optimizes, so this is the
+    offline evidence that the TPU training target IS the reference's."""
+    from ncnet_tpu.training.loss import weak_loss
+
+    sd = make_resnet101_state_dict()
+    k = 3
+    w = RNG.normal(0, 0.3 / np.sqrt(k**4), (k, k, k, k, 1, 1)).astype(np.float32)
+    bias = RNG.normal(0, 0.02, 1).astype(np.float32)
+    nc_torch = [(torch.from_numpy(np.transpose(w, (5, 4, 0, 1, 2, 3))),
+                 torch.from_numpy(bias))]
+    params = {
+        "backbone": bb.import_torch_backbone(sd, "resnet101"),
+        "nc": [{"w": jnp.asarray(w), "b": jnp.asarray(bias)}],
+    }
+    x = RNG.normal(0, 1, (3, 3, 48, 48)).astype(np.float32)
+    y = RNG.normal(0, 1, (3, 3, 48, 48)).astype(np.float32)
+    with torch.no_grad():
+        want = float(torch_weak_loss(
+            sd, nc_torch, torch.from_numpy(x), torch.from_numpy(y)
+        ))
+    cfg = ModelConfig(backbone="resnet101", ncons_kernel_sizes=(k,), ncons_channels=(1,))
+    got = float(weak_loss(
+        cfg, params,
+        {
+            "source_image": jnp.asarray(np.transpose(x, (0, 2, 3, 1))),
+            "target_image": jnp.asarray(np.transpose(y, (0, 2, 3, 1))),
+        },
+    ))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
 def test_full_forward_matches_torch_twin():
     sd = make_resnet101_state_dict()
     k, chans = 3, [(1, 8), (8, 1)]
