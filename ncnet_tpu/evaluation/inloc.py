@@ -488,7 +488,10 @@ def run_inloc_eval(
         # (async) before pair idx's result is pulled, so the tunnel's
         # dispatch/transfer latency hides behind the previous pair's device
         # compute and host-side sort/dedup.  Depth 2 bounds live device
-        # buffers to two preprocessed panos (~90 MB each at 3200 px).
+        # buffers to two preprocessed panos (~90 MB each at 3200 px) and is
+        # the measured optimum: the r3 depth sweep on v5e gave 0.62 (no
+        # pipeline) / 0.285 (depth 2) / 0.47 (3) / 0.51 (4) s/pair — deeper
+        # queues regress, so don't raise this without re-measuring.
         in_flight = []  # [(idx, handle)]
 
         def drain_one():
