@@ -113,6 +113,43 @@ def _load_torch_state_dict(path: str, backbone: str):
 # ---------------------------------------------------------------------------
 
 
+def swap_ab_taps(layer: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """The layer whose plain application equals ``transpose ∘ layer ∘
+    transpose`` (A↔B volume transposition): kernel tap groups (kA,kWA) and
+    (kB,kWB) swapped, channels untouched.  Requires a cubic kernel."""
+    return {"w": jnp.transpose(layer["w"], (2, 3, 0, 1, 4, 5)),
+            "b": layer["b"]}
+
+
+def tap_swap_fusable(nc_params) -> bool:
+    """Whether the symmetric pass may run as tap-swapped stacks with a fused
+    first layer — the shape class the optimization was MEASURED on (see
+    neigh_consensus): cubic kernels, exactly two layers, 1-channel input."""
+    return (
+        len(nc_params) == 2
+        and nc_params[0]["w"].shape[4] == 1
+        and all(
+            layer["w"].shape[0:2] == layer["w"].shape[2:4]
+            for layer in nc_params
+        )
+    )
+
+
+def tap_swap_fused_layers(nc_params):
+    """``(fused_l1, l2, l2_swapped)`` for the tap-swapped symmetric fast
+    path.  The ONE construction of the fusion arithmetic — the unsharded
+    (:func:`neigh_consensus`) and hB-sharded
+    (parallel/spatial.py) branches both build from it, because their
+    bit-compatibility is a resume-artifact contract (the InLoc eval shares
+    per-query .mat files across ``spatial_shards`` settings)."""
+    sw = [swap_ab_taps(layer) for layer in nc_params]
+    fused_l1 = {
+        "w": jnp.concatenate([nc_params[0]["w"], sw[0]["w"]], axis=-1),
+        "b": jnp.concatenate([nc_params[0]["b"], sw[0]["b"]]),
+    }
+    return fused_l1, nc_params[1], sw[1]
+
+
 def neigh_consensus(
     nc_params: List[Dict[str, jnp.ndarray]],
     corr: jnp.ndarray,
@@ -159,7 +196,6 @@ def neigh_consensus(
 
     x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
     if symmetric:
-        xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))  # swap (hA,wA) ↔ (hB,wB)
         # folding the two passes into the batch dim doubles every NC
         # intermediate's live footprint — an OOM at the InLoc volume, and a
         # formulation downgrade (conv4d's auto gate demotes the folded batch
@@ -183,9 +219,30 @@ def neigh_consensus(
             # is numerically identical (batching does not reassociate the
             # per-volume convs).  Rectangular volumes (InLoc) keep two passes.
             b = x.shape[0]
+            xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))  # swap (hA,wA)↔(hB,wB)
             y = stack(jnp.concatenate([x, xt], axis=0))
             out = y[:b] + jnp.transpose(y[b:], (0, 3, 4, 1, 2, 5))
+        elif tap_swap_fusable(nc_params):
+            # rectangular volumes cannot batch-fold, but the transpose pass
+            # is avoidable algebraically: transposition commutes with ReLU
+            # and swaps a cubic kernel's A/B tap groups, so
+            # NC(xᵀ)ᵀ ≡ NC_tap-swapped(x) — and with a 1-channel first layer
+            # the two stacks' L1s fuse into ONE double-width conv over x.
+            # Measured COMPOSED on the 56M-cell InLoc volume (IVD arch,
+            # bf16, v5e): filter stage 109 → 46 ms/pair in production (the
+            # hand-built probe estimated 76; XLA fuses the production
+            # composition further); the unfused tap-swap alone is SLOWER
+            # (123), so only the measured 2-layer shape class takes this
+            # path (deeper stacks keep the transpose form).
+            fused_l1, l2, l2s = tap_swap_fused_layers(nc_params)
+            y = one_layer(fused_l1["w"], fused_l1["b"], x)  # 1 → 2C, one pass
+            c = nc_params[0]["w"].shape[5]
+            out = (
+                one_layer(l2["w"], l2["b"], y[..., :c])
+                + one_layer(l2s["w"], l2s["b"], y[..., c:])
+            )
         else:
+            xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
             out = stack(x) + jnp.transpose(stack(xt), (0, 3, 4, 1, 2, 5))
     else:
         out = stack(x)

@@ -122,11 +122,37 @@ def _nc_stack_sharded(
 def _neigh_consensus_sharded(
     nc_params: List[dict], corr: jnp.ndarray, n_shards: int, symmetric: bool
 ) -> jnp.ndarray:
-    """Stack-level symmetric NC filtering on an hB-sharded volume.  The
-    transposed pass swaps (hA,wA)↔(hB,wB), which moves the sharded dim to
-    position 1 — halos are exchanged there instead (model.py:144-150
-    semantics, sharded)."""
+    """Stack-level symmetric NC filtering on an hB-sharded volume.
+
+    Mirrors :func:`ncnet_tpu.models.ncnet.neigh_consensus`'s rectangular
+    branch exactly (the two must stay bit-compatible — the InLoc eval's
+    resume artifacts are shared across ``spatial_shards`` settings):
+
+      * measured shape class (2 cubic layers, 1-channel input): the
+        symmetric pass runs tap-SWAPPED on x — no volume transposes, both
+        stacks halo along the same sharded hB, and the fused double-width
+        first layer needs ONE halo exchange for both passes;
+      * otherwise: the transposed pass swaps (hA,wA)↔(hB,wB), which moves
+        the sharded dim to position 1 — halos are exchanged there instead
+        (model.py:144-150 semantics, sharded).
+    """
+    from ncnet_tpu.models.ncnet import tap_swap_fusable, tap_swap_fused_layers
+
     x = corr[..., None]
+    if symmetric and tap_swap_fusable(nc_params):
+        fused_l1, l2, l2s = tap_swap_fused_layers(nc_params)
+        y = _nc_stack_sharded([fused_l1], x, 3, n_shards)
+        # one halo exchange serves BOTH second-layer convs (the channel
+        # halves share the same hB neighborhood)
+        halo = l2["w"].shape[2] // 2
+        yp = _halo_pad(y, 3, halo, n_shards)
+        c = l2["w"].shape[4]
+        out = jax.nn.relu(
+            conv4d(yp[..., :c], l2["w"], l2["b"], pad_hb=False)
+        ) + jax.nn.relu(
+            conv4d(yp[..., c:], l2s["w"], l2s["b"], pad_hb=False)
+        )
+        return out[..., 0]
     out = _nc_stack_sharded(nc_params, x, 3, n_shards)
     if symmetric:
         xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))

@@ -233,3 +233,38 @@ def test_init_ncnet_rejects_mismatched_config():
     bad = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3, 3, 3), ncons_channels=(10, 1))
     with pytest.raises(ValueError, match="equal length"):
         models.init_ncnet(bad, jax.random.key(0))
+
+
+def test_symmetric_tap_swap_equals_transpose_form(rng):
+    """The rectangular symmetric fast path (tap-swapped kernels + fused
+    1-channel first layer; models/ncnet.py neigh_consensus) must equal the
+    transpose form ``stack(x) + stack(xT)^T`` it replaces — the algebraic
+    identity NC(xT)^T == NC_tap-swapped(x) for cubic kernels."""
+    from ncnet_tpu.models.ncnet import neigh_consensus, tap_swap_fusable
+    from ncnet_tpu import ops
+
+    nc_params = []
+    for ci, co, k in ((1, 6, 5), (6, 1, 3)):
+        nc_params.append({
+            "w": jnp.asarray(rng.standard_normal((k, k, k, k, ci, co))
+                             .astype(np.float32) * 0.2),
+            "b": jnp.asarray(rng.standard_normal(co).astype(np.float32) * 0.1),
+        })
+    assert tap_swap_fusable(nc_params)
+    # rectangular volume => the batch-fold branch cannot take it
+    corr = jnp.asarray(rng.standard_normal((2, 5, 7, 6, 4)).astype(np.float32))
+
+    got = neigh_consensus(nc_params, corr, symmetric=True)
+
+    def stack(x):
+        for layer in nc_params:
+            x = jax.nn.relu(ops.conv4d(x, layer["w"], layer["b"]))
+        return x
+
+    x = corr[..., None]
+    xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
+    want = (stack(x) + jnp.transpose(stack(xt), (0, 3, 4, 1, 2, 5)))[..., 0]
+    # identical math, different tap-summation order: float32 reassociation
+    # shows up at the ~1e-6 level (measured 3/1680 elements at 6.7e-6 abs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)

@@ -153,3 +153,22 @@ def test_spatial_filter_scaling_sanity(rng):
     # generous bound: virtual devices serialize the work, so the ratio is
     # (1x work + overhead) / 1x work; 3x means overhead ≤ 2x compute.
     assert t_shard < 3.0 * t_ref + 0.05, (t_shard, t_ref)
+
+
+def test_spatial_filter_parity_three_layer_transpose_form(rng):
+    """3-layer stacks are NOT tap_swap_fusable, so this pins the sharded
+    transposed-pass fallback (halo exchange along the volume's leading dim,
+    axis 1) that every 2-layer config now bypasses."""
+    from ncnet_tpu.models.ncnet import tap_swap_fusable
+
+    cfg = _volume_cfg(ncons_kernel_sizes=(3, 3, 3), ncons_channels=(4, 4, 1))
+    params = init_ncnet(cfg, jax.random.key(7))
+    assert not tap_swap_fusable(params["nc"])
+    corr = jnp.asarray(rng.standard_normal((1, 5, 7, 16, 6)).astype(np.float32))
+    mesh = _mesh(1, 4)
+    ref = ncnet_filter(cfg, params, corr).corr
+    got = jax.jit(
+        lambda p, c: parallel.spatial_filter(cfg, p, c, mesh).corr
+    )(params, corr)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
