@@ -262,60 +262,71 @@ def bench_jax(res=None):
     for k in [k for k, v in res.items() if v is None]:  # prune in place so a
         del res[k]  # shared res dict keeps already-captured metrics on retry
 
-    # train step (BASELINE north-star: image-pairs/sec; reference bs=16 —
-    # on a single 16G chip the largest fitting batch is used and reported,
-    # the full 16 sharding over ≥2 chips via the data mesh)
-    # measured: bs16 needs 20.8G fp32 (15.8G bf16) — skip its doomed multi-
-    # minute compile on 16G devices and start the ladder at the size that fits
-    batch_ladder = (16, 8, 4)
-    if "lite" in jax.devices()[0].device_kind:  # v5e/v6e: 16G HBM
-        batch_ladder = (8, 4)
-    if res.get("train_pairs_per_sec") is not None:
-        batch_ladder = ()  # a prior attempt already captured the train metric
-    for bs_try in batch_ladder:
+    # train step (BASELINE north-star: image-pairs/sec at the reference's
+    # bs=16 recipe, train.py:39-43).  The volume-chunked gradient-
+    # accumulation path (training/loss.py weak_loss_and_grads, r4) caps live
+    # memory at one chunk, so the full reference batch fits one 16G chip in
+    # BOTH precisions — the ladder is only a compile-failure fallback.
+    def measure_train(bs_try, half):
+        tcfg = TrainConfig(
+            model=cfg.replace(half_precision=half), batch_size=bs_try,
+            data_parallel=False,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            state, optimizer, mcfg, _ = training.create_train_state(tcfg)
+        step = training.make_train_step(
+            mcfg, optimizer, donate=False, stop_backbone_grad=True,
+            accum_chunks=tcfg.accum_chunks,
+        )
+
+        def train_out(src, tgt):
+            new_state, loss = step(
+                state, {"source_image": src, "target_image": tgt}
+            )
+            # consume the UPDATED trainable params, not just the loss —
+            # otherwise XLA dead-code-eliminates the whole backward pass
+            # + optimizer update and this measures a forward-only step
+            nc_dep = sum(
+                jnp.sum(leaf.astype(jnp.float32))
+                for layer in new_state.params["nc"]
+                for leaf in layer.values()
+            )
+            return loss.astype(jnp.float32) + nc_dep * 1e-6
+
+        ms = _timeit_scan(
+            chain_step(train_out), image_pair_input(bs_try), n_long=4, reps=3
+        )
+        if ms <= 0:  # all reps jitter-corrupted: don't emit garbage
+            raise RuntimeError(f"non-positive train timing {ms}")
+        return ms
+
+    for bs_try in ((16, 8, 4) if res.get("train_pairs_per_sec") is None
+                   else ()):
         try:
-            tcfg = TrainConfig(model=cfg, batch_size=bs_try, data_parallel=False)
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")
-                state, optimizer, mcfg, _ = training.create_train_state(tcfg)
-            step = training.make_train_step(
-                mcfg, optimizer, donate=False, stop_backbone_grad=True
-            )
-
-            def train_out(src, tgt):
-                new_state, loss = step(
-                    state, {"source_image": src, "target_image": tgt}
-                )
-                # consume the UPDATED trainable params, not just the loss —
-                # otherwise XLA dead-code-eliminates the whole backward pass
-                # + optimizer update and this measures a forward-only step
-                nc_dep = sum(
-                    jnp.sum(leaf.astype(jnp.float32))
-                    for layer in new_state.params["nc"]
-                    for leaf in layer.values()
-                )
-                return loss.astype(jnp.float32) + nc_dep * 1e-6
-
-            train_tick = chain_step(train_out)
-
-            ms = _timeit_scan(
-                train_tick, image_pair_input(bs_try), n_long=4, reps=3
-            )
-            if ms <= 0:  # all reps jitter-corrupted: don't emit garbage
-                raise RuntimeError(f"non-positive train timing {ms}")
+            ms = measure_train(bs_try, half=False)
             res["train_pairs_per_sec"] = bs_try / (ms * 1e-3)
             res["train_step_ms"] = ms
             res["train_batch_size"] = bs_try
             break
         except Exception as e:
-            # expected path: OOM at bs16 on a single 16G chip → retry smaller.
-            # Anything else is still printed so breakage can't hide as "didn't
-            # fit" (stdout stays reserved for the one JSON line).
+            # fallback path: a failed compile/OOM → retry smaller.  Anything
+            # else is still printed so breakage can't hide as "didn't fit"
+            # (stdout stays reserved for the one JSON line).
             import sys
 
             print(f"train bench bs={bs_try} failed: {str(e)[:200]}",
                   file=sys.stderr)
             continue
+    if res.get("train_pairs_per_sec_bf16") is None:
+        try:
+            bs_try = res.get("train_batch_size", 16)
+            ms = measure_train(bs_try, half=True)
+            res["train_pairs_per_sec_bf16"] = bs_try / (ms * 1e-3)
+        except Exception as e:
+            import sys
+
+            print(f"train bench bf16 failed: {str(e)[:200]}", file=sys.stderr)
     return res
 
 

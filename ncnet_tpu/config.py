@@ -75,10 +75,35 @@ class TrainConfig:
     nc_custom_grad: bool = False         # conv4d custom VJP: ~18% slower but
                                          # ~45% less backward temp memory
                                          # than plain AD (models/ncnet.py).
-                                         # Does NOT rescue bs16 fp32 on one
-                                         # 16G chip (compile still fails,
-                                         # tried r3); the bs16 recipe stays
-                                         # remat_nc_layers + half_precision
+                                         # Since r4 the default bs16 recipe
+                                         # is accum_chunks (below), which
+                                         # fits 16G in both precisions; this
+                                         # knob passes through to the
+                                         # chunked backward too
+    fold_pos_neg: bool = False           # one 2B-batch NC-filter call for the
+                                         # positive+negative volumes instead
+                                         # of two B-sized calls — identical
+                                         # math but measured NO faster (r4)
+                                         # and the larger program crashes the
+                                         # tunnel compile-helper at bs8 fp32;
+                                         # kept as an explicit knob only
+                                         # (training/loss.py)
+    remat_filter: bool = True            # jax.checkpoint around the NC filter
+                                         # (recompute volumes in the backward)
+    accum_chunks: int = -1               # frozen trunk only: exact
+                                         # volume-chunked gradient
+                                         # accumulation — scan the filter
+                                         # backward over chunks of the 2B
+                                         # pos/neg volume batch; fits and
+                                         # compiles ANY batch size, skips
+                                         # the remat recompute, and is the
+                                         # fastest measured path (bs8 fp32
+                                         # 9.75→13.4 pairs/s, bf16 16.6;
+                                         # tools/train_probe.py r4).
+                                         # -1 = auto chunking, 0 = off
+                                         # (whole-batch backward), >1 =
+                                         # explicit chunk count
+                                         # (training/loss.py)
     # static jit shapes need whole batches; dropping the val remainder (4 of
     # 308 PF-Pascal pairs at bs=16) makes best-checkpoint selection score a
     # fixed subset each epoch.  A documented deviation: the reference scores

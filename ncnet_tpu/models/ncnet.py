@@ -156,7 +156,7 @@ def neigh_consensus(
     *,
     symmetric: bool = True,
     remat_layers: bool = False,
-    custom_grad: bool = False,
+    custom_grad: "bool | Sequence[Dict[str, str]]" = False,
 ) -> jnp.ndarray:
     """Neighbourhood-consensus filtering of the 4D volume.
 
@@ -176,21 +176,45 @@ def neigh_consensus(
     (tools/vjp_probe.py, 25⁴ symmetric stack, fp32): ~18% SLOWER than XLA's
     plain transpose (56.9 vs 48.4 ms/pair at bs4) but ~45% less XLA temp
     memory (7.2 vs 12.7 GB) — a memory knob, cheaper per saved byte than
-    ``remat_layers``' ~30% step-time cost, not a speed default.
+    ``remat_layers``' ~30% step-time cost, not a speed default.  Instead of
+    ``True`` a per-layer routing may be given: a sequence (one entry per NC
+    layer) of ``{"dx": <variant>, "dw": <variant>}`` dicts passed to
+    :func:`ncnet_tpu.ops.conv4d.make_conv4d_same` (tools/vjp_sweep_probe.py
+    measures the combos composed).
     """
-    conv = conv4d_same if custom_grad else conv4d
+    if custom_grad is True:
+        convs = [conv4d_same] * len(nc_params)
+    elif isinstance(custom_grad, (list, tuple)):
+        # an (accidentally) empty routing list must hit the length check
+        # below, not silently mean "plain AD"
+        from ncnet_tpu.ops.conv4d import make_conv4d_same
 
-    def one_layer(w, b, x):
-        return jax.nn.relu(conv(x, w, b))
+        if len(custom_grad) != len(nc_params):
+            raise ValueError(
+                f"custom_grad routing has {len(custom_grad)} entries for "
+                f"{len(nc_params)} NC layers"
+            )
+        convs = [
+            conv4d if spec is None else
+            make_conv4d_same(spec.get("dx", "auto"), spec.get("dw", "coutfold"))
+            for spec in custom_grad
+        ]
+    else:
+        convs = [conv4d] * len(nc_params)
 
-    if remat_layers:
-        one_layer = jax.checkpoint(one_layer)
+    def make_layer(i):
+        def one_layer(w, b, x):
+            return jax.nn.relu(convs[i](x, w, b))
+
+        return jax.checkpoint(one_layer) if remat_layers else one_layer
+
+    layers = [make_layer(i) for i in range(len(nc_params))]
 
     def stack(x: jnp.ndarray) -> jnp.ndarray:
         # every layer takes and emits the plain channels-last volume;
         # conv4d's 'auto' chooser (ops/conv4d.py) is the single authority
         # for the per-layer MXU formulation
-        for layer in nc_params:
+        for one_layer, layer in zip(layers, nc_params):
             x = one_layer(layer["w"], layer["b"], x)
         return x
 
@@ -235,11 +259,11 @@ def neigh_consensus(
             # (123), so only the measured 2-layer shape class takes this
             # path (deeper stacks keep the transpose form).
             fused_l1, l2, l2s = tap_swap_fused_layers(nc_params)
-            y = one_layer(fused_l1["w"], fused_l1["b"], x)  # 1 → 2C, one pass
+            y = layers[0](fused_l1["w"], fused_l1["b"], x)  # 1 → 2C, one pass
             c = nc_params[0]["w"].shape[5]
             out = (
-                one_layer(l2["w"], l2["b"], y[..., :c])
-                + one_layer(l2s["w"], l2s["b"], y[..., c:])
+                layers[1](l2["w"], l2["b"], y[..., :c])
+                + layers[1](l2s["w"], l2s["b"], y[..., c:])
             )
         else:
             xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))

@@ -444,9 +444,17 @@ def conv4d_transpose_weights(weight: jnp.ndarray) -> jnp.ndarray:
     return jnp.transpose(weight[::-1, ::-1, ::-1, ::-1], (0, 1, 2, 3, 5, 4))
 
 
-@jax.custom_vjp
-def conv4d_same(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray):
-    """Same-padded ``conv4d`` with an optimized backward pass.
+# Formulation whose XLA transpose computes the weight gradient.  Measured on
+# v5e at the 25⁴ symmetric stack (tools/vjp_probe.py, bs8 fp32, ms/pair /
+# XLA temp): coutfold 55.8 / 12.4G beats tapfold 73.4 / 13.7G and unroll
+# 89.0 / 13.3G — unroll additionally makes XLA pick channel-minor layouts
+# padded 8-10x for whole-volume relu/copy temporaries.
+_DW_VARIANT = "coutfold"
+
+
+@functools.lru_cache(maxsize=None)
+def make_conv4d_same(dx_variant: str = "auto", dw_variant: str = _DW_VARIANT):
+    """Same-padded ``conv4d`` with an explicitly-routed backward pass.
 
     Forward is exactly ``conv4d(x, weight, bias)`` (auto variant).  The
     difference is under autodiff: XLA's mechanical transpose of the fastest
@@ -456,56 +464,54 @@ def conv4d_same(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray):
     formulation instead:
 
       * ``dx``  — itself a same-padded conv4d: ``conv4d(g, flipped/swapped
-        weights)``, which re-enters the auto variant chooser with the
-        *gradient's* channel shape (a 16→1 layer's dx is a 1→16 conv →
-        tapfold, etc.).
-      * ``dw``  — AD of the ``_DW_VARIANT`` formulation (measured choice,
+        weights, variant=dx_variant)``; the default ``'auto'`` re-enters the
+        variant chooser with the *gradient's* channel shape (a 16→1 layer's
+        dx is a 1→16 conv → tapfold, etc.).
+      * ``dw``  — AD of the ``dw_variant`` formulation (measured default,
         see tools/vjp_probe.py; demoted to ``unroll`` past the
         channel-folding memory gate).
       * ``db``  — a plain sum reduction.
 
     Odd kernel sizes only (the reference's only case) — asserted, because
-    the dx identity above needs them.
+    the dx identity above needs them.  The factory is cached so each
+    (dx, dw) routing is ONE custom_vjp primitive (stable jit caching).
     """
-    return conv4d(x, weight, bias)
+
+    @jax.custom_vjp
+    def _conv4d_same(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray):
+        return conv4d(x, weight, bias)
+
+    def _fwd(x, weight, bias):
+        assert all(k % 2 == 1 for k in weight.shape[:4]), (
+            "conv4d_same requires odd kernel sizes (same-padding transpose)"
+        )
+        return conv4d(x, weight, bias), (x, weight)
+
+    def _bwd(res, g):
+        x, weight = res
+        dx = conv4d(g, conv4d_transpose_weights(weight), variant=dx_variant)
+        dwv = dw_variant
+        # honor the same channel-folding memory gate as the forward
+        # auto-chooser: at volumes where the kA·ch whole-volume copy cannot
+        # fit, demote to the 1x-footprint unroll formulation
+        fold_ch = {"coutfold": weight.shape[5], "tapfold": weight.shape[4],
+                   "afold": weight.shape[1] * weight.shape[5]}.get(dwv)
+        if fold_ch is not None and not conv4d_fold_fits(
+            x.shape[0], x.shape[1], x.shape[2], x.shape[3], x.shape[4],
+            weight.shape[0], fold_ch, x.dtype,
+        ):
+            dwv = "unroll"
+        _, w_vjp = jax.vjp(lambda ww: conv4d(x, ww, variant=dwv), weight)
+        (dw,) = w_vjp(g)
+        db = jnp.sum(g, axis=(0, 1, 2, 3, 4))
+        return dx, dw, db
+
+    _conv4d_same.defvjp(_fwd, _bwd)
+    return _conv4d_same
 
 
-def _conv4d_same_fwd(x, weight, bias):
-    assert all(k % 2 == 1 for k in weight.shape[:4]), (
-        "conv4d_same requires odd kernel sizes (same-padding transpose)"
-    )
-    return conv4d(x, weight, bias), (x, weight)
-
-
-# Formulation whose XLA transpose computes the weight gradient.  Measured on
-# v5e at the 25⁴ symmetric stack (tools/vjp_probe.py, bs8 fp32, ms/pair /
-# XLA temp): coutfold 55.8 / 12.4G beats tapfold 73.4 / 13.7G and unroll
-# 89.0 / 13.3G — unroll additionally makes XLA pick channel-minor layouts
-# padded 8-10x for whole-volume relu/copy temporaries.
-_DW_VARIANT = "coutfold"
-
-
-def _conv4d_same_bwd(res, g):
-    x, weight = res
-    dx = conv4d(g, conv4d_transpose_weights(weight))
-    dw_variant = _DW_VARIANT
-    # honor the same channel-folding memory gate as the forward auto-chooser:
-    # at volumes where the kA·ch whole-volume copy cannot fit, demote to the
-    # 1x-footprint unroll formulation
-    fold_ch = {"coutfold": weight.shape[5], "tapfold": weight.shape[4],
-               "afold": weight.shape[1] * weight.shape[5]}.get(dw_variant)
-    if fold_ch is not None and not conv4d_fold_fits(
-        x.shape[0], x.shape[1], x.shape[2], x.shape[3], x.shape[4],
-        weight.shape[0], fold_ch, x.dtype,
-    ):
-        dw_variant = "unroll"
-    _, w_vjp = jax.vjp(lambda ww: conv4d(x, ww, variant=dw_variant), weight)
-    (dw,) = w_vjp(g)
-    db = jnp.sum(g, axis=(0, 1, 2, 3, 4))
-    return dx, dw, db
-
-
-conv4d_same.defvjp(_conv4d_same_fwd, _conv4d_same_bwd)
+#: the default routing (kept as a module-level callable for back-compat)
+conv4d_same = make_conv4d_same()
 
 
 def conv4d_init(

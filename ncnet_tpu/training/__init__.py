@@ -1,6 +1,12 @@
 """Training: weak-supervision loss, jitted steps, epoch loop, checkpoints."""
 
-from ncnet_tpu.training.loss import match_score, weak_loss
+from ncnet_tpu.training.loss import (
+    auto_accum_chunks,
+    match_score,
+    match_score_per_pair,
+    weak_loss,
+    weak_loss_and_grads,
+)
 from ncnet_tpu.training.train import (
     TrainState,
     create_train_state,
@@ -22,9 +28,12 @@ __all__ = [
     "make_eval_step",
     "make_optimizer",
     "make_train_step",
+    "auto_accum_chunks",
     "match_score",
+    "match_score_per_pair",
     "process_epoch",
     "save_train_checkpoint",
     "trainable_labels",
     "weak_loss",
+    "weak_loss_and_grads",
 ]

@@ -15,10 +15,11 @@ roughly half the FLOPs (the backbone dominates at 400²).
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ncnet_tpu.config import ModelConfig
 from ncnet_tpu.models.ncnet import extract_features, ncnet_filter
@@ -35,23 +36,31 @@ def _normalize(x: jnp.ndarray, axis: int, normalization: str) -> jnp.ndarray:
     raise ValueError(f"unknown normalization {normalization!r}")
 
 
-def match_score(corr: jnp.ndarray, normalization: str = "softmax") -> jnp.ndarray:
-    """Mean best-match score of a filtered volume, averaged over both
+def match_score_per_pair(
+    corr: jnp.ndarray, normalization: str = "softmax"
+) -> jnp.ndarray:
+    """Per-pair best-match score of a filtered volume, averaged over both
     matching directions (train.py:125-134).
 
     Args:
       corr: ``(B, hA, wA, hB, wB)``.
     Returns:
-      scalar score (mean over batch, cells, directions).
+      ``(B,)`` scores (mean over cells and directions per pair).
     """
     b, ha, wa, hb, wb = corr.shape
     # B→A direction: distribution over A cells for each B cell
     nc_b = _normalize(corr.reshape(b, ha * wa, hb, wb), 1, normalization)
     # A→B direction: distribution over B cells for each A cell
     nc_a = _normalize(corr.reshape(b, ha, wa, hb * wb), 3, normalization)
-    scores_b = jnp.max(nc_b, axis=1)          # (B, hB, wB)
-    scores_a = jnp.max(nc_a, axis=3)          # (B, hA, wA)
-    return jnp.mean(scores_a + scores_b) / 2.0
+    scores_b = jnp.mean(jnp.max(nc_b, axis=1), axis=(1, 2))  # (B,)
+    scores_a = jnp.mean(jnp.max(nc_a, axis=3), axis=(1, 2))  # (B,)
+    return (scores_a + scores_b) / 2.0
+
+
+def match_score(corr: jnp.ndarray, normalization: str = "softmax") -> jnp.ndarray:
+    """Batch-mean of :func:`match_score_per_pair` (the reference's scalar
+    pair score, train.py:125-134)."""
+    return jnp.mean(match_score_per_pair(corr, normalization))
 
 
 def weak_loss(
@@ -62,6 +71,8 @@ def weak_loss(
     stop_backbone_grad: bool = False,
     remat_nc_layers: bool = False,
     nc_custom_grad: bool = False,
+    fold_pos_neg: bool = False,
+    remat_filter: bool = True,
 ) -> jnp.ndarray:
     """score(negative) − score(positive) on an image-pair batch.
 
@@ -92,6 +103,21 @@ def weak_loss(
     ``nc_custom_grad``: the other memory knob — conv4d's custom VJP, ~18%
     slower but ~45% less temp memory than plain AD (see
     :func:`ncnet_tpu.models.ncnet.neigh_consensus`).
+
+    ``fold_pos_neg``: run the positive and negative volumes through ONE
+    NC-filter call at batch 2B instead of two B-sized calls.  Identical
+    math (the filter is per-volume; batching does not reassociate), but the
+    doubled batch fills the MXU better and the backward transposes one
+    program instead of two.  Composes with the square-volume symmetric
+    batch fold in ``neigh_consensus`` (→ 4B).  Measured on v5e
+    (tools/train_probe.py r4, 400²): NO faster (bs4 fp32 405.9 vs 390.0 ms
+    base), and the doubled whole-batch backward program crashes the tunnel
+    compile-helper at bs8 fp32 — default off; the fast path is
+    :func:`weak_loss_and_grads` instead.
+
+    ``remat_filter``: wrap the NC filter in ``jax.checkpoint`` so the
+    backward recomputes the volume intermediates instead of storing them
+    (the round-2 memory default).
     """
     fa = extract_features(config, params, batch["source_image"])
     fb = extract_features(config, params, batch["target_image"])
@@ -102,15 +128,134 @@ def weak_loss(
         fa = fa.astype(jnp.bfloat16)
         fb = fb.astype(jnp.bfloat16)
 
-    filt = jax.checkpoint(
-        lambda p, corr: ncnet_filter(
+    def filt(p, corr):
+        return ncnet_filter(
             config, p, corr, remat_nc_layers=remat_nc_layers,
             nc_custom_grad=nc_custom_grad,
         ).corr
-    )
-    corr_pos = filt(params, correlation_4d(fa, fb))
-    corr_neg = filt(params, correlation_4d(jnp.roll(fa, -1, axis=0), fb))
 
-    score_pos = match_score(corr_pos, normalization)
-    score_neg = match_score(corr_neg, normalization)
+    if remat_filter:
+        filt = jax.checkpoint(filt)
+    corr_pos = correlation_4d(fa, fb)
+    corr_neg = correlation_4d(jnp.roll(fa, -1, axis=0), fb)
+
+    if fold_pos_neg:
+        b = corr_pos.shape[0]
+        nc = filt(params, jnp.concatenate([corr_pos, corr_neg], axis=0))
+        scores = match_score_per_pair(nc, normalization)  # (2B,)
+        return jnp.mean(scores[b:]) - jnp.mean(scores[:b])
+
+    score_pos = match_score(filt(params, corr_pos), normalization)
+    score_neg = match_score(filt(params, corr_neg), normalization)
     return score_neg - score_pos
+
+
+def auto_accum_chunks(batch_size: int, n_dev: int = 1) -> int:
+    """Chunk count for :func:`weak_loss_and_grads`: target chunk size of
+    FOUR volumes — the fastest measured on v5e at the PF-Pascal 25⁴ workload
+    across bs8/bs16 × fp32/bf16 (tools/train_probe.py r4: chunk-4 beats
+    chunk-8 and chunk-16 in every cell, e.g. bf16 bs8 481.8 vs 542.5 ms) —
+    rounded up to a multiple of the data-parallel device count so the
+    sharded pair axis still divides.  The DATA-PARALLEL caller must pass
+    ``n_dev`` itself (``fit`` does); :func:`weak_loss_and_grads`' own ``-1``
+    resolution assumes a single device."""
+    n2 = 2 * batch_size
+    target = max(4, n_dev)
+    # nearest feasible chunk size to the target: a multiple of n_dev that
+    # divides 2B — search below the target first (smaller chunks measured
+    # no worse and use less memory), then above, else one whole chunk
+    for c in list(range(target, n_dev - 1, -1)) + list(range(target + 1, n2)):
+        if c > 0 and n2 % c == 0 and c % n_dev == 0:
+            return n2 // c
+    return 1
+
+
+def weak_loss_and_grads(
+    config: ModelConfig,
+    params,
+    batch: Dict[str, jnp.ndarray],
+    normalization: str = "softmax",
+    accum_chunks: int = -1,
+    remat_nc_layers: bool = False,
+    nc_custom_grad: bool = False,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Exact :func:`weak_loss` value AND parameter gradients via
+    volume-chunked gradient accumulation — the frozen-trunk fast path.
+
+    With the trunk frozen (the reference's default training mode,
+    /root/reference/train.py:60-63 with ``fe_finetune_params=0``), the loss
+    is LINEAR in per-volume scores: ``mean(score(neg)) − mean(score(pos))``.
+    So: extract features once for the whole batch (no gradient), build the
+    2B-volume score list (B positives weighted −1/B, B rolled negatives
+    weighted +1/B, the global-batch roll of train.py:137), and
+    ``lax.scan`` the NC-filter forward+backward over ``accum_chunks``
+    chunks of it, summing parameter grads.  Exact — chunking a weighted sum
+    reassociates nothing across chunks — and the compiled program holds ONE
+    chunk's filter backward, which:
+
+      * sidesteps the tunnel-toolchain compile-crash at large whole-batch
+        backward programs (bs8 fp32 / bs16 bf16 un-rematted forms crash
+        ``tpu_compile_helper``; measured r4),
+      * needs no ``jax.checkpoint`` recompute (the round-3 default burned
+        ~25% of the step rematerializing the filter; tools/train_probe.py),
+      * caps live memory at one chunk regardless of batch size — the
+        reference's bs16 recipe fits a 16G chip without the
+        ``remat_nc_layers`` throughput penalty.
+
+    Backbone gradient leaves come back as zeros (the trunk is detached),
+    matching the ``optax.multi_transform`` frozen partition in
+    training/train.py.  Requires ``2 * B % accum_chunks == 0``.
+    """
+    fa = extract_features(config, params, batch["source_image"])
+    fb = extract_features(config, params, batch["target_image"])
+    fa = jax.lax.stop_gradient(fa)
+    fb = jax.lax.stop_gradient(fb)
+    if config.half_precision:
+        fa = fa.astype(jnp.bfloat16)
+        fb = fb.astype(jnp.bfloat16)
+
+    b = fa.shape[0]
+    n2 = 2 * b
+    if accum_chunks == -1:
+        accum_chunks = auto_accum_chunks(b)
+    if n2 % accum_chunks:
+        raise ValueError(
+            f"accum_chunks={accum_chunks} must divide 2*batch={n2}"
+        )
+    fa2 = jnp.concatenate([fa, jnp.roll(fa, -1, axis=0)], axis=0)
+    fb2 = jnp.concatenate([fb, fb], axis=0)
+    w2 = jnp.concatenate(
+        [jnp.full((b,), -1.0 / b), jnp.full((b,), 1.0 / b)]
+    )
+
+    def chunk_loss(nc_params, fac, fbc, wc):
+        p = {**params, "nc": nc_params}
+        nc = ncnet_filter(
+            config, p, correlation_4d(fac, fbc),
+            remat_nc_layers=remat_nc_layers, nc_custom_grad=nc_custom_grad,
+        ).corr
+        return jnp.sum(match_score_per_pair(nc, normalization) * wc)
+
+    c = n2 // accum_chunks
+    chunked = lambda x: x.reshape(accum_chunks, c, *x.shape[1:])  # noqa: E731
+
+    def body(acc, xs):
+        fac, fbc, wc = xs
+        val, g_nc = jax.value_and_grad(chunk_loss)(params["nc"], fac, fbc, wc)
+        return (
+            acc[0] + val,
+            jax.tree.map(jnp.add, acc[1], g_nc),
+        ), None
+
+    zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params["nc"]))
+    (loss, g_nc), _ = lax.scan(
+        body, zero, (chunked(fa2), chunked(fb2), chunked(w2))
+    )
+    # zero gradients for the (detached) trunk — the optax frozen partition
+    # expects the full param tree structure
+    grads = {
+        **jax.tree.map(jnp.zeros_like, {k: v for k, v in params.items()
+                                        if k != "nc"}),
+        "nc": g_nc,
+    }
+    return loss, grads

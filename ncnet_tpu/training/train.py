@@ -37,7 +37,11 @@ from ncnet_tpu.data import DataLoader, ImagePairDataset
 from ncnet_tpu.models import backbone as bb
 from ncnet_tpu.models import checkpoint as ckpt_io
 from ncnet_tpu.models.ncnet import init_ncnet
-from ncnet_tpu.training.loss import weak_loss
+from ncnet_tpu.training.loss import (
+    auto_accum_chunks,
+    weak_loss,
+    weak_loss_and_grads,
+)
 from ncnet_tpu.utils.profiling import annotate, maybe_trace
 
 
@@ -97,6 +101,9 @@ def make_train_step(
     stop_backbone_grad: bool = False,
     remat_nc_layers: bool = False,
     nc_custom_grad: bool = False,
+    fold_pos_neg: bool = False,
+    remat_filter: bool = True,
+    accum_chunks: int = 0,
 ):
     """Jitted (state, batch) → (state, loss).
 
@@ -106,17 +113,40 @@ def make_train_step(
     backward pass off the trunk activations entirely — required to fit the
     reference batch sizes at 400² on one chip.  It must stay False when
     finetuning, so False is the (safe) default; ``fit`` derives it from the
-    config."""
+    config.
+
+    ``accum_chunks != 0`` (frozen trunk only) switches to
+    :func:`ncnet_tpu.training.loss.weak_loss_and_grads` — exact
+    volume-chunked gradient accumulation, the fastest path and the one that
+    fits/compiles any batch size (see its docstring for the measurements);
+    ``-1`` = auto chunk choice."""
+
+    if accum_chunks != 0 and not stop_backbone_grad:
+        raise ValueError(
+            "accum_chunks requires the frozen trunk (fe_finetune_params=0): "
+            "chunked accumulation detaches the features"
+        )
 
     def step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: weak_loss(
-                model_config, p, batch,
-                stop_backbone_grad=stop_backbone_grad,
+        if accum_chunks != 0:
+            # the memory knobs pass through (fold_pos_neg/remat_filter do
+            # not apply: chunking already bounds the live volume set)
+            loss, grads = weak_loss_and_grads(
+                model_config, state.params, batch, accum_chunks=accum_chunks,
                 remat_nc_layers=remat_nc_layers,
                 nc_custom_grad=nc_custom_grad,
             )
-        )(state.params)
+        else:
+            loss, grads = jax.value_and_grad(
+                lambda p: weak_loss(
+                    model_config, p, batch,
+                    stop_backbone_grad=stop_backbone_grad,
+                    remat_nc_layers=remat_nc_layers,
+                    nc_custom_grad=nc_custom_grad,
+                    fold_pos_neg=fold_pos_neg,
+                    remat_filter=remat_filter,
+                )
+            )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
@@ -363,6 +393,14 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         stop_backbone_grad=config.fe_finetune_params == 0,
         remat_nc_layers=config.remat_nc_layers,
         nc_custom_grad=config.nc_custom_grad,
+        fold_pos_neg=config.fold_pos_neg,
+        remat_filter=config.remat_filter,
+        accum_chunks=(
+            (auto_accum_chunks(config.batch_size,
+                               n_dev if config.data_parallel else 1)
+             if config.accum_chunks == -1 else config.accum_chunks)
+            if config.fe_finetune_params == 0 else 0
+        ),
     )
     eval_step = make_eval_step(model_config)
 

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Split the symmetric NC stack's backward cost into dx vs dw.
+
+  nc_fwd     forward only
+  nc_dx      grad w.r.t. the input volume, params stopped  (dx chain x3)
+  nc_dw      grad w.r.t. params                            (dw x3 + dx x2)
+  nc_both    grad w.r.t. both
+
+Usage: python tools/nc_grad_split_probe.py [batch] [dtype]
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+from ncnet_tpu.models.ncnet import neigh_consensus  # noqa: E402
+from ncnet_tpu.ops import conv4d_init, correlation_4d  # noqa: E402
+from ncnet_tpu.ops.norm import feature_l2_norm  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+DT = jnp.bfloat16 if (len(sys.argv) > 2 and sys.argv[2] == "bf16") else jnp.float32
+S, C = 25, 1024
+
+
+def init_params(key):
+    ks = jax.random.split(key, 3)
+    chans = [(1, 16), (16, 16), (16, 1)]
+    return [
+        dict(zip(("w", "b"), conv4d_init(k, 5, ci, co)))
+        for k, (ci, co) in zip(ks, chans)
+    ]
+
+
+def stack_loss(params, corr):
+    params = jax.tree.map(lambda x: x.astype(DT), params)
+    out = neigh_consensus(params, corr, symmetric=True)
+    return jnp.mean(out.astype(jnp.float32))
+
+
+def main():
+    params0 = init_params(jax.random.key(7))
+
+    for variant in ("nc_fwd", "nc_dx", "nc_dw", "nc_both"):
+
+        def tick(carry, _v=variant):
+            fa, fb, params = carry
+            corr = correlation_4d(fa, fb).astype(DT)
+            if _v == "nc_fwd":
+                val = stack_loss(params, corr)
+                gp, gc = None, None
+            elif _v == "nc_dx":
+                val, gc = jax.value_and_grad(
+                    lambda c: stack_loss(jax.lax.stop_gradient(params), c)
+                )(corr)
+                gp = None
+            elif _v == "nc_dw":
+                val, gp = jax.value_and_grad(stack_loss)(params, corr)
+                gc = None
+            else:
+                val, (gp, gc) = jax.value_and_grad(stack_loss, argnums=(0, 1))(
+                    params, corr)
+            fa = fa + (val * 1e-9).astype(fa.dtype)
+            if gc is not None:
+                fa = fa + (jnp.sum(gc.astype(jnp.float32)) * 1e-12).astype(fa.dtype)
+            if gp is not None:
+                params = jax.tree.map(
+                    lambda p, gg: p + (jnp.sum(gg.astype(jnp.float32)) * 1e-12
+                                       ).astype(p.dtype), params, gp)
+            return (fa, fb, params)
+
+        def make_input(key):
+            k1, k2 = jax.random.split(key)
+            fa = feature_l2_norm(jax.random.normal(k1, (B, S, S, C), jnp.float32))
+            fb = feature_l2_norm(jax.random.normal(k2, (B, S, S, C), jnp.float32))
+            return (fa, fb, params0)
+
+        try:
+            ms = timeit(tick, make_input, n_long=4, reps=3)
+            print(f"{variant:8s} {ms:8.1f} ms/step  {ms / B:6.2f} ms/pair",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{variant:8s} FAILED: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+
+
+# -- appended sweep: plain AD vs custom VJP for the full stack grad ---------
+def stack_loss_custom(params, corr):
+    params = jax.tree.map(lambda x: x.astype(DT), params)
+    out = neigh_consensus(params, corr, symmetric=True, custom_grad=True)
+    return jnp.mean(out.astype(jnp.float32))
+
+
+def main2():
+    params0 = init_params(jax.random.key(7))
+    for name, fn in (("plain", stack_loss), ("custom", stack_loss_custom)):
+
+        def tick(carry, _fn=fn):
+            fa, fb, params = carry
+            corr = correlation_4d(fa, fb).astype(DT)
+            val, (gp, gc) = jax.value_and_grad(_fn, argnums=(0, 1))(params, corr)
+            fa = fa + (val * 1e-9 + jnp.sum(gc.astype(jnp.float32)) * 1e-12
+                       ).astype(fa.dtype)
+            params = jax.tree.map(
+                lambda p, gg: p + (jnp.sum(gg.astype(jnp.float32)) * 1e-12
+                                   ).astype(p.dtype), params, gp)
+            return (fa, fb, params)
+
+        def make_input(key):
+            k1, k2 = jax.random.split(key)
+            fa = feature_l2_norm(jax.random.normal(k1, (B, S, S, C), jnp.float32))
+            fb = feature_l2_norm(jax.random.normal(k2, (B, S, S, C), jnp.float32))
+            return (fa, fb, params0)
+
+        try:
+            ms = timeit(tick, make_input, n_long=4, reps=3)
+            print(f"{name:8s} {ms:8.1f} ms/step  {ms / B:6.2f} ms/pair", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:8s} FAILED: {str(e)[:200]}", flush=True)
+
+
+main2 = main2  # noqa: PLW0127

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Attribute the training-step backward cost stage by stage.
+
+Times ``value_and_grad`` (w.r.t. the NC params) of ablated variants of the
+weak loss, with the backbone replaced by on-device random L2-normalized
+features (so the volume is BORN from the production einsum — probe hygiene)
+— isolating, by differences:
+
+  full        corr(pos+neg) → [fold/seq] filter → per-pair scores
+  one_vol     positive volume only (halves the filter work)
+  no_mm       mutual_matching removed before+after the NC stack
+  mean_score  softmax/max score replaced by a plain volume mean
+  nc_only     bare symmetric NC stack + mean (no mm, no corr pairing)
+
+Usage: python tools/train_attr_probe.py [batch] [dtype] [fold:y/n]
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+from ncnet_tpu.models.ncnet import neigh_consensus  # noqa: E402
+from ncnet_tpu.ops import conv4d_init, correlation_4d, mutual_matching  # noqa: E402
+from ncnet_tpu.ops.norm import feature_l2_norm  # noqa: E402
+from ncnet_tpu.training.loss import match_score_per_pair  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+DT = jnp.bfloat16 if (len(sys.argv) > 2 and sys.argv[2] == "bf16") else jnp.float32
+FOLD = len(sys.argv) > 3 and sys.argv[3] == "y"
+S, C = 25, 1024
+
+
+def init_params(key):
+    ks = jax.random.split(key, 3)
+    chans = [(1, 16), (16, 16), (16, 1)]
+    return [
+        dict(zip(("w", "b"), conv4d_init(k, 5, ci, co)))
+        for k, (ci, co) in zip(ks, chans)
+    ]
+
+
+def make_loss(variant):
+    def filt(params, corr, with_mm=True):
+        if with_mm:
+            corr = mutual_matching(corr)
+        corr = neigh_consensus(params, corr, symmetric=True)
+        if with_mm:
+            corr = mutual_matching(corr)
+        return corr
+
+    def loss(params, fa, fb):
+        params = jax.tree.map(lambda x: x.astype(DT), params)
+        corr_p = correlation_4d(fa, fb).astype(DT)
+        if variant == "nc_only":
+            out = neigh_consensus(params, corr_p, symmetric=True)
+            return jnp.mean(out.astype(jnp.float32))
+        if variant == "one_vol":
+            return -jnp.mean(match_score_per_pair(filt(params, corr_p)))
+        corr_n = correlation_4d(jnp.roll(fa, -1, axis=0), fb).astype(DT)
+        with_mm = variant != "no_mm"
+        if FOLD:
+            nc = filt(params, jnp.concatenate([corr_p, corr_n], axis=0), with_mm)
+            if variant == "mean_score":
+                return jnp.mean(nc[B:].astype(jnp.float32)) - jnp.mean(
+                    nc[:B].astype(jnp.float32))
+            s = match_score_per_pair(nc)
+            return jnp.mean(s[B:]) - jnp.mean(s[:B])
+        nc_p = filt(params, corr_p, with_mm)
+        nc_n = filt(params, corr_n, with_mm)
+        if variant == "mean_score":
+            return jnp.mean(nc_n.astype(jnp.float32)) - jnp.mean(
+                nc_p.astype(jnp.float32))
+        return jnp.mean(match_score_per_pair(nc_n)) - jnp.mean(
+            match_score_per_pair(nc_p))
+
+    return loss
+
+
+def main():
+    params0 = init_params(jax.random.key(7))
+
+    for variant in ("full", "one_vol", "no_mm", "mean_score", "nc_only"):
+        loss = make_loss(variant)
+
+        def tick(carry, _loss=loss):
+            fa, fb, params = carry
+            val, g = jax.value_and_grad(_loss)(params, fa, fb)
+            fa = fa + (val * 1e-9).astype(fa.dtype)
+            params = jax.tree.map(
+                lambda p, gg: p + (jnp.sum(gg.astype(jnp.float32)) * 1e-12
+                                   ).astype(p.dtype), params, g)
+            return (fa, fb, params)
+
+        def make_input(key):
+            k1, k2 = jax.random.split(key)
+            fa = feature_l2_norm(jax.random.normal(k1, (B, S, S, C), jnp.float32))
+            fb = feature_l2_norm(jax.random.normal(k2, (B, S, S, C), jnp.float32))
+            return (fa, fb, params0)
+
+        try:
+            ms = timeit(tick, make_input, n_long=4, reps=3)
+            print(f"{variant:12s} {ms:8.1f} ms/step  {ms / B:6.2f} ms/pair",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{variant:12s} FAILED: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Time the COMPOSED production train step across backward-path knobs.
+
+The round-3 verdict localized the training cost in the backward (816 ms/step
+@ bs8 fp32 vs ~94 ms of batch forward) and prescribed two levers: folding the
+positive/negative volumes into one 2B-batch filter pass, and per-layer
+gradient-formulation choice.  This probe measures the real
+``make_train_step`` program (donated state, optimizer included) under each
+knob combination, per the probe law: standalone numbers are hypotheses only —
+the composed program is the unit of measurement.
+
+Usage: python tools/train_probe.py [batch] [dtype] [combo ...]
+  combo: name=fold,remat_filter,remat_layers,custom  (y/n each), e.g.
+         base=n,y,n,n fold=y,y,n,n fold_noremat=y,n,n,n
+  default sweep: base, fold, noremat, fold_noremat
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+from ncnet_tpu.config import ModelConfig, TrainConfig  # noqa: E402
+from ncnet_tpu.training import train as tr  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+DT_HALF = len(sys.argv) > 2 and sys.argv[2] == "bf16"
+SIZE = 400
+
+COMBOS = []
+for arg in sys.argv[3:]:
+    name, spec = arg.split("=")
+    parts = spec.split(",")
+    fold, rf, rl, cg = [s == "y" for s in parts[:4]]
+    chunks = int(parts[4]) if len(parts) > 4 else 0
+    COMBOS.append((name, fold, rf, rl, cg, chunks))
+if not COMBOS:
+    COMBOS = [
+        ("base", False, True, False, False, 0),
+        ("fold", True, True, False, False, 0),
+        ("noremat", False, False, False, False, 0),
+        ("fold_noremat", True, False, False, False, 0),
+    ]
+
+
+def main():
+    mcfg = ModelConfig(
+        ncons_kernel_sizes=(5, 5, 5), ncons_channels=(16, 16, 1),
+        half_precision=DT_HALF,
+    )
+    tcfg = TrainConfig(model=mcfg, batch_size=B, data_parallel=False)
+    state, optimizer, mcfg, _ = tr.create_train_state(tcfg)
+    params = state.params
+
+    for name, fold, rf, rl, cg, chunks in COMBOS:
+        step = tr.make_train_step(
+            mcfg, optimizer, donate=False,  # scan carry already reuses buffers
+            stop_backbone_grad=True, remat_nc_layers=rl, nc_custom_grad=cg,
+            fold_pos_neg=fold, remat_filter=rf, accum_chunks=chunks,
+        )
+
+        def tick(carry, _step=step):
+            # src is leaves[0] (the harness's consumed output): fold the loss
+            # AND a trainable-param summary into it so neither the filter
+            # backward nor the optimizer update can be DCE'd out of the scan
+            src, tgt, st = carry
+            st2, loss = _step(st, {"source_image": src, "target_image": tgt})
+            psum = jnp.sum(st2.params["nc"][0]["w"].astype(jnp.float32))
+            src = src + (loss * 1e-9 + psum * 1e-12).astype(src.dtype)
+            return (src, tgt, st2)
+
+        def make_input(key):
+            k1, k2 = jax.random.split(key)
+            src = jax.random.uniform(k1, (B, SIZE, SIZE, 3), jnp.float32)
+            tgt = jax.random.uniform(k2, (B, SIZE, SIZE, 3), jnp.float32)
+            return (src, tgt, state)
+
+        try:
+            ms = timeit(tick, make_input, n_long=4, reps=3)
+            print(f"{name:16s} {ms:8.1f} ms/step  {B / (ms * 1e-3):6.2f} pairs/s",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 — print and continue the sweep
+            print(f"{name:16s} FAILED: {str(e)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Sweep per-layer (dx, dw) gradient-formulation routings COMPOSED in the
+symmetric NC stack (value_and_grad w.r.t. params AND the volume — the
+training chain).
+
+Round-3 measured only a GLOBAL dw choice (custom ~= plain); the grad-split
+probe (tools/nc_grad_split_probe.py, bf16 bs8) shows dx ~= 50 ms and
+dw ~= 50 ms per application vs ~23 ms of forward — both ~2x their FLOP
+cost — so this probe hunts a better routing per layer.
+
+Usage: python tools/vjp_sweep_probe.py [batch] [dtype] [spec ...]
+  spec: name=dx0/dw0,dx1/dw1,dx2/dw2   ('-' = plain AD for that layer)
+  default: plain, all-custom-default, dx sweeps, dw sweeps
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+from ncnet_tpu.models.ncnet import neigh_consensus  # noqa: E402
+from ncnet_tpu.ops import conv4d_init, correlation_4d  # noqa: E402
+from ncnet_tpu.ops.norm import feature_l2_norm  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+DT = jnp.bfloat16 if (len(sys.argv) > 2 and sys.argv[2] == "bf16") else jnp.float32
+S, C = 25, 1024
+
+
+def parse_spec(s):
+    out = []
+    for part in s.split(","):
+        if part == "-":
+            out.append(None)
+        else:
+            dx, dw = part.split("/")
+            out.append({"dx": dx, "dw": dw})
+    return out
+
+
+SWEEP = []
+for arg in sys.argv[3:]:
+    name, spec = arg.split("=")
+    SWEEP.append((name, parse_spec(spec)))
+if not SWEEP:
+    SWEEP = [
+        ("plain", None),
+        ("custom_def", [{"dx": "auto", "dw": "coutfold"}] * 3),
+        ("dx_unroll", [{"dx": "unroll", "dw": "coutfold"}] * 3),
+        ("dx_tapfold", [{"dx": "tapfold", "dw": "coutfold"}] * 3),
+        ("dw_unroll", [{"dx": "auto", "dw": "unroll"}] * 3),
+        ("dw_tapfold", [{"dx": "auto", "dw": "tapfold"}] * 3),
+    ]
+
+
+def main():
+    ks = jax.random.split(jax.random.key(7), 3)
+    chans = [(1, 16), (16, 16), (16, 1)]
+    params0 = [
+        dict(zip(("w", "b"), conv4d_init(k, 5, ci, co)))
+        for k, (ci, co) in zip(ks, chans)
+    ]
+
+    for name, routing in SWEEP:
+        cg = False if routing is None else routing
+
+        def loss(params, corr, _cg=cg):
+            params = jax.tree.map(lambda x: x.astype(DT), params)
+            out = neigh_consensus(params, corr, symmetric=True, custom_grad=_cg)
+            return jnp.mean(out.astype(jnp.float32))
+
+        def tick(carry, _loss=loss):
+            fa, fb, params = carry
+            corr = correlation_4d(fa, fb).astype(DT)
+            val, (gp, gc) = jax.value_and_grad(_loss, argnums=(0, 1))(params, corr)
+            fa = fa + (val * 1e-9 + jnp.sum(gc.astype(jnp.float32)) * 1e-12
+                       ).astype(fa.dtype)
+            params = jax.tree.map(
+                lambda p, gg: p + (jnp.sum(gg.astype(jnp.float32)) * 1e-12
+                                   ).astype(p.dtype), params, gp)
+            return (fa, fb, params)
+
+        def make_input(key):
+            k1, k2 = jax.random.split(key)
+            fa = feature_l2_norm(jax.random.normal(k1, (B, S, S, C), jnp.float32))
+            fb = feature_l2_norm(jax.random.normal(k2, (B, S, S, C), jnp.float32))
+            return (fa, fb, params0)
+
+        try:
+            ms = timeit(tick, make_input, n_long=4, reps=3)
+            print(f"{name:14s} {ms:8.1f} ms/step  {ms / B:6.2f} ms/pair",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:14s} FAILED: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
