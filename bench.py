@@ -319,14 +319,18 @@ def bench_jax(res=None):
                   file=sys.stderr)
             continue
     if res.get("train_pairs_per_sec_bf16") is None:
-        try:
-            bs_try = res.get("train_batch_size", 16)
-            ms = measure_train(bs_try, half=True)
-            res["train_pairs_per_sec_bf16"] = bs_try / (ms * 1e-3)
-        except Exception as e:
-            import sys
+        # same ladder fallback as fp32, starting from the size fp32 landed on
+        start = res.get("train_batch_size", 16)
+        for bs_try in [b for b in (16, 8, 4) if b <= start]:
+            try:
+                ms = measure_train(bs_try, half=True)
+                res["train_pairs_per_sec_bf16"] = bs_try / (ms * 1e-3)
+                break
+            except Exception as e:
+                import sys
 
-            print(f"train bench bf16 failed: {str(e)[:200]}", file=sys.stderr)
+                print(f"train bench bf16 bs={bs_try} failed: {str(e)[:200]}",
+                      file=sys.stderr)
     return res
 
 

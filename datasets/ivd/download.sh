@@ -7,6 +7,22 @@ set -e
 
 bash make_dirs.sh
 
+# a transiently-failed `wget -nc -O` leaves a 0-byte file that -nc then
+# skips forever (ADVICE r3): clear any such husks so reruns retry them
+find . -name '*.jpg' -size 0 -delete 2>/dev/null || true
+
 # urls.txt rows are "<relative path> <url>"; fetch 8-wide, tolerate misses
-# (venue photos occasionally disappear from Google Maps)
-<urls.txt xargs -n2 -P8 wget -nc -O || true
+# (venue photos occasionally disappear from Google Maps).  Fetch to a temp
+# name and mv on success so a failed fetch cannot masquerade as done.
+fetch_one() {
+    local path="$1" url="$2"
+    [ -s "$path" ] && return 0
+    if wget -q -O "$path.part" "$url"; then
+        mv "$path.part" "$path"
+    else
+        rm -f "$path.part"
+        return 1
+    fi
+}
+export -f fetch_one
+<urls.txt xargs -n2 -P8 bash -c 'fetch_one "$@"' _ || true

@@ -178,8 +178,11 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
 
     def prep_input(img) -> jnp.ndarray:
         """The ONE preprocessing call both input paths share — a divergence
-        here would desync the PreparedQuery path from the in-dispatch path
-        bit-for-bit."""
+        here would desync the PreparedQuery path from the in-dispatch path.
+        (Scope note, ADVICE r3: sharing the preprocessed tensor makes the
+        PREPROCESSING identical; the cached-trunk feature path itself is
+        bit-stable only within one compiled program, so the eval loop uses
+        the PreparedQuery path for every pair rather than mixing paths.)"""
         return prep(
             jnp.asarray(img), image_size=preprocess_image_size, k_size=k
         )

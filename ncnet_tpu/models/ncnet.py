@@ -138,10 +138,12 @@ def tap_swap_fusable(nc_params) -> bool:
 def tap_swap_fused_layers(nc_params):
     """``(fused_l1, l2, l2_swapped)`` for the tap-swapped symmetric fast
     path.  The ONE construction of the fusion arithmetic — the unsharded
-    (:func:`neigh_consensus`) and hB-sharded
-    (parallel/spatial.py) branches both build from it, because their
-    bit-compatibility is a resume-artifact contract (the InLoc eval shares
-    per-query .mat files across ``spatial_shards`` settings)."""
+    (:func:`neigh_consensus`) and hB-sharded (parallel/spatial.py) branches
+    both build from it so they agree to float-level numerical parity (the
+    InLoc eval shares per-query .mat files across ``spatial_shards``
+    settings; the sharded path's halo-padded conv shapes can still round
+    differently through the variant chooser, so the agreement is
+    within-tolerance, NOT bit-exact — see tests/test_spatial.py)."""
     sw = [swap_ab_taps(layer) for layer in nc_params]
     fused_l1 = {
         "w": jnp.concatenate([nc_params[0]["w"], sw[0]["w"]], axis=-1),
@@ -327,8 +329,14 @@ def ncnet_forward_from_features(
     The InLoc eval matches one query against ~10 panos; recomputing the
     query's trunk per pair (as the reference does, eval_inloc.py:124-132)
     wastes ~30 ms/pair of device time at 3200 px.  ``source_features`` must
-    be exactly ``extract_features(config, params, src)`` — the outputs are
-    then bit-identical to :func:`ncnet_forward`."""
+    be exactly ``extract_features(config, params, src)``.  Identity caveat
+    (ADVICE r3): when the features come from a SEPARATELY-jitted
+    ``extract_features`` program, on-TPU fusion may round them differently
+    than the trunk embedded in a fused forward — so outputs are bit-stable
+    within one input path, and match :func:`ncnet_forward` to float-level
+    tolerance (demonstrated bit-exact on CPU only).  The InLoc eval loop
+    uses the cached-features path consistently for every pair, which is
+    what its resume artifacts rely on."""
     fa = source_features
     fb = extract_features(config, params, target_images)
     if config.half_precision:

@@ -53,19 +53,36 @@ FEATURE_B_SPEC = P(DATA_AXIS, SPATIAL_AXIS, None, None)  # (B, hB, wB, C)
 FEATURE_A_SPEC = P(DATA_AXIS, None, None, None)
 
 
+def padded_hb(hb_fine: int, k_size: int, n_shards: int) -> Optional[int]:
+    """Fine-grid hB after pad-to-shardable: the smallest multiple of
+    ``n_shards·k`` ≥ ``hb_fine``.  ``None`` when padding cannot make the
+    volume shardable exactly — ``hb_fine`` must itself be a multiple of
+    ``k`` (otherwise the unsharded pooling's ragged final window would mix
+    real and pad rows, and the sharded result could not match it)."""
+    k = max(k_size, 1)
+    if hb_fine % k != 0:
+        return None
+    step = n_shards * k
+    return ((hb_fine + step - 1) // step) * step
+
+
 def shardable_hb(
     hb_fine: int, k_size: int, n_shards: int, kernel_sizes
 ) -> bool:
     """Whether a volume whose fine-grid hB is ``hb_fine`` can shard over
-    ``n_shards``: the (post-pooling) dim must split evenly and each local
-    shard must be at least one conv halo tall.  The single source of truth
-    for the gating policy — :func:`spatial_filter` enforces it and callers
-    (e.g. the InLoc matcher's fallback) pre-check it."""
+    ``n_shards`` — directly, or by zero-padding hB up to the next
+    ``n_shards·k`` multiple with the pad rows masked out of every max and
+    conv (the r4 pad-and-mask path; the canonical InLoc fine hB=200 now
+    8-way shards via pad-to-208).  Each local shard must still be at least
+    one conv halo tall after padding.  The single source of truth for the
+    gating policy — :func:`spatial_filter` enforces it and callers (e.g.
+    the InLoc matcher's fallback) pre-check it."""
     k = max(k_size, 1)
-    if hb_fine % (n_shards * k) != 0:
+    hb_pad = padded_hb(hb_fine, k_size, n_shards)
+    if hb_pad is None:
         return False
     max_halo = max(ks // 2 for ks in kernel_sizes)
-    return hb_fine // n_shards // k >= max_halo
+    return hb_pad // n_shards // k >= max_halo
 
 
 def _halo_pad(x: jnp.ndarray, axis: int, halo: int, n_shards: int) -> jnp.ndarray:
@@ -89,13 +106,37 @@ def _halo_pad(x: jnp.ndarray, axis: int, halo: int, n_shards: int) -> jnp.ndarra
     return jnp.concatenate([from_left, x, from_right], axis=axis)
 
 
-def _mutual_matching_sharded(corr: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def _valid_rows_mask(
+    rows_local: int, valid_rows: int, axis: int, ndim: int
+) -> jnp.ndarray:
+    """Shard-local boolean mask along the sharded ``axis``: True for global
+    rows < ``valid_rows`` (real data), False for the pad-to-shardable tail.
+    Shape is 1 everywhere except ``axis``."""
+    idx = lax.axis_index(SPATIAL_AXIS)
+    rows_global = idx * rows_local + jnp.arange(rows_local)
+    shape = [1] * ndim
+    shape[axis] = rows_local
+    return (rows_global < valid_rows).reshape(shape)
+
+
+def _mutual_matching_sharded(
+    corr: jnp.ndarray,
+    eps: float = 1e-5,
+    valid_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
     """Shard-local body of :func:`ncnet_tpu.ops.matching.mutual_matching`:
     the per-B-cell max over A dims sees full A locally; the per-A-cell max
-    over B dims needs a pmax across the hB shards."""
+    over B dims needs a pmax across the hB shards.
+
+    ``valid_mask`` (pad-and-mask path): pad hB rows carry zeros and must not
+    win the B-side max — they are −inf'd out of that reduction, and their
+    own output stays exactly 0 (0/x · 0/y · 0)."""
     max_over_a = jnp.max(corr, axis=(1, 2), keepdims=True)
+    b_src = corr
+    if valid_mask is not None:
+        b_src = jnp.where(valid_mask, corr, jnp.asarray(-jnp.inf, corr.dtype))
     max_over_b = lax.pmax(
-        jnp.max(corr, axis=(3, 4), keepdims=True), SPATIAL_AXIS
+        jnp.max(b_src, axis=(3, 4), keepdims=True), SPATIAL_AXIS
     )
     ratio_b = corr / (max_over_a + eps)
     ratio_a = corr / (max_over_b + eps)
@@ -103,11 +144,26 @@ def _mutual_matching_sharded(corr: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarra
 
 
 def _nc_stack_sharded(
-    nc_params: List[dict], x: jnp.ndarray, sharded_axis: int, n_shards: int
+    nc_params: List[dict],
+    x: jnp.ndarray,
+    sharded_axis: int,
+    n_shards: int,
+    valid_rows: Optional[int] = None,
 ) -> jnp.ndarray:
     """[Conv4d+ReLU]×N with per-layer halo exchange along ``sharded_axis``
-    (1 = the volume's leading spatial dim, 3 = hB)."""
+    (1 = the volume's leading spatial dim, 3 = hB).
+
+    ``valid_rows`` (pad-and-mask path): global row count of real data along
+    the sharded axis.  The pad tail is re-zeroed after every conv+ReLU —
+    each layer's conv must see zeros beyond the true boundary, exactly like
+    the unsharded 'same' zero padding (a conv's bias + halo contributions
+    would otherwise leak nonzero pad rows into the next layer)."""
     assert sharded_axis in (1, 3)
+    mask = None
+    if valid_rows is not None:
+        mask = _valid_rows_mask(
+            x.shape[sharded_axis], valid_rows, sharded_axis, x.ndim
+        )
     for layer in nc_params:
         halo = layer["w"].shape[0] // 2
         x = _halo_pad(x, sharded_axis, halo, n_shards)
@@ -116,17 +172,25 @@ def _nc_stack_sharded(
             pad_ha=sharded_axis != 1, pad_hb=sharded_axis != 3,
         )
         x = jax.nn.relu(x)
+        if mask is not None:
+            x = jnp.where(mask, x, jnp.zeros((), x.dtype))
     return x
 
 
 def _neigh_consensus_sharded(
-    nc_params: List[dict], corr: jnp.ndarray, n_shards: int, symmetric: bool
+    nc_params: List[dict],
+    corr: jnp.ndarray,
+    n_shards: int,
+    symmetric: bool,
+    valid_rows: Optional[int] = None,
 ) -> jnp.ndarray:
     """Stack-level symmetric NC filtering on an hB-sharded volume.
 
     Mirrors :func:`ncnet_tpu.models.ncnet.neigh_consensus`'s rectangular
-    branch exactly (the two must stay bit-compatible — the InLoc eval's
-    resume artifacts are shared across ``spatial_shards`` settings):
+    branch (numerical parity within float tolerance — the halo-padded conv
+    shapes can make the variant chooser and reassociation differ from the
+    unsharded program, so InLoc resume artifacts produced under different
+    ``spatial_shards`` settings agree to tolerance, not bit-exactly):
 
       * measured shape class (2 cubic layers, 1-channel input): the
         symmetric pass runs tap-SWAPPED on x — no volume transposes, both
@@ -141,7 +205,7 @@ def _neigh_consensus_sharded(
     x = corr[..., None]
     if symmetric and tap_swap_fusable(nc_params):
         fused_l1, l2, l2s = tap_swap_fused_layers(nc_params)
-        y = _nc_stack_sharded([fused_l1], x, 3, n_shards)
+        y = _nc_stack_sharded([fused_l1], x, 3, n_shards, valid_rows)
         # one halo exchange serves BOTH second-layer convs (the channel
         # halves share the same hB neighborhood)
         halo = l2["w"].shape[2] // 2
@@ -152,17 +216,26 @@ def _neigh_consensus_sharded(
         ) + jax.nn.relu(
             conv4d(yp[..., c:], l2s["w"], l2s["b"], pad_hb=False)
         )
+        if valid_rows is not None:
+            out = jnp.where(
+                _valid_rows_mask(out.shape[3], valid_rows, 3, out.ndim),
+                out, jnp.zeros((), out.dtype),
+            )
         return out[..., 0]
-    out = _nc_stack_sharded(nc_params, x, 3, n_shards)
+    out = _nc_stack_sharded(nc_params, x, 3, n_shards, valid_rows)
     if symmetric:
         xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
-        yt = _nc_stack_sharded(nc_params, xt, 1, n_shards)
+        yt = _nc_stack_sharded(nc_params, xt, 1, n_shards, valid_rows)
         out = out + jnp.transpose(yt, (0, 3, 4, 1, 2, 5))
     return out[..., 0]
 
 
 def spatial_filter(
-    config: ModelConfig, params, corr: jnp.ndarray, mesh: Mesh
+    config: ModelConfig,
+    params,
+    corr: jnp.ndarray,
+    mesh: Mesh,
+    hb_valid: Optional[int] = None,
 ) -> NCNetOutput:
     """The post-correlation pipeline ([maxpool4d] → MutualMatching →
     NeighConsensus → MutualMatching) with the volume sharded over hB.
@@ -170,16 +243,36 @@ def spatial_filter(
     Drop-in parallel twin of :func:`ncnet_tpu.models.ncnet.ncnet_filter`
     (parity-tested against it); call under ``jit`` with ``mesh`` holding a
     ``spatial`` axis of size > 1.
+
+    When hB does not divide ``n_shards·k`` the volume is zero-padded along
+    hB up to the next multiple (pad-and-mask): pad rows stay exactly zero
+    through every stage — they are −inf'd out of the mutual-matching B-max
+    and re-zeroed after each conv layer, so the real region computes the
+    same function as the unsharded filter — and the output is sliced back
+    to the true pooled hB.  The canonical InLoc shape (fine hB=200, k=2)
+    8-way shards via pad-to-208 this way.
     """
     n_shards = mesh.shape[SPATIAL_AXIS]
     k = config.relocalization_k_size
-    hb = corr.shape[3]
+    # hb_valid: true fine-grid rows when the CALLER already padded hB (the
+    # sharded-correlation path pads the feature rows so the einsum shards)
+    hb = hb_valid if hb_valid is not None else corr.shape[3]
     if not shardable_hb(hb, k, n_shards, config.ncons_kernel_sizes):
         raise ValueError(
             f"hB={hb} cannot shard over {n_shards} spatial shards (needs "
-            f"k={max(k, 1)}-aligned even split with each shard ≥ the conv "
+            f"hB divisible by k={max(k, 1)} and post-pad shards ≥ the conv "
             "halo); use fewer shards for this volume"
         )
+    hb_pad = padded_hb(hb, k, n_shards)
+    kk = max(k, 1)
+    valid_rows = hb // kk if hb_pad > hb else None  # pooled-grid real rows
+    if corr.shape[3] < hb_pad:
+        corr = jnp.pad(
+            corr, ((0, 0),) * 3 + ((0, hb_pad - corr.shape[3]), (0, 0))
+        )
+    assert corr.shape[3] == hb_pad, (
+        f"corr hB={corr.shape[3]} inconsistent with padded plan {hb_pad}"
+    )
 
     nc_params = params["nc"]
     if config.half_precision:
@@ -197,17 +290,28 @@ def spatial_filter(
         delta = None
         if k > 1:
             corr_loc, delta = maxpool4d_with_argmax(corr_loc, k)
-        corr_loc = _mutual_matching_sharded(corr_loc)
+        vmask = None
+        if valid_rows is not None:
+            vmask = _valid_rows_mask(
+                corr_loc.shape[3], valid_rows, 3, corr_loc.ndim
+            )
+        corr_loc = _mutual_matching_sharded(corr_loc, valid_mask=vmask)
         corr_loc = _neigh_consensus_sharded(
-            nc, corr_loc, n_shards, config.symmetric_mode
+            nc, corr_loc, n_shards, config.symmetric_mode, valid_rows
         )
-        corr_loc = _mutual_matching_sharded(corr_loc)
+        corr_loc = _mutual_matching_sharded(corr_loc, valid_mask=vmask)
         return (corr_loc, delta) if k > 1 else corr_loc
 
     result = run(nc_params, corr)
-    if k > 1:
-        return NCNetOutput(*result)
-    return NCNetOutput(result, None)
+    corr_out, delta = result if k > 1 else (result, None)
+    if valid_rows is not None:
+        # slice the pad tail off so downstream match extraction sees the
+        # true pooled grid (the global slice of a sharded value is fine
+        # under jit; GSPMD re-shards as needed)
+        corr_out = corr_out[:, :, :, :valid_rows, :]
+        if delta is not None:
+            delta = tuple(d[:, :, :, :valid_rows, :] for d in delta)
+    return NCNetOutput(corr_out, delta)
 
 
 def spatial_correlation(
@@ -250,5 +354,13 @@ def spatial_forward(
     if config.half_precision:
         fa = fa.astype(jnp.bfloat16)
         fb = fb.astype(jnp.bfloat16)
+    # pad-and-mask: zero feature rows make exactly-zero correlation rows,
+    # so the padded volume is born sharded instead of padded after the fact
+    hb = fb.shape[1]
+    hb_pad = padded_hb(
+        hb, config.relocalization_k_size, mesh.shape[SPATIAL_AXIS]
+    )
+    if hb_pad is not None and hb_pad > hb:
+        fb = jnp.pad(fb, ((0, 0), (0, hb_pad - hb), (0, 0), (0, 0)))
     corr = spatial_correlation(fa, fb, mesh)
-    return spatial_filter(config, params, corr, mesh)
+    return spatial_filter(config, params, corr, mesh, hb_valid=hb)

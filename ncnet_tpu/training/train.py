@@ -295,6 +295,25 @@ def load_train_checkpoint(path: str, state_like: TrainState):
     )
 
 
+def _resolve_accum_chunks(config: TrainConfig, n_dev: int) -> int:
+    """Chunked accumulation needs the frozen trunk: the auto default (-1)
+    quietly falls back to the whole-batch backward when finetuning, but an
+    EXPLICIT chunk count with finetuning is a contradiction the user must
+    resolve (the same combination raises in make_train_step)."""
+    if config.fe_finetune_params > 0:
+        if config.accum_chunks > 0:
+            raise ValueError(
+                f"accum_chunks={config.accum_chunks} requires the frozen "
+                "trunk, but fe_finetune_params="
+                f"{config.fe_finetune_params} finetunes backbone blocks; "
+                "drop one of the two settings"
+            )
+        return 0
+    if config.accum_chunks == -1:
+        return auto_accum_chunks(config.batch_size, n_dev)
+    return config.accum_chunks
+
+
 # ---------------------------------------------------------------------------
 # fit: the whole reference train.py flow
 # ---------------------------------------------------------------------------
@@ -395,11 +414,8 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         nc_custom_grad=config.nc_custom_grad,
         fold_pos_neg=config.fold_pos_neg,
         remat_filter=config.remat_filter,
-        accum_chunks=(
-            (auto_accum_chunks(config.batch_size,
-                               n_dev if config.data_parallel else 1)
-             if config.accum_chunks == -1 else config.accum_chunks)
-            if config.fe_finetune_params == 0 else 0
+        accum_chunks=_resolve_accum_chunks(
+            config, n_dev if config.data_parallel else 1
         ),
     )
     eval_step = make_eval_step(model_config)
@@ -442,7 +458,10 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         stamp = float(int(parts[0]) * 86400 + int(parts[1]))
     ckpt_name = os.path.join(
         config.result_model_dir,
-        time.strftime("%Y-%m-%d_%H:%M", time.localtime(stamp))
+        # gmtime, not localtime: processes with differing TZ env would
+        # format different paths from the same broadcast stamp and
+        # re-diverge the collective save (ADVICE r3)
+        time.strftime("%Y-%m-%d_%H:%M", time.gmtime(stamp))
         + "_" + config.result_model_fn,
     )
     if progress:

@@ -110,12 +110,98 @@ def test_spatial_forward_parity_end_to_end(rng):
         np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
 
 
-def test_spatial_filter_rejects_indivisible_hb(rng):
-    cfg = _volume_cfg()
-    params = init_ncnet(cfg, jax.random.key(0))
-    corr = jnp.asarray(rng.standard_normal((1, 4, 4, 6, 4)).astype(np.float32))
+def test_spatial_filter_rejects_unshardable_hb(rng):
+    """Pad-and-mask relaxed the divisibility gate; what remains rejected:
+    fine hB not a multiple of k (ragged pooling window), and volumes whose
+    post-pad shards are thinner than the conv halo."""
+    params = init_ncnet(_volume_cfg(), jax.random.key(0))
+    # k=2 with odd fine hB: pooling would mix real and pad rows
+    cfg_k2 = _volume_cfg(relocalization_k_size=2)
+    corr = jnp.asarray(rng.standard_normal((1, 4, 4, 7, 4)).astype(np.float32))
     with pytest.raises(ValueError, match="spatial shards"):
-        parallel.spatial_filter(cfg, params, corr, _mesh(1, 4))
+        parallel.spatial_filter(cfg_k2, params, corr, _mesh(1, 4))
+    # kernel-5 halo of 2 > post-pad shard height of 1
+    cfg = _volume_cfg()
+    corr = jnp.asarray(rng.standard_normal((1, 4, 4, 8, 4)).astype(np.float32))
+    with pytest.raises(ValueError, match="spatial shards"):
+        parallel.spatial_filter(cfg, params, corr, _mesh(1, 8))
+
+
+@pytest.mark.parametrize("spatial,hb", [(4, 10), (8, 20)])
+def test_spatial_filter_parity_padded_hb(rng, spatial, hb):
+    """Pad-and-mask parity (VERDICT r3 item 2): an hB that does NOT divide
+    the shard count must still reproduce the unsharded filter exactly —
+    pad rows are masked out of the mutual-matching maxes and re-zeroed
+    after every conv, and the output is sliced back to the true hB."""
+    cfg = _volume_cfg()
+    params = init_ncnet(cfg, jax.random.key(4))
+    assert hb % spatial != 0  # the case the gate used to reject
+    corr = jnp.asarray(
+        rng.standard_normal((1, 5, 7, hb, 6)).astype(np.float32)
+    )
+    mesh = _mesh(1, spatial)
+    ref = ncnet_filter(cfg, params, corr).corr
+    got = jax.jit(
+        lambda p, c: parallel.spatial_filter(cfg, p, c, mesh).corr
+    )(params, corr)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_spatial_filter_parity_padded_hb_relocalization(rng):
+    """The canonical-InLoc shape class: k=2 relocalization with fine hB not
+    dividing n_shards·k (20 % (8·2) != 0 → pad to 32, pooled 10 valid)."""
+    cfg = _volume_cfg(relocalization_k_size=2)
+    params = init_ncnet(cfg, jax.random.key(5))
+    corr = jnp.asarray(rng.standard_normal((1, 6, 8, 20, 12)).astype(np.float32))
+    mesh = _mesh(1, 8)
+    ref = ncnet_filter(cfg, params, corr)
+    got = jax.jit(
+        lambda p, c: parallel.spatial_filter(cfg, p, c, mesh)
+    )(params, corr)
+    assert got.corr.shape == ref.corr.shape
+    np.testing.assert_allclose(np.asarray(got.corr), np.asarray(ref.corr),
+                               rtol=2e-5, atol=2e-5)
+    for g, r in zip(got.delta4d, ref.delta4d):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_spatial_filter_parity_padded_hb_three_layer(rng):
+    """Padded hB through the transposed symmetric pass (3 layers are not
+    tap-swap-fusable): pad rows must be re-zeroed along the volume's
+    LEADING dim in the transposed stack too."""
+    cfg = _volume_cfg(ncons_kernel_sizes=(3, 3, 3), ncons_channels=(4, 4, 1))
+    params = init_ncnet(cfg, jax.random.key(6))
+    corr = jnp.asarray(rng.standard_normal((1, 5, 7, 10, 6)).astype(np.float32))
+    mesh = _mesh(1, 4)
+    ref = ncnet_filter(cfg, params, corr).corr
+    got = jax.jit(
+        lambda p, c: parallel.spatial_filter(cfg, p, c, mesh).corr
+    )(params, corr)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_spatial_forward_parity_padded_end_to_end(rng):
+    """Images whose target feature rows don't divide the shard count: the
+    features are zero-row padded pre-correlation and the result must still
+    equal the plain forward (incl. output shape)."""
+    cfg = _volume_cfg(relocalization_k_size=2)
+    params = init_ncnet(cfg, jax.random.key(8))
+    src = jnp.asarray(rng.uniform(-1, 1, (1, 96, 128, 3)).astype(np.float32))
+    # 320 px → 20 feature rows: 20 % (4·2) != 0 → pad-and-mask path
+    tgt = jnp.asarray(rng.uniform(-1, 1, (1, 320, 128, 3)).astype(np.float32))
+    mesh = _mesh(1, 4)
+    ref = ncnet_forward(cfg, params, src, tgt)
+    got = jax.jit(
+        lambda p, s, t: parallel.spatial_forward(cfg, p, s, t, mesh)
+    )(params, src, tgt)
+    assert got.corr.shape == ref.corr.shape
+    np.testing.assert_allclose(np.asarray(got.corr), np.asarray(ref.corr),
+                               rtol=2e-5, atol=2e-5)
+    for g, r in zip(got.delta4d, ref.delta4d):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
 
 
 @pytest.mark.slow

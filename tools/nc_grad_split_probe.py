@@ -91,39 +91,5 @@ if __name__ == "__main__":
     main()
 
 
-# -- appended sweep: plain AD vs custom VJP for the full stack grad ---------
-def stack_loss_custom(params, corr):
-    params = jax.tree.map(lambda x: x.astype(DT), params)
-    out = neigh_consensus(params, corr, symmetric=True, custom_grad=True)
-    return jnp.mean(out.astype(jnp.float32))
-
-
-def main2():
-    params0 = init_params(jax.random.key(7))
-    for name, fn in (("plain", stack_loss), ("custom", stack_loss_custom)):
-
-        def tick(carry, _fn=fn):
-            fa, fb, params = carry
-            corr = correlation_4d(fa, fb).astype(DT)
-            val, (gp, gc) = jax.value_and_grad(_fn, argnums=(0, 1))(params, corr)
-            fa = fa + (val * 1e-9 + jnp.sum(gc.astype(jnp.float32)) * 1e-12
-                       ).astype(fa.dtype)
-            params = jax.tree.map(
-                lambda p, gg: p + (jnp.sum(gg.astype(jnp.float32)) * 1e-12
-                                   ).astype(p.dtype), params, gp)
-            return (fa, fb, params)
-
-        def make_input(key):
-            k1, k2 = jax.random.split(key)
-            fa = feature_l2_norm(jax.random.normal(k1, (B, S, S, C), jnp.float32))
-            fb = feature_l2_norm(jax.random.normal(k2, (B, S, S, C), jnp.float32))
-            return (fa, fb, params0)
-
-        try:
-            ms = timeit(tick, make_input, n_long=4, reps=3)
-            print(f"{name:8s} {ms:8.1f} ms/step  {ms / B:6.2f} ms/pair", flush=True)
-        except Exception as e:  # noqa: BLE001
-            print(f"{name:8s} FAILED: {str(e)[:200]}", flush=True)
-
-
-main2 = main2  # noqa: PLW0127
+# The plain-AD vs custom-VJP composed comparison lives in
+# tools/vjp_sweep_probe.py (its 'plain' and 'custom_def' rows).
