@@ -30,6 +30,21 @@ from test_backbone import make_resnet101_state_dict, torch_resnet101_features
 RNG = np.random.default_rng(7)
 
 
+def make_nc_layers(chans, k):
+    """Random NC stack in BOTH layouts: torch Conv4d (C_out, C_in, kA, kWA,
+    kB, kWB) and ours (kA, kWA, kB, kWB, C_in, C_out) — the one place the
+    cross-framework weight transpose is written."""
+    nc_torch, nc_ours = [], []
+    for cin, cout in chans:
+        w = RNG.normal(0, 0.3 / np.sqrt(cin * k**4),
+                       (k, k, k, k, cin, cout)).astype(np.float32)
+        bias = RNG.normal(0, 0.02, cout).astype(np.float32)
+        nc_torch.append((torch.from_numpy(np.transpose(w, (5, 4, 0, 1, 2, 3))),
+                         torch.from_numpy(bias)))
+        nc_ours.append({"w": jnp.asarray(w), "b": jnp.asarray(bias)})
+    return nc_torch, nc_ours
+
+
 def torch_l2norm(f):
     return f / torch.sqrt(torch.sum(f * f, dim=1, keepdim=True) + 1e-6)
 
@@ -121,13 +136,10 @@ def test_weak_loss_matches_torch_twin():
 
     sd = make_resnet101_state_dict()
     k = 3
-    w = RNG.normal(0, 0.3 / np.sqrt(k**4), (k, k, k, k, 1, 1)).astype(np.float32)
-    bias = RNG.normal(0, 0.02, 1).astype(np.float32)
-    nc_torch = [(torch.from_numpy(np.transpose(w, (5, 4, 0, 1, 2, 3))),
-                 torch.from_numpy(bias))]
+    nc_torch, nc_ours = make_nc_layers([(1, 1)], k)
     params = {
         "backbone": bb.import_torch_backbone(sd, "resnet101"),
-        "nc": [{"w": jnp.asarray(w), "b": jnp.asarray(bias)}],
+        "nc": nc_ours,
     }
     x = RNG.normal(0, 1, (3, 3, 48, 48)).astype(np.float32)
     y = RNG.normal(0, 1, (3, 3, 48, 48)).astype(np.float32)
@@ -149,15 +161,7 @@ def test_weak_loss_matches_torch_twin():
 def test_full_forward_matches_torch_twin():
     sd = make_resnet101_state_dict()
     k, chans = 3, [(1, 8), (8, 1)]
-    nc_torch, nc_ours = [], []
-    for cin, cout in chans:
-        w = RNG.normal(0, 0.3 / np.sqrt(cin * k**4),
-                       (k, k, k, k, cin, cout)).astype(np.float32)
-        bias = RNG.normal(0, 0.02, cout).astype(np.float32)
-        # torch Conv4d layout (C_out, C_in, kA, kWA, kB, kWB)
-        nc_torch.append((torch.from_numpy(np.transpose(w, (5, 4, 0, 1, 2, 3))),
-                         torch.from_numpy(bias)))
-        nc_ours.append({"w": jnp.asarray(w), "b": jnp.asarray(bias)})
+    nc_torch, nc_ours = make_nc_layers(chans, k)
 
     x = RNG.normal(0, 1, (1, 3, 64, 64)).astype(np.float32)
     y = RNG.normal(0, 1, (1, 3, 64, 48)).astype(np.float32)
@@ -182,3 +186,185 @@ def test_full_forward_matches_torch_twin():
     np.testing.assert_allclose(
         np.asarray(got), want[:, 0], rtol=2e-4, atol=2e-4
     )
+
+
+# ---------------------------------------------------------------------------
+# PCK-metric-level parity (VERDICT r3 item 4): the full eval pipeline
+# dataset → corr_to_matches(do_softmax) → bilinearInterpPointTnf → pck
+# re-stated in torch per eval_pf_pascal.py:69-81 + lib/point_tnf.py +
+# lib/eval_util.py:12-50, against our jitted chain on the same volume.
+# ---------------------------------------------------------------------------
+
+
+def torch_normalize_axis(x, L):
+    return (x - 1 - (L - 1) / 2) * 2 / (L - 1)  # point_tnf.py:6-7
+
+
+def torch_unnormalize_axis(x, L):
+    return x * (L - 1) / 2 + 1 + (L - 1) / 2  # point_tnf.py:9-10
+
+
+def torch_corr_to_matches(corr4d, do_softmax=True):
+    """point_tnf.py:12-80, default direction, scale='centered', k_size=1."""
+    b, _, fs1, fs2, fs3, fs4 = corr4d.size()
+    XA, YA = np.meshgrid(np.linspace(-1, 1, fs2), np.linspace(-1, 1, fs1))
+    XB, YB = np.meshgrid(np.linspace(-1, 1, fs4), np.linspace(-1, 1, fs3))
+    JA, IA = np.meshgrid(range(fs2), range(fs1))
+    JB, IB = np.meshgrid(range(fs4), range(fs3))
+    XA, YA = torch.FloatTensor(XA), torch.FloatTensor(YA)
+    XB, YB = torch.FloatTensor(XB), torch.FloatTensor(YB)
+    IA, JA = (torch.LongTensor(IA).view(1, -1), torch.LongTensor(JA).view(1, -1))
+    IB, JB = (torch.LongTensor(IB).view(1, -1), torch.LongTensor(JB).view(1, -1))
+    nc_B_Avec = corr4d.view(b, fs1 * fs2, fs3, fs4)
+    if do_softmax:
+        nc_B_Avec = F.softmax(nc_B_Avec, dim=1)
+    match_B_vals, idx_B_Avec = torch.max(nc_B_Avec, dim=1)
+    score = match_B_vals.view(b, -1)
+    iA = IA.view(-1)[idx_B_Avec.view(-1)].view(b, -1)
+    jA = JA.view(-1)[idx_B_Avec.view(-1)].view(b, -1)
+    iB = IB.expand_as(iA)
+    jB = JB.expand_as(jA)
+    xA = XA[iA.view(-1), jA.view(-1)].view(b, -1)
+    yA = YA[iA.view(-1), jA.view(-1)].view(b, -1)
+    xB = XB[iB.view(-1), jB.view(-1)].view(b, -1)
+    yB = YB[iB.view(-1), jB.view(-1)].view(b, -1)
+    return xA, yA, xB, yB, score
+
+
+def torch_bilinear_interp_point_tnf(matches, target_points_norm):
+    """point_tnf.py:96-148 verbatim (note: its flat indexing reads batch 0's
+    grids — correct only at batch size 1, which is how the reference eval
+    runs; the parity loop below therefore compares per single-pair batch)."""
+    xA, yA, xB, yB = matches
+    feature_size = int(np.sqrt(xB.shape[-1]))
+    b, _, N = target_points_norm.size()
+    X_, Y_ = xB.view(-1), yB.view(-1)
+    grid = torch.FloatTensor(
+        np.linspace(-1, 1, feature_size)).unsqueeze(0).unsqueeze(2)
+    x_minus = torch.sum(
+        ((target_points_norm[:, 0, :] - grid) > 0).long(), dim=1,
+        keepdim=True) - 1
+    x_minus[x_minus < 0] = 0
+    x_plus = x_minus + 1
+    y_minus = torch.sum(
+        ((target_points_norm[:, 1, :] - grid) > 0).long(), dim=1,
+        keepdim=True) - 1
+    y_minus[y_minus < 0] = 0
+    y_plus = y_minus + 1
+    toidx = lambda x, y, L: y * L + x  # noqa: E731
+    m_m_idx = toidx(x_minus, y_minus, feature_size)
+    p_p_idx = toidx(x_plus, y_plus, feature_size)
+    p_m_idx = toidx(x_plus, y_minus, feature_size)
+    m_p_idx = toidx(x_minus, y_plus, feature_size)
+    topoint = lambda idx, X, Y: torch.cat(  # noqa: E731
+        (X[idx.view(-1)].view(b, 1, N).contiguous(),
+         Y[idx.view(-1)].view(b, 1, N).contiguous()), dim=1)
+    P_m_m = topoint(m_m_idx, X_, Y_)
+    P_p_p = topoint(p_p_idx, X_, Y_)
+    P_p_m = topoint(p_m_idx, X_, Y_)
+    P_m_p = topoint(m_p_idx, X_, Y_)
+    multrows = lambda x: x[:, 0, :] * x[:, 1, :]  # noqa: E731
+    f_p_p = multrows(torch.abs(target_points_norm - P_m_m))
+    f_m_m = multrows(torch.abs(target_points_norm - P_p_p))
+    f_m_p = multrows(torch.abs(target_points_norm - P_p_m))
+    f_p_m = multrows(torch.abs(target_points_norm - P_m_p))
+    Q_m_m = topoint(m_m_idx, xA.reshape(-1), yA.reshape(-1))
+    Q_p_p = topoint(p_p_idx, xA.reshape(-1), yA.reshape(-1))
+    Q_p_m = topoint(p_m_idx, xA.reshape(-1), yA.reshape(-1))
+    Q_m_p = topoint(m_p_idx, xA.reshape(-1), yA.reshape(-1))
+    return (Q_m_m * f_m_m + Q_p_p * f_p_p + Q_m_p * f_m_p + Q_p_m * f_p_m) / (
+        f_p_p + f_m_m + f_m_p + f_p_m)
+
+
+def torch_points_to_unit(P, im_size):
+    h, w = im_size[:, 0], im_size[:, 1]
+    out = P.clone()
+    out[:, 0, :] = torch_normalize_axis(P[:, 0, :], w.unsqueeze(1).expand_as(P[:, 0, :]))
+    out[:, 1, :] = torch_normalize_axis(P[:, 1, :], h.unsqueeze(1).expand_as(P[:, 1, :]))
+    return out
+
+
+def torch_points_to_pixel(P, im_size):
+    h, w = im_size[:, 0], im_size[:, 1]
+    out = P.clone()
+    out[:, 0, :] = torch_unnormalize_axis(P[:, 0, :], w.unsqueeze(1).expand_as(P[:, 0, :]))
+    out[:, 1, :] = torch_unnormalize_axis(P[:, 1, :], h.unsqueeze(1).expand_as(P[:, 1, :]))
+    return out
+
+
+def torch_pck(source_points, warped_points, L_pck, alpha=0.1):
+    """eval_util.py:12-25 verbatim (per-sample valid-prefix slice)."""
+    batch_size = source_points.size(0)
+    out = torch.zeros(batch_size)
+    for i in range(batch_size):
+        p_src = source_points[i, :]
+        p_wrp = warped_points[i, :]
+        N_pts = int(torch.sum(
+            torch.ne(p_src[0, :], -1) * torch.ne(p_src[1, :], -1)))
+        d = torch.pow(torch.sum(
+            torch.pow(p_src[:, :N_pts] - p_wrp[:, :N_pts], 2), 0), 0.5)
+        correct = torch.le(d, L_pck[i].expand_as(d) * alpha)
+        out[i] = torch.mean(correct.float())
+    return out
+
+
+def test_pck_metric_matches_torch_twin():
+    """The strongest offline proxy for the unverifiable headline ~78.9%:
+    with identical weights, OUR dataset→matches→warp→PCK chain and the
+    reference's (re-stated in torch) produce the same per-pair PCK to 1e-4
+    on synthetic annotated pairs, across varying keypoint counts."""
+    from ncnet_tpu.evaluation.pck import pck_metric
+    from ncnet_tpu.ops import corr_to_matches
+
+    sd = make_resnet101_state_dict()
+    k, chans = 3, [(1, 4), (4, 1)]
+    nc_torch, nc_ours = make_nc_layers(chans, k)
+    cfg = ModelConfig(backbone="resnet101", ncons_kernel_sizes=(k, k),
+                      ncons_channels=(4, 1))
+    params = {"backbone": bb.import_torch_backbone(sd, "resnet101"),
+              "nc": nc_ours}
+
+    n_pairs, n_kp = 3, 20
+    for i in range(n_pairs):  # reference eval runs batch_size 1 (see twin)
+        x = RNG.normal(0, 1, (1, 3, 64, 64)).astype(np.float32)
+        y = RNG.normal(0, 1, (1, 3, 64, 64)).astype(np.float32)
+        n_valid = [5, 11, 20][i]
+        pts_src = np.full((1, 2, n_kp), -1.0, np.float32)
+        pts_tgt = np.full((1, 2, n_kp), -1.0, np.float32)
+        pts_src[0, :, :n_valid] = RNG.uniform(2, 62, (2, n_valid))
+        pts_tgt[0, :, :n_valid] = RNG.uniform(2, 62, (2, n_valid))
+        im_src = np.array([[64.0, 64.0, 3.0]], np.float32)
+        im_tgt = np.array([[64.0, 64.0, 3.0]], np.float32)
+        l_pck = RNG.uniform(20, 50, (1, 1)).astype(np.float32)
+
+        with torch.no_grad():
+            corr_t = torch_full_forward(
+                sd, nc_torch, torch.from_numpy(x), torch.from_numpy(y))
+            m_t = torch_corr_to_matches(corr_t, do_softmax=True)
+            tgt_norm = torch_points_to_unit(
+                torch.from_numpy(pts_tgt), torch.from_numpy(im_tgt))
+            warped_norm = torch_bilinear_interp_point_tnf(m_t[:4], tgt_norm)
+            warped = torch_points_to_pixel(warped_norm, torch.from_numpy(im_src))
+            want = torch_pck(torch.from_numpy(pts_src), warped,
+                             torch.from_numpy(l_pck))
+
+        out = ncnet_forward(
+            cfg, params,
+            jnp.asarray(np.transpose(x, (0, 2, 3, 1))),
+            jnp.asarray(np.transpose(y, (0, 2, 3, 1))),
+        )
+        matches = corr_to_matches(out.corr, do_softmax=True)
+        got = pck_metric(
+            {
+                "source_points": jnp.asarray(pts_src),
+                "target_points": jnp.asarray(pts_tgt),
+                "source_im_size": jnp.asarray(im_src),
+                "target_im_size": jnp.asarray(im_tgt),
+                "L_pck": jnp.asarray(l_pck),
+            },
+            matches,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), want.numpy(), rtol=0, atol=1e-4,
+            err_msg=f"pair {i} (n_valid={n_valid})",
+        )

@@ -440,3 +440,73 @@ def test_train_step_accum_chunks_reduces_loss(rng):
     for _ in range(5):
         state, loss = step(state, batch)
     assert float(loss) < float(first)
+
+
+def test_training_improves_pck_on_structured_shift_pairs():
+    """Train→metric convergence (VERDICT r3 item 7): weak-loss training must
+    IMPROVE PCK, not just the loss.  Dense random textures fail here (their
+    correlation has no consistent structure to amplify — a measured r3
+    negative), so the fixture is structured blob scenes with shifted-copy
+    targets: the positive volume carries a spatially-consistent peak
+    structure the NC filter can learn to amplify, and the circular shift
+    gives exact GT correspondences for PCK."""
+    from ncnet_tpu.evaluation.pck import pck_metric
+    from ncnet_tpu.ops import corr_to_matches
+
+    r = np.random.default_rng(3)
+
+    def blob_image(hw, n_blobs):
+        img = np.zeros(hw + (3,), np.float32)
+        yy, xx = np.mgrid[0:hw[0], 0:hw[1]]
+        for _ in range(n_blobs):
+            cy, cx = r.uniform(6, hw[0] - 6), r.uniform(6, hw[1] - 6)
+            col = r.uniform(0.3, 1.0, 3)
+            g = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2)
+                       / (2 * r.uniform(2.0, 4.0) ** 2))
+            img += g[..., None] * col
+        return np.clip(img, 0, 1)
+
+    bsz, s, shift = 8, 96, 32
+    src = np.stack([blob_image((s, s), 25) for _ in range(bsz)])
+    tgt = np.roll(src, (shift, shift), axis=(1, 2))
+    batch = {"source_image": jnp.asarray(src), "target_image": jnp.asarray(tgt)}
+
+    n_kp = 16
+    ky = r.uniform(4, s - 4, (bsz, n_kp))
+    kx = r.uniform(4, s - 4, (bsz, n_kp))
+    pts_tgt = np.full((bsz, 2, 20), -1.0, np.float32)
+    pts_src = np.full((bsz, 2, 20), -1.0, np.float32)
+    pts_tgt[:, 0, :n_kp], pts_tgt[:, 1, :n_kp] = kx, ky
+    pts_src[:, 0, :n_kp] = (kx - shift) % s
+    pts_src[:, 1, :n_kp] = (ky - shift) % s
+    im = np.tile(np.array([[float(s), float(s), 3.0]], np.float32), (bsz, 1))
+    eval_batch = {
+        "source_points": jnp.asarray(pts_src),
+        "target_points": jnp.asarray(pts_tgt),
+        "source_im_size": jnp.asarray(im),
+        "target_im_size": jnp.asarray(im),
+        "L_pck": jnp.asarray(np.full((bsz, 1), float(s), np.float32)),
+    }
+
+    def mean_pck(params):
+        out = ncnet_forward(TINY, params,
+                            batch["source_image"], batch["target_image"])
+        m = corr_to_matches(out.corr, do_softmax=True)
+        # alpha·L = 19 px ≥ the 16 px feature-cell pitch: the metric scores
+        # cell-level matching, not sub-cell interpolation luck
+        return float(jnp.mean(pck_metric(eval_batch, m, alpha=0.2)))
+
+    state, optimizer, mc, _ = training.create_train_state(
+        TrainConfig(model=TINY, batch_size=bsz, lr=3e-3, data_parallel=False)
+    )
+    step = training.make_train_step(
+        mc, optimizer, donate=False, stop_backbone_grad=True, accum_chunks=-1
+    )
+    pck_before = mean_pck(state.params)
+    for _ in range(40):
+        state, loss = step(state, batch)
+    pck_after = mean_pck(state.params)
+    # measured on this fixture/seed: 0.42 -> 0.52; the bar leaves slack for
+    # cross-platform float drift while still requiring a real improvement
+    assert pck_after > pck_before + 0.04, (pck_before, pck_after)
+    assert float(loss) < 0.0
