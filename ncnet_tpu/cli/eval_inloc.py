@@ -39,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output_root", type=str, default="matches")
     p.add_argument("--spatial_shards", type=int, default=1,
                    help="shard the 4D volume over this many devices")
+    p.add_argument("--pipeline_depth", type=int, default=0,
+                   help="dispatch/fetch pipeline depth (0 = adaptive to the "
+                        "link's latency regime; >0 pins it)")
     p.add_argument("--host_index", type=int, default=-1,
                    help="stripe queries across hosts: this host's index "
                         "(-1 = auto from jax.process_index)")
@@ -70,6 +73,7 @@ def main(argv=None) -> int:
         query_path=args.query_path,
         output_root=args.output_root,
         spatial_shards=args.spatial_shards,
+        pipeline_depth=args.pipeline_depth,
         host_index=args.host_index,
         host_count=args.host_count,
         skip_existing=args.skip_existing,

@@ -144,6 +144,12 @@ class EvalInLocConfig:
     output_root: str = "matches"
     # TPU-native addition: shard the 4D volume spatially over this many devices.
     spatial_shards: int = 1
+    # dispatch/fetch pipeline depth of the eval loop. 0 = adaptive: start at
+    # the low-latency optimum of 2 (r3 sweep: 0.62/0.285/0.47/0.51 s/pair at
+    # depths 1/2/3/4) and deepen to at most 4 when the rolling per-pair wall
+    # shows the tunnel's dispatch latency dominating (r3 observation: under
+    # ~2-3x latency regimes depth 3-4 beat 2). >0 pins the depth.
+    pipeline_depth: int = 0
     # TPU-native addition: stripe queries across hosts (each host writes its
     # own per-query .mat files — the host-parallel eval analog of the
     # reference's MATLAB parfor).  -1 → auto from jax.process_index/count.

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import math
 import os
+import time
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -368,6 +369,46 @@ def load_shortlist(path: str):
     return query_fns, pano_fns
 
 
+class _PipelineDepthController:
+    """Adaptive dispatch/fetch pipeline depth for the eval loop.
+
+    Depth 2 is the measured optimum when the tunnel's dispatch latency is
+    low (r3 sweep on v5e: 0.62/0.285/0.47/0.51 s/pair at depths 1/2/3/4),
+    but the same code measured 0.99 s/pair on a high-latency day, where
+    deeper queues (3-4) won by hiding more round-trips.  This controller
+    watches the rolling mean of the last 8 drain-to-drain walls: above
+    ``high`` s/pair (≈2× the ~0.35 s device compute — latency-dominated) it
+    deepens one step up to 4; below ``low`` it returns to 2.  Inter-query
+    gaps (preprocess + IO) are excluded via :meth:`note_gap`.
+    """
+
+    def __init__(self, fixed: int = 0, high: float = 0.7, low: float = 0.45):
+        self.depth = fixed if fixed > 0 else 2
+        self._fixed = fixed > 0
+        self._high, self._low = high, low
+        self._t_last: Optional[float] = None
+        self._samples: list = []
+
+    def note_drain(self) -> None:
+        now = time.perf_counter()
+        if self._t_last is not None:
+            self._samples.append(now - self._t_last)
+            del self._samples[:-8]  # rolling window only
+        self._t_last = now
+        if self._fixed or len(self._samples) < 8:
+            return
+        mean = sum(self._samples[-8:]) / 8.0
+        if mean > self._high and self.depth < 4:
+            self.depth += 1
+            self._samples.clear()
+        elif mean < self._low and self.depth > 2:
+            self.depth = 2
+            self._samples.clear()
+
+    def note_gap(self) -> None:
+        self._t_last = None
+
+
 def run_inloc_eval(
     config: EvalInLocConfig,
     model_config: Optional[ModelConfig] = None,
@@ -487,19 +528,25 @@ def run_inloc_eval(
         src = matcher.preprocess(
             load_raw(os.path.join(config.query_path, query_fns[q]))
         )
-        # depth-2 pipeline: pair idx+1's upload + forward are dispatched
+        # pipelined dispatch: pair idx+1's upload + forward are dispatched
         # (async) before pair idx's result is pulled, so the tunnel's
         # dispatch/transfer latency hides behind the previous pair's device
-        # compute and host-side sort/dedup.  Depth 2 bounds live device
-        # buffers to two preprocessed panos (~90 MB each at 3200 px) and is
-        # the measured optimum: the r3 depth sweep on v5e gave 0.62 (no
-        # pipeline) / 0.285 (depth 2) / 0.47 (3) / 0.51 (4) s/pair — deeper
-        # queues regress, so don't raise this without re-measuring.
+        # compute and host-side sort/dedup.  The depth adapts to the
+        # tunnel's latency regime (see _PipelineDepthController); each
+        # in-flight slot holds one preprocessed pano (~90 MB at 3200 px).
+        depth_ctl.note_gap()  # query preprocess/IO gap is not pair latency
         in_flight = []  # [(idx, handle)]
 
-        def drain_one():
+        def drain_one(sample: bool = True):
             idx0, handle = in_flight.pop(0)
             xa, ya, xb, yb, score = matcher.fetch(handle)
+            if sample:
+                depth_ctl.note_drain()
+            else:
+                # end-of-query tail: queued pairs fetch back-to-back with no
+                # dispatch between them — not a per-pair wall; recording
+                # them would bias the controller toward spurious shrink
+                depth_ctl.note_gap()
             store_pair(idx0, xa, ya, xb, yb, score)
 
         def store_pair(idx, xa, ya, xb, yb, score):
@@ -530,16 +577,21 @@ def run_inloc_eval(
             if idx + 1 < len(jobs):
                 pending = io_pool.submit(load_raw, jobs[idx + 1])
             in_flight.append((idx, matcher.dispatch(src, tgt)))
-            if len(in_flight) > 1:
+            # `while`, not `if`: when the controller SHRINKS the depth
+            # mid-query the extra in-flight slots must actually drain, or
+            # the old deeper queue (and its ~90 MB/slot pano buffers)
+            # would persist to the end of the query
+            while len(in_flight) >= depth_ctl.depth:
                 drain_one()
         while in_flight:
-            drain_one()
+            drain_one(sample=False)
         atomic_savemat(
             out_path,
             {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
             do_compression=True,
         )
 
+    depth_ctl = _PipelineDepthController(config.pipeline_depth)
     with ThreadPoolExecutor(max_workers=1) as io_pool:
         for q in range(host_index, n_queries, host_count):
             process_query(q, io_pool)

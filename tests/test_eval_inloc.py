@@ -380,3 +380,42 @@ def test_prepared_query_features_path_bit_identical():
     slow = matcher(np.asarray(prepared.image), db)
     for a, b in zip(fast, slow):
         np.testing.assert_array_equal(a, b)
+
+
+def test_pipeline_depth_controller_adapts(monkeypatch):
+    """Latency-regime adaptation: deepen past 2 only when the rolling
+    per-pair wall shows dispatch latency dominating; return to 2 when the
+    tunnel recovers; gaps excluded; never adapt when pinned."""
+    import ncnet_tpu.evaluation.inloc as inloc_mod
+
+    now = [0.0]
+    monkeypatch.setattr(inloc_mod.time, "perf_counter", lambda: now[0])
+
+    ctl = inloc_mod._PipelineDepthController(0, high=0.7, low=0.45)
+    assert ctl.depth == 2
+
+    def drain_every(dt, n):
+        for _ in range(n):
+            now[0] += dt
+            ctl.note_drain()
+
+    ctl.note_drain()            # first drain: no interval yet
+    drain_every(1.0, 8)         # high-latency regime
+    assert ctl.depth == 3
+    drain_every(1.0, 8)
+    assert ctl.depth == 4
+    drain_every(0.3, 16)        # tunnel recovered
+    assert ctl.depth == 2
+
+    ctl.note_gap()              # inter-query gap must not count as latency
+    now[0] += 100.0
+    ctl.note_drain()
+    assert 100.0 not in ctl._samples
+    assert len(ctl._samples) <= 8  # rolling window, not an unbounded log
+
+    pinned = inloc_mod._PipelineDepthController(3)
+    drain_every_p = pinned.note_drain
+    for _ in range(20):
+        now[0] += 5.0
+        drain_every_p()
+    assert pinned.depth == 3
