@@ -294,14 +294,16 @@ def bench_jax(res=None):
                     algo_bytes / (peak_b * 1e9) * 1e3, 3)
                 res["roofline_filter_pct_of_mxu_bound"] = round(
                     100 * mxu_ms / meas, 1)
-                # the honest statement: the filter is NOT HBM-bound — the
-                # gap to the MXU bound is XLA's conv lowering of the
-                # 4D-decomposed shapes, and no measured alternative (bare
-                # GEMM, Pallas banded-Toeplitz, afold) beats it
+                # the binding constraint is whichever analytic bound is
+                # larger.  On v5e the MXU bound (1.43 ms) exceeds the HBM
+                # bound (0.48 ms as-formulated) — the filter is NOT
+                # bandwidth-bound; the gap from the measured ~7 ms to the
+                # MXU bound is XLA's conv lowering of the 4D-decomposed
+                # shapes, and no measured alternative (bare GEMM, Pallas
+                # banded-Toeplitz, afold) beats it
                 # (tools/xla_conv_probe.py, ops/conv4d_pallas.py)
                 res["roofline_verdict"] = (
-                    "mxu-lowering-bound"
-                    if mxu_ms > 3 * hbm_ms else "hbm-bound"
+                    "mxu-lowering-bound" if mxu_ms >= hbm_ms else "hbm-bound"
                 )
         except Exception:
             pass
