@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nc_custom_grad", action="store_true",
                    help="conv4d custom VJP: ~45%% less backward temp memory "
                         "at ~18%% step-time cost (the other memory knob)")
+    p.add_argument("--accum_chunks", type=int, default=-1,
+                   help="volume-chunked gradient accumulation (frozen trunk "
+                        "only): -1 auto (default, the fastest measured "
+                        "backward — any batch fits one 16G chip), 0 "
+                        "whole-batch backward, >1 explicit chunk count")
     return p
 
 
@@ -78,6 +83,7 @@ def main(argv=None) -> int:
         num_workers=args.num_workers,
         remat_nc_layers=args.remat_nc_layers,
         nc_custom_grad=args.nc_custom_grad,
+        accum_chunks=args.accum_chunks,
     )
     fit(config)
     print("Done!")

@@ -311,6 +311,16 @@ def _resolve_accum_chunks(config: TrainConfig, n_dev: int) -> int:
         return 0
     if config.accum_chunks == -1:
         return auto_accum_chunks(config.batch_size, n_dev)
+    if config.accum_chunks < 0:
+        raise ValueError(
+            f"accum_chunks={config.accum_chunks}: use -1 (auto), 0 (off) or "
+            "a positive chunk count"
+        )
+    if config.accum_chunks and (2 * config.batch_size) % config.accum_chunks:
+        raise ValueError(
+            f"accum_chunks={config.accum_chunks} must divide "
+            f"2*batch_size={2 * config.batch_size}"
+        )
     return config.accum_chunks
 
 
@@ -407,6 +417,10 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         if progress:
             print(f"Data parallel over {n_dev} devices (mesh {mesh.shape})")
 
+    accum = _resolve_accum_chunks(config, n_dev if config.data_parallel else 1)
+    if progress and accum:
+        print(f"Gradient accumulation: {accum} chunks of "
+              f"{2 * config.batch_size // accum} volumes")
     train_step = make_train_step(
         model_config, optimizer, donate=config.donate_state,
         stop_backbone_grad=config.fe_finetune_params == 0,
@@ -414,9 +428,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         nc_custom_grad=config.nc_custom_grad,
         fold_pos_neg=config.fold_pos_neg,
         remat_filter=config.remat_filter,
-        accum_chunks=_resolve_accum_chunks(
-            config, n_dev if config.data_parallel else 1
-        ),
+        accum_chunks=accum,
     )
     eval_step = make_eval_step(model_config)
 

@@ -510,3 +510,50 @@ def test_training_improves_pck_on_structured_shift_pairs():
     # cross-platform float drift while still requiring a real improvement
     assert pck_after > pck_before + 0.04, (pck_before, pck_after)
     assert float(loss) < 0.0
+
+
+def test_explicit_accum_chunks_with_finetune_raises(tmp_path):
+    """An explicit chunk count contradicts finetuning (the chunked path
+    detaches the trunk); fit must refuse loudly rather than silently
+    dropping the knob (r4 review finding), while the auto default quietly
+    falls back to the whole-batch backward."""
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=4, image_hw=(48, 48), shift=(16, 16),
+                       seed=9)
+    kw = dict(
+        model=TINY, image_size=48, dataset_image_path=root,
+        dataset_csv_path=root + "/image_pairs", num_epochs=1, batch_size=2,
+        result_model_dir=str(tmp_path / "m"), data_parallel=False,
+        fe_finetune_params=1,
+    )
+    with pytest.raises(ValueError, match="accum_chunks"):
+        training.fit(TrainConfig(**kw, accum_chunks=4), progress=False)
+    # auto (-1) with finetuning: falls back, trains fine
+    r = training.fit(TrainConfig(**kw, accum_chunks=-1), progress=False)
+    assert np.isfinite(r["train_loss"]).all()
+
+    with pytest.raises(ValueError, match="frozen trunk"):
+        training.make_train_step(
+            TINY, training.make_optimizer(
+                training.trainable_labels(
+                    TINY, models.init_ncnet(TINY, jax.random.key(0)), 1)
+            )(1e-3),
+            stop_backbone_grad=False, accum_chunks=4,
+        )
+
+
+@pytest.mark.parametrize("bad", [-2, 3])
+def test_invalid_explicit_accum_chunks_rejected_early(tmp_path, bad):
+    """Bad explicit chunk counts (below -1, or not dividing 2*batch) must be
+    a clear config error before compile, not a trace-time reshape failure."""
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=4, image_hw=(48, 48), shift=(16, 16),
+                       seed=10)
+    cfg = TrainConfig(
+        model=TINY, image_size=48, dataset_image_path=root,
+        dataset_csv_path=root + "/image_pairs", num_epochs=1, batch_size=2,
+        result_model_dir=str(tmp_path / "m"), data_parallel=False,
+        accum_chunks=bad,
+    )
+    with pytest.raises(ValueError, match="accum_chunks"):
+        training.fit(cfg, progress=False)
