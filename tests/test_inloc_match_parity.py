@@ -1,0 +1,190 @@
+"""Cross-framework parity for the InLoc match-extraction chain.
+
+The InLoc headline depends on the POST-filter chain as much as the filter:
+maxpool4d relocalization → ``corr_to_matches(scale='positive', delta4d,
+k_size)`` in both directions → score-sort → coordinate dedup → cell-center
+recentering (/root/reference/eval_inloc.py:134-190, lib/model.py:177-191,
+lib/point_tnf.py:12-80).  This re-states that chain in torch/numpy verbatim
+and runs the same filtered volume through our pieces
+(``maxpool4d_with_argmax`` → ``corr_to_matches`` → ``recenter`` →
+``sort_and_dedup``), comparing the final match tables.  The InLoc analog of
+tests/test_torch_parity.py::test_pck_metric_matches_torch_twin.
+"""
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from ncnet_tpu.evaluation.inloc import recenter, sort_and_dedup
+from ncnet_tpu.ops import corr_to_matches
+from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
+
+
+def torch_maxpool4d(corr4d_hres, k_size):
+    """lib/model.py:177-191 verbatim (integer div → //)."""
+    slices = []
+    for i in range(k_size):
+        for j in range(k_size):
+            for k in range(k_size):
+                for m in range(k_size):
+                    slices.append(
+                        corr4d_hres[:, 0, i::k_size, j::k_size, k::k_size,
+                                    m::k_size].unsqueeze(0))
+    slices = torch.cat(tuple(slices), dim=1)
+    corr4d, max_idx = torch.max(slices, dim=1, keepdim=True)
+    max_l = torch.fmod(max_idx, k_size)
+    max_k = torch.fmod((max_idx - max_l) // k_size, k_size)
+    max_j = torch.fmod(((max_idx - max_l) // k_size - max_k) // k_size, k_size)
+    max_i = (((max_idx - max_l) // k_size - max_k) // k_size - max_j) // k_size
+    return corr4d, max_i, max_j, max_k, max_l
+
+
+def torch_corr_to_matches(corr4d, delta4d=None, k_size=1, do_softmax=False,
+                          scale="positive", invert_matching_direction=False):
+    """lib/point_tnf.py:12-80 verbatim (CPU)."""
+    batch_size, _, fs1, fs2, fs3, fs4 = corr4d.size()
+    if scale == "centered":
+        XA, YA = np.meshgrid(np.linspace(-1, 1, fs2 * k_size),
+                             np.linspace(-1, 1, fs1 * k_size))
+        XB, YB = np.meshgrid(np.linspace(-1, 1, fs4 * k_size),
+                             np.linspace(-1, 1, fs3 * k_size))
+    else:
+        XA, YA = np.meshgrid(np.linspace(0, 1, fs2 * k_size),
+                             np.linspace(0, 1, fs1 * k_size))
+        XB, YB = np.meshgrid(np.linspace(0, 1, fs4 * k_size),
+                             np.linspace(0, 1, fs3 * k_size))
+    JA, IA = np.meshgrid(range(fs2), range(fs1))
+    JB, IB = np.meshgrid(range(fs4), range(fs3))
+    XA, YA = torch.FloatTensor(XA), torch.FloatTensor(YA)
+    XB, YB = torch.FloatTensor(XB), torch.FloatTensor(YB)
+    JA, IA = (torch.LongTensor(JA).view(1, -1), torch.LongTensor(IA).view(1, -1))
+    JB, IB = (torch.LongTensor(JB).view(1, -1), torch.LongTensor(IB).view(1, -1))
+
+    if invert_matching_direction:
+        nc_A_Bvec = corr4d.view(batch_size, fs1, fs2, fs3 * fs4)
+        if do_softmax:
+            nc_A_Bvec = F.softmax(nc_A_Bvec, dim=3)
+        match_A_vals, idx_A_Bvec = torch.max(nc_A_Bvec, dim=3)
+        score = match_A_vals.view(batch_size, -1)
+        iB = IB.view(-1)[idx_A_Bvec.view(-1)].view(batch_size, -1)
+        jB = JB.view(-1)[idx_A_Bvec.view(-1)].view(batch_size, -1)
+        iA = IA.expand_as(iB)
+        jA = JA.expand_as(jB)
+    else:
+        nc_B_Avec = corr4d.view(batch_size, fs1 * fs2, fs3, fs4)
+        if do_softmax:
+            nc_B_Avec = F.softmax(nc_B_Avec, dim=1)
+        match_B_vals, idx_B_Avec = torch.max(nc_B_Avec, dim=1)
+        score = match_B_vals.view(batch_size, -1)
+        iA = IA.view(-1)[idx_B_Avec.view(-1)].view(batch_size, -1)
+        jA = JA.view(-1)[idx_B_Avec.view(-1)].view(batch_size, -1)
+        iB = IB.expand_as(iA)
+        jB = JB.expand_as(jA)
+
+    if delta4d is not None:  # relocalization, point_tnf.py:60-71
+        delta_iA, delta_jA, delta_iB, delta_jB = delta4d
+        diA = delta_iA.squeeze(0).squeeze(0)[
+            iA.view(-1), jA.view(-1), iB.view(-1), jB.view(-1)]
+        djA = delta_jA.squeeze(0).squeeze(0)[
+            iA.view(-1), jA.view(-1), iB.view(-1), jB.view(-1)]
+        diB = delta_iB.squeeze(0).squeeze(0)[
+            iA.view(-1), jA.view(-1), iB.view(-1), jB.view(-1)]
+        djB = delta_jB.squeeze(0).squeeze(0)[
+            iA.view(-1), jA.view(-1), iB.view(-1), jB.view(-1)]
+        iA = iA * k_size + diA.expand_as(iA)
+        jA = jA * k_size + djA.expand_as(jA)
+        iB = iB * k_size + diB.expand_as(iB)
+        jB = jB * k_size + djB.expand_as(jB)
+
+    xA = XA[iA.view(-1), jA.view(-1)].view(batch_size, -1)
+    yA = YA[iA.view(-1), jA.view(-1)].view(batch_size, -1)
+    xB = XB[iB.view(-1), jB.view(-1)].view(batch_size, -1)
+    yB = YB[iB.view(-1), jB.view(-1)].view(batch_size, -1)
+    return xA, yA, xB, yB, score
+
+
+def torch_inloc_matches(corr_fine, k_size, do_softmax=True):
+    """eval_inloc.py:134-190: maxpool4d → both-direction matches → sort →
+    dedup → recenter, returning the final (5, N) table."""
+    c = torch.from_numpy(corr_fine)[:, None]  # (1, 1, hA, wA, hB, wB)
+    corr4d, mi, mj, mk, ml = torch_maxpool4d(c, k_size)
+    delta4d = (mi, mj, mk, ml)
+    _, _, fs1, fs2, fs3, fs4 = corr4d.size()
+
+    a = torch_corr_to_matches(corr4d, delta4d=delta4d, k_size=k_size,
+                              do_softmax=do_softmax)
+    b = torch_corr_to_matches(corr4d, delta4d=delta4d, k_size=k_size,
+                              do_softmax=do_softmax,
+                              invert_matching_direction=True)
+    xA_, yA_, xB_, yB_, score_ = (
+        torch.cat((u, v), 1) for u, v in zip(a, b))
+    sorted_index = torch.sort(-score_)[1].squeeze()
+    xA_, yA_, xB_, yB_, score_ = (
+        v.squeeze()[sorted_index].unsqueeze(0)
+        for v in (xA_, yA_, xB_, yB_, score_))
+    concat_coords = np.concatenate(
+        (xA_.numpy(), yA_.numpy(), xB_.numpy(), yB_.numpy()), 0)
+    _, unique_index = np.unique(concat_coords, axis=1, return_index=True)
+    ui = torch.LongTensor(unique_index)
+    xA_, yA_, xB_, yB_, score_ = (
+        v.squeeze()[ui].unsqueeze(0) for v in (xA_, yA_, xB_, yB_, score_))
+    # recenter (eval_inloc.py:179-189)
+    yA_ = yA_ * (fs1 * k_size - 1) / (fs1 * k_size) + 0.5 / (fs1 * k_size)
+    xA_ = xA_ * (fs2 * k_size - 1) / (fs2 * k_size) + 0.5 / (fs2 * k_size)
+    yB_ = yB_ * (fs3 * k_size - 1) / (fs3 * k_size) + 0.5 / (fs3 * k_size)
+    xB_ = xB_ * (fs4 * k_size - 1) / (fs4 * k_size) + 0.5 / (fs4 * k_size)
+    return np.stack([v.view(-1).numpy() for v in (xA_, yA_, xB_, yB_, score_)])
+
+
+def ours_inloc_matches(corr_fine, k_size, do_softmax=True):
+    """Our pieces composed exactly as the production matcher's jitted run()
+    (evaluation/inloc.py): pool → both-direction matches → recenter on
+    device → host sort/dedup."""
+    corr, delta4d = maxpool4d_with_argmax(jnp.asarray(corr_fine), k_size)
+    fs1, fs2, fs3, fs4 = corr.shape[1:]
+    ms = [
+        corr_to_matches(corr, delta4d=delta4d, k_size=k_size,
+                        do_softmax=do_softmax, scale="positive"),
+        corr_to_matches(corr, delta4d=delta4d, k_size=k_size,
+                        do_softmax=do_softmax, scale="positive",
+                        invert_matching_direction=True),
+    ]
+    xa = np.asarray(jnp.concatenate([m.xA for m in ms], axis=1)).ravel()
+    ya = np.asarray(jnp.concatenate([m.yA for m in ms], axis=1)).ravel()
+    xb = np.asarray(jnp.concatenate([m.xB for m in ms], axis=1)).ravel()
+    yb = np.asarray(jnp.concatenate([m.yB for m in ms], axis=1)).ravel()
+    sc = np.asarray(jnp.concatenate([m.score for m in ms], axis=1)).ravel()
+    ya = np.asarray(recenter(jnp.asarray(ya), fs1 * k_size))
+    xa = np.asarray(recenter(jnp.asarray(xa), fs2 * k_size))
+    yb = np.asarray(recenter(jnp.asarray(yb), fs3 * k_size))
+    xb = np.asarray(recenter(jnp.asarray(xb), fs4 * k_size))
+    return np.stack(sort_and_dedup(xa, ya, xb, yb, sc))
+
+
+def _fine_volume(rng, ha, wa, hb, wb, c=64):
+    fa = rng.standard_normal((1, ha, wa, c)).astype(np.float32)
+    fb = rng.standard_normal((1, hb, wb, c)).astype(np.float32)
+    fa /= np.linalg.norm(fa, axis=-1, keepdims=True)
+    fb /= np.linalg.norm(fb, axis=-1, keepdims=True)
+    return np.einsum("bijc,bklc->bijkl", fa, fb)
+
+
+def test_inloc_match_chain_matches_torch_twin(rng):
+    """Rectangular fine volume, k=2 relocalization, both directions: the
+    final deduped match tables agree row for row."""
+    corr = _fine_volume(rng, 24, 20, 16, 12)
+    ours = ours_inloc_matches(corr, k_size=2)
+    want = torch_inloc_matches(corr, k_size=2)
+    assert ours.shape == want.shape
+    np.testing.assert_allclose(ours[:4], want[:4], atol=1e-6)
+    np.testing.assert_allclose(ours[4], want[4], rtol=1e-5, atol=1e-7)
+
+
+def test_inloc_match_chain_matches_torch_twin_no_softmax(rng):
+    corr = _fine_volume(rng, 12, 16, 20, 12)
+    ours = ours_inloc_matches(corr, k_size=2, do_softmax=False)
+    want = torch_inloc_matches(corr, k_size=2, do_softmax=False)
+    np.testing.assert_allclose(ours[:4], want[:4], atol=1e-6)
+    np.testing.assert_allclose(ours[4], want[4], rtol=1e-5, atol=1e-7)
