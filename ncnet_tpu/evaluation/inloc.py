@@ -134,6 +134,49 @@ class PreparedQuery(NamedTuple):
     features: jnp.ndarray
 
 
+def extract_match_table(
+    out,
+    *,
+    k_size: int,
+    do_softmax: bool,
+    both_directions: bool,
+    flip_direction: bool = False,
+) -> jnp.ndarray:
+    """The post-forward half of the pair matcher's jitted program: filtered
+    ``NCNetOutput`` → stacked ``(5, N)`` match table (xA, yA, xB, yB, score),
+    cell-center recentered (eval_inloc.py:151-189 minus the host-side
+    sort/dedup, which :func:`sort_and_dedup` applies after the single
+    device→host pull).  Factored out of the matcher so the cross-framework
+    parity test (tests/test_inloc_match_parity.py) binds to the PRODUCTION
+    composition, not a restatement."""
+    corr, delta4d = out.corr.astype(jnp.float32), out.delta4d
+    fs1, fs2, fs3, fs4 = corr.shape[1:]
+    k = max(k_size, 1)
+    ms = []
+    if both_directions or not flip_direction:
+        ms.append(corr_to_matches(
+            corr, delta4d=delta4d, k_size=k, do_softmax=do_softmax,
+            scale="positive"))
+    if both_directions or flip_direction:
+        ms.append(corr_to_matches(
+            corr, delta4d=delta4d, k_size=k, do_softmax=do_softmax,
+            scale="positive", invert_matching_direction=True))
+    xa = jnp.concatenate([m.xA for m in ms], axis=1)
+    ya = jnp.concatenate([m.yA for m in ms], axis=1)
+    xb = jnp.concatenate([m.xB for m in ms], axis=1)
+    yb = jnp.concatenate([m.yB for m in ms], axis=1)
+    score = jnp.concatenate([m.score for m in ms], axis=1)
+    ya = recenter(ya, fs1 * k)
+    xa = recenter(xa, fs2 * k)
+    yb = recenter(yb, fs3 * k)
+    xb = recenter(xb, fs4 * k)
+    # one stacked (5, N) result: the device→host pull is a single
+    # transfer instead of five round trips through the tunnel
+    return jnp.stack(
+        [v.astype(jnp.float32).ravel() for v in (xa, ya, xb, yb, score)]
+    )
+
+
 def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
                       both_directions: bool, flip_direction: bool,
                       mesh=None, preprocess_image_size: Optional[int] = None):
@@ -203,37 +246,9 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
             out = ncnet_forward_from_features(config, p, src, tgt)
         else:
             out = forward(p, src, tgt, sharded)
-        corr, delta4d = out.corr.astype(jnp.float32), out.delta4d
-        fs1, fs2, fs3, fs4 = corr.shape[1:]
-        ms = []
-        if both_directions:
-            ms.append(corr_to_matches(
-                corr, delta4d=delta4d, k_size=k, do_softmax=do_softmax,
-                scale="positive"))
-            ms.append(corr_to_matches(
-                corr, delta4d=delta4d, k_size=k, do_softmax=do_softmax,
-                scale="positive", invert_matching_direction=True))
-        elif flip_direction:
-            ms.append(corr_to_matches(
-                corr, delta4d=delta4d, k_size=k, do_softmax=do_softmax,
-                scale="positive", invert_matching_direction=True))
-        else:
-            ms.append(corr_to_matches(
-                corr, delta4d=delta4d, k_size=k, do_softmax=do_softmax,
-                scale="positive"))
-        xa = jnp.concatenate([m.xA for m in ms], axis=1)
-        ya = jnp.concatenate([m.yA for m in ms], axis=1)
-        xb = jnp.concatenate([m.xB for m in ms], axis=1)
-        yb = jnp.concatenate([m.yB for m in ms], axis=1)
-        score = jnp.concatenate([m.score for m in ms], axis=1)
-        ya = recenter(ya, fs1 * k)
-        xa = recenter(xa, fs2 * k)
-        yb = recenter(yb, fs3 * k)
-        xb = recenter(xb, fs4 * k)
-        # one stacked (5, N) result: the device→host pull is a single
-        # transfer instead of five round trips through the tunnel
-        return jnp.stack(
-            [v.astype(jnp.float32).ravel() for v in (xa, ya, xb, yb, score)]
+        return extract_match_table(
+            out, k_size=k, do_softmax=do_softmax,
+            both_directions=both_directions, flip_direction=flip_direction,
         )
 
     jitted = jax.jit(run, static_argnames=("sharded", "src_is_features"))

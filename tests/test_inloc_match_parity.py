@@ -17,8 +17,8 @@ import torch.nn.functional as F
 
 import jax.numpy as jnp
 
-from ncnet_tpu.evaluation.inloc import recenter, sort_and_dedup
-from ncnet_tpu.ops import corr_to_matches
+from ncnet_tpu.evaluation.inloc import extract_match_table, sort_and_dedup
+from ncnet_tpu.models.ncnet import NCNetOutput
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
 
 
@@ -139,28 +139,14 @@ def torch_inloc_matches(corr_fine, k_size, do_softmax=True):
 
 
 def ours_inloc_matches(corr_fine, k_size, do_softmax=True):
-    """Our pieces composed exactly as the production matcher's jitted run()
-    (evaluation/inloc.py): pool → both-direction matches → recenter on
-    device → host sort/dedup."""
+    """The PRODUCTION post-forward chain: pool → ``extract_match_table``
+    (the same function the pair matcher jits) → host sort/dedup."""
     corr, delta4d = maxpool4d_with_argmax(jnp.asarray(corr_fine), k_size)
-    fs1, fs2, fs3, fs4 = corr.shape[1:]
-    ms = [
-        corr_to_matches(corr, delta4d=delta4d, k_size=k_size,
-                        do_softmax=do_softmax, scale="positive"),
-        corr_to_matches(corr, delta4d=delta4d, k_size=k_size,
-                        do_softmax=do_softmax, scale="positive",
-                        invert_matching_direction=True),
-    ]
-    xa = np.asarray(jnp.concatenate([m.xA for m in ms], axis=1)).ravel()
-    ya = np.asarray(jnp.concatenate([m.yA for m in ms], axis=1)).ravel()
-    xb = np.asarray(jnp.concatenate([m.xB for m in ms], axis=1)).ravel()
-    yb = np.asarray(jnp.concatenate([m.yB for m in ms], axis=1)).ravel()
-    sc = np.asarray(jnp.concatenate([m.score for m in ms], axis=1)).ravel()
-    ya = np.asarray(recenter(jnp.asarray(ya), fs1 * k_size))
-    xa = np.asarray(recenter(jnp.asarray(xa), fs2 * k_size))
-    yb = np.asarray(recenter(jnp.asarray(yb), fs3 * k_size))
-    xb = np.asarray(recenter(jnp.asarray(xb), fs4 * k_size))
-    return np.stack(sort_and_dedup(xa, ya, xb, yb, sc))
+    table = extract_match_table(
+        NCNetOutput(corr, delta4d), k_size=k_size, do_softmax=do_softmax,
+        both_directions=True,
+    )
+    return np.stack(sort_and_dedup(*np.asarray(table)))
 
 
 def _fine_volume(rng, ha, wa, hb, wb, c=64):

@@ -26,6 +26,7 @@ from ncnet_tpu.models import backbone as bb
 from ncnet_tpu.models.ncnet import ncnet_forward
 
 from test_backbone import make_resnet101_state_dict, torch_resnet101_features
+from test_inloc_match_parity import torch_corr_to_matches
 
 RNG = np.random.default_rng(7)
 
@@ -204,33 +205,6 @@ def torch_unnormalize_axis(x, L):
     return x * (L - 1) / 2 + 1 + (L - 1) / 2  # point_tnf.py:9-10
 
 
-def torch_corr_to_matches(corr4d, do_softmax=True):
-    """point_tnf.py:12-80, default direction, scale='centered', k_size=1."""
-    b, _, fs1, fs2, fs3, fs4 = corr4d.size()
-    XA, YA = np.meshgrid(np.linspace(-1, 1, fs2), np.linspace(-1, 1, fs1))
-    XB, YB = np.meshgrid(np.linspace(-1, 1, fs4), np.linspace(-1, 1, fs3))
-    JA, IA = np.meshgrid(range(fs2), range(fs1))
-    JB, IB = np.meshgrid(range(fs4), range(fs3))
-    XA, YA = torch.FloatTensor(XA), torch.FloatTensor(YA)
-    XB, YB = torch.FloatTensor(XB), torch.FloatTensor(YB)
-    IA, JA = (torch.LongTensor(IA).view(1, -1), torch.LongTensor(JA).view(1, -1))
-    IB, JB = (torch.LongTensor(IB).view(1, -1), torch.LongTensor(JB).view(1, -1))
-    nc_B_Avec = corr4d.view(b, fs1 * fs2, fs3, fs4)
-    if do_softmax:
-        nc_B_Avec = F.softmax(nc_B_Avec, dim=1)
-    match_B_vals, idx_B_Avec = torch.max(nc_B_Avec, dim=1)
-    score = match_B_vals.view(b, -1)
-    iA = IA.view(-1)[idx_B_Avec.view(-1)].view(b, -1)
-    jA = JA.view(-1)[idx_B_Avec.view(-1)].view(b, -1)
-    iB = IB.expand_as(iA)
-    jB = JB.expand_as(jA)
-    xA = XA[iA.view(-1), jA.view(-1)].view(b, -1)
-    yA = YA[iA.view(-1), jA.view(-1)].view(b, -1)
-    xB = XB[iB.view(-1), jB.view(-1)].view(b, -1)
-    yB = YB[iB.view(-1), jB.view(-1)].view(b, -1)
-    return xA, yA, xB, yB, score
-
-
 def torch_bilinear_interp_point_tnf(matches, target_points_norm):
     """point_tnf.py:96-148 verbatim (note: its flat indexing reads batch 0's
     grids — correct only at batch size 1, which is how the reference eval
@@ -340,7 +314,8 @@ def test_pck_metric_matches_torch_twin():
         with torch.no_grad():
             corr_t = torch_full_forward(
                 sd, nc_torch, torch.from_numpy(x), torch.from_numpy(y))
-            m_t = torch_corr_to_matches(corr_t, do_softmax=True)
+            m_t = torch_corr_to_matches(corr_t, do_softmax=True,
+                                        scale="centered")
             tgt_norm = torch_points_to_unit(
                 torch.from_numpy(pts_tgt), torch.from_numpy(im_tgt))
             warped_norm = torch_bilinear_interp_point_tnf(m_t[:4], tgt_norm)
