@@ -29,6 +29,7 @@ from ncnet_tpu.training import train as tr  # noqa: E402
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 DT_HALF = len(sys.argv) > 2 and sys.argv[2] == "bf16"
+BF16_TRUNK = len(sys.argv) > 2 and sys.argv[2] == "fp32bt"  # fp32 volume, bf16 trunk
 SIZE = 400
 
 COMBOS = []
@@ -50,7 +51,7 @@ if not COMBOS:
 def main():
     mcfg = ModelConfig(
         ncons_kernel_sizes=(5, 5, 5), ncons_channels=(16, 16, 1),
-        half_precision=DT_HALF,
+        half_precision=DT_HALF, backbone_bf16=BF16_TRUNK,
     )
     tcfg = TrainConfig(model=mcfg, batch_size=B, data_parallel=False)
     state, optimizer, mcfg, _ = tr.create_train_state(tcfg)
