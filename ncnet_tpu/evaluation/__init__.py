@@ -1,7 +1,21 @@
 """Metrics and evaluation loops."""
 
-from ncnet_tpu.evaluation.inloc import run_inloc_eval
+from ncnet_tpu.evaluation.inloc import (
+    extract_match_table,
+    make_pair_matcher,
+    run_inloc_eval,
+    sort_and_dedup,
+)
 from ncnet_tpu.evaluation.pck import pck, pck_metric
 from ncnet_tpu.evaluation.pf_pascal import make_eval_step, run_eval
 
-__all__ = ["make_eval_step", "pck", "pck_metric", "run_eval", "run_inloc_eval"]
+__all__ = [
+    "extract_match_table",
+    "make_eval_step",
+    "make_pair_matcher",
+    "pck",
+    "pck_metric",
+    "run_eval",
+    "run_inloc_eval",
+    "sort_and_dedup",
+]
