@@ -17,6 +17,7 @@ from ncnet_tpu.ops.conv4d import (
     conv4d_fold_fits,
     conv4d_init,
     conv4d_same,
+    make_conv4d_same,
     conv4d_transpose_weights,
 )
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
@@ -49,6 +50,7 @@ __all__ = [
     "conv4d_fold_fits",
     "conv4d_init",
     "conv4d_same",
+    "make_conv4d_same",
     "conv4d_transpose_weights",
     "maxpool4d_with_argmax",
     "mutual_matching",

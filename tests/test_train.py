@@ -557,3 +557,14 @@ def test_invalid_explicit_accum_chunks_rejected_early(tmp_path, bad):
     )
     with pytest.raises(ValueError, match="accum_chunks"):
         training.fit(cfg, progress=False)
+
+
+def test_default_config_resolves_to_chunked_backward():
+    """The production default (frozen trunk, accum auto) must resolve to a
+    real chunk count — pinning that the measured fast path IS the default."""
+    from ncnet_tpu.training.train import _resolve_accum_chunks
+
+    assert _resolve_accum_chunks(TrainConfig(), n_dev=1) == 8  # bs16, chunk 4
+    assert _resolve_accum_chunks(TrainConfig(), n_dev=8) == 4  # chunk 8
+    assert _resolve_accum_chunks(
+        TrainConfig(accum_chunks=0), n_dev=1) == 0  # explicit off respected
