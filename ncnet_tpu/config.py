@@ -146,9 +146,11 @@ class EvalInLocConfig:
     spatial_shards: int = 1
     # dispatch/fetch pipeline depth of the eval loop. 0 = adaptive: start at
     # the low-latency optimum of 2 (r3 sweep: 0.62/0.285/0.47/0.51 s/pair at
-    # depths 1/2/3/4) and deepen to at most 4 when the rolling per-pair wall
-    # shows the tunnel's dispatch latency dominating (r3 observation: under
-    # ~2-3x latency regimes depth 3-4 beat 2). >0 pins the depth.
+    # depths 1/2/3/4) and deepen to at most 4 when the per-pair wall EWMA
+    # exceeds 2x the windowed-minimum wall (a measured device-compute
+    # estimate), capped at the r3-measured 0.7 s (r3 observation: under
+    # ~2-3x latency regimes depth 3-4 beat 2). >0 pins the depth verbatim,
+    # BYPASSING the 2-4 adaptive band; negative values are rejected.
     pipeline_depth: int = 0
     # TPU-native addition: stripe queries across hosts (each host writes its
     # own per-query .mat files — the host-parallel eval analog of the

@@ -321,6 +321,18 @@ def _resolve_accum_chunks(config: TrainConfig, n_dev: int) -> int:
             f"accum_chunks={config.accum_chunks} must divide "
             f"2*batch_size={2 * config.batch_size}"
         )
+    if config.accum_chunks and n_dev > 1:
+        chunk = (2 * config.batch_size) // config.accum_chunks
+        if chunk % n_dev:
+            # a chunk that doesn't divide over the data mesh forces GSPMD to
+            # reshard/gather the volume every scan iteration — reject loudly
+            # rather than silently running the slow program
+            raise ValueError(
+                f"accum_chunks={config.accum_chunks} gives chunk size "
+                f"{chunk}, which does not divide over {n_dev} data-parallel "
+                f"devices; pick a count where (2*batch_size/accum_chunks) % "
+                f"n_devices == 0, or use -1 (auto)"
+            )
     return config.accum_chunks
 
 

@@ -568,3 +568,20 @@ def test_default_config_resolves_to_chunked_backward():
     assert _resolve_accum_chunks(TrainConfig(), n_dev=8) == 4  # chunk 8
     assert _resolve_accum_chunks(
         TrainConfig(accum_chunks=0), n_dev=1) == 0  # explicit off respected
+
+
+def test_explicit_accum_chunks_must_divide_over_devices():
+    """An explicit chunk count whose chunk size does not divide over the
+    data mesh would force GSPMD resharding every scan iteration — rejected
+    loudly instead (ADVICE r4)."""
+    from ncnet_tpu.training.train import _resolve_accum_chunks
+
+    # bs8, accum 8 → chunk 2: fine on 1-2 devices, rejected on 8
+    cfg = TrainConfig(batch_size=8, accum_chunks=8)
+    assert _resolve_accum_chunks(cfg, n_dev=1) == 8
+    assert _resolve_accum_chunks(cfg, n_dev=2) == 8
+    with pytest.raises(ValueError, match="does not divide over 8"):
+        _resolve_accum_chunks(cfg, n_dev=8)
+    # a coherent explicit count still passes on the same mesh
+    assert _resolve_accum_chunks(
+        TrainConfig(batch_size=8, accum_chunks=2), n_dev=8) == 2
