@@ -297,11 +297,14 @@ def bench_jax(res=None):
                 # the binding constraint is whichever analytic bound is
                 # larger.  On v5e the MXU bound (1.43 ms) exceeds the HBM
                 # bound (0.48 ms as-formulated) — the filter is NOT
-                # bandwidth-bound; the gap from the measured ~7 ms to the
-                # MXU bound is XLA's conv lowering of the 4D-decomposed
-                # shapes, and no measured alternative (bare GEMM, Pallas
-                # banded-Toeplitz, afold) beats it
-                # (tools/xla_conv_probe.py, ops/conv4d_pallas.py)
+                # bandwidth-bound.  r4 measured ~7.9 ms (18% of the MXU
+                # bound): XLA's conv lowering of the 4D-decomposed shapes.
+                # r5 closes most of that gap with the fused-(hB·wB)-lane
+                # Pallas stack (ops/nc_fused_lane.py): ~4.2 ms (~34% of
+                # bound; the kernel's own dot measures ~88% of peak — the
+                # residual is the A-operand build, a structural 25× tap
+                # copy, plus corr/mm seams; see tools/pallas_l2_probe.py
+                # ablations and tools/filter_stage_probe.py)
                 res["roofline_verdict"] = (
                     "mxu-lowering-bound" if mxu_ms >= hbm_ms else "hbm-bound"
                 )

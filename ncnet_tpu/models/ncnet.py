@@ -159,6 +159,7 @@ def neigh_consensus(
     symmetric: bool = True,
     remat_layers: bool = False,
     custom_grad: "bool | Sequence[Dict[str, str]]" = False,
+    allow_pallas: bool = True,
 ) -> jnp.ndarray:
     """Neighbourhood-consensus filtering of the 4D volume.
 
@@ -183,6 +184,15 @@ def neigh_consensus(
     layer) of ``{"dx": <variant>, "dw": <variant>}`` dicts passed to
     :func:`ncnet_tpu.ops.conv4d.make_conv4d_same` (tools/vjp_sweep_probe.py
     measures the combos composed).
+
+    ``allow_pallas``: permit routing the whole stack through the fused-lane
+    Pallas kernels (ops/nc_fused_lane.py) when the shape class fits —
+    bfloat16, cubic uniform odd kernels, VMEM-feasible volume, Mosaic
+    compile-probe green.  Measured 2.0 vs 3.95 ms/volume against the XLA
+    stack at the PF-Pascal bench workload (v5e, tools/nc_fused_lane_probe).
+    Training paths pass ``False``: the kernels are forward-fast but their
+    VJP replays the XLA stack (one extra forward), a bad trade under
+    ``value_and_grad``.
     """
     if custom_grad is True:
         convs = [conv4d_same] * len(nc_params)
@@ -212,15 +222,47 @@ def neigh_consensus(
 
     layers = [make_layer(i) for i in range(len(nc_params))]
 
+    x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
+
+    use_fused = False
+    if allow_pallas and not remat_layers and custom_grad is False \
+            and x.dtype == jnp.bfloat16:
+        from ncnet_tpu.ops.conv4d import _pallas_available
+        from ncnet_tpu.ops.nc_fused_lane import (
+            fused_lane_compiles,
+            fused_lane_feasible,
+        )
+
+        b, ha, wa, hb, wb = corr.shape
+        kernels = tuple(layer["w"].shape[0] for layer in nc_params)
+        channels = tuple(layer["w"].shape[5] for layer in nc_params)
+        shapes = {(ha, wa, hb, wb)}
+        if symmetric and (ha, wa) != (hb, wb) \
+                and not tap_swap_fusable(nc_params):
+            # only the rectangular two-pass fallback runs stack() on the
+            # A<->B transposed volume — gate that orientation only when it
+            # will actually execute (a square volume batch-folds and the
+            # tap-swap class never transposes)
+            shapes.add((hb, wb, ha, wa))
+        use_fused = _pallas_available() and all(
+            fused_lane_feasible(*s, kernels, channels)
+            and fused_lane_compiles(*s, kernels, channels)
+            for s in shapes
+        )
+
     def stack(x: jnp.ndarray) -> jnp.ndarray:
-        # every layer takes and emits the plain channels-last volume;
-        # conv4d's 'auto' chooser (ops/conv4d.py) is the single authority
-        # for the per-layer MXU formulation
+        # every layer takes and emits the plain channels-last volume.  The
+        # fused-lane Pallas chain replaces the whole stack when the shape
+        # class fits (see ``allow_pallas`` above); otherwise conv4d's
+        # 'auto' chooser (ops/conv4d.py) remains the single authority for
+        # the per-layer MXU formulation
+        if use_fused:
+            from ncnet_tpu.ops.nc_fused_lane import nc_stack_fused
+
+            return nc_stack_fused(nc_params, x)
         for one_layer, layer in zip(layers, nc_params):
             x = one_layer(layer["w"], layer["b"], x)
         return x
-
-    x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
     if symmetric:
         # folding the two passes into the batch dim doubles every NC
         # intermediate's live footprint — an OOM at the InLoc volume, and a
@@ -348,12 +390,14 @@ def ncnet_forward_from_features(
 
 def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
                  remat_nc_layers: bool = False,
-                 nc_custom_grad: bool = False) -> NCNetOutput:
+                 nc_custom_grad: bool = False,
+                 nc_pallas: bool = True) -> NCNetOutput:
     """The post-correlation half of the forward pass: [maxpool4d] →
     MutualMatching → NeighConsensus → MutualMatching.  Split out so the
     high-res/sharded paths can feed their own correlation volume.
     ``remat_nc_layers`` / ``nc_custom_grad``: see :func:`neigh_consensus`
-    (training memory knobs)."""
+    (training memory knobs).  ``nc_pallas``: permit the fused-lane Pallas
+    stack on the forward (training passes False — see ``allow_pallas``)."""
     nc_params = params["nc"]
     if config.half_precision:
         nc_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), nc_params)
@@ -364,7 +408,8 @@ def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
     corr = mutual_matching(corr)
     corr = neigh_consensus(nc_params, corr, symmetric=config.symmetric_mode,
                            remat_layers=remat_nc_layers,
-                           custom_grad=nc_custom_grad)
+                           custom_grad=nc_custom_grad,
+                           allow_pallas=nc_pallas)
     corr = mutual_matching(corr)
     return NCNetOutput(corr, delta4d)
 

@@ -20,6 +20,11 @@ from ncnet_tpu.ops.conv4d import (
     make_conv4d_same,
     conv4d_transpose_weights,
 )
+from ncnet_tpu.ops.nc_fused_lane import (
+    fused_lane_feasible,
+    nc_stack_fused,
+    nc_stack_fused_lane,
+)
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
 from ncnet_tpu.ops.matching import (
     Matches,
@@ -52,6 +57,9 @@ __all__ = [
     "conv4d_same",
     "make_conv4d_same",
     "conv4d_transpose_weights",
+    "fused_lane_feasible",
+    "nc_stack_fused",
+    "nc_stack_fused_lane",
     "maxpool4d_with_argmax",
     "mutual_matching",
     "corr_to_matches",

@@ -1,0 +1,308 @@
+"""Fused-(hB·wB)-lane Pallas kernels for the NC filter stack.
+
+The r5 composed breakdown (tools/filter_stage_probe.py, v5e, PF-Pascal 25⁴
+bf16 bs4) pinned the filter's cost: the 16→16 layer runs at 28% of MXU peak
+and the 16→1 layer at 3.7% under XLA's conv lowering, and every XLA-level
+reformulation measured worse (tools/filter_combo_probe.py: 'abfold' 25.7 vs
+7.7 ms/pair baseline).  This module implements the one formulation XLA
+cannot express, in Pallas:
+
+  * volume rows ride as ``(j, C sublanes, fused padded (hB+h)(wB+h) lanes)``
+    — for the 25⁴ volume with k=5, 841 lanes (94% lane fill at the 896 pad);
+  * the matmul contracts K = (kA, kWA, C_in) — 400 for the 16-channel
+    layers, filling the MXU contraction depth (measured ~88% of peak on the
+    dot, tools/pallas_l2_probe.py ablations);
+  * the B-side (kB, kWB) taps become PURE LANE OFFSETS of the fused kl dim
+    (tap (r,s) ↔ lane shift r·(wB+h)+s), resolved by a vectorized VMEM
+    epilogue over the dot's N = (kB, kWB, C_out) — which measured FREE (it
+    hides behind the MXU);
+  * bias + ReLU fuse into the epilogue; inter-layer volumes stay in the
+    fused layout (no per-layer HBM transpose).
+
+Every primitive was legality-probed on this toolchain before the design was
+fixed (tools/mosaic_probes.py ``r5_*`` battery — the round-2/3 kernel's
+lane-dim reshape is exactly what Mosaic rejects, ops/conv4d_pallas.py).
+
+Thin channel dims are padded up to ``_MIN_CB`` sublanes with zero weights:
+a 1-sublane epilogue block would pay ~3k tiny VPU ops per volume (op-
+overhead-bound); an 8-sublane block rides full native rows.  The extra dot
+FLOPs are the cheaper currency (the dots run at ~88% of peak).
+
+Measured at the bench workload (v5e, bf16, 8 batch-folded volumes,
+tools/pallas_l2_probe.py): 16→16 layer 1.87 ms/volume including the layout
+conversion vs XLA coutfold 2.52 in the same process.
+
+Reference semantics match ``ops/conv4d.py`` 'same' conv (cross-correlation,
+zero padding) + bias + ReLU — the reference's NeighConsensus layer
+(/root/reference/lib/model.py:122-153 with lib/conv4d.py:39-48).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# VMEM working-set budget (v5e: ~16 MiB/core usable by one Pallas program)
+_VMEM_BUDGET = 13 * 2 ** 20
+# pad thin channel dims (c_in of the first layer, c_out of the last) up to
+# this many sublanes.  Swept on v5e at the PF-Pascal stack
+# (tools/nc_fused_lane_probe.py, ms/volume): 8 → 2.63, 4 → 2.20, 2 → 1.997,
+# 1 → 2.04 — the dot's padded-FLOP cost beats the thin-tile epilogue cost
+# down to 2 sublanes, below which tiny epilogue ops dominate.
+import os as _os
+
+_MIN_CB = int(_os.environ.get("NCNET_FUSED_LANE_MIN_CB", "2"))
+# j-chunk of the dot/epilogue loop (measured insensitive across 4-6 at the
+# bench workload; env knob for probes)
+_JCH = int(_os.environ.get("NCNET_FUSED_LANE_JCH", "5"))
+
+
+def _kernel(*refs, k, c_in, c_out, s_j, sp_j, kl, sp_l, je_list):
+    """One (b, i) output row of relu(conv4d_same(x) + bias).
+
+    refs = (x_0..x_{k-1}, w, bias, mask, out):
+      x_p:  (1, 1, sp_j, c_in, kl) — padded input row i+p.
+      w:    (k²·c_in, k²·c_out) = w4d[(p,q,c), (r,s,o)].
+      bias: (1, c_out, 1); mask: (1, 1, kl) halo zeroing.
+      out:  (1, 1, s_j, c_out, kl) — same fused frame, halo lanes zeroed.
+    """
+    x_refs, w_ref, b_ref, m_ref, out_ref = \
+        refs[:k], refs[k], refs[k + 1], refs[k + 2], refs[k + 3]
+    w = w_ref[:]
+    n_lane = kl - sp_l * (k - 1) - (k - 1)  # valid-support slice length
+    h = k - 1
+    for j0, je in je_list:
+        # A[(j), (p,q,c), (kl)]: k² shifted row slabs along the sublane dim
+        a3 = jnp.concatenate(
+            [x_refs[p][0, 0, j0 + q:j0 + q + je] for p in range(k)
+             for q in range(k)],
+            axis=1,
+        )  # (je, k²·c_in, kl)
+        ys = []
+        for j in range(je):
+            y = jax.lax.dot_general(
+                w, a3[j], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (k²·c_out, kl) f32, rows ordered (r, s, o)
+            ys.append(y.astype(jnp.bfloat16))
+        ybuf = jnp.stack(ys, axis=0)
+        acc = jnp.zeros((je, c_out, n_lane), jnp.float32)
+        for r in range(k):
+            for s in range(k):
+                blk = (r * k + s) * c_out
+                off = r * sp_l + s
+                acc = acc + ybuf[:, blk:blk + c_out, off:off + n_lane].astype(
+                    jnp.float32)
+        acc = jnp.maximum(acc + b_ref[:].astype(jnp.float32), 0.0)
+        pad_lo = (h // 2) * sp_l + h // 2
+        full = jnp.pad(acc, ((0, 0), (0, 0), (pad_lo, kl - pad_lo - n_lane)))
+        out_ref[0, 0, j0:j0 + je] = (
+            full * m_ref[:].astype(jnp.float32)).astype(out_ref.dtype)
+
+
+def _conv_fused_lane(xp, w2, bias, mask, *, k, c_in, c_out, s_j, sp_l, kl,
+                     interpret=False):
+    """xp: (B, sp_i, sp_j, c_in, kl) padded fused-lane rows (bf16).
+    Returns (B, s_i, s_j, c_out, kl) with halo lanes zeroed."""
+    b, sp_i, sp_j = xp.shape[:3]
+    s_i = sp_i - (k - 1)
+    je_list = tuple((j0, min(_JCH, s_j - j0)) for j0 in range(0, s_j, _JCH))
+    kern = functools.partial(
+        _kernel, k=k, c_in=c_in, c_out=c_out, s_j=s_j, sp_j=sp_j, kl=kl,
+        sp_l=sp_l, je_list=je_list,
+    )
+    row_spec = lambda p: pl.BlockSpec(  # noqa: E731
+        (1, 1, sp_j, c_in, kl), lambda bi, ii, p=p: (bi, ii + p, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(b, s_i),
+        in_specs=[row_spec(p) for p in range(k)] + [
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, s_j, c_out, kl), lambda bi, ii: (bi, ii, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, s_i, s_j, c_out, kl), xp.dtype),
+        interpret=interpret,
+    )(*([xp] * k), w2, bias, mask)
+
+
+def _pad_c(c: int) -> int:
+    return max(c, _MIN_CB)
+
+
+def _pack_weight(w, k, c_in, c_out):
+    """(k,k,k,k,C_in,C_out) -> (k²·cinP, k²·coutP) [(p,q,c),(r,s,o)], with
+    thin channel dims zero-padded to _MIN_CB sublanes."""
+    ci, co = _pad_c(c_in), _pad_c(c_out)
+    wp = jnp.pad(
+        w, ((0, 0),) * 4 + ((0, ci - c_in), (0, co - c_out))
+    )
+    return jnp.transpose(wp, (0, 1, 4, 2, 3, 5)).reshape(
+        k * k * ci, k * k * co
+    )
+
+
+def _make_mask(s_kl: tuple, k: int) -> np.ndarray:
+    """(1, 1, kl) bf16: 1 on the valid (k,l) support, 0 on halo lanes."""
+    hb, wb = s_kl
+    h = k - 1
+    m = np.zeros((hb + h, wb + h), np.float32)
+    m[h // 2:h // 2 + hb, h // 2:h // 2 + wb] = 1.0
+    return m.reshape(1, 1, -1)
+
+
+def fused_lane_feasible(ha, wa, hb, wb, kernels, channels) -> bool:
+    """Whether every layer's working set fits the VMEM budget and the shape
+    class matches the kernel (cubic odd kernels, one k for the stack)."""
+    ks = set(kernels)
+    if len(ks) != 1 or kernels[0] % 2 == 0:
+        return False
+    if channels[-1] != 1:
+        # the chain's un-fuse step returns the scalar volume (channel 0);
+        # a wider final layer is not the NC-stack shape class
+        return False
+    k = kernels[0]
+    sp_l = wb + k - 1
+    kl = (hb + k - 1) * sp_l
+    sp_j = wa + k - 1
+    c_in = 1
+    for c_out in channels:
+        ci, co = _pad_c(c_in), _pad_c(c_out)
+        rows = k * sp_j * ci * kl * 2                       # k input rows
+        a3 = _JCH * k * k * ci * kl * 2                     # A build
+        ybuf = _JCH * k * k * co * kl * 2                   # bf16 Y
+        yf32 = k * k * co * kl * 4                          # one dot output
+        out = wa * co * kl * 2
+        w = (k * k * ci) * (k * k * co) * 2
+        if rows + a3 + ybuf + yf32 + out + w > _VMEM_BUDGET:
+            return False
+        c_in = c_out
+    return True
+
+
+@functools.lru_cache(maxsize=8)
+def fused_lane_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
+    """Real-compile probe at batch 1 (cached per shape class): Mosaic
+    lowering legality depends on concrete shapes, so the chooser verifies an
+    actual compile and any failure falls back to the XLA formulations —
+    the same discipline as ops/conv4d_pallas.pallas_compiles."""
+    try:
+        x = jax.ShapeDtypeStruct((1, ha, wa, hb, wb, 1), jnp.bfloat16)
+        ws, bs = [], []
+        c_in = 1
+        for kk, c_out in zip(kernels, channels):
+            ws.append(jax.ShapeDtypeStruct(
+                (kk,) * 4 + (c_in, c_out), jnp.bfloat16))
+            bs.append(jax.ShapeDtypeStruct((c_out,), jnp.bfloat16))
+            c_in = c_out
+        def run(x, ws, bs):
+            params = [{"w": w, "b": b} for w, b in zip(ws, bs)]
+            return nc_stack_fused_lane(params, x)
+        jax.jit(run).lower(x, ws, bs).compile()
+        return True
+    except Exception:
+        return False
+
+
+def nc_stack_fused_lane(nc_params: List[dict], x: jnp.ndarray,
+                        interpret: bool = False) -> jnp.ndarray:
+    """The full [conv4d_same + bias + ReLU]×N stack on ``x``
+    ``(B, hA, wA, hB, wB, 1)``, chained through the fused-lane layout.
+
+    Numerically equivalent (up to bf16 rounding; the dots accumulate f32) to
+    the XLA stack in models/ncnet.py `neigh_consensus.stack`.  Forward-only:
+    wrap under `jax.custom_vjp` at the call site for training (the chooser
+    only routes eval/forward here — see neigh_consensus).
+    """
+    b, ha, wa, hb, wb, _ = x.shape
+    assert nc_params[-1]["w"].shape[5] == 1, (
+        "nc_stack_fused_lane requires a 1-channel final layer (the NC-stack "
+        "shape class); wider stacks must use the XLA formulations"
+    )
+    k = nc_params[0]["w"].shape[0]
+    h = k - 1
+    sp_l = wb + h
+    kl = (hb + h) * sp_l
+    mask = jnp.asarray(_make_mask((hb, wb), k), jnp.bfloat16)
+
+    # (B, hA, wA, hB, wB, 1) -> (B, hA+h, wA+h, 1->cinP, kl): pure pads +
+    # minor-dim reshape (no transpose: (k,l) is already minor)
+    xp = jnp.pad(
+        x[..., 0],
+        ((0, 0),) + ((h // 2, h // 2),) * 4,
+    ).reshape(b, ha + h, wa + h, 1, kl)
+    xp = jnp.pad(xp, ((0, 0),) * 3 + ((0, _pad_c(1) - 1), (0, 0)))
+    xp = xp.astype(jnp.bfloat16)
+
+    c_in = 1
+    for li, layer in enumerate(nc_params):
+        c_out = layer["w"].shape[5]
+        co_p = _pad_c(c_out)
+        w2 = _pack_weight(
+            layer["w"].astype(jnp.bfloat16), k, c_in, c_out)
+        bias = jnp.pad(
+            layer["b"].astype(jnp.bfloat16), (0, co_p - c_out)
+        ).reshape(1, co_p, 1)
+        y = _conv_fused_lane(
+            xp, w2, bias, mask, k=k, c_in=_pad_c(c_in), c_out=co_p,
+            s_j=wa, sp_l=sp_l, kl=kl, interpret=interpret,
+        )
+        if li + 1 < len(nc_params):
+            # re-pad rows/cols for the next layer's halo (cheap leading-dim
+            # pads; the lane halos are already zeroed by the kernel mask)
+            xp = jnp.pad(
+                y, ((0, 0), (h // 2, h // 2), (h // 2, h // 2), (0, 0),
+                    (0, 0)),
+            )
+        c_in = c_out
+
+    # (B, hA, wA, coP, kl) -> take channel 0, unfuse lanes, drop halo
+    out = y[:, :, :, 0, :].reshape(b, ha, wa, hb + h, wb + h)
+    out = out[:, :, :, h // 2:h // 2 + hb, h // 2:h // 2 + wb]
+    return out[..., None]
+
+
+def _xla_stack(nc_params, x):
+    """The equivalent XLA stack (conv4d auto) — the custom-VJP backward."""
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    for layer in nc_params:
+        x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
+    return x
+
+
+@jax.custom_vjp
+def nc_stack_fused(nc_params, x):
+    """:func:`nc_stack_fused_lane` with an XLA-fallback backward.
+
+    Pallas kernels have no AD rule; differentiating this op replays the
+    equivalent XLA stack's VJP (one extra XLA forward).  Training paths
+    route to the XLA stack directly (``allow_pallas=False`` in
+    models/ncnet.py) — this VJP exists so a user-level ``jax.grad`` over
+    the eval forward stays correct rather than erroring."""
+    return nc_stack_fused_lane(nc_params, x)
+
+
+def _fused_fwd(nc_params, x):
+    return nc_stack_fused_lane(nc_params, x), (nc_params, x)
+
+
+def _fused_bwd(res, g):
+    nc_params, x = res
+    _, vjp = jax.vjp(_xla_stack, nc_params, x)
+    return vjp(g.astype(x.dtype))
+
+
+nc_stack_fused.defvjp(_fused_fwd, _fused_bwd)

@@ -129,9 +129,11 @@ def weak_loss(
         fb = fb.astype(jnp.bfloat16)
 
     def filt(p, corr):
+        # nc_pallas=False: under value_and_grad the fused-lane kernels'
+        # VJP replays the XLA stack (an extra forward) — a net loss
         return ncnet_filter(
             config, p, corr, remat_nc_layers=remat_nc_layers,
-            nc_custom_grad=nc_custom_grad,
+            nc_custom_grad=nc_custom_grad, nc_pallas=False,
         ).corr
 
     if remat_filter:
@@ -233,6 +235,7 @@ def weak_loss_and_grads(
         nc = ncnet_filter(
             config, p, correlation_4d(fac, fbc),
             remat_nc_layers=remat_nc_layers, nc_custom_grad=nc_custom_grad,
+            nc_pallas=False,  # see weak_loss: the fused VJP replays XLA
         ).corr
         return jnp.sum(match_score_per_pair(nc, normalization) * wc)
 

@@ -1,0 +1,123 @@
+"""Fused-lane Pallas NC stack: numerics (interpret mode), gating, VJP.
+
+The kernel's on-chip timing lives in tools/nc_fused_lane_probe.py (measured
+2.0 vs 3.95 ms/volume against the XLA stack, v5e r5); these tests lock the
+numerics and the routing so the fast path cannot drift from the XLA
+formulations it replaces.  Reference semantics: NeighConsensus
+(/root/reference/lib/model.py:122-153).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ncnet_tpu.ops.conv4d import conv4d
+from ncnet_tpu.ops.nc_fused_lane import (
+    fused_lane_feasible,
+    nc_stack_fused,
+    nc_stack_fused_lane,
+)
+
+
+def xla_stack(params, x):
+    for layer in params:
+        x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
+    return x
+
+
+def make_params(key, kernels, channels, dtype=jnp.float32):
+    params, c_in = [], 1
+    for i, (k, c_out) in enumerate(zip(kernels, channels)):
+        k1, k2, key = jax.random.split(key, 3)
+        params.append({
+            "w": jax.random.normal(k1, (k,) * 4 + (c_in, c_out), dtype) * 0.1,
+            "b": jax.random.normal(k2, (c_out,), dtype) * 0.1,
+        })
+        c_in = c_out
+    return params
+
+
+@pytest.mark.parametrize("shape,kernels,channels", [
+    ((2, 7, 7, 7, 7), (3, 3), (4, 1)),          # IVD-like 2-layer
+    ((1, 6, 5, 7, 6), (3, 3, 3), (4, 4, 1)),    # rectangular, 3-layer
+    ((1, 9, 9, 9, 9), (5, 5, 5), (4, 4, 1)),    # PF-Pascal k=5 class
+])
+def test_interpret_parity(shape, kernels, channels):
+    """Interpret-mode fused chain == XLA stack (same bf16 inputs, f32
+    comparison): locks the A-build order, the (r,s) lane-offset epilogue,
+    the halo masks, and the thin-channel zero padding."""
+    key = jax.random.key(0)
+    # bf16 end-to-end: the kernel computes in bf16 (f32 dot accumulation),
+    # so the XLA reference must see the same operands or the comparison
+    # measures bf16 rounding, not the kernel
+    params = make_params(key, kernels, channels, dtype=jnp.bfloat16)
+    x = (jax.random.normal(jax.random.key(7), shape + (1,)) * 0.5
+         ).astype(jnp.bfloat16)
+
+    ref = np.asarray(xla_stack(params, x), np.float32)
+    got = np.asarray(
+        nc_stack_fused_lane(params, x, interpret=True), np.float32
+    )
+    scale = max(1e-6, float(np.max(np.abs(ref))))
+    np.testing.assert_allclose(got / scale, ref / scale, atol=3e-2)
+
+
+def test_feasibility_gate():
+    """Shape-class gate: PF-Pascal passes; InLoc-scale VMEM blowups, mixed
+    kernel sizes, and even kernels are all rejected."""
+    assert fused_lane_feasible(25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+    assert fused_lane_feasible(13, 13, 13, 13, (3, 3), (16, 1))
+    # InLoc fine grid: the fused kl dim alone is ~30k lanes
+    assert not fused_lane_feasible(100, 75, 150, 200, (3, 3), (16, 1))
+    assert not fused_lane_feasible(25, 25, 25, 25, (5, 3, 5), (16, 16, 1))
+    assert not fused_lane_feasible(25, 25, 25, 25, (4, 4, 4), (16, 16, 1))
+    # the chain returns the scalar volume: wider final layers are not the
+    # NC-stack shape class
+    assert not fused_lane_feasible(25, 25, 25, 25, (5, 5), (16, 16))
+
+
+def test_cpu_routing_falls_back_to_xla():
+    """On the CPU backend the chooser must not route to Mosaic: the
+    neigh_consensus output equals the XLA stack bit-for-bit."""
+    from ncnet_tpu.models.ncnet import neigh_consensus
+
+    key = jax.random.key(1)
+    params = make_params(key, (3, 3), (4, 1), dtype=jnp.bfloat16)
+    corr = (jax.random.normal(jax.random.key(2), (2, 7, 7, 7, 7)) * 0.5
+            ).astype(jnp.bfloat16)
+    out = neigh_consensus(params, corr, symmetric=True)
+    # reference: the explicit XLA-only path
+    ref = neigh_consensus(params, corr, symmetric=True, allow_pallas=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_custom_vjp_matches_xla_grads():
+    """jax.grad through nc_stack_fused must equal grads of the XLA stack
+    (the VJP replays the XLA formulations; the forward here runs interpret
+    via monkeypatching is unnecessary — on CPU the fused forward is only
+    reachable in interpret mode, so compare the VJP rule directly)."""
+    key = jax.random.key(3)
+    params = make_params(key, (3,), (2,))
+    x = jax.random.normal(jax.random.key(4), (1, 5, 5, 5, 5, 1)) * 0.5
+
+    def loss_fused(p, x):
+        # forward value comes from the fused path's own primal; its VJP is
+        # defined as the XLA stack's — evaluate via jax.vjp directly
+        _, vjp = jax.vjp(lambda pp, xx: nc_stack_fused(pp, xx), p, x)
+        return vjp
+
+    # build cotangent from the XLA forward (shapes match)
+    out_ref, vjp_ref = jax.vjp(lambda pp, xx: xla_stack(pp, xx), params, x)
+    g = jnp.ones_like(out_ref)
+
+    # the fused op's bwd rule is exactly the XLA stack's VJP
+    from ncnet_tpu.ops.nc_fused_lane import _fused_bwd
+
+    d_fused = _fused_bwd((params, x), g)
+    d_ref = vjp_ref(g)
+    for a, b in zip(jax.tree.leaves(d_fused), jax.tree.leaves(d_ref)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-4, atol=1e-5,
+        )

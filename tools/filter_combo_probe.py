@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Composed-filter timings for per-layer variant combos, incl. 'abfold'.
+
+The r5 stage breakdown (filter_stage_probe.py) pinned the composed cost:
+L2 (16->16) 4.56 ms/pair at 28% MXU, L3 (16->1) 2.12 at 3.7% — the rest is
+noise.  This probe times the FULL composed filter (corr -> mm -> batch-fold
+-> L1 -> L2 -> L3 -> unfold -> mm) with per-layer variant overrides, plus a
+new 'abfold' formulation: kA folded into INPUT channels (shift-concat) and
+kWA folded into OUTPUT channels (shifted sum), turning the 4D conv into a
+single 2D conv over (hB, wB) with kA*C_in x kWA*C_out channels — an
+80x80-channel (5,5) conv for L2, the shape class XLA's TPU conv lowering
+handles best (ResNet-like), instead of coutfold's 3D conv with its
+kA-shifted channel-slice epilogue.
+
+Usage: python tools/filter_combo_probe.py [batch]
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+S = 25
+DT = jnp.bfloat16
+
+
+def conv4d_abfold(x, weight, bias=None):
+    """kA -> input-channel fold, kWA -> output-channel fold; one 2D conv."""
+    b, ha, wa, hb, wb, c_in = x.shape
+    ka, kwa, kb, kwb, _, c_out = weight.shape
+    xp = jnp.pad(x, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 4)
+    shifts = jnp.concatenate(
+        [lax.slice_in_dim(xp, p, p + ha, axis=1) for p in range(ka)], axis=-1
+    )  # (b, ha, wa, hb, wb, ka*c_in)
+    # kernel (kb, kwb, ka*c_in, kwa*c_out): w[p,q,r,s,c,o] -> [(r,s),(p,c),(q,o)]
+    wf = jnp.transpose(weight, (2, 3, 0, 4, 1, 5)).reshape(
+        kb, kwb, ka * c_in, kwa * c_out
+    )
+    dn = lax.conv_dimension_numbers(
+        (b * ha * wa, hb, wb, ka * c_in), wf.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    y = lax.conv_general_dilated(
+        shifts.reshape(b * ha * wa, hb, wb, ka * c_in),
+        wf,
+        window_strides=(1, 1),
+        padding=[(kb // 2, kb // 2), (kwb // 2, kwb // 2)],
+        dimension_numbers=dn,
+    )
+    y = y.reshape(b, ha, wa, hb, wb, kwa * c_out)
+    y = jnp.pad(y, ((0, 0), (0, 0), (kwa // 2, kwa // 2)) + ((0, 0),) * 3)
+    out = None
+    for q in range(kwa):
+        o = lax.slice_in_dim(y, q, q + wa, axis=2)[..., q * c_out:(q + 1) * c_out]
+        out = o if out is None else out + o
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def make_input(key):
+    k1, k2, *ks = jax.random.split(key, 5)
+    fa = jax.random.normal(k1, (B, S, S, 128), jnp.float32) * 0.03
+    fb = jax.random.normal(k2, (B, S, S, 128), jnp.float32) * 0.03
+    chans = [(1, 16), (16, 16), (16, 1)]
+    params = []
+    for kk, (ci, co) in zip(ks, chans):
+        params.append({
+            "w": jax.random.normal(kk, (5, 5, 5, 5, ci, co), DT) * 0.05,
+            "b": jnp.zeros((co,), DT),
+        })
+    return fa, fb, params
+
+
+def make_step(variants):
+    from ncnet_tpu.ops import correlation_4d, mutual_matching
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    def apply(i, x, params):
+        v = variants[i]
+        w, bias = params[i]["w"], params[i]["b"]
+        if v == "abfold":
+            return jax.nn.relu(conv4d_abfold(x, w, bias))
+        return jax.nn.relu(conv4d(x, w, bias, variant=v))
+
+    def step(carry):
+        fa, fb, params = carry
+        x = correlation_4d(fa.astype(DT), fb.astype(DT))
+        x = mutual_matching(x)[..., None]
+        xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
+        x = jnp.concatenate([x, xt], axis=0)
+        for i in range(3):
+            x = apply(i, x, params)
+        y = x[..., 0]
+        x = mutual_matching(y[:B] + jnp.transpose(y[B:], (0, 3, 4, 1, 2)))
+        eps = (jnp.sum(x.astype(jnp.float32)) * 1e-12).astype(fa.dtype)
+        return fa + eps, fb, params
+
+    return step
+
+
+def check_abfold():
+    """Numerical parity of abfold vs the production conv4d."""
+    import numpy as np
+
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (2, 7, 7, 7, 7, 16), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (5, 5, 5, 5, 16, 8), jnp.float32)
+    b = jax.random.normal(jax.random.key(2), (8,), jnp.float32)
+    ref = conv4d(x, w, b, variant="unroll")
+    got = conv4d_abfold(x, w, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4,
+                               atol=2e-4)
+    print("abfold parity OK")
+
+
+COMBOS = [
+    ("baseline (tapfold,coutfold,coutfold)", ["tapfold", "coutfold", "coutfold"]),
+    ("L2=abfold", ["tapfold", "abfold", "coutfold"]),
+    ("L3=afold", ["tapfold", "coutfold", "afold"]),
+    ("L2=abfold L3=afold", ["tapfold", "abfold", "afold"]),
+    ("L1=abfold L2=abfold L3=afold", ["abfold", "abfold", "afold"]),
+    ("L2=abfold L3=abfold", ["tapfold", "abfold", "abfold"]),
+]
+
+
+def main():
+    check_abfold()
+    print(f"device={jax.devices()[0].device_kind} batch={B} dtype=bf16")
+    for name, variants in COMBOS:
+        try:
+            ms = timeit(make_step(variants), make_input, per=B, n_long=8)
+            print(f"{name:>36}: {ms:7.3f} ms/pair")
+        except Exception as e:
+            print(f"{name:>36}: ERR {str(e)[:80]}")
+
+
+if __name__ == "__main__":
+    main()

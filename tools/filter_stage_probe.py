@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Composed-filter stage breakdown at the PF-Pascal bench shape.
+
+The bench's ``filter_ms_per_pair_bf16`` (7.93 r4) sits 5.5x above its MXU
+bound; before building a fused kernel, measure WHERE the time goes — in
+composition, not standalone (standalone wins have twice inverted composed,
+see ops/conv4d.py history).  Prefix-differencing: time the composed filter
+truncated after each stage (volume born from the production einsum, every
+output consumed); consecutive differences are the composed per-stage costs.
+
+Stages mirror ncnet_filter + the batch-folded symmetric stack
+(models/ncnet.py): MM -> [fold 2B] L1 -> L2 -> L3 -> [unfold+add] -> MM.
+
+Usage: python tools/filter_stage_probe.py [batch]
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+IMG_FEAT = 25
+DT = jnp.bfloat16
+
+
+def make_input(key):
+    k1, k2, *ks = jax.random.split(key, 5)
+    feat = (IMG_FEAT, IMG_FEAT)
+    fa = jax.random.normal(k1, (B, *feat, 128), jnp.float32) * 0.03
+    fb = jax.random.normal(k2, (B, *feat, 128), jnp.float32) * 0.03
+    chans = [(1, 16), (16, 16), (16, 1)]
+    params = []
+    for kk, (ci, co) in zip(ks, chans):
+        params.append({
+            "w": jax.random.normal(kk, (5, 5, 5, 5, ci, co), DT) * 0.05,
+            "b": jnp.zeros((co,), DT),
+        })
+    return fa, fb, params
+
+
+def make_prefix(n_stages):
+    """Composed filter truncated after stage n (1=corr, 2=+MM, 3=+fold+L1,
+    4=+L2, 5=+L3, 6=+unfold/add, 7=+final MM)."""
+    from ncnet_tpu.ops import correlation_4d, mutual_matching
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    def step(carry):
+        fa, fb, params = carry
+        x = correlation_4d(fa.astype(DT), fb.astype(DT))
+        if n_stages >= 2:
+            x = mutual_matching(x)
+        if n_stages >= 3:
+            x = x[..., None]
+            xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
+            x = jnp.concatenate([x, xt], axis=0)  # batch-fold: 2B volumes
+            x = jax.nn.relu(conv4d(x, params[0]["w"], params[0]["b"]))
+        if n_stages >= 4:
+            x = jax.nn.relu(conv4d(x, params[1]["w"], params[1]["b"]))
+        if n_stages >= 5:
+            x = jax.nn.relu(conv4d(x, params[2]["w"], params[2]["b"]))
+        if n_stages >= 6:
+            y = x[..., 0]
+            x = y[:B] + jnp.transpose(y[B:], (0, 3, 4, 1, 2))
+        if n_stages >= 7:
+            x = mutual_matching(x)
+        eps = (jnp.sum(x.astype(jnp.float32)) * 1e-12).astype(fa.dtype)
+        return fa + eps, fb, params
+
+    return step
+
+
+NAMES = ["corr", "+mm1", "+fold+L1", "+L2", "+L3", "+unfold", "+mm2"]
+
+
+def main():
+    print(f"device={jax.devices()[0].device_kind} batch={B} dtype=bf16 "
+          f"(symmetric batch-fold: convs see batch {2 * B})")
+    prev = 0.0
+    for n in range(1, 8):
+        ms = timeit(make_prefix(n), make_input, per=B, n_long=8)
+        print(f"prefix {n} ({NAMES[n-1]:>9}): {ms:7.3f} ms/pair   "
+              f"delta {ms - prev:7.3f}")
+        prev = ms
+
+
+if __name__ == "__main__":
+    main()

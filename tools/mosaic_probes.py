@@ -162,6 +162,85 @@ def probe_old_kernel():
         raise RuntimeError("pallas_compiles -> False")
 
 
+
+# --- r5 probes: primitives for the fused-(hB*wB)-lane NC-stack kernel ---
+# Layout: tiles (J, C=16 sublane-blocks, 841 fused-kl lanes); K=(p,q,c)=400.
+
+KL, CC, JJ = 841, 16, 5
+
+
+def probe_r5_sublane_offset_store_3d():
+    """store a (J,16,KL) slab at a 16-aligned sublane offset of (J,400,KL)."""
+    def k(x_ref, o_ref):
+        o_ref[:] = jnp.zeros((JJ, 400, KL), DT)
+        o_ref[:, 32:48, :] = x_ref[:]
+    _compile(k, (JJ, 400, KL), (JJ, CC, KL))
+
+
+def probe_r5_lane_shift_add_3d():
+    """arbitrary-lane-offset slice of a 3D tile + accumulate (epilogue)."""
+    def k(x_ref, o_ref):
+        acc = jnp.zeros((JJ, CC, 721), jnp.float32)
+        for off in (0, 33, 60, 120):
+            acc = acc + x_ref[:, :, off:off + 721].astype(jnp.float32)
+        o_ref[:] = acc.astype(DT)
+    _compile(k, (JJ, CC, 721), (JJ, CC, KL))
+
+
+def probe_r5_dot_k400():
+    """dot_general contracting dim0 of both: (400,400)x(400,841)->(400,841)."""
+    def k(w_ref, a_ref, o_ref):
+        o_ref[:] = jax.lax.dot_general(
+            w_ref[:], a_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(DT)
+    _compile(k, (400, KL), (400, 400), (400, KL))
+
+
+def probe_r5_dot_rhs3d():
+    """dot with a 3D rhs free dim: (400,400)x(J,400,841)->(400,J,841)."""
+    def k(w_ref, a_ref, o_ref):
+        o_ref[:] = jax.lax.dot_general(
+            w_ref[:], a_ref[:], (((0,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(DT)
+    _compile(k, (400, JJ, KL), (400, 400), (JJ, 400, KL))
+
+
+def probe_r5_lane_mask_mul():
+    """multiply a (J,16,841) tile by a (1,1,841) lane mask (halo zeroing)."""
+    def k(x_ref, m_ref, o_ref):
+        o_ref[:] = x_ref[:] * m_ref[:]
+    _compile(k, (JJ, CC, KL), (JJ, CC, KL), (1, 1, KL))
+
+
+def probe_r5_bias_sublane_broadcast():
+    """add a per-sublane bias (1,16,1) to a (J,16,841) tile (+ relu)."""
+    def k(x_ref, b_ref, o_ref):
+        o_ref[:] = jnp.maximum(x_ref[:] + b_ref[:], 0)
+    _compile(k, (JJ, CC, KL), (JJ, CC, KL), (1, CC, 1))
+
+
+def probe_r5_leading_index_dot():
+    """leading-index a (J,400,KL) scratch then 2D dot per j (static loop)."""
+    def k(w_ref, a_ref, o_ref):
+        for j in range(JJ):
+            o_ref[j] = jax.lax.dot_general(
+                w_ref[:], a_ref[j], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(DT)
+    _compile(k, (JJ, 400, KL), (400, 400), (JJ, 400, KL))
+
+
+def probe_r5_leading_slab_copy():
+    """copy a leading-dim slab between 3D refs (A-build primitive)."""
+    def k(x_ref, o_ref):
+        o_ref[:] = jnp.zeros((JJ, 400, KL), DT)
+        for pq in range(4):
+            o_ref[:, pq * CC:(pq + 1) * CC, :] = x_ref[pq:pq + JJ, :, :]
+    _compile(k, (JJ, 400, KL), (JJ + 4, CC, KL))
+
+
 PROBES = {
     n[len("probe_"):]: f
     for n, f in sorted(globals().items())

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Measure a fused-(hB*wB)-lane Pallas kernel for the L2 conv (16->16, 5^4).
+
+The r5 composed breakdown (filter_stage_probe.py): L2 = 4.56 ms/pair at bs4
+(= 2.28 ms/volume at the batch-folded 2B=8) — 28% of MXU peak — and every
+XLA-level reformulation measured worse (filter_combo_probe.py).  This kernel
+tests the one shape XLA cannot express: volume tiles of layout
+``(j, C sublanes, fused padded (hB+4)(wB+4)=841 lanes)`` where
+
+  * K = (kA, kWA, C_in) = 400 fills the MXU contraction depth (vs XLA conv
+    lowering's effective 28%),
+  * the B-side (kB, kWB) taps become PURE LANE OFFSETS of the fused kl dim
+    (r*29+s), resolved by a vectorized VMEM epilogue over N=(r,s,o)=400,
+  * inter-op intermediates never touch HBM.
+
+All primitives probed legal on this toolchain (tools/mosaic_probes.py
+r5_*).  Prints ms/volume for the kernel (including the XLA-side layout
+conversion, measured separately) vs the XLA composed reference.
+
+Usage: python tools/pallas_l2_probe.py [batch]
+"""
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+S, K, C = 25, 5, 16
+SP = S + K - 1          # 29: padded rows/cols
+KL = SP * SP            # 841 fused (k,l) lanes
+JCH = int(sys.argv[2]) if len(sys.argv) > 2 else 5   # j-chunk
+YDT = jnp.bfloat16 if (len(sys.argv) <= 3 or sys.argv[3] == "bf16") \
+    else jnp.float32  # Ybuf dtype between dot and epilogue
+# ablation: full | noepi (build+dots, sum Y) | nodots (build only, sum A3)
+MODE = sys.argv[4] if len(sys.argv) > 4 else "full"
+# A-build method: concat (one 25-piece sublane concat) | scratch (stores)
+BUILD = sys.argv[5] if len(sys.argv) > 5 else "concat"
+
+
+def _kernel(*refs, je_list):
+    """One (b, i) step: refs = (x_0..x_4, w, bias, mask, out[, a_scr])."""
+    x_refs, w_ref, b_ref, m_ref, out_ref = refs[:K], refs[K], refs[K + 1], \
+        refs[K + 2], refs[K + 3]
+    a_scr = refs[K + 4] if BUILD == "scratch" else None
+    w = w_ref[:]
+    for j0, je in je_list:
+        # A3[(j), (p,q,c), (kl)]: 25 shifted row slabs along the sublane dim
+        if BUILD == "scratch":
+            for p in range(K):
+                for q in range(K):
+                    pq = p * K + q
+                    a_scr[:je, pq * C:(pq + 1) * C, :] = \
+                        x_refs[p][0, 0, j0 + q:j0 + q + je]
+            a3 = a_scr[:je]
+        else:
+            a3 = jnp.concatenate(
+                [x_refs[p][0, 0, j0 + q:j0 + q + je] for p in range(K)
+                 for q in range(K)],
+                axis=1,
+            )  # (je, 400, 841)
+        if MODE == "nodots":
+            out_ref[0, 0, j0:j0 + je] = jnp.broadcast_to(
+                jnp.sum(a3.astype(jnp.float32)) * 1e-9, (je, C, KL)
+            ).astype(out_ref.dtype)
+            continue
+        ys = []
+        for j in range(je):
+            y = jax.lax.dot_general(
+                w, a3[j], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (400, 841) f32, rows ordered (r,s,o)
+            ys.append(y.astype(YDT))
+        ybuf = jnp.stack(ys, axis=0)  # (je, 400, 841)
+        if MODE == "noepi":
+            out_ref[0, 0, j0:j0 + je] = jnp.broadcast_to(
+                jnp.sum(ybuf.astype(jnp.float32)) * 1e-9, (je, C, KL)
+            ).astype(out_ref.dtype)
+            continue
+        acc = jnp.zeros((je, C, 721), jnp.float32)
+        for r in range(K):
+            for s in range(K):
+                blk = (r * K + s) * C
+                off = r * SP + s
+                acc = acc + ybuf[:, blk:blk + C, off:off + 721].astype(
+                    jnp.float32)
+        acc = jnp.maximum(acc + b_ref[:].astype(jnp.float32), 0.0)
+        full = jnp.pad(acc, ((0, 0), (0, 0), (60, 60)))
+        out_ref[0, 0, j0:j0 + je] = (
+            full * m_ref[:].astype(jnp.float32)).astype(out_ref.dtype)
+
+
+def conv_l2_pallas(xp, w2, bias, mask):
+    """xp: (B, 29, 29, 16, 841) padded fused-lane volume (bf16).
+    w2: (400, 400) = w[(p,q,c), (r,s,o)].  Returns (B, 25, 25, 16, 841)
+    relu(conv+bias) rows in the same padded-lane frame (halos zeroed)."""
+    b = xp.shape[0]
+    je_list = tuple(
+        (j0, min(JCH, S - j0)) for j0 in range(0, S, JCH)
+    )
+    kern = functools.partial(_kernel, je_list=je_list)
+    row_spec = lambda p: pl.BlockSpec(  # noqa: E731
+        (1, 1, SP, C, KL), lambda bi, ii, p=p: (bi, ii + p, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(b, S),
+        in_specs=[row_spec(p) for p in range(K)] + [
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, S, C, KL), lambda bi, ii: (bi, ii, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, S, S, C, KL), xp.dtype),
+        scratch_shapes=(
+            [pltpu.VMEM((JCH, K * K * C, KL), xp.dtype)]
+            if BUILD == "scratch" else []
+        ),
+    )(*([xp] * K), w2, bias, mask)
+
+
+def to_fused_layout(x):
+    """(B, 25, 25, 25, 25, 16) -> (B, 29, 29, 16, 841) padded bf16."""
+    b = x.shape[0]
+    xp = jnp.pad(x, ((0, 0), (2, 2), (2, 2), (2, 2), (2, 2), (0, 0)))
+    xp = jnp.transpose(xp, (0, 1, 2, 5, 3, 4)).reshape(b, SP, SP, C, KL)
+    return xp
+
+
+def from_fused_layout(y):
+    """(B, 25, 25, 16, 841) -> (B, 25, 25, 25, 25, 16)."""
+    b = y.shape[0]
+    y = y.reshape(b, S, S, C, SP, SP)[:, :, :, :, 2:2 + S, 2:2 + S]
+    return jnp.transpose(y, (0, 1, 2, 4, 5, 3))
+
+
+def pack_weight(w):
+    """(5,5,5,5,16,16) -> (400, 400) [(p,q,c),(r,s,o)]."""
+    return jnp.transpose(w, (0, 1, 4, 2, 3, 5)).reshape(K * K * C, K * K * C)
+
+
+def make_mask():
+    m = np.zeros((SP, SP), np.float32)
+    m[2:2 + S, 2:2 + S] = 1.0
+    return jnp.asarray(m.reshape(1, 1, KL), jnp.bfloat16)
+
+
+def check():
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, S, S, S, S, C)) * 0.1, jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(K, K, K, K, C, C)) * 0.05, jnp.bfloat16)
+    bias = jnp.asarray(rng.normal(size=(C,)) * 0.1, jnp.bfloat16)
+
+    ref = jax.nn.relu(conv4d(x, w, bias, variant="unroll"))
+    got = from_fused_layout(
+        conv_l2_pallas(
+            to_fused_layout(x), pack_weight(w),
+            bias.reshape(1, C, 1), make_mask(),
+        )
+    )
+    err = np.max(np.abs(np.asarray(got, np.float32) -
+                        np.asarray(ref, np.float32)))
+    rel = err / max(1e-6, float(np.max(np.abs(np.asarray(ref, np.float32)))))
+    print(f"parity: max abs err {err:.4g} (rel {rel:.3%})")
+    assert rel < 0.05, "numerics mismatch"
+
+
+def main():
+    print(f"device={jax.devices()[0].device_kind} n_volumes={B} "
+          f"(bench shape: bs4 pairs = 8 batch-folded volumes) mode={MODE}")
+    if MODE == "full":
+        check()
+
+    def make_input(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return (
+            jax.random.normal(k1, (B, S, S, S, S, C), jnp.bfloat16) * 0.1,
+            jax.random.normal(k2, (K,) * 4 + (C, C), jnp.bfloat16) * 0.05,
+            jax.random.normal(k3, (C,), jnp.bfloat16) * 0.1,
+        )
+
+    def step_layout_only(carry):
+        x, w, bias = carry
+        xp = to_fused_layout(x)
+        eps = (jnp.sum(xp.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+        return x + eps, w, bias
+
+    def step_kernel(carry):
+        x, w, bias = carry
+        out = conv_l2_pallas(to_fused_layout(x), pack_weight(w),
+                             bias.reshape(1, C, 1), make_mask())
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+        return x + eps, w, bias
+
+    def step_xla(carry):
+        from ncnet_tpu.ops.conv4d import conv4d
+
+        x, w, bias = carry
+        out = jax.nn.relu(conv4d(x, w, bias, variant="coutfold"))
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+        return x + eps, w, bias
+
+    ms_layout = timeit(step_layout_only, make_input, per=B, n_long=8)
+    ms_kernel = timeit(step_kernel, make_input, per=B, n_long=8)
+    ms_xla = timeit(step_xla, make_input, per=B, n_long=8)
+    print(f"layout conversion only : {ms_layout:7.3f} ms/volume")
+    print(f"pallas kernel (+layout): {ms_kernel:7.3f} ms/volume")
+    print(f"xla coutfold reference : {ms_xla:7.3f} ms/volume")
+
+
+if __name__ == "__main__":
+    main()
