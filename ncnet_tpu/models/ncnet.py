@@ -226,7 +226,11 @@ def neigh_consensus(
 
     use_fused = False
     if allow_pallas and not remat_layers and custom_grad is False \
-            and x.dtype == jnp.bfloat16:
+            and x.dtype == jnp.bfloat16 \
+            and all(layer["w"].dtype == jnp.bfloat16 for layer in nc_params):
+        # params must already be bf16 (ncnet_filter casts them): mixed
+        # fp32-params/bf16-volume calls keep the XLA path, where XLA's own
+        # promotion rules apply, instead of a silent bf16 downcast
         from ncnet_tpu.ops.conv4d import _pallas_available
         from ncnet_tpu.ops.nc_fused_lane import (
             fused_lane_compiles,

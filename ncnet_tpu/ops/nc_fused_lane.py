@@ -45,8 +45,11 @@ from typing import List
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+# NOTE: jax.experimental.pallas is imported lazily inside _conv_fused_lane —
+# the package re-exports this module, and `import ncnet_tpu.ops` must stay
+# light and pallas-independent (the same discipline as ops/conv4d.py's
+# function-local pallas imports)
 
 # VMEM working-set budget (v5e: ~16 MiB/core usable by one Pallas program)
 _VMEM_BUDGET = 13 * 2 ** 20
@@ -110,6 +113,9 @@ def _conv_fused_lane(xp, w2, bias, mask, *, k, c_in, c_out, s_j, sp_l, kl,
                      interpret=False):
     """xp: (B, sp_i, sp_j, c_in, kl) padded fused-lane rows (bf16).
     Returns (B, s_i, s_j, c_out, kl) with halo lanes zeroed."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     b, sp_i, sp_j = xp.shape[:3]
     s_i = sp_i - (k - 1)
     je_list = tuple((j0, min(_JCH, s_j - j0)) for j0 in range(0, s_j, _JCH))

@@ -62,6 +62,24 @@ def test_run_eval_batch_size_invariance(tmp_path, identity_tiny_net):
     np.testing.assert_allclose(s1["per_pair"], s3["per_pair"], rtol=1e-5, atol=1e-5)
 
 
+def test_run_eval_bf16_trunk_upload_path(tmp_path):
+    """A backbone_bf16 net takes the bf16 image-upload fast path (halved
+    tunnel bytes); the cast commutes with the trunk's own bf16 cast, so the
+    identity-kernel shift recovery must still score like the fp32 path."""
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,), backbone_bf16=True)
+    net = models.NCNet(cfg, seed=0)
+    w = np.zeros((3, 3, 3, 3, 1, 1), np.float32)
+    w[1, 1, 1, 1, 0, 0] = 1.0
+    net.params["nc"] = [{"w": jnp.asarray(w), "b": jnp.zeros((1,))}]
+    root = str(tmp_path)
+    write_pf_pascal_like(root, n_pairs=4, image_hw=(96, 96), shift=(16, 16), seed=2)
+    config = EvalPFPascalConfig(image_size=96, eval_dataset_path=root)
+    stats = run_eval(config, net=net, batch_size=2, progress=False)
+    assert stats["total"] == 4 and stats["valid"] == 4
+    assert stats["pck"] > 0.7, stats
+
+
 def test_cli_smoke(tmp_path, capsys):
     from ncnet_tpu.cli.eval_pf_pascal import main
 

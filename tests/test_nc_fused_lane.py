@@ -77,6 +77,10 @@ def test_feasibility_gate():
     assert not fused_lane_feasible(25, 25, 25, 25, (5, 5), (16, 16))
 
 
+@pytest.mark.skipif(
+    "TPU" in jax.devices()[0].device_kind,
+    reason="on a TPU backend the default path legitimately routes to Mosaic",
+)
 def test_cpu_routing_falls_back_to_xla():
     """On the CPU backend the chooser must not route to Mosaic: the
     neigh_consensus output equals the XLA stack bit-for-bit."""
@@ -92,32 +96,49 @@ def test_cpu_routing_falls_back_to_xla():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_custom_vjp_matches_xla_grads():
-    """jax.grad through nc_stack_fused must equal grads of the XLA stack
-    (the VJP replays the XLA formulations; the forward here runs interpret
-    via monkeypatching is unnecessary — on CPU the fused forward is only
-    reachable in interpret mode, so compare the VJP rule directly)."""
+def test_mixed_precision_params_keep_xla_path():
+    """bf16 volume + fp32 NC params must NOT take the fused path (which
+    would SILENTLY downcast the weights to bf16): the gate keeps the XLA
+    path, where the dtype mismatch fails loudly — the production API
+    (ncnet_filter) always casts volume and params together."""
+    from ncnet_tpu.models.ncnet import neigh_consensus
+
+    params = make_params(jax.random.key(5), (3,), (1,), dtype=jnp.float32)
+    corr = (jax.random.normal(jax.random.key(6), (1, 6, 6, 6, 6)) * 0.5
+            ).astype(jnp.bfloat16)
+    with pytest.raises(TypeError, match="same dtypes"):
+        neigh_consensus(params, corr, symmetric=False)
+
+
+def test_custom_vjp_matches_xla_grads(monkeypatch):
+    """User-level jax.vjp THROUGH nc_stack_fused (the registered custom_vjp,
+    not its private pieces) must produce the XLA stack's gradients — this
+    exercises the defvjp wiring end-to-end.  The primal runs in interpret
+    mode on CPU via monkeypatching the forward the rule calls."""
+    import ncnet_tpu.ops.nc_fused_lane as mod
+
     key = jax.random.key(3)
-    params = make_params(key, (3,), (2,))
-    x = jax.random.normal(jax.random.key(4), (1, 5, 5, 5, 5, 1)) * 0.5
+    params = make_params(key, (3,), (1,), dtype=jnp.bfloat16)
+    x = (jax.random.normal(jax.random.key(4), (1, 5, 5, 5, 5, 1)) * 0.5
+         ).astype(jnp.bfloat16)
 
-    def loss_fused(p, x):
-        # forward value comes from the fused path's own primal; its VJP is
-        # defined as the XLA stack's — evaluate via jax.vjp directly
-        _, vjp = jax.vjp(lambda pp, xx: nc_stack_fused(pp, xx), p, x)
-        return vjp
+    real = mod.nc_stack_fused_lane
+    monkeypatch.setattr(
+        mod, "nc_stack_fused_lane",
+        lambda p, xx, interpret=True: real(p, xx, interpret=True),
+    )
 
-    # build cotangent from the XLA forward (shapes match)
+    out_f, vjp_f = jax.vjp(mod.nc_stack_fused, params, x)
     out_ref, vjp_ref = jax.vjp(lambda pp, xx: xla_stack(pp, xx), params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_f, np.float32), np.asarray(out_ref, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
     g = jnp.ones_like(out_ref)
-
-    # the fused op's bwd rule is exactly the XLA stack's VJP
-    from ncnet_tpu.ops.nc_fused_lane import _fused_bwd
-
-    d_fused = _fused_bwd((params, x), g)
+    d_fused = vjp_f(g)
     d_ref = vjp_ref(g)
     for a, b in zip(jax.tree.leaves(d_fused), jax.tree.leaves(d_ref)):
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
-            rtol=1e-4, atol=1e-5,
+            rtol=1e-3, atol=1e-3,
         )
