@@ -53,36 +53,23 @@ def _to_np(v) -> np.ndarray:
     return v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
 
 
-def import_torch_checkpoint(
-    ckpt: Any, base_config: ModelConfig = ModelConfig()
-) -> Tuple[ModelConfig, Dict[str, Any]]:
-    """Convert a reference ``.pth.tar`` checkpoint (path or loaded dict).
+def split_reference_state_dict(state_dict, config: ModelConfig):
+    """Rekey + split a reference state_dict into framework-layout views.
 
-    Returns ``(config, params)`` with arch hyperparams overridden from the
-    checkpoint's stored args, like the reference does.
+    Applies the load-time quirks the reference itself applies — the legacy
+    ``'vgg'→'model'`` key rename (model.py:225-232) and the
+    ``num_batches_tracked`` filter (model.py:244-248) — then splits into:
+
+      * ``fe_sd``: trunk weights keyed by torchvision names (numpy), and
+      * ``nc_raw``: per-NC-layer ``(weight, bias)`` numpy pairs in the
+        STORED Conv4d layout ``(kA, C_out, C_in, kWA, kB, kWB)``
+        (/root/reference/lib/conv4d.py:72-77).
+
+    The one parsing used BOTH by the production importer and by the
+    torch-twin activation check (tools/parity_kit.py) — a loader quirk
+    added here is automatically exercised by the parity runbook.
     """
-    if isinstance(ckpt, (str, os.PathLike)):
-        import torch
-
-        ckpt = torch.load(ckpt, map_location="cpu", weights_only=False)
-
-    sd = {k.replace("vgg", "model"): _to_np(v) for k, v in ckpt["state_dict"].items()}
-
-    config = base_config
-    args = ckpt.get("args")
-    if args is not None:
-        config = config.replace(
-            ncons_kernel_sizes=tuple(getattr(args, "ncons_kernel_sizes", config.ncons_kernel_sizes)),
-            ncons_channels=tuple(getattr(args, "ncons_channels", config.ncons_channels)),
-        )
-        fe = getattr(args, "feature_extraction_cnn", None)
-        if fe:
-            config = config.replace(backbone=fe)
-        fe_last = getattr(args, "feature_extraction_last_layer", None)
-        if fe_last:
-            config = config.replace(backbone_last_layer=fe_last)
-
-    # --- backbone ---------------------------------------------------------
+    sd = {k.replace("vgg", "model"): _to_np(v) for k, v in state_dict.items()}
     fe_sd = {}
     for k, v in sd.items():
         if not k.startswith("FeatureExtraction.model."):
@@ -98,25 +85,53 @@ def import_torch_checkpoint(
             fe_sd[f"{name}.{tail}"] = v
         else:
             fe_sd[rest] = v
+    # Sequential [Conv4d, ReLU]×N → conv layers at indices 0, 2, 4, ...
+    nc_raw = [
+        (sd[f"NeighConsensus.conv.{2 * j}.weight"],
+         sd[f"NeighConsensus.conv.{2 * j}.bias"])
+        for j in range(len(config.ncons_kernel_sizes))
+    ]
+    return fe_sd, nc_raw
+
+
+def import_torch_checkpoint(
+    ckpt: Any, base_config: ModelConfig = ModelConfig()
+) -> Tuple[ModelConfig, Dict[str, Any]]:
+    """Convert a reference ``.pth.tar`` checkpoint (path or loaded dict).
+
+    Returns ``(config, params)`` with arch hyperparams overridden from the
+    checkpoint's stored args, like the reference does.
+    """
+    if isinstance(ckpt, (str, os.PathLike)):
+        import torch
+
+        ckpt = torch.load(ckpt, map_location="cpu", weights_only=False)
+
+    config = base_config
+    args = ckpt.get("args")
+    if args is not None:
+        config = config.replace(
+            ncons_kernel_sizes=tuple(getattr(args, "ncons_kernel_sizes", config.ncons_kernel_sizes)),
+            ncons_channels=tuple(getattr(args, "ncons_channels", config.ncons_channels)),
+        )
+        fe = getattr(args, "feature_extraction_cnn", None)
+        if fe:
+            config = config.replace(backbone=fe)
+        fe_last = getattr(args, "feature_extraction_last_layer", None)
+        if fe_last:
+            config = config.replace(backbone_last_layer=fe_last)
+
+    fe_sd, nc_raw = split_reference_state_dict(ckpt["state_dict"], config)
     backbone_params = bb.import_torch_backbone(
         fe_sd, config.backbone, last_layer=config.backbone_last_layer
     )
-
-    # --- neighbourhood consensus -----------------------------------------
-    # Sequential [Conv4d, ReLU]×N → conv layers at indices 0, 2, 4, ...
-    # Stored Conv4d weights are pre-permuted to (kA, C_out, C_in, kWA, kB,
-    # kWB) (/root/reference/lib/conv4d.py:72-77); ours are
-    # (kA, kWA, kB, kWB, C_in, C_out).
-    nc = []
-    for j in range(len(config.ncons_kernel_sizes)):
-        w = sd[f"NeighConsensus.conv.{2 * j}.weight"]
-        b = sd[f"NeighConsensus.conv.{2 * j}.bias"]
-        nc.append(
-            {
-                "w": jnp.asarray(np.transpose(w, (0, 3, 4, 5, 2, 1))),
-                "b": jnp.asarray(b),
-            }
-        )
+    # stored Conv4d layout (kA, C_out, C_in, kWA, kB, kWB) → ours
+    # (kA, kWA, kB, kWB, C_in, C_out)
+    nc = [
+        {"w": jnp.asarray(np.transpose(w, (0, 3, 4, 5, 2, 1))),
+         "b": jnp.asarray(b)}
+        for w, b in nc_raw
+    ]
 
     return config, {"backbone": backbone_params, "nc": nc}
 

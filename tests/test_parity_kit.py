@@ -103,3 +103,99 @@ def test_trace_and_compare(torch_ckpt, pf_root, tmp_path, capsys):
     )
     assert parity_kit.main(["--compare", ours, trunc]) == 1
     assert parity_kit.main(["--compare", ours, trunc, "--allow_missing"]) == 0
+
+
+def test_all_runbook(torch_ckpt, pf_root, capsys):
+    """The --all real-weights-day runbook end-to-end on the synthetic
+    checkpoint: import + arch report + torch-twin activation golden-check +
+    PCK vs the ⚠ 78.9% target — proving the single command runs before the
+    day the released weights are reachable (VERDICT r4 item 6)."""
+    rc = parity_kit.main([
+        "--all", "--pfpascal_checkpoint", torch_ckpt, "--ivd_checkpoint", "",
+        "--dataset", pf_root, "--image_size", "64", "--quiet",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "twin activation check" in out and "PASS" in out
+    assert "PCK@0.1" in out and "78.9%" in out
+    assert "arch: backbone=resnet101" in out
+    assert "[ivd] no checkpoint given" in out
+
+    # --expect_pck converts a shortfall into exit 1 (synthetic identity-less
+    # weights cannot hit 101%)
+    rc = parity_kit.main([
+        "--all", "--pfpascal_checkpoint", torch_ckpt, "--ivd_checkpoint", "",
+        "--dataset", pf_root, "--image_size", "64", "--quiet",
+        "--expect_pck", "101.0",
+    ])
+    assert rc == 1
+
+    # --expect_pck with no way to run PCK must FAIL, not silently pass
+    rc = parity_kit.main([
+        "--all", "--pfpascal_checkpoint", torch_ckpt, "--ivd_checkpoint", "",
+        "--image_size", "64", "--quiet", "--expect_pck", "50.0",
+    ])
+    assert rc == 1
+    assert "never ran" in capsys.readouterr().out
+
+    # an EXPLICIT missing checkpoint path is a typo → argparse error, not a
+    # silent skip
+    with pytest.raises(SystemExit):
+        parity_kit.main([
+            "--all", "--pfpascal_checkpoint", "/nonexistent/x.pth.tar",
+        ])
+
+
+def test_legacy_vgg_rekey_checkpoint(tmp_path, capsys):
+    """The reference's oldest checkpoints key the trunk as
+    'FeatureExtraction.vgg.*'; load-time it renames 'vgg'→'model'
+    (/root/reference/lib/model.py:225-232).  A fabricated legacy checkpoint
+    must load through NCNet(checkpoint=...) end-to-end and produce the SAME
+    params and forward as its modern-keyed twin (VERDICT r4 item 6)."""
+    import argparse as ap
+
+    import torch
+
+    import jax.numpy as jnp
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models import NCNet
+
+    rng = np.random.default_rng(3)
+    name_to_idx = {"conv1": "0", "bn1": "1", "layer1": "4", "layer2": "5",
+                   "layer3": "6"}
+    modern, legacy = {}, {}
+    for k, v in make_resnet101_state_dict().items():
+        name, _, tail = k.partition(".")
+        t = torch.tensor(v)
+        modern[f"FeatureExtraction.model.{name_to_idx[name]}.{tail}"] = t
+        legacy[f"FeatureExtraction.vgg.{name_to_idx[name]}.{tail}"] = t
+    w = rng.standard_normal((3, 3, 3, 3, 1, 1)).astype(np.float32) * 0.2
+    for sd in (modern, legacy):
+        sd["NeighConsensus.conv.0.weight"] = torch.tensor(
+            np.transpose(w, (0, 5, 4, 1, 2, 3)))
+        sd["NeighConsensus.conv.0.bias"] = torch.tensor(
+            np.zeros(1, np.float32))
+    args = ap.Namespace(ncons_kernel_sizes=[3], ncons_channels=[1],
+                        feature_extraction_cnn="resnet101")
+    p_modern = str(tmp_path / "modern.pth.tar")
+    p_legacy = str(tmp_path / "legacy.pth.tar")
+    torch.save({"state_dict": modern, "args": args}, p_modern)
+    torch.save({"state_dict": legacy, "args": args}, p_legacy)
+
+    import jax
+
+    net_m = NCNet(ModelConfig(checkpoint=p_modern))
+    net_l = NCNet(ModelConfig(checkpoint=p_legacy))
+    leaves_m = [np.asarray(x) for x in jax.tree.leaves(net_m.params)]
+    leaves_l = [np.asarray(x) for x in jax.tree.leaves(net_l.params)]
+    assert len(leaves_m) == len(leaves_l)
+    for a, b in zip(leaves_m, leaves_l):
+        np.testing.assert_array_equal(a, b)
+
+    x = rng.standard_normal((1, 48, 48, 3)).astype(np.float32)
+    y = rng.standard_normal((1, 48, 48, 3)).astype(np.float32)
+    out_m = np.asarray(net_m(jnp.asarray(x), jnp.asarray(y)).corr)
+    out_l = np.asarray(net_l(jnp.asarray(x), jnp.asarray(y)).corr)
+    np.testing.assert_array_equal(out_m, out_l)
+    assert np.isfinite(out_l).all()
