@@ -197,6 +197,12 @@ def torch_twin_activation_check(torch_checkpoint: str, net,
               f"resnet101[:layer3] trunk, checkpoint has "
               f"{net.config.backbone}[{net.config.backbone_last_layer}]")
         return True
+    if not os.path.isfile(torch_checkpoint):
+        # the rest of the kit accepts orbax checkpoint DIRECTORIES too;
+        # the twin needs the reference's torch state_dict layout
+        print("  twin check skipped: not a torch .pth.tar "
+              "(orbax checkpoints have no reference-layout state_dict)")
+        return True
 
     ckpt = torch.load(torch_checkpoint, map_location="cpu",
                       weights_only=False)
@@ -250,8 +256,10 @@ def run_all(args) -> int:
             continue
         print(f"[{label}] importing {ckpt_path}")
         net = build_net(ckpt_path)
-        print(f"  arch: backbone={net.config.backbone}"
-              f"[{net.config.backbone_last_layer or 'layer3'}] "
+        last = net.config.backbone_last_layer or (
+            "layer3" if net.config.backbone == "resnet101" else "default-cut"
+        )
+        print(f"  arch: backbone={net.config.backbone}[{last}] "
               f"ncons_kernel_sizes={list(net.config.ncons_kernel_sizes)} "
               f"ncons_channels={list(net.config.ncons_channels)}")
         if not torch_twin_activation_check(ckpt_path, net,
