@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Variant timings at the SHARDED filter's conv shapes (VERDICT r4 item 8).
+
+The hB-sharded path (parallel/spatial.py `_nc_stack_sharded`) re-enters
+``choose_conv4d_variant`` with shapes the chooser's measurements never
+covered: per-shard volumes with a halo-padded hB and ``pad_hb=False``
+(valid conv).  The chooser's decision depends only on shapes — not on the
+mesh — so the per-shard convs can be timed honestly on ONE chip by feeding
+inputs at exactly the halo-padded shapes the shards see.
+
+Workload: the canonical InLoc case (image 3200, k=2 pooled 56M-cell volume
+(1,100,75,100,75), IVD arch k=3 with the tap-swap-fused first layer
+1→32ch), hB=100 sharded 8 ways → per-shard hB_local=13 (+pad to 104/8) + 1
+halo each side.  Composed structure mirrors `_neigh_consensus_sharded`'s
+fused branch: L1 (1→32, pad_hb=False) → relu → halo-shape L2 twins
+(16→1 ×2, pad_hb=False) → sum.
+
+Result (v5e, r5, bf16, ms/shard-pass): auto(tapfold,coutfold) 4.76 —
+already the fastest; L1=coutfold 7.2, L1=unroll 8.9, L2=unroll 10.9,
+L2=tapfold 18.1, both-unroll 19.9.  The chooser's routing HOLDS at the
+halo-padded valid-conv shapes; no pin needed.
+
+Usage: python tools/sharded_variant_probe.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+DT = jnp.bfloat16
+# per-shard InLoc shape: hB 100 -> pad 104 -> 13 local (+2*halo(k=3)=1)
+HA, WA, HB_LOC, WB = 100, 75, 13, 75
+HALO = 1
+K = 3
+C = 16
+
+
+def make_input(key):
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (1, HA, WA, HB_LOC + 2 * HALO, WB, 1), DT) * 0.1
+    w1 = jax.random.normal(ks[1], (K,) * 4 + (1, 2 * C), DT) * 0.1
+    w2a = jax.random.normal(ks[2], (K,) * 4 + (C, 1), DT) * 0.1
+    w2b = jax.random.normal(ks[3], (K,) * 4 + (C, 1), DT) * 0.1
+    return x, w1, w2a, w2b
+
+
+def make_step(v1, v2):
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    def step(carry):
+        x, w1, w2a, w2b = carry
+        y = jax.nn.relu(conv4d(x, w1, pad_hb=False, variant=v1))
+        # the production path re-halos between layers (ppermute); the
+        # single-chip stand-in pads the SAME number of rows so L2 sees the
+        # identical shape class
+        yp = jnp.pad(y, ((0, 0),) * 3 + ((HALO, HALO),) + ((0, 0),) * 2)
+        out = jax.nn.relu(conv4d(yp[..., :C], w2a, pad_hb=False, variant=v2)) \
+            + jax.nn.relu(conv4d(yp[..., C:], w2b, pad_hb=False, variant=v2))
+        eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+        return x + eps, w1, w2a, w2b
+
+    return step
+
+
+def main():
+    from ncnet_tpu.ops.conv4d import choose_conv4d_variant
+
+    auto1 = choose_conv4d_variant(
+        1, 2 * C, HB_LOC + 2 * HALO, WB, shape_a=(HA, WA), kernel=(K,) * 4,
+        same_pad=False, dtype=DT, batch=1,
+    )
+    auto2 = choose_conv4d_variant(
+        C, 1, HB_LOC, WB, shape_a=(HA, WA), kernel=(K,) * 4,
+        same_pad=False, dtype=DT, batch=1,
+    )
+    print(f"device={jax.devices()[0].device_kind}  "
+          f"auto routing: L1={auto1} L2={auto2}")
+
+    combos = [
+        ("auto", ("auto", "auto")),
+        (f"pinned auto ({auto1},{auto2})", (auto1, auto2)),
+        ("L1=coutfold", ("coutfold", auto2)),
+        ("L1=unroll", ("unroll", auto2)),
+        ("L2=unroll", (auto1, "unroll")),
+        ("L2=tapfold", (auto1, "tapfold")),
+        ("both unroll", ("unroll", "unroll")),
+    ]
+    for name, (v1, v2) in combos:
+        try:
+            ms = timeit(make_step(v1, v2), make_input, n_long=6)
+            print(f"{name:>28}: {ms:8.3f} ms/shard-pass")
+        except Exception as e:
+            print(f"{name:>28}: ERR {str(e)[:80]}")
+
+
+if __name__ == "__main__":
+    main()
