@@ -57,22 +57,25 @@ def _dn3(x_shape, w_shape):
     return lax.conv_dimension_numbers(x_shape, w_shape, ("NDHWC", "DHWIO", "NDHWC"))
 
 
-def _pads3(kwa: int, kb: int, kwb: int, pad_hb: bool):
+def _pads3(kwa: int, kb: int, kwb: int, pad_hb: bool,
+           pad_wa: bool = True, pad_wb: bool = True):
     return [
-        (kwa // 2, kwa // 2),
+        (kwa // 2, kwa // 2) if pad_wa else (0, 0),
         (kb // 2, kb // 2) if pad_hb else (0, 0),
-        (kwb // 2, kwb // 2),
+        (kwb // 2, kwb // 2) if pad_wb else (0, 0),
     ]
 
 
-def _conv4d_unroll(x, weight, *, precision, pad_ha, pad_hb):
+def _conv4d_unroll(x, weight, *, precision, pad_ha, pad_hb, pad_wa, pad_wb):
     """Sum over kA taps of a 3D conv on shifted whole-volume views."""
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     if pad_ha:
         x = jnp.pad(x, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 4)
     ha = x.shape[1] - (ka - 1)
+    wa_out = wa if pad_wa else wa - (kwa - 1)
     hb_out = hb if pad_hb else hb - (kb - 1)
+    wb_out = wb if pad_wb else wb - (kwb - 1)
     dn = _dn3((b * ha, wa, hb, wb, c_in), (kwa, kb, kwb, c_in, c_out))
     out = None
     for p in range(ka):  # static unroll: ka ≤ 5, traced once under jit
@@ -81,22 +84,24 @@ def _conv4d_unroll(x, weight, *, precision, pad_ha, pad_hb):
             sl.reshape(b * ha, wa, hb, wb, c_in),
             weight[p],
             window_strides=(1, 1, 1),
-            padding=_pads3(kwa, kb, kwb, pad_hb),
+            padding=_pads3(kwa, kb, kwb, pad_hb, pad_wa, pad_wb),
             dimension_numbers=dn,
             precision=precision,
         )
         out = o if out is None else out + o
-    return out.reshape(b, ha, wa, hb_out, wb, c_out)
+    return out.reshape(b, ha, wa_out, hb_out, wb_out, c_out)
 
 
-def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb):
+def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb, pad_wa, pad_wb):
     """One 3D conv with the kA taps folded into input channels."""
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     if pad_ha:
         x = jnp.pad(x, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 4)
     ha = x.shape[1] - (ka - 1)
+    wa_out = wa if pad_wa else wa - (kwa - 1)
     hb_out = hb if pad_hb else hb - (kb - 1)
+    wb_out = wb if pad_wb else wb - (kwb - 1)
     shifts = jnp.concatenate(
         [lax.slice_in_dim(x, p, p + ha, axis=1) for p in range(ka)], axis=-1
     )
@@ -108,18 +113,20 @@ def _conv4d_tapfold(x, weight, *, precision, pad_ha, pad_hb):
         shifts.reshape(b * ha, wa, hb, wb, ka * c_in),
         wf,
         window_strides=(1, 1, 1),
-        padding=_pads3(kwa, kb, kwb, pad_hb),
+        padding=_pads3(kwa, kb, kwb, pad_hb, pad_wa, pad_wb),
         dimension_numbers=dn,
         precision=precision,
     )
-    return o.reshape(b, ha, wa, hb_out, wb, c_out)
+    return o.reshape(b, ha, wa_out, hb_out, wb_out, c_out)
 
 
-def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
+def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb, pad_wa, pad_wb):
     """One 3D conv producing kA·C_out channels + shifted sum over hA."""
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
+    wa_out = wa if pad_wa else wa - (kwa - 1)
     hb_out = hb if pad_hb else hb - (kb - 1)
+    wb_out = wb if pad_wb else wb - (kwb - 1)
     wf = jnp.transpose(weight, (1, 2, 3, 4, 0, 5)).reshape(
         kwa, kb, kwb, c_in, ka * c_out
     )
@@ -128,7 +135,7 @@ def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
         x.reshape(b * ha_in, wa, hb, wb, c_in),
         wf,
         window_strides=(1, 1, 1),
-        padding=_pads3(kwa, kb, kwb, pad_hb),
+        padding=_pads3(kwa, kb, kwb, pad_hb, pad_wa, pad_wb),
         dimension_numbers=dn,
         precision=precision,
     )
@@ -136,7 +143,7 @@ def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
     # The tap is selected by slicing the fused (ka·C_out) channel dim —
     # splitting it into a (…, ka, C_out) axis pair makes XLA materialize a
     # relayout of the whole volume (~30ms at the PF-Pascal workload).
-    y = y.reshape(b, ha_in, wa, hb_out, wb, ka * c_out)
+    y = y.reshape(b, ha_in, wa_out, hb_out, wb_out, ka * c_out)
     if pad_ha:
         y = jnp.pad(y, ((0, 0), (ka // 2, ka // 2)) + ((0, 0),) * 4)
     ha = y.shape[1] - (ka - 1)
@@ -147,7 +154,8 @@ def _conv4d_coutfold(x, weight, *, precision, pad_ha, pad_hb):
     return out
 
 
-def _conv4d_afold(x, weight, *, precision, pad_ha, pad_hb):
+def _conv4d_afold(x, weight, *, precision, pad_ha, pad_hb,
+                  pad_wa=True, pad_wb=True):
     """One 2D conv over (hB,wB) producing kA·kWA·C_out channels + a shifted
     sum over BOTH A dims.
 
@@ -216,8 +224,14 @@ def _shift_masks(hb_in: int, wb_in: int, hb_out: int, wb_out: int,
     return np.stack(ms).astype(np.float32)
 
 
-def _conv4d_toeplitz_b(x, weight, *, precision, pad_ha, pad_hb):
+def _conv4d_toeplitz_b(x, weight, *, precision, pad_ha, pad_hb,
+                       pad_wa=True, pad_wb=True):
     """kA·kWA shifted matmuls against a dense banded B-stencil matrix."""
+    if not (pad_wa and pad_wb):
+        raise ValueError(
+            "toeplitz_b does not support valid (unpadded) wA/wB; use "
+            "unroll/tapfold/coutfold for the 2D-sharded shapes"
+        )
     b, ha_in, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     hb_out = hb if pad_hb else hb - (kb - 1)
@@ -381,6 +395,8 @@ def conv4d(
     precision=None,
     pad_ha: bool = True,
     pad_hb: bool = True,
+    pad_wa: bool = True,
+    pad_wb: bool = True,
     variant: str = "auto",
 ) -> jnp.ndarray:
     """4D convolution over the correlation volume ("same" by default).
@@ -389,17 +405,20 @@ def conv4d(
       x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume.
       weight: ``(kA, kWA, kB, kWB, C_in, C_out)``.
       bias:   ``(C_out,)`` or None.
-      pad_ha / pad_hb: when False, the hA / hB dim is treated as *valid* —
-        the caller already padded it (the spatially-sharded path pre-pads
-        with halo slabs exchanged between shards, parallel/spatial.py) and
-        the output is ``k//2`` smaller on each side of that dim.
+      pad_ha / pad_hb / pad_wa / pad_wb: when False, that dim is treated as
+        *valid* — the caller already padded it (the spatially-sharded path
+        pre-pads with halo slabs exchanged between shards,
+        parallel/spatial.py; the 2D-sharded path halos hB AND wB, or hA AND
+        wA on the transposed pass) and the output is ``k//2`` smaller on
+        each side of that dim.
       variant: 'auto' (per-layer MXU heuristic, `choose_conv4d_variant`), or
         an explicit formulation from 'unroll' / 'tapfold' / 'coutfold' /
         'afold' / 'toeplitz_b' (see module docstring).  All variants are
-        numerically equivalent up to float reassociation.
+        numerically equivalent up to float reassociation (afold/toeplitz_b
+        support the same-padded w dims only).
 
     Returns:
-      ``(B, hA', wA, hB', wB, C_out)`` (primed dims shrink iff unpadded).
+      ``(B, hA', wA', hB', wB', C_out)`` (primed dims shrink iff unpadded).
     """
     c_in, c_out = weight.shape[4], weight.shape[5]
     hb, wb = x.shape[3], x.shape[4]
@@ -411,14 +430,15 @@ def conv4d(
             kernel=tuple(weight.shape[:4]),
             # the pallas kernel runs its dot at default MXU precision: keep
             # explicit-precision calls on the XLA variants, which honor it
-            same_pad=pad_ha and pad_hb and precision is None,
+            same_pad=(pad_ha and pad_hb and pad_wa and pad_wb
+                      and precision is None),
             dtype=x.dtype,
             batch=x.shape[0],
         )
     if variant == "pallas":
         from ncnet_tpu.ops.conv4d_pallas import conv4d_small_cout
 
-        assert pad_ha and pad_hb, (
+        assert pad_ha and pad_hb and pad_wa and pad_wb, (
             "the pallas variant supports only the same-padded volume form"
         )
         assert precision is None, (
@@ -428,7 +448,8 @@ def conv4d(
         out = conv4d_small_cout(x, weight)
     else:
         out = _VARIANTS[variant](
-            x, weight, precision=precision, pad_ha=pad_ha, pad_hb=pad_hb
+            x, weight, precision=precision, pad_ha=pad_ha, pad_hb=pad_hb,
+            pad_wa=pad_wa, pad_wb=pad_wb,
         )
     if bias is not None:
         out = out + bias
