@@ -50,6 +50,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "only): -1 auto (default, the fastest measured "
                         "backward — any batch fits one 16G chip), 0 "
                         "whole-batch backward, >1 explicit chunk count")
+    # fault tolerance (see the training/train.py module docstring)
+    p.add_argument("--checkpoint_steps", type=int, default=0,
+                   help="also checkpoint every N train steps (atomic "
+                        "step_<N> versions with a mid-epoch resume "
+                        "position); 0 = epoch-end saves only")
+    p.add_argument("--keep_checkpoints", type=int, default=3,
+                   help="retention window of step_<N> checkpoint versions "
+                        "(the best_ copy is separate and never pruned)")
+    p.add_argument("--max_bad_steps", type=int, default=3,
+                   help="abort after this many CONSECUTIVE non-finite-loss "
+                        "steps (each one is skipped, keeping the bad batch "
+                        "out of Adam state)")
+    p.add_argument("--no_nan_guard", action="store_true",
+                   help="disable the jitted non-finite-loss guard (saves "
+                        "one host sync per step; a NaN then poisons Adam "
+                        "state, as in the reference)")
+    p.add_argument("--decode_retries", type=int, default=1,
+                   help="transient per-image decode retries before a sample "
+                        "is quarantined")
+    p.add_argument("--fail_on_bad_samples", action="store_true",
+                   help="crash on an undecodable image instead of "
+                        "quarantining it and substituting the next healthy "
+                        "sample")
     return p
 
 
@@ -84,6 +107,12 @@ def main(argv=None) -> int:
         remat_nc_layers=args.remat_nc_layers,
         nc_custom_grad=args.nc_custom_grad,
         accum_chunks=args.accum_chunks,
+        checkpoint_steps=args.checkpoint_steps,
+        keep_checkpoints=args.keep_checkpoints,
+        max_bad_steps=args.max_bad_steps,
+        nan_guard=not args.no_nan_guard,
+        decode_retries=args.decode_retries,
+        quarantine_decode_errors=not args.fail_on_bad_samples,
     )
     fit(config)
     print("Done!")

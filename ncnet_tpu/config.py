@@ -113,6 +113,28 @@ class TrainConfig:
                                          # per-host input sharding
     profile_dir: str = ""                # capture a jax profiler trace here
                                          # (also honours $NCNET_TPU_PROFILE_DIR)
+    # fault tolerance (training/train.py "Fault tolerance" docstring;
+    # no reference analog — the reference can only restart at epoch 1):
+    checkpoint_steps: int = 0            # ALSO save every N train steps
+                                         # (mid-epoch, with resume position);
+                                         # 0 = epoch-end saves only
+    keep_checkpoints: int = 3            # retention window of step_<N>
+                                         # versions per root (the best_ copy
+                                         # is separate and never pruned)
+    nan_guard: bool = True               # jitted non-finite-loss detector:
+                                         # skip the poisoned update (params
+                                         # AND Adam state untouched); costs
+                                         # one host sync per step
+    max_bad_steps: int = 3               # abort (TrainDivergedError) after
+                                         # K consecutive skipped steps
+    io_retries: int = 3                  # bounded retry of orbax save/
+                                         # restore; forced to 1 multi-process
+                                         # (collective-save deadlock rules)
+    io_retry_backoff: float = 0.5        # seconds, doubled per attempt
+    decode_retries: int = 1              # per-image transient decode retries
+    quarantine_decode_errors: bool = True  # skip+log undecodable samples
+                                         # (loader substitutes the next
+                                         # healthy one) instead of crashing
 
 
 @dataclasses.dataclass(frozen=True)

@@ -5,6 +5,7 @@ from ncnet_tpu.data.datasets import (
     MAX_KEYPOINTS,
     PASCAL_CATEGORIES,
     PFPascalDataset,
+    SampleDecodeError,
     load_image,
 )
 from ncnet_tpu.data.loader import DataLoader, default_collate
@@ -15,6 +16,7 @@ __all__ = [
     "MAX_KEYPOINTS",
     "PASCAL_CATEGORIES",
     "PFPascalDataset",
+    "SampleDecodeError",
     "default_collate",
     "load_image",
 ]

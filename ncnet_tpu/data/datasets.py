@@ -22,6 +22,7 @@ import pandas as pd
 from PIL import Image
 
 from ncnet_tpu.ops.image import normalize_imagenet, resize_bilinear_align_corners_np
+from ncnet_tpu.utils import faults
 
 PASCAL_CATEGORIES = (
     "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
@@ -32,9 +33,21 @@ PASCAL_CATEGORIES = (
 MAX_KEYPOINTS = 20  # reference pads keypoint arrays to 20 (pf_dataset.py:106-108)
 
 
+class SampleDecodeError(RuntimeError):
+    """A sample's image could not be decoded after all retries.
+
+    Carries the offending ``path`` so the loader's quarantine policy can log
+    and skip exactly that file (data/loader.py)."""
+
+    def __init__(self, path: str, cause: Exception):
+        super().__init__(f"failed to decode {path!r}: {cause}")
+        self.path = path
+
+
 def load_image(path: str) -> np.ndarray:
     """Decode to (H, W, 3) uint8; grayscale replicated to 3 channels
     (im_pair_dataset.py:64-65)."""
+    faults.decode_hook(path)  # no-op unless a test armed an injected fault
     with Image.open(path) as im:
         arr = np.asarray(im)
     if arr.ndim == 2:
@@ -58,7 +71,12 @@ def _preprocess(
 
 class ImagePairDataset:
     """Weak-supervision pairs from a ``source,target,class,flip`` CSV
-    (im_pair_dataset.py:26-57)."""
+    (im_pair_dataset.py:26-57).
+
+    ``decode_retries``: transient decode errors (network filesystems, busy
+    mounts) are retried that many times per image; a sample that still fails
+    raises :class:`SampleDecodeError`, which the loader's quarantine policy
+    can absorb (one corrupt file must not kill a long run)."""
 
     def __init__(
         self,
@@ -70,10 +88,12 @@ class ImagePairDataset:
         normalize: bool = True,
         random_crop: bool = False,
         seed: int = 1,
+        decode_retries: int = 1,
     ):
         self.out_h, self.out_w = output_size
         self.random_crop = random_crop
         self.normalize = normalize
+        self.decode_retries = decode_retries
         df = pd.read_csv(os.path.join(dataset_csv_path, dataset_csv_file))
         if dataset_size:
             df = df.iloc[: min(dataset_size, len(df))]
@@ -94,8 +114,17 @@ class ImagePairDataset:
     def __len__(self) -> int:
         return len(self.img_a_names)
 
+    def _load_with_retry(self, path: str) -> np.ndarray:
+        err: Optional[Exception] = None
+        for _ in range(max(self.decode_retries, 0) + 1):
+            try:
+                return load_image(path)
+            except Exception as e:  # PIL raises OSError/ValueError variants
+                err = e
+        raise SampleDecodeError(path, err)
+
     def _get_image(self, name: str, flip: int, rng) -> Tuple[np.ndarray, np.ndarray]:
-        image = load_image(os.path.join(self.image_path, name))
+        image = self._load_with_retry(os.path.join(self.image_path, name))
         if self.random_crop:
             # crop bounds exactly as the reference draws them
             # (im_pair_dataset.py:68-74)

@@ -24,9 +24,11 @@ from __future__ import annotations
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Set
 
 import numpy as np
+
+from ncnet_tpu.data.datasets import SampleDecodeError
 
 
 def default_collate(samples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -55,6 +57,19 @@ class DataLoader:
       drop_last: drop the trailing partial batch.
       num_shards / shard_index: this host's share of the (shuffled) epoch.
       seed: base seed; the epoch index is mixed in per epoch.
+      on_decode_error: 'raise' (default) propagates a dataset
+        :class:`SampleDecodeError`; 'quarantine' logs + records the bad
+        path (``self.quarantined``) and substitutes the next healthy
+        dataset sample, so one corrupt file costs the epoch at most that
+        sample instead of the whole run.  Substitution is
+        index-deterministic (idx+1, idx+2, ... mod len), so a given corrupt
+        file always maps to the same replacement.
+
+    Mid-epoch resume: ``set_epoch(epoch, start_batch=B)`` skips the first
+    ``B`` batches of the epoch *before* decode (no wasted work) while
+    keeping the epoch-keyed shuffle, so a resumed run sees exactly the
+    batches the crashed run never consumed.  ``len()`` still reports the
+    full epoch; consumers read ``start_batch`` back for global indexing.
     """
 
     def __init__(
@@ -68,9 +83,15 @@ class DataLoader:
         shard_index: int = 0,
         seed: int = 1,
         prefetch_batches: int = 2,
+        on_decode_error: str = "raise",
     ):
         if not 0 <= shard_index < num_shards:
             raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+        if on_decode_error not in ("raise", "quarantine"):
+            raise ValueError(
+                f"on_decode_error {on_decode_error!r}: use 'raise' or "
+                "'quarantine'"
+            )
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -80,10 +101,15 @@ class DataLoader:
         self.shard_index = shard_index
         self.seed = seed
         self.prefetch_batches = prefetch_batches
+        self.on_decode_error = on_decode_error
+        self.quarantined: Set[str] = set()   # bad image paths, for reporting
+        self._bad_indices: Set[int] = set()  # dataset indices to skip over
         self.epoch = 0  # bump (or pass to set_epoch) to reshuffle
+        self.start_batch = 0
 
-    def set_epoch(self, epoch: int) -> None:
+    def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
         self.epoch = epoch
+        self.start_batch = start_batch
 
     def _shard_len(self) -> int:
         n = len(self.dataset)
@@ -109,18 +135,65 @@ class DataLoader:
 
     def _batches(self) -> Iterator[np.ndarray]:
         idx = self._epoch_indices()
-        for start in range(0, len(idx), self.batch_size):
+        for bi, start in enumerate(range(0, len(idx), self.batch_size)):
             chunk = idx[start : start + self.batch_size]
             if self.drop_last and len(chunk) < self.batch_size:
                 return
+            if bi < self.start_batch:
+                continue  # mid-epoch resume: already consumed before a crash
             yield chunk
+
+    def _quarantine(self, err: SampleDecodeError, idx: int) -> None:
+        self._bad_indices.add(idx)
+        if err.path not in self.quarantined:
+            self.quarantined.add(err.path)
+            print(f"[fault-tolerance] quarantined undecodable sample "
+                  f"{err.path!r}: {err}")
+
+    # fresh (not previously known-bad) decode failures tolerated within ONE
+    # substitution scan before declaring the failure systemic: large enough
+    # to ride out a cluster of corrupt files, small enough that a wrong
+    # --dataset_image_path fails in seconds, not after scanning every sample
+    _MAX_FRESH_FAILURES = 8
+
+    def _fetch(self, i: int) -> Dict[str, np.ndarray]:
+        i = int(i)
+        try:
+            if i not in self._bad_indices:
+                return self.dataset[i]
+            err = None  # known-bad: go straight to substitution
+        except SampleDecodeError as e:
+            if self.on_decode_error != "quarantine":
+                raise
+            self._quarantine(e, i)
+            err = e
+        n = len(self.dataset)
+        fresh_failures = 1 if err is not None else 0
+        for k in range(1, n):
+            j = (i + k) % n
+            if j in self._bad_indices:
+                continue
+            try:
+                return self.dataset[j]
+            except SampleDecodeError as e:
+                self._quarantine(e, j)
+                err = e
+                fresh_failures += 1
+                if fresh_failures >= self._MAX_FRESH_FAILURES:
+                    raise SampleDecodeError(
+                        f"<{fresh_failures} consecutive samples>", e
+                    ) from e  # systemic (bad image root?), not one bad file
+        raise SampleDecodeError(
+            f"<no decodable sample left: {len(self._bad_indices)}/{n} "
+            "quarantined>", err
+        )
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         if hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(self.epoch)
         if self.num_workers <= 0:
             for chunk in self._batches():
-                yield default_collate([self.dataset[int(i)] for i in chunk])
+                yield default_collate([self._fetch(i) for i in chunk])
             return
         yield from self._prefetch_iter()
 
@@ -146,7 +219,7 @@ class DataLoader:
                         if stop.is_set():
                             return
                         samples = list(
-                            pool.map(self.dataset.__getitem__, [int(i) for i in chunk])
+                            pool.map(self._fetch, [int(i) for i in chunk])
                         )
                         if not put_interruptible(default_collate(samples)):
                             return

@@ -6,6 +6,18 @@ argparse Namespace inside the pickle, /root/reference/lib/model.py:215-220)
 and an orbax pytree of params (plus opt_state/step for training state, see
 ``ncnet_tpu.training``).
 
+Versioned training roots: ``fit`` writes *versioned* checkpoints — a root
+directory holding ``step_<N>`` subdirectories, each a complete native
+checkpoint as above.  A version is written to ``step_<N>.tmp`` and committed
+by a single atomic rename, so a directory matching ``step_<N>`` (no ``.tmp``
+suffix) with a ``config.json`` inside IS the completeness marker; anything
+still carrying ``.tmp`` is a crashed save and is ignored (and reclaimed by
+the next writer).  :func:`resolve_checkpoint_dir` maps either layout — a
+version root, a single version, a ``best_`` copy, or a legacy flat
+checkpoint — onto the concrete directory to read, so every loader
+(:func:`load_params`, ``training.load_train_checkpoint``, eval/finetune
+``--checkpoint``) accepts any of them interchangeably.
+
 Torch importer: reads the reference's ``.pth.tar`` pickles
 (``{epoch, args, state_dict, ...}``, /root/reference/train.py:197-205) and
 converts weights into our pytrees — needed to reproduce paper numbers from
@@ -21,7 +33,9 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, Tuple
+import re
+import time
+from typing import Any, Callable, Dict, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -137,6 +151,121 @@ def import_torch_checkpoint(
 
 
 # ---------------------------------------------------------------------------
+# versioned checkpoint roots (atomic step_<N> layout; see module docstring)
+# ---------------------------------------------------------------------------
+
+_VERSION_RE = re.compile(r"^step_(\d+)$")
+
+
+def checkpoint_version_name(step: int) -> str:
+    """``step_<N>`` zero-padded so lexicographic order == numeric order."""
+    return f"step_{step:08d}"
+
+
+def list_checkpoint_versions(root: str) -> List[Tuple[int, str]]:
+    """Complete ``step_<N>`` versions under ``root``, ascending by step.
+
+    Complete = the directory name carries no ``.tmp`` suffix (the atomic
+    rename IS the commit) *and* ``config.json`` exists inside (belt and
+    braces against hand-made empty directories).  A ``step_<N>.old``
+    directory — the displaced original of a same-step re-save — stands in
+    for version N when the replacement's commit never happened (a crash
+    between the two renames): it IS a previously committed version, and
+    refusing it would strand the run.  Returns ``[]`` when ``root`` is not
+    a directory or holds no versions.
+    """
+    if not os.path.isdir(root):
+        return []
+    out, displaced = {}, {}
+    for name in os.listdir(root):
+        base, old = (name[:-4], True) if name.endswith(".old") else (name, False)
+        m = _VERSION_RE.match(base)
+        path = os.path.join(root, name)
+        if not (m and os.path.isdir(path)
+                and os.path.isfile(os.path.join(path, "config.json"))):
+            continue
+        (displaced if old else out)[int(m.group(1))] = path
+    for n, path in displaced.items():
+        out.setdefault(n, path)  # recovered only when step_<N> is absent
+    return sorted(out.items())
+
+
+def resolve_checkpoint_dir(path: str) -> str:
+    """Map any checkpoint-directory spelling onto the directory to read.
+
+    A versioned root resolves to its newest *complete* version; anything
+    else (a single version dir, a ``best_`` copy, a legacy flat checkpoint)
+    resolves to itself.  Raises if ``path`` holds only ``.tmp`` carcasses —
+    every save crashed and there is nothing safe to load.
+    """
+    path = os.path.abspath(path)
+    versions = list_checkpoint_versions(path)
+    if versions:
+        return versions[-1][1]
+    if os.path.isdir(path) and not os.path.isfile(os.path.join(path, "config.json")):
+        if any(n.endswith(".tmp") and _VERSION_RE.match(n[:-4])
+               for n in os.listdir(path)):
+            raise FileNotFoundError(
+                f"checkpoint root {path!r} holds only incomplete .tmp "
+                "versions (every save crashed mid-write); nothing to load"
+            )
+    return path
+
+
+def owning_checkpoint_root(path: str) -> str | None:
+    """The versioned root that owns ``path``, or None.
+
+    ``fit`` uses this to continue writing versions *in place* when resumed
+    from its own output (a root, or a version directory inside one) rather
+    than forking a fresh timestamped root per restart.
+    """
+    path = os.path.abspath(path)
+    if list_checkpoint_versions(path):
+        return path
+    base = os.path.basename(path)
+    if base.endswith(".old"):  # a crash-recovered displaced version
+        base = base[:-4]
+    if _VERSION_RE.match(base):
+        parent = os.path.dirname(path)
+        if list_checkpoint_versions(parent):
+            return parent
+    return None
+
+
+def with_io_retries(
+    fn: Callable[[], Any],
+    attempts: int = 3,
+    backoff: float = 0.5,
+    what: str = "checkpoint I/O",
+) -> Any:
+    """Run ``fn`` with bounded retry + exponential backoff.
+
+    For transient filesystem/orbax failures (GCS hiccups, NFS timeouts).
+    Multi-process: retries are forced OFF (one attempt) — a single host
+    re-entering a *collective* orbax save while the others have moved on
+    deadlocks the job, so distributed saves fail fast and the job-level
+    restart (which re-enters collectively) is the retry.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        attempts = 1
+    last: Exception | None = None
+    for i in range(max(attempts, 1)):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — orbax raises heterogeneous types
+            last = e
+            if i + 1 < max(attempts, 1):
+                delay = backoff * (2 ** i)
+                print(f"[fault-tolerance] {what} failed "
+                      f"(attempt {i + 1}/{attempts}): {e}; retrying in "
+                      f"{delay:.1f}s")
+                time.sleep(delay)
+    raise last  # type: ignore[misc]
+
+
+# ---------------------------------------------------------------------------
 # native (orbax) checkpoints
 # ---------------------------------------------------------------------------
 
@@ -157,15 +286,17 @@ def save_params(path: str, config: ModelConfig, params) -> None:
 def load_params(path: str, base_config: ModelConfig = ModelConfig()):
     """Load a checkpoint from either format.
 
-    ``path`` may be a torch ``.pth.tar`` file (reference format) or a native
-    orbax directory written by :func:`save_params`.
+    ``path`` may be a torch ``.pth.tar`` file (reference format), a native
+    orbax directory written by :func:`save_params`, or a versioned training
+    root / ``step_<N>`` version written by ``training.fit`` (resolved to the
+    newest complete version via :func:`resolve_checkpoint_dir`).
     Returns ``(config, params)``.
     """
     if os.path.isfile(path):
         return import_torch_checkpoint(path, base_config)
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
+    path = resolve_checkpoint_dir(path)
     with open(os.path.join(path, "config.json")) as f:
         cfg_dict = json.load(f)
     for key in ("ncons_kernel_sizes", "ncons_channels"):
@@ -177,5 +308,8 @@ def load_params(path: str, base_config: ModelConfig = ModelConfig()):
         **{k: cfg_dict[k] for k in _ARCH_FIELDS if k in cfg_dict}
     )
     ckptr = ocp.StandardCheckpointer()
-    params = ckptr.restore(os.path.join(path, "params"))
+    params = with_io_retries(
+        lambda: ckptr.restore(os.path.join(path, "params")),
+        what=f"restore of {path}",
+    )
     return config, params

@@ -171,6 +171,11 @@ def _conv4d_afold(x, weight, *, precision, pad_ha, pad_hb,
     its transpose breaks under AD on this toolchain, so ``auto`` still
     avoids it (see choose_conv4d_variant).
     """
+    if not (pad_wa and pad_wb):
+        raise ValueError(
+            "afold does not support valid (unpadded) wA/wB; use "
+            "unroll/tapfold/coutfold for the 2D-sharded shapes"
+        )
     b, ha, wa, hb, wb, c_in = x.shape
     ka, kwa, kb, kwb, _, c_out = weight.shape
     hb_out = hb if pad_hb else hb - (kb - 1)

@@ -237,6 +237,13 @@ def nc_stack_fused_lane(nc_params: List[dict], x: jnp.ndarray,
         "nc_stack_fused_lane requires a 1-channel final layer (the NC-stack "
         "shape class); wider stacks must use the XLA formulations"
     )
+    # the lane packing below keeps only channel 0 of the input (x[..., 0]):
+    # reject wider inputs loudly instead of silently dropping channels
+    assert x.shape[-1] == 1 and nc_params[0]["w"].shape[4] == 1, (
+        "nc_stack_fused_lane requires a 1-channel input volume and first "
+        "layer (the NC-stack shape class); wider inputs must use the XLA "
+        "formulations"
+    )
     k = nc_params[0]["w"].shape[0]
     h = k - 1
     sp_l = wb + h

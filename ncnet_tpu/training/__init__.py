@@ -8,6 +8,8 @@ from ncnet_tpu.training.loss import (
     weak_loss_and_grads,
 )
 from ncnet_tpu.training.train import (
+    PreemptionHandler,
+    TrainDivergedError,
     TrainState,
     create_train_state,
     fit,
@@ -21,6 +23,8 @@ from ncnet_tpu.training.train import (
 )
 
 __all__ = [
+    "PreemptionHandler",
+    "TrainDivergedError",
     "TrainState",
     "create_train_state",
     "fit",

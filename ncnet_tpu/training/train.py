@@ -15,6 +15,63 @@ Improvements over the reference, by design:
     train.py:71,190);
   * frozen parameters are handled by ``optax.multi_transform`` with
     ``set_to_zero``, so the update pytree structure is stable and shardable.
+
+Fault tolerance
+===============
+
+Long weakly-supervised runs on preemptible TPU time must survive crashes at
+ANY point, not just epoch boundaries.  Four mechanisms (each proven
+end-to-end by tests/test_faults.py via the ncnet_tpu/utils/faults.py
+injection harness):
+
+**Checkpoint directory layout** — ``fit`` writes a versioned root::
+
+    <result_model_dir>/<stamp>_<name>/       # the "root"; result["checkpoint"]
+        step_00000004/                       # complete version (committed)
+            config.json   # ModelConfig + _train/_epoch/_position/loss keys
+            params/       # orbax pytree (readable by models.load_params)
+            opt/          # {opt_state, step} for full-state resume
+        step_00000006.tmp/                   # crashed save: ignored, reclaimed
+    <result_model_dir>/best_<stamp>_<name>/  # flat copy of the best version
+
+Every version is written to ``step_<N>.tmp`` and committed by one atomic
+rename; a crash mid-save leaves only a ``.tmp`` carcass that loaders skip
+and the next save reclaims.  Retention keeps the newest
+``TrainConfig.keep_checkpoints`` versions (the ``best_`` copy is a separate
+flat directory and never pruned).  Orbax save/restore calls get bounded
+retry + backoff (``io_retries``/``io_retry_backoff``) in single-process runs.
+
+**Resume contract** — point ``model.checkpoint`` at the root (or a version,
+or the ``best_`` copy): the newest *complete* version is restored — params,
+optimizer state, step counter AND loader position.  ``_position`` in
+config.json records ``{"epoch": E, "next_batch": B}`` = the first batch not
+yet consumed; resume re-enters epoch E and skips its first B batches, which
+is deterministic because the shuffle is epoch-keyed and per-sample
+augmentation draws are (seed, epoch, idx)-keyed (data/loader.py).  Resuming
+from a root written by ``fit`` continues *in place* (new versions land in
+the same root); foreign checkpoints start a fresh timestamped root.
+``checkpoint_steps > 0`` saves every N steps mid-epoch; the epoch-end save
+(with val loss + best tracking) always happens.  A mid-epoch-resumed epoch
+logs its train loss over the remaining batches only.
+
+**In-loop guards** — with ``nan_guard`` (default on), the jitted step
+detects a non-finite loss IN-GRAPH and keeps the whole update out of params
+and Adam state (the step counter still advances, so step numbering stays
+batch-deterministic); the host counts consecutive skips and raises
+:class:`TrainDivergedError` after ``max_bad_steps``.  The guard costs one
+host sync per step (the loss is fetched eagerly instead of at log points).
+SIGTERM/SIGINT request a final checkpoint at the next step boundary and a
+clean return (``result["preempted"]``); a second SIGINT aborts immediately.
+
+**Multi-process collective-save rules** — invariants every edit must keep:
+every process calls ``save_train_checkpoint`` (orbax saves are collective;
+gating on process 0 deadlocks); version names derive from the host-side step
+counter (identical everywhere — never from clocks); the non-collective
+extras (config.json, commit rename, retention pruning, ``best_`` copy) are
+primary-only, with a ``sync_global_processes`` barrier before the commit;
+I/O retries are forced off (a lone host re-entering a collective save
+deadlocks); NaN-guard and preemption-stop decisions are taken from
+replicated values / at collective boundaries so all hosts agree.
 """
 
 from __future__ import annotations
@@ -24,8 +81,10 @@ import json
 import math
 import os
 import shutil
+import signal
+import threading
 import time
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +101,15 @@ from ncnet_tpu.training.loss import (
     weak_loss,
     weak_loss_and_grads,
 )
+from ncnet_tpu.utils import faults
 from ncnet_tpu.utils.profiling import annotate, maybe_trace
+
+
+class TrainDivergedError(RuntimeError):
+    """``max_bad_steps`` consecutive non-finite losses: the run is diverging
+    (or its data is systematically poisoned), so continuing to skip updates
+    would only burn accelerator time.  Params/opt state are NOT corrupted —
+    every bad update was kept out by the NaN guard."""
 
 
 class TrainState(NamedTuple):
@@ -104,8 +171,20 @@ def make_train_step(
     fold_pos_neg: bool = False,
     remat_filter: bool = True,
     accum_chunks: int = 0,
+    nan_guard: bool = False,
 ):
     """Jitted (state, batch) → (state, loss).
+
+    ``nan_guard=True`` adds an in-graph non-finite detector over the loss
+    AND the update tree (a backward overflow can produce non-finite grads
+    under a finite loss): when either is non-finite the whole update
+    (params AND Adam moments/count) is dropped and the previous state
+    carried forward, so one poisoned batch cannot contaminate optimizer
+    state for every remaining step.  The step
+    counter still advances (it counts consumed batches, keeping step
+    numbering — and therefore checkpoint version names and resume positions
+    — deterministic regardless of how many steps were skipped).  The loss is
+    returned as computed so the host can count/log the skip.
 
     Pass ``stop_backbone_grad=True`` when no backbone blocks are being
     finetuned (``fe_finetune_params == 0``, the reference default): the trunk
@@ -149,6 +228,25 @@ def make_train_step(
             )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        if nan_guard:
+            # loss finiteness alone is not enough: a backward overflow can
+            # produce non-finite updates under a finite loss, which would
+            # poison params while the guard looks the other way — AND in
+            # the whole update tree (the optax.apply_if_finite discipline)
+            ok = jnp.isfinite(loss)
+            for u in jax.tree.leaves(updates):
+                ok = ok & jnp.all(jnp.isfinite(u))
+            params = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old), params, state.params
+            )
+            opt_state = jax.tree.map(
+                lambda new, old: jnp.where(ok, new, old),
+                opt_state, state.opt_state,
+            )
+            # report NaN for any rejected step so host-side skip counting
+            # and the epoch-mean exclusion see EVERY skip, including the
+            # finite-loss/non-finite-grads case
+            loss = jnp.where(ok, loss, jnp.nan)
         return TrainState(params, opt_state, state.step + 1), loss
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
@@ -166,11 +264,27 @@ def process_epoch(
     loader: DataLoader,
     log_interval: int = 1,
     put_batch=None,
+    step_base: int = 0,
+    on_step: Optional[Callable[[int, TrainState, jnp.ndarray], bool]] = None,
 ) -> Tuple[TrainState, float]:
     """One pass over ``loader``; mirrors the reference's per-batch logging
     (train.py:161-181).  ``put_batch`` maps a host array onto devices
     (defaults to plain transfer; the data-parallel path shards the pair
-    axis)."""
+    axis).
+
+    Mid-epoch resume: the loader's ``start_batch`` (set via
+    ``loader.set_epoch(epoch, start_batch=...)``) is the single source of
+    the skip — this function reads it back for global batch indexing, so
+    logging and checkpoint positions stay aligned with the full epoch.
+
+    ``on_step(batch_idx, state, loss)`` runs after every train step (NaN
+    accounting, periodic/preemption checkpoints live in ``fit``'s closure);
+    returning True ends the epoch early.  ``step_base`` is the host-side
+    global step count entering this epoch (used to address fault-injection
+    hooks without a device sync).  Non-finite losses are excluded from the
+    epoch mean (and counted), so one guarded-away batch does not wipe out
+    the epoch statistic.
+    """
     put_batch = put_batch or jnp.asarray
     n = len(loader)
     if n == 0:
@@ -178,8 +292,15 @@ def process_epoch(
             f"{mode} loader is empty (dataset smaller than batch_size with "
             "drop_last) — refusing to report a fake 0.0 epoch loss"
         )
+    start_batch = getattr(loader, "start_batch", 0)
+    if start_batch:
+        print(f"{mode.capitalize()} Epoch: {epoch} resuming at batch "
+              f"{start_batch}/{n}")
     losses = []  # device scalars; only synced at log points / epoch end
-    for batch_idx, batch in enumerate(loader):
+    for off, batch in enumerate(loader):
+        batch_idx = start_batch + off
+        if mode == "train":
+            batch = faults.corrupt_batch_hook(batch, step_base + off + 1)
         images = {
             "source_image": put_batch(batch["source_image"]),
             "target_image": put_batch(batch["target_image"]),
@@ -195,7 +316,27 @@ def process_epoch(
                 f"{mode.capitalize()} Epoch: {epoch} [{batch_idx}/{n} "
                 f"({100.0 * batch_idx / n:.0f}%)]\t\tLoss: {float(loss):.6f}"
             )
-    epoch_loss = float(jnp.mean(jnp.stack(losses)))
+        if on_step is not None and on_step(batch_idx, state, loss):
+            break
+    if not losses:
+        # a resume position at the very end of an epoch: nothing left to do
+        print(f"{mode.capitalize()} set: no batches past resume position "
+              f"{start_batch}/{n}")
+        return state, float("nan")
+    arr = jnp.stack(losses)
+    if mode == "train":
+        # guarded-away (non-finite) steps must not wipe out the epoch
+        # statistic.  TRAIN ONLY: a val batch with a non-finite loss means
+        # the model itself misbehaves on part of the val set — its epoch
+        # mean must stay NaN so it can never be crowned best_
+        finite = jnp.isfinite(arr)
+        n_bad = int(jnp.sum(~finite))
+        if n_bad:
+            print(f"{mode.capitalize()} set: excluded {n_bad} non-finite "
+                  f"step loss(es) from the epoch mean")
+        epoch_loss = float(jnp.nanmean(jnp.where(finite, arr, jnp.nan)))
+    else:
+        epoch_loss = float(jnp.mean(arr))
     print(f"{mode.capitalize()} set: Average loss: {epoch_loss:.4f}")
     return state, epoch_loss
 
@@ -203,6 +344,13 @@ def process_epoch(
 # ---------------------------------------------------------------------------
 # checkpointing (full train state)
 # ---------------------------------------------------------------------------
+
+
+def _sync_processes(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_processes(tag)
 
 
 def save_train_checkpoint(
@@ -214,31 +362,72 @@ def save_train_checkpoint(
     train_loss: np.ndarray,
     test_loss: np.ndarray,
     is_best: bool,
-) -> None:
-    """Epoch checkpoint; on improvement also copied to ``best_<name>``
-    (torch_util.py:48-61).
+    *,
+    step: Optional[int] = None,
+    position: Optional[Dict[str, int]] = None,
+    keep: int = 0,
+    io_retries: int = 3,
+    io_retry_backoff: float = 0.5,
+) -> str:
+    """Atomic, versioned checkpoint: write ``<path>/step_<N>.tmp``, commit by
+    rename.  On improvement the committed version is also copied flat to
+    ``best_<name>`` beside the root (torch_util.py:48-61).  Returns the
+    committed version directory.
 
-    Layout is a superset of :func:`ncnet_tpu.models.checkpoint.save_params`:
-    ``config.json`` carries the ModelConfig fields at top level (plus train
-    metadata under ``_train``/``_epoch``/loss keys) and the weights live in a
+    ``path`` is the versioned ROOT (see the module docstring for the
+    layout).  ``step`` names the version (defaults to ``state.step`` — pass
+    the host-side counter to avoid a device sync); ``position`` is the
+    resume cursor stored as ``_position``; ``keep > 0`` prunes all but the
+    newest ``keep`` complete versions after the commit.  A crash at any
+    point leaves every previously committed version intact: the in-progress
+    ``.tmp`` is skipped by loaders and reclaimed by the next save.
+
+    Each version's layout is a superset of
+    :func:`ncnet_tpu.models.checkpoint.save_params`: ``config.json`` carries
+    the ModelConfig fields at top level (plus train metadata under
+    ``_train``/``_epoch``/``_position``/loss keys) and the weights live in a
     ``params/`` subtree — so ``load_params`` (and therefore eval/finetune
     ``--checkpoint``) reads a training checkpoint directly.  Optimizer state
-    + step go in a separate ``opt/`` subtree for :func:`load_train_checkpoint`.
+    + step go in a separate ``opt/`` subtree for
+    :func:`load_train_checkpoint`.
 
     Multi-process: EVERY process must call this — the orbax saves are
     collective (``sync_global_processes`` inside ``save``; gating them on
-    process 0 deadlocks the job, caught by the two-process smoke test).
-    Orbax itself writes array data from the primary host only; the
-    non-collective extras (config.json, the ``best_`` copy) are primary-only
-    here.
+    process 0 deadlocks the job, caught by the two-process smoke test), and
+    the version name must be computed from replicated state (the host step
+    counter), never from clocks.  Orbax itself writes array data from the
+    primary host only; the non-collective extras (config.json, the commit
+    rename, retention pruning, the ``best_`` copy) are primary-only here,
+    with a cross-process barrier before the commit so no process can observe
+    a half-written version.  I/O retries are disabled multi-process
+    (``with_io_retries``): one host re-entering a collective save alone
+    would deadlock the job.
     """
     import orbax.checkpoint as ocp
 
     primary = jax.process_index() == 0
-    path = os.path.abspath(path)
-    os.makedirs(path, exist_ok=True)
+    root = os.path.abspath(path)
+    os.makedirs(root, exist_ok=True)
+    n = int(step) if step is not None else int(jax.device_get(state.step))
+    final = os.path.join(root, ckpt_io.checkpoint_version_name(n))
+    tmp = final + ".tmp"
     if primary:
-        with open(os.path.join(path, "config.json"), "w") as f:
+        # reclaim carcasses of crashed saves (fit is the root's sole writer):
+        # .tmp = uncommitted replacement, always dropped; .old = the
+        # displaced original of a same-step re-save — restored when the
+        # replacement's commit rename never happened, dropped otherwise
+        for name in os.listdir(root):
+            full = os.path.join(root, name)
+            if name.endswith(".tmp"):
+                shutil.rmtree(full, ignore_errors=True)
+            elif name.endswith(".old"):
+                committed = os.path.join(root, name[:-4])
+                if os.path.isdir(committed):
+                    shutil.rmtree(full, ignore_errors=True)
+                else:
+                    os.rename(full, committed)
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "config.json"), "w") as f:
             json.dump(
                 {
                     **dataclasses.asdict(model_config),
@@ -248,51 +437,179 @@ def save_train_checkpoint(
                         if k != "model"
                     },
                     "_epoch": epoch,
-                    "_train_loss": list(map(float, train_loss)),
-                    "_test_loss": list(map(float, test_loss)),
+                    "_step": n,
+                    "_position": position,
+                    # non-finite entries (a resumed epoch whose train phase
+                    # was already consumed) serialize as null, keeping
+                    # config.json strict JSON; load maps null back to NaN
+                    "_train_loss": [
+                        float(v) if math.isfinite(v) else None
+                        for v in train_loss
+                    ],
+                    "_test_loss": [
+                        float(v) if math.isfinite(v) else None
+                        for v in test_loss
+                    ],
                 },
                 f,
                 indent=2,
                 default=list,
             )
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "params"), state.params, force=True)
-    ckptr.save(
-        os.path.join(path, "opt"),
-        {"opt_state": state.opt_state, "step": state.step},
-        force=True,
-    )
-    ckptr.wait_until_finished()
-    if is_best and primary:
-        best = os.path.join(os.path.dirname(path), "best_" + os.path.basename(path))
-        if os.path.isdir(best):
-            shutil.rmtree(best)
-        shutil.copytree(path, best)
+
+    def _save(subdir, tree):
+        ckpt_io.with_io_retries(
+            lambda: (ckptr.save(os.path.join(tmp, subdir), tree, force=True),
+                     ckptr.wait_until_finished()),
+            attempts=io_retries, backoff=io_retry_backoff,
+            what=f"save of {tmp}/{subdir}",
+        )
+
+    _save("params", state.params)
+    faults.kill_mid_save_hook(n)  # no-op unless a test armed it
+    _save("opt", {"opt_state": state.opt_state, "step": state.step})
+    # all processes must have finished their collective part before the
+    # primary commits (a rename concurrent with a straggler's save window
+    # could publish a version that is still being written)
+    _sync_processes(f"ncnet_ckpt_commit_{n}")
+    if primary:
+        if os.path.isdir(final):
+            # re-save at the same step (an epoch-end save landing on a
+            # periodic-save step): replace the old version, still leaving a
+            # complete directory at every instant
+            stale = final + ".old"
+            shutil.rmtree(stale, ignore_errors=True)
+            os.rename(final, stale)
+            os.rename(tmp, final)
+            shutil.rmtree(stale, ignore_errors=True)
+        else:
+            os.rename(tmp, final)  # THE commit point
+        if keep > 0:
+            for _, old in ckpt_io.list_checkpoint_versions(root)[:-keep]:
+                shutil.rmtree(old, ignore_errors=True)
+        if is_best:
+            best = os.path.join(
+                os.path.dirname(root), "best_" + os.path.basename(root)
+            )
+            if os.path.isdir(best):
+                shutil.rmtree(best)
+            shutil.copytree(final, best)
+    return final
 
 
-def load_train_checkpoint(path: str, state_like: TrainState):
+def load_train_checkpoint(
+    path: str,
+    state_like: TrainState,
+    io_retries: int = 3,
+    io_retry_backoff: float = 0.5,
+):
     """Restore a full train state (params + optimizer + step) for resume —
     the capability the reference saves for but never implements
     (train.py:71 creates a fresh Adam; ``checkpoint['optimizer']`` is never
-    read)."""
+    read).
+
+    ``path`` may be a versioned root (resolved to its newest COMPLETE
+    version — in-progress ``.tmp`` saves are never considered), a single
+    ``step_<N>`` version, or a legacy flat checkpoint.  Returns ``(state,
+    epoch, train_loss, test_loss, position)`` where ``epoch`` counts fully
+    completed epochs and ``position`` is the ``{"epoch": E, "next_batch":
+    B}`` resume cursor (synthesized as epoch-start for checkpoints predating
+    mid-epoch saves)."""
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
+    path = ckpt_io.resolve_checkpoint_dir(path)
     ckptr = ocp.StandardCheckpointer()
-    params = ckptr.restore(os.path.join(path, "params"), target=state_like.params)
-    opt = ckptr.restore(
-        os.path.join(path, "opt"),
-        target={"opt_state": state_like.opt_state, "step": state_like.step},
+    params = ckpt_io.with_io_retries(
+        lambda: ckptr.restore(
+            os.path.join(path, "params"), target=state_like.params
+        ),
+        attempts=io_retries, backoff=io_retry_backoff,
+        what=f"restore of {path}/params",
+    )
+    opt = ckpt_io.with_io_retries(
+        lambda: ckptr.restore(
+            os.path.join(path, "opt"),
+            target={"opt_state": state_like.opt_state, "step": state_like.step},
+        ),
+        attempts=io_retries, backoff=io_retry_backoff,
+        what=f"restore of {path}/opt",
     )
     with open(os.path.join(path, "config.json")) as f:
         meta = json.load(f)
     state = TrainState(params, opt["opt_state"], opt["step"])
+    position = meta.get("_position") or {
+        "epoch": meta["_epoch"] + 1, "next_batch": 0
+    }
     return (
         state,
         meta["_epoch"],
-        np.asarray(meta["_train_loss"]),
-        np.asarray(meta["_test_loss"]),
+        # null entries (non-finite at save time) come back as NaN
+        np.asarray(meta["_train_loss"], dtype=np.float64),
+        np.asarray(meta["_test_loss"], dtype=np.float64),
+        position,
     )
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT → "checkpoint at the next step boundary, then stop".
+
+    Installed around the fit epoch loop.  The handler only flips a flag —
+    the train loop notices it between steps, writes a final checkpoint (with
+    the exact resume position) and returns cleanly with
+    ``result["preempted"]``.  A second SIGINT raises KeyboardInterrupt
+    immediately (the operator escape hatch).  Installation is skipped off
+    the main thread (``signal.signal`` would raise) and previous handlers
+    are always restored.
+
+    Multi-process: each host observes only its own signal; real preemption
+    (GCE/TPU maintenance) delivers SIGTERM to every host.  The stop decision
+    is still agreed collectively — ``fit`` ORs the flags across hosts at
+    checkpoint/epoch boundaries (``_global_any``) so one host can never
+    enter a collective save alone.
+    """
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._old: Dict[int, Any] = {}
+
+    def _handle(self, signum, frame):
+        if self.requested and signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        self.requested = True
+        self.signum = signum
+        # os.write, not print: a buffered flush interrupted by the signal
+        # can replay its buffer (duplicated log lines), and print() from a
+        # handler can deadlock on the interrupted stream's lock
+        os.write(2, (f"[fault-tolerance] received "
+                     f"{signal.Signals(signum).name}; will checkpoint at "
+                     "the next step boundary and stop\n").encode())
+
+    def __enter__(self) -> "PreemptionHandler":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.SIGNALS:
+                self._old[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc):
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        self._old = {}
+        return False
+
+
+def _global_any(flag: bool) -> bool:
+    """OR a host-local flag across processes (identity single-process).
+    Collective — in multi-process mode call it only at points every process
+    reaches (checkpoint/epoch boundaries)."""
+    if jax.process_count() <= 1:
+        return flag
+    from jax.experimental import multihost_utils
+
+    got = multihost_utils.process_allgather(np.asarray([flag], np.int32))
+    return bool(np.any(got))
 
 
 def _resolve_accum_chunks(config: TrainConfig, n_dev: int) -> int:
@@ -373,15 +690,39 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
 
     state, optimizer, model_config, labels = create_train_state(config)
 
-    # resume: a checkpoint directory written by fit() carries opt/ — restore
-    # the full train state and continue from the saved epoch
+    # resume: a checkpoint written by fit() carries opt/ — restore the full
+    # train state (params + optimizer + step + loader position).  A root of
+    # step_<N> versions resolves to its newest COMPLETE version; ``.tmp``
+    # carcasses from a crash mid-save are never considered.
     start_epoch = 0
     prev_train = prev_test = None
+    resume_epoch: Optional[int] = None
+    resume_batch = 0
+    resume_root = None
     ckpt = config.model.checkpoint
-    if ckpt and os.path.isdir(os.path.join(ckpt, "opt")):
-        state, start_epoch, prev_train, prev_test = load_train_checkpoint(ckpt, state)
+    resolved = (
+        ckpt_io.resolve_checkpoint_dir(ckpt)
+        if ckpt and os.path.isdir(ckpt) else ""
+    )
+    if resolved and os.path.isdir(os.path.join(resolved, "opt")):
+        # pass the resolved version (not the raw path) so this is the ONE
+        # point of version selection — load_train_checkpoint's own resolve
+        # is then the identity
+        state, start_epoch, prev_train, prev_test, position = (
+            load_train_checkpoint(
+                resolved, state, io_retries=config.io_retries,
+                io_retry_backoff=config.io_retry_backoff,
+            )
+        )
+        resume_epoch = int(position["epoch"])
+        resume_batch = int(position["next_batch"])
+        # resumed from our own versioned output: keep writing new versions
+        # into the SAME root (crash/preempt/restart cycles share one lineage)
+        resume_root = ckpt_io.owning_checkpoint_root(resolved)
         if progress:
-            print(f"Resumed full train state from {ckpt} at epoch {start_epoch}")
+            print(f"Resumed full train state from {resolved}: "
+                  f"{start_epoch} completed epoch(s), position epoch "
+                  f"{resume_epoch} batch {resume_batch}")
 
     n_trainable = sum(
         int(np.prod(np.asarray(x.shape)))
@@ -441,17 +782,23 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         fold_pos_neg=config.fold_pos_neg,
         remat_filter=config.remat_filter,
         accum_chunks=accum,
+        nan_guard=config.nan_guard,
     )
     eval_step = make_eval_step(model_config)
 
+    decode_policy = (
+        "quarantine" if config.quarantine_decode_errors else "raise"
+    )
     size = (config.image_size, config.image_size)
     train_loader = DataLoader(
         ImagePairDataset(
             config.dataset_csv_path, "train_pairs.csv", config.dataset_image_path,
             output_size=size, seed=config.seed,
+            decode_retries=config.decode_retries,
         ),
         batch_size=local_batch, shuffle=True,
         num_workers=config.num_workers, seed=config.seed, drop_last=True,
+        on_decode_error=decode_policy,
         **shard_kwargs,
     )
     # val: no shuffle — with drop_last (config.val_drop_last), a shuffle
@@ -461,33 +808,39 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         ImagePairDataset(
             config.dataset_csv_path, "val_pairs.csv", config.dataset_image_path,
             output_size=size, seed=config.seed,
+            decode_retries=config.decode_retries,
         ),
         batch_size=local_batch, shuffle=False,
         num_workers=config.eval_num_workers, seed=config.seed,
         drop_last=config.val_drop_last,
+        on_decode_error=decode_policy,
         **shard_kwargs,
     )
 
-    # the checkpoint path must agree across processes (orbax saves are
-    # collective): stamp from process 0's clock, broadcast to the others.
-    # Broadcast as (days, seconds-of-day) int32s — with x64 disabled a float
-    # timestamp would be quantized to ~128 s and an int64 silently truncated.
-    stamp = time.time()
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    if resume_root:
+        ckpt_name = resume_root
+    else:
+        # the checkpoint path must agree across processes (orbax saves are
+        # collective): stamp from process 0's clock, broadcast to the others.
+        # Broadcast as (days, seconds-of-day) int32s — with x64 disabled a
+        # float timestamp would be quantized to ~128 s and an int64 silently
+        # truncated.
+        stamp = time.time()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
 
-        parts = multihost_utils.broadcast_one_to_all(
-            np.asarray([int(stamp) // 86400, int(stamp) % 86400], np.int32)
+            parts = multihost_utils.broadcast_one_to_all(
+                np.asarray([int(stamp) // 86400, int(stamp) % 86400], np.int32)
+            )
+            stamp = float(int(parts[0]) * 86400 + int(parts[1]))
+        ckpt_name = os.path.join(
+            config.result_model_dir,
+            # gmtime, not localtime: processes with differing TZ env would
+            # format different paths from the same broadcast stamp and
+            # re-diverge the collective save (ADVICE r3)
+            time.strftime("%Y-%m-%d_%H:%M", time.gmtime(stamp))
+            + "_" + config.result_model_fn,
         )
-        stamp = float(int(parts[0]) * 86400 + int(parts[1]))
-    ckpt_name = os.path.join(
-        config.result_model_dir,
-        # gmtime, not localtime: processes with differing TZ env would
-        # format different paths from the same broadcast stamp and
-        # re-diverge the collective save (ADVICE r3)
-        time.strftime("%Y-%m-%d_%H:%M", time.gmtime(stamp))
-        + "_" + config.result_model_fn,
-    )
     if progress:
         print(f"Checkpoint name: {ckpt_name}")
 
@@ -498,31 +851,145 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         n_keep = min(start_epoch, config.num_epochs)
         train_loss[:n_keep] = prev_train[:n_keep]
         test_loss[:n_keep] = prev_test[:n_keep]
-        if n_keep:
-            best = float(np.min(prev_test[:n_keep]))
-    for epoch in range(start_epoch + 1, config.num_epochs + 1):
-        train_loader.set_epoch(epoch)
-        val_loader.set_epoch(epoch)
-        # trace only the first post-resume epoch: a bounded, representative
-        # capture (compile + steady-state steps) instead of a runaway file
-        with maybe_trace(config.profile_dir, enabled=epoch == start_epoch + 1):
-            state, train_loss[epoch - 1] = process_epoch(
-                "train", epoch, state, train_step, train_loader,
+        finite_prev = prev_test[:n_keep][np.isfinite(prev_test[:n_keep])]
+        if finite_prev.size:
+            best = float(np.min(finite_prev))
+
+    if len(train_loader) == 0:
+        raise ValueError(
+            "train loader is empty (dataset smaller than batch_size with "
+            "drop_last) — refusing to report a fake 0.0 epoch loss"
+        )
+
+    first_epoch = resume_epoch if resume_epoch is not None else start_epoch + 1
+    steps_done = int(jax.device_get(state.step))  # host mirror of state.step
+    if resume_root and jax.process_index() == 0:
+        # explicit rollback (resume from a non-newest version): versions
+        # newer than the resume point are stale — left in place, a crash
+        # before the new lineage surpasses them would make the next resume
+        # silently pick the very checkpoint the operator rolled back from
+        for n_v, p_v in ckpt_io.list_checkpoint_versions(resume_root):
+            if n_v > steps_done:
+                shutil.rmtree(p_v, ignore_errors=True)
+                print(f"[fault-tolerance] pruned stale version {p_v} "
+                      f"(rolled back to step {steps_done})")
+    if resume_root:
+        _sync_processes("ncnet_rollback_prune")
+    nan_streak = nan_skipped = 0
+    preempted = False
+    save_kwargs = dict(
+        keep=config.keep_checkpoints, io_retries=config.io_retries,
+        io_retry_backoff=config.io_retry_backoff,
+    )
+
+    with PreemptionHandler() as preempt:
+        for epoch in range(first_epoch, config.num_epochs + 1):
+            start_b = resume_batch if epoch == first_epoch else 0
+            n_train = len(train_loader)
+            train_loader.set_epoch(epoch, start_batch=min(start_b, n_train))
+            val_loader.set_epoch(epoch)
+            stop_epoch = {"preempted": False}
+
+            def on_step(batch_idx, cur_state, loss,
+                        epoch=epoch, stop=stop_epoch):
+                nonlocal steps_done, nan_streak, nan_skipped
+                steps_done += 1
+                if config.nan_guard:
+                    # the guard's one host sync per step; the loss is
+                    # replicated (computed on the global batch), so every
+                    # process takes the same branch
+                    if not math.isfinite(float(loss)):
+                        nan_streak += 1
+                        nan_skipped += 1
+                        print(f"[fault-tolerance] non-finite loss at step "
+                              f"{steps_done}: update skipped (streak "
+                              f"{nan_streak}/{config.max_bad_steps})")
+                        if nan_streak >= config.max_bad_steps:
+                            raise TrainDivergedError(
+                                f"{nan_streak} consecutive non-finite losses "
+                                f"up to step {steps_done} (epoch {epoch}); "
+                                "params/opt state are NOT corrupted (every "
+                                "bad update was skipped) — lower the lr or "
+                                "inspect the data"
+                            )
+                    else:
+                        nan_streak = 0
+                faults.sigterm_hook(steps_done)  # no-op unless a test armed it
+                at_ckpt = (config.checkpoint_steps > 0
+                           and steps_done % config.checkpoint_steps == 0)
+                if jax.process_count() > 1:
+                    # one host must never stop (and final-save) alone: the
+                    # stop decision is agreed at collective boundaries.
+                    # Those boundaries must stay frequent regardless of
+                    # checkpoint_steps (a preemption grace window is ~30s;
+                    # a 1000-step save cadence would forfeit it), so agree
+                    # every few steps — one tiny host allgather, amortized
+                    agree_every = (min(config.checkpoint_steps, 8)
+                                   if config.checkpoint_steps else 8)
+                    want_stop = (steps_done % agree_every == 0
+                                 and _global_any(preempt.requested))
+                else:
+                    want_stop = preempt.requested
+                if want_stop or at_ckpt:
+                    save_train_checkpoint(
+                        ckpt_name, config, model_config, cur_state,
+                        epoch - 1, train_loss, test_loss, False,
+                        step=steps_done,
+                        position={"epoch": epoch, "next_batch": batch_idx + 1},
+                        **save_kwargs,
+                    )
+                if want_stop:
+                    stop["preempted"] = True
+                    return True
+                return False
+
+            if train_loader.start_batch < n_train:
+                # trace only the first post-resume epoch: a bounded,
+                # representative capture (compile + steady-state steps)
+                # instead of a runaway file
+                with maybe_trace(config.profile_dir,
+                                 enabled=epoch == first_epoch):
+                    state, train_loss[epoch - 1] = process_epoch(
+                        "train", epoch, state, train_step, train_loader,
+                        config.log_interval, put_batch,
+                        step_base=steps_done, on_step=on_step,
+                    )
+            else:
+                # resume position at the epoch's very end (killed between the
+                # last periodic save and the epoch-end save): nothing to
+                # recompute, but val + the epoch-end save still run
+                print(f"Train Epoch: {epoch} already fully consumed at the "
+                      "resume position; skipping to validation")
+                train_loss[epoch - 1] = float("nan")
+            if stop_epoch["preempted"]:
+                preempted = True
+                break
+            _, test_loss[epoch - 1] = process_epoch(
+                "test", epoch, state, eval_step, val_loader,
                 config.log_interval, put_batch,
             )
-        _, test_loss[epoch - 1] = process_epoch(
-            "test", epoch, state, eval_step, val_loader,
-            config.log_interval, put_batch,
-        )
-        is_best = test_loss[epoch - 1] < best
-        best = min(test_loss[epoch - 1], best)
-        # multi-host: losses are computed on the global batch (replicated to
-        # every process), so is_best agrees everywhere.  Every process calls
-        # the (collective) save; orbax writes from the primary host only.
-        save_train_checkpoint(
-            ckpt_name, config, model_config, state, epoch, train_loss,
-            test_loss, is_best,
-        )
+            is_best = test_loss[epoch - 1] < best  # False for a NaN epoch
+            # fmin, not min: a NaN val epoch must not poison best tracking
+            # (min(nan, best) is nan, disabling best_ for the rest of the run)
+            best = float(np.fmin(test_loss[epoch - 1], best))
+            # multi-host: losses are computed on the global batch (replicated
+            # to every process), so is_best agrees everywhere.  Every process
+            # calls the (collective) save; orbax writes from the primary host
+            # only.
+            save_train_checkpoint(
+                ckpt_name, config, model_config, state, epoch, train_loss,
+                test_loss, is_best, step=steps_done,
+                position={"epoch": epoch + 1, "next_batch": 0},
+                **save_kwargs,
+            )
+            if _global_any(preempt.requested):
+                preempted = True
+                print("[fault-tolerance] stopping after the epoch "
+                      "checkpoint (preemption requested)")
+                break
+    if preempted and progress:
+        print(f"Preemption checkpoint committed under {ckpt_name}; resume "
+              "by pointing --checkpoint at it")
     return {
         "state": state,
         "model_config": model_config,
@@ -530,4 +997,9 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         "test_loss": test_loss,
         "best_test_loss": best,
         "checkpoint": ckpt_name,
+        "preempted": preempted,
+        "nan_steps_skipped": nan_skipped,
+        "quarantined": sorted(
+            train_loader.quarantined | val_loader.quarantined
+        ),
     }

@@ -1,4 +1,6 @@
-"""Utilities: seeding, profiling, atomic artifact I/O."""
+"""Utilities: seeding, profiling, atomic artifact I/O, fault injection
+(``ncnet_tpu.utils.faults`` — stdlib+numpy only; its hooks are no-ops
+unless a test arms a plan)."""
 
 from ncnet_tpu.utils.io import atomic_savemat
 from ncnet_tpu.utils.profiling import annotate, maybe_trace

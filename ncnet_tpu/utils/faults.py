@@ -1,0 +1,163 @@
+"""Deterministic fault injection for proving the fault-tolerance layer.
+
+The training stack (ncnet_tpu/training/train.py) claims to survive four
+real-world failure modes: undecodable images, non-finite losses, a process
+killed mid-checkpoint-save, and SIGTERM preemption.  Claims about crash paths
+rot unless they are executed, so the production code carries four tiny hook
+call sites and this module arms them deterministically from tests:
+
+  * ``decode_hook(path)``         — data/datasets.load_image: raises
+    :class:`InjectedFault` (an OSError) for matching image paths, optionally
+    only for the first k attempts per path (exercises decode retry).
+  * ``corrupt_batch_hook(b, s)``  — training/train.process_epoch: NaN-fills
+    the source images of selected global train steps, so the NaN flows
+    through the real jitted loss/grads/update and the guard must keep it out
+    of Adam state (injecting at the loss value would bypass the mechanism
+    under test).
+  * ``kill_mid_save_hook(n)``     — training/train.save_train_checkpoint:
+    SIGKILLs the process between the ``params`` and ``opt`` writes of
+    checkpoint version ``step_<n>`` — the ``.tmp`` directory exists with
+    partial content and the commit rename never runs.
+  * ``sigterm_hook(step)``        — the fit train loop: delivers SIGTERM to
+    the process after a given global step (exercises the preemption handler
+    end-to-end, including the final boundary checkpoint).
+
+Arming: programmatic via :func:`install`/:func:`clear` (or the
+:func:`injected` context manager) in-process, or the ``NCNET_TPU_FAULTS``
+environment variable (a JSON object of :class:`FaultPlan` fields) for
+subprocess tests — the kill-mid-save test SIGKILLs its worker, so the plan
+must survive process creation.  Every hook is a no-op returning after one
+``is None`` check when nothing is armed; the production hot path pays nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import signal
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class InjectedFault(OSError):
+    """An injected I/O failure.  Subclasses OSError so production retry and
+    quarantine paths treat it exactly like a real decode error."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """What to break, and when.  All fields default to 'never'."""
+
+    # global train steps (1-based, = TrainState.step after the batch) whose
+    # input batch is NaN-corrupted before the jitted step runs
+    nan_loss_steps: Tuple[int, ...] = ()
+    # image paths containing this substring raise InjectedFault on decode
+    decode_fail_substring: str = ""
+    # -1: every decode attempt fails; k >= 0: only the first k attempts per
+    # path fail (a transient error that retry should absorb)
+    decode_fail_times: int = -1
+    # SIGKILL self mid-save of checkpoint version step_<N> (between the
+    # params and opt writes: .tmp exists, commit rename never happens)
+    kill_at_version: int = -1
+    # SIGTERM self after this global train step (1-based)
+    sigterm_at_step: int = -1
+
+
+_plan: Optional[FaultPlan] = None
+_env_read = False
+_decode_attempts: Dict[str, int] = {}
+_lock = threading.Lock()
+
+
+def install(plan: FaultPlan) -> None:
+    """Arm ``plan`` for this process (replaces any prior plan)."""
+    global _plan
+    with _lock:
+        _plan = plan
+        _decode_attempts.clear()
+
+
+def clear() -> None:
+    """Disarm all faults (tests must always pair install with clear)."""
+    global _plan, _env_read
+    with _lock:
+        _plan = None
+        _env_read = True  # an explicit clear also wins over the env var
+        _decode_attempts.clear()
+
+
+@contextlib.contextmanager
+def injected(plan: FaultPlan):
+    """``with injected(FaultPlan(...)):`` — armed inside, disarmed after."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def _active() -> Optional[FaultPlan]:
+    global _plan, _env_read
+    if _plan is None and not _env_read:
+        with _lock:
+            if _plan is None and not _env_read:
+                _env_read = True
+                env = os.environ.get("NCNET_TPU_FAULTS", "")
+                if env:
+                    fields = json.loads(env)
+                    if "nan_loss_steps" in fields:
+                        fields["nan_loss_steps"] = tuple(fields["nan_loss_steps"])
+                    _plan = FaultPlan(**fields)
+    return _plan
+
+
+# ---------------------------------------------------------------------------
+# hooks (called from production code; no-ops when nothing is armed)
+# ---------------------------------------------------------------------------
+
+
+def decode_hook(path: str) -> None:
+    """Raise :class:`InjectedFault` when ``path`` is scheduled to fail."""
+    p = _active()
+    if p is None or not p.decode_fail_substring:
+        return
+    if p.decode_fail_substring not in path:
+        return
+    if p.decode_fail_times >= 0:
+        with _lock:
+            n = _decode_attempts.get(path, 0)
+            _decode_attempts[path] = n + 1
+        if n >= p.decode_fail_times:
+            return  # transient fault already absorbed by earlier attempts
+    raise InjectedFault(f"injected decode failure for {path!r}")
+
+
+def corrupt_batch_hook(batch: dict, step: int) -> dict:
+    """NaN-fill the source images of the host batch feeding global ``step``."""
+    p = _active()
+    if p is None or step not in p.nan_loss_steps:
+        return batch
+    out = dict(batch)
+    src = np.asarray(out["source_image"], dtype=np.float32)
+    out["source_image"] = np.full_like(src, np.nan)
+    return out
+
+
+def kill_mid_save_hook(version: int) -> None:
+    """SIGKILL self mid-save of checkpoint version ``version`` (if armed)."""
+    p = _active()
+    if p is None or p.kill_at_version < 0 or version != p.kill_at_version:
+        return
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def sigterm_hook(step: int) -> None:
+    """Deliver SIGTERM to self after global train step ``step`` (if armed)."""
+    p = _active()
+    if p is None or p.sigterm_at_step < 0 or step != p.sigterm_at_step:
+        return
+    os.kill(os.getpid(), signal.SIGTERM)

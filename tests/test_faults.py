@@ -1,0 +1,433 @@
+"""Fault-tolerance tests: each injected failure mode (decode errors, NaN
+losses, kill-mid-save, SIGTERM preemption) must be survived by the mechanism
+built for it — proven end-to-end on the synthetic dataset via the
+ncnet_tpu/utils/faults.py injection harness, whose hooks live inside the
+production code paths themselves."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.config import ModelConfig, TrainConfig
+from ncnet_tpu.data import DataLoader, ImagePairDataset, SampleDecodeError
+from ncnet_tpu.data.synthetic import write_pair_dataset
+from ncnet_tpu.models import checkpoint as ckpt_io
+from ncnet_tpu import training
+from ncnet_tpu.utils import faults
+from ncnet_tpu.utils.faults import FaultPlan
+
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dataset(tmp_path, n_pairs=4, seed=1):
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=n_pairs, image_hw=(48, 48),
+                       shift=(16, 16), seed=seed)
+    return root
+
+
+def _cfg(root, out_dir, **kw):
+    base = dict(
+        model=TINY, image_size=48,
+        dataset_image_path=root, dataset_csv_path=root + "/image_pairs",
+        num_epochs=1, batch_size=2, lr=1e-3,
+        result_model_dir=str(out_dir), log_interval=10, data_parallel=False,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _assert_states_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        a.params, b.params,
+    )
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        a.opt_state, b.opt_state,
+    )
+    assert int(a.step) == int(b.step)
+
+
+# ---------------------------------------------------------------------------
+# atomic versioned checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_version_resolver_skips_tmp_and_picks_newest(tmp_path):
+    root = tmp_path / "root"
+    for name, complete in [("step_00000002", True), ("step_00000010", True),
+                           ("step_00000012.tmp", False)]:
+        d = root / name
+        d.mkdir(parents=True)
+        if complete:
+            (d / "config.json").write_text("{}")
+    (root / "step_00000011").mkdir()  # committed name but empty: incomplete
+    (root / "notes.txt").write_text("junk")
+
+    assert [n for n, _ in ckpt_io.list_checkpoint_versions(str(root))] == [2, 10]
+    assert ckpt_io.resolve_checkpoint_dir(str(root)).endswith("step_00000010")
+    # a non-versioned directory resolves to itself
+    flat = tmp_path / "flat"
+    flat.mkdir()
+    (flat / "config.json").write_text("{}")
+    assert ckpt_io.resolve_checkpoint_dir(str(flat)) == str(flat)
+    # ownership: root and versions map back to the root, foreigners to None
+    assert ckpt_io.owning_checkpoint_root(str(root)) == str(root)
+    assert ckpt_io.owning_checkpoint_root(
+        str(root / "step_00000002")) == str(root)
+    assert ckpt_io.owning_checkpoint_root(str(flat)) is None
+
+
+def test_resolver_rejects_root_with_only_tmp_carcasses(tmp_path):
+    root = tmp_path / "root"
+    (root / "step_00000003.tmp").mkdir(parents=True)
+    with pytest.raises(FileNotFoundError, match="incomplete"):
+        ckpt_io.resolve_checkpoint_dir(str(root))
+
+
+def test_with_io_retries_bounded():
+    calls = []
+
+    def flaky(fail_n):
+        def fn():
+            calls.append(1)
+            if len(calls) <= fail_n:
+                raise OSError("transient")
+            return 7
+        return fn
+
+    assert ckpt_io.with_io_retries(flaky(2), attempts=3, backoff=0.0) == 7
+    assert len(calls) == 3
+    calls.clear()
+    with pytest.raises(OSError, match="transient"):
+        ckpt_io.with_io_retries(flaky(5), attempts=2, backoff=0.0)
+    assert len(calls) == 2
+
+
+def test_retention_window_and_positions(tmp_path):
+    """checkpoint_steps saves carry exact resume cursors; retention keeps
+    only the newest ``keep_checkpoints`` versions; best_ copy survives."""
+    root = _dataset(tmp_path, n_pairs=8)  # 4 train batches at bs=2
+    cfg = _cfg(root, tmp_path / "ckpts", checkpoint_steps=1,
+               keep_checkpoints=2)
+    result = training.fit(cfg, progress=False)
+    ckpt_root = result["checkpoint"]
+    versions = ckpt_io.list_checkpoint_versions(ckpt_root)
+    assert [n for n, _ in versions] == [3, 4]  # 1, 2 pruned
+    with open(os.path.join(versions[0][1], "config.json")) as f:
+        meta3 = json.load(f)
+    assert meta3["_position"] == {"epoch": 1, "next_batch": 3}
+    assert meta3["_epoch"] == 0  # saved mid-epoch-1
+    with open(os.path.join(versions[1][1], "config.json")) as f:
+        meta4 = json.load(f)  # epoch-end save overwrote the periodic one
+    assert meta4["_position"] == {"epoch": 2, "next_batch": 0}
+    assert meta4["_epoch"] == 1
+    assert any(d.startswith("best_")
+               for d in os.listdir(tmp_path / "ckpts"))
+
+
+def test_rollback_resume_prunes_stale_newer_versions(tmp_path, capsys):
+    """Resuming from a NON-newest version is a rollback: versions newer
+    than the resume point must be pruned, or a crash before the new lineage
+    surpasses them would silently resume the rolled-back-from checkpoint."""
+    root = _dataset(tmp_path, n_pairs=8)  # 4 train batches at bs=2
+    r1 = training.fit(
+        _cfg(root, tmp_path / "ckpts", checkpoint_steps=1,
+             keep_checkpoints=10),
+        progress=False,
+    )
+    ckpt_root = r1["checkpoint"]
+    assert [n for n, _ in ckpt_io.list_checkpoint_versions(ckpt_root)] \
+        == [1, 2, 3, 4]
+    cfg2 = _cfg(root, tmp_path / "ckpts", checkpoint_steps=1,
+                keep_checkpoints=10,
+                model=TINY.replace(
+                    checkpoint=os.path.join(ckpt_root, "step_00000002")))
+    r2 = training.fit(cfg2, progress=False)
+    assert "pruned stale version" in capsys.readouterr().out
+    # the rolled-back lineage regenerates 3 and 4 deterministically
+    assert [n for n, _ in ckpt_io.list_checkpoint_versions(ckpt_root)] \
+        == [1, 2, 3, 4]
+    _assert_states_equal(r2["state"], r1["state"])
+
+
+def test_same_step_resave_crash_window_recovers(tmp_path):
+    """A same-step re-save commits via rename(final→.old), rename(tmp→final);
+    a crash between the two renames must not strand the run: readers accept
+    the displaced .old as version N, and the next save restores it."""
+    cfg = TrainConfig(model=TINY, data_parallel=False)
+    state, _, mc, _ = training.create_train_state(cfg)
+    root = str(tmp_path / "root")
+    z = np.zeros(1)
+    v = training.save_train_checkpoint(
+        root, cfg, mc, state, 1, z, z, False,
+        step=2, position={"epoch": 2, "next_batch": 0},
+    )
+    # simulate the crash window: original displaced, replacement uncommitted
+    os.rename(v, v + ".old")
+    os.makedirs(v + ".tmp")
+    assert ckpt_io.list_checkpoint_versions(root) == [(2, v + ".old")]
+    assert ckpt_io.resolve_checkpoint_dir(root) == v + ".old"
+    assert ckpt_io.owning_checkpoint_root(v + ".old") == root
+    # the next save's reclaim pass restores the displaced version and
+    # drops the uncommitted tmp
+    training.save_train_checkpoint(
+        root, cfg, mc, state, 1, z, z, False,
+        step=3, position={"epoch": 2, "next_batch": 1},
+    )
+    assert sorted(os.listdir(root)) == ["step_00000002", "step_00000003"]
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf loss guard
+# ---------------------------------------------------------------------------
+
+
+def test_nan_guard_step_skips_update(rng):
+    """A non-finite loss must leave params AND Adam state bitwise unchanged
+    (the step counter still counts the consumed batch); the next good batch
+    updates normally."""
+    state, optimizer, mc, _ = training.create_train_state(
+        TrainConfig(model=TINY, batch_size=2, data_parallel=False)
+    )
+    step = training.make_train_step(mc, optimizer, donate=False,
+                                    nan_guard=True)
+    good = {
+        "source_image": jnp.asarray(
+            rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+        "target_image": jnp.asarray(
+            rng.uniform(0, 1, (2, 48, 48, 3)).astype(np.float32)),
+    }
+    bad = dict(good, source_image=jnp.full((2, 48, 48, 3), np.nan))
+
+    s1, l1 = step(state, good)
+    assert np.isfinite(float(l1))
+    s2, l2 = step(s1, bad)
+    assert not np.isfinite(float(l2))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s2.params, s1.params,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s2.opt_state, s1.opt_state,
+    )
+    assert int(s2.step) == 2  # batches consumed, not updates applied
+    s3, l3 = step(s2, good)
+    assert np.isfinite(float(l3))
+    assert not np.array_equal(np.asarray(s3.params["nc"][0]["w"]),
+                              np.asarray(s2.params["nc"][0]["w"]))
+
+
+def test_fit_nan_injection_skips_and_completes(tmp_path, capsys):
+    root = _dataset(tmp_path)
+    cfg = _cfg(root, tmp_path / "ckpts")
+    with faults.injected(FaultPlan(nan_loss_steps=(1,))):
+        result = training.fit(cfg, progress=False)
+    assert result["nan_steps_skipped"] == 1
+    assert np.isfinite(result["train_loss"]).all()  # mean excludes the NaN
+    out = capsys.readouterr().out
+    assert "non-finite loss at step 1" in out
+
+
+def test_fit_nan_streak_aborts_with_clear_error(tmp_path):
+    root = _dataset(tmp_path)
+    cfg = _cfg(root, tmp_path / "ckpts", max_bad_steps=2)
+    with faults.injected(FaultPlan(nan_loss_steps=(1, 2))):
+        with pytest.raises(training.TrainDivergedError,
+                           match="2 consecutive non-finite"):
+            training.fit(cfg, progress=False)
+
+
+# ---------------------------------------------------------------------------
+# data-path resilience: decode retry + quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_decode_retry_absorbs_transient_fault(tmp_path):
+    root = _dataset(tmp_path)
+    ds = ImagePairDataset(root + "/image_pairs", "train_pairs.csv", root,
+                          output_size=(48, 48), decode_retries=1)
+    with faults.injected(FaultPlan(decode_fail_substring="train_1_b",
+                                   decode_fail_times=1)):
+        sample = ds[1]  # first attempt fails, the retry succeeds
+    assert sample["source_image"].shape == (48, 48, 3)
+    ds0 = ImagePairDataset(root + "/image_pairs", "train_pairs.csv", root,
+                           output_size=(48, 48), decode_retries=0)
+    with faults.injected(FaultPlan(decode_fail_substring="train_1_b")):
+        with pytest.raises(SampleDecodeError, match="train_1_b"):
+            ds0[1]
+
+
+def test_loader_raise_policy_propagates(tmp_path):
+    root = _dataset(tmp_path)
+    bad = os.path.join(root, "images", "train_0_a.jpg")
+    with open(bad, "wb") as f:
+        f.write(b"not a jpeg at all")
+    ds = ImagePairDataset(root + "/image_pairs", "train_pairs.csv", root,
+                          output_size=(48, 48), decode_retries=0)
+    loader = DataLoader(ds, batch_size=2)  # default: raise
+    with pytest.raises(SampleDecodeError, match="train_0_a"):
+        list(loader)
+
+
+def test_loader_quarantine_substitutes_and_reports(tmp_path):
+    root = _dataset(tmp_path)
+    bad = os.path.join(root, "images", "train_0_a.jpg")
+    with open(bad, "wb") as f:
+        f.write(b"not a jpeg at all")
+    ds = ImagePairDataset(root + "/image_pairs", "train_pairs.csv", root,
+                          output_size=(48, 48), decode_retries=0)
+    loader = DataLoader(ds, batch_size=2, on_decode_error="quarantine")
+    batches = list(loader)
+    assert len(batches) == len(loader)  # full epoch, every batch full
+    for b in batches:
+        assert b["source_image"].shape == (2, 48, 48, 3)
+    assert loader.quarantined == {bad}
+    # the replacement for sample 0 is the next healthy sample (index 1)
+    np.testing.assert_array_equal(
+        batches[0]["target_image"][0], batches[0]["target_image"][1]
+    )
+
+
+def test_systemic_decode_failure_fails_fast(tmp_path):
+    """When EVERY decode fails (wrong image root, unmounted disk), the
+    quarantine substitution must declare the failure systemic after a
+    bounded number of fresh failures — not scan the whole dataset."""
+    root = _dataset(tmp_path, n_pairs=8)
+    ds = ImagePairDataset(root + "/image_pairs", "train_pairs.csv", root,
+                          output_size=(48, 48), decode_retries=0)
+    loader = DataLoader(ds, batch_size=2, on_decode_error="quarantine")
+    with faults.injected(FaultPlan(decode_fail_substring="images/")):
+        with pytest.raises(SampleDecodeError, match="consecutive"):
+            list(loader)
+    assert len(loader.quarantined) <= DataLoader._MAX_FRESH_FAILURES
+
+
+def test_fit_quarantines_corrupt_image_and_completes(tmp_path, capsys):
+    """Acceptance: one corrupt image costs the epoch at most that sample;
+    the run completes and the quarantined path is reported."""
+    root = _dataset(tmp_path)
+    bad = os.path.join(root, "images", "train_1_a.jpg")
+    with open(bad, "wb") as f:
+        f.write(b"\xff\xd8garbage")
+    result = training.fit(_cfg(root, tmp_path / "ckpts"), progress=False)
+    assert result["quarantined"] == [bad]
+    assert np.isfinite(result["train_loss"]).all()
+    assert "quarantined undecodable sample" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM preemption
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_checkpoints_at_boundary_then_resumes(tmp_path):
+    root = _dataset(tmp_path)
+    cfg = _cfg(root, tmp_path / "ckpts", num_epochs=3)
+    with faults.injected(FaultPlan(sigterm_at_step=2)):
+        r1 = training.fit(cfg, progress=False)
+    assert r1["preempted"]
+    ckpt_root = r1["checkpoint"]
+    versions = ckpt_io.list_checkpoint_versions(ckpt_root)
+    assert [n for n, _ in versions] == [2]  # the boundary checkpoint
+    with open(os.path.join(versions[0][1], "config.json")) as f:
+        assert json.load(f)["_position"] == {"epoch": 1, "next_batch": 2}
+
+    # resume finishes the remaining epochs (epoch 1 was fully consumed:
+    # only its val pass and the epoch-end bookkeeping remain)
+    cfg2 = _cfg(root, tmp_path / "ckpts", num_epochs=3,
+                model=TINY.replace(checkpoint=ckpt_root))
+    r2 = training.fit(cfg2, progress=False)
+    assert not r2["preempted"]
+    assert int(r2["state"].step) == 6  # 3 epochs x 2 batches
+    assert r2["checkpoint"] == ckpt_root  # continued in place
+    assert np.isfinite(r2["train_loss"][1:]).all()
+
+
+# ---------------------------------------------------------------------------
+# kill-mid-save → resume (the acceptance bitwise-equivalence test)
+# ---------------------------------------------------------------------------
+
+
+def test_kill_mid_save_then_resume_is_bitwise_identical(tmp_path):
+    """SIGKILL a training subprocess between the params and opt writes of a
+    checkpoint version: the .tmp carcass must be ignored, resume must pick
+    the last COMPLETE version, and the finished run must match an
+    uninterrupted run bitwise (params, opt_state, step)."""
+    root = _dataset(tmp_path, n_pairs=8)  # 4 train batches at bs=2
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {_REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ncnet_tpu.config import ModelConfig, TrainConfig
+from ncnet_tpu import training
+
+cfg = TrainConfig(
+    model=ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,)),
+    image_size=48,
+    dataset_image_path={root!r},
+    dataset_csv_path={root + "/image_pairs"!r},
+    num_epochs=1, batch_size=2, lr=1e-3,
+    result_model_dir={str(tmp_path / "killed")!r},
+    log_interval=10, data_parallel=False,
+    checkpoint_steps=1, keep_checkpoints=10,
+)
+training.fit(cfg, progress=False)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # identical device topology to the in-process runs (conftest's 8 virtual
+    # CPU devices): XLA CPU partitions reductions per device count, and the
+    # bitwise-equality bar below tolerates no reassociation
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["NCNET_TPU_FAULTS"] = json.dumps({"kill_at_version": 3})
+    proc = subprocess.run(
+        [sys.executable, str(worker)], env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == -9, f"expected SIGKILL, got:\n{proc.stdout[-3000:]}"
+
+    (ckpt_root,) = [
+        os.path.join(tmp_path / "killed", d)
+        for d in os.listdir(tmp_path / "killed")
+    ]
+    names = sorted(os.listdir(ckpt_root))
+    assert "step_00000003.tmp" in names  # the mid-save carcass
+    assert "step_00000003" not in names  # never committed
+    assert [n for n, _ in ckpt_io.list_checkpoint_versions(ckpt_root)] == [1, 2]
+
+    # resume from the same directory: continues from step_2 (epoch 1,
+    # batch 2) and reclaims the carcass
+    cfg_resume = _cfg(root, tmp_path / "killed",
+                      model=TINY.replace(checkpoint=ckpt_root),
+                      checkpoint_steps=1, keep_checkpoints=10)
+    r_resumed = training.fit(cfg_resume, progress=True)
+    assert r_resumed["checkpoint"] == ckpt_root
+    assert not any(d.endswith(".tmp") for d in os.listdir(ckpt_root))
+    assert [n for n, _ in ckpt_io.list_checkpoint_versions(ckpt_root)] \
+        == [1, 2, 3, 4]
+
+    # the uninterrupted twin
+    r_full = training.fit(
+        _cfg(root, tmp_path / "full", checkpoint_steps=1,
+             keep_checkpoints=10),
+        progress=False,
+    )
+    _assert_states_equal(r_resumed["state"], r_full["state"])
+    assert int(r_resumed["state"].step) == 4

@@ -63,6 +63,22 @@ def test_interpret_parity(shape, kernels, channels):
     np.testing.assert_allclose(got / scale, ref / scale, atol=3e-2)
 
 
+def test_rejects_multichannel_input():
+    """The lane packing keeps only input channel 0 (x[..., 0]): calls whose
+    volume or first layer carries more than 1 input channel must be rejected
+    loudly, not silently given wrong results (ADVICE r5)."""
+    params = make_params(jax.random.key(0), (3,), (1,), dtype=jnp.bfloat16)
+    x2 = jnp.zeros((1, 5, 5, 5, 5, 2), jnp.bfloat16)
+    with pytest.raises(AssertionError, match="1-channel input"):
+        nc_stack_fused_lane(params, x2, interpret=True)
+    # a first layer with c_in > 1 is the same class of misuse
+    wide = [{"w": jnp.zeros((3, 3, 3, 3, 2, 1), jnp.bfloat16),
+             "b": jnp.zeros((1,), jnp.bfloat16)}]
+    x1 = jnp.zeros((1, 5, 5, 5, 5, 1), jnp.bfloat16)
+    with pytest.raises(AssertionError, match="1-channel input"):
+        nc_stack_fused_lane(wide, x1, interpret=True)
+
+
 def test_feasibility_gate():
     """Shape-class gate: PF-Pascal passes; InLoc-scale VMEM blowups, mixed
     kernel sizes, and even kernels are all rejected."""

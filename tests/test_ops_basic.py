@@ -143,6 +143,48 @@ def test_conv4d_variants_and_pad_modes_agree(rng, variant, pad_ha, pad_hb):
     )
 
 
+@pytest.mark.parametrize("variant", ["unroll", "tapfold", "coutfold"])
+@pytest.mark.parametrize("pad_wa,pad_wb",
+                         [(False, True), (True, False), (False, False)])
+def test_conv4d_valid_w_matches_cropped_same(rng, variant, pad_wa, pad_wb):
+    """The valid (unpadded) wA/wB paths must equal the same-padded output
+    cropped by k//2 per side on that dim — the 2D-sharded path feeds
+    pre-haloed volumes with pad_wa/pad_wb=False and relies on exactly this
+    shrink arithmetic (ADVICE r5: these paths previously shipped with no
+    callers and no coverage)."""
+    b, ha, wa, hb, wb, cin, cout, k = 1, 4, 6, 3, 7, 2, 3, 3
+    x = jnp.asarray(rng.standard_normal((b, ha, wa, hb, wb, cin)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((k, k, k, k, cin, cout)).astype(np.float32))
+    bias = jnp.asarray(rng.standard_normal((cout,)).astype(np.float32))
+
+    got = ops.conv4d(x, w, bias, pad_wa=pad_wa, pad_wb=pad_wb, variant=variant)
+    pad = k // 2
+    exp_wa = wa if pad_wa else wa - 2 * pad
+    exp_wb = wb if pad_wb else wb - 2 * pad
+    assert got.shape == (b, ha, exp_wa, hb, exp_wb, cout)
+    full = ops.conv4d(x, w, bias)  # same-padded reference
+    sl_wa = slice(None) if pad_wa else slice(pad, -pad)
+    sl_wb = slice(None) if pad_wb else slice(pad, -pad)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full)[:, :, sl_wa, :, sl_wb],
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+@pytest.mark.parametrize("variant", ["afold", "toeplitz_b"])
+@pytest.mark.parametrize("pad_wa,pad_wb",
+                         [(False, True), (True, False), (False, False)])
+def test_conv4d_valid_w_unsupported_variants_raise(rng, variant, pad_wa, pad_wb):
+    """afold/toeplitz_b support the same-padded w dims only (module
+    docstring); both must refuse valid-w calls loudly instead of silently
+    returning a same-padded wrong-shape result (ADVICE r5)."""
+    b, ha, wa, hb, wb, cin, cout, k = 1, 4, 4, 3, 3, 2, 2, 3
+    x = jnp.asarray(rng.standard_normal((b, ha, wa, hb, wb, cin)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((k, k, k, k, cin, cout)).astype(np.float32))
+    with pytest.raises(ValueError, match="unpadded"):
+        ops.conv4d(x, w, pad_wa=pad_wa, pad_wb=pad_wb, variant=variant)
+
+
 def test_conv4d_auto_variant_matches_unroll(rng):
     """'auto' picks tapfold for 1-channel input and coutfold for 1-channel
     output; both must match the unroll formulation on NC-shaped layers."""

@@ -164,10 +164,11 @@ def test_fit_and_resume(tmp_path, capsys):
 
     # resume: fresh state restored from disk equals in-memory final state
     state2, optimizer, mc, _ = training.create_train_state(cfg)
-    restored, epoch, tr, te = training.load_train_checkpoint(
+    restored, epoch, tr, te, position = training.load_train_checkpoint(
         result["checkpoint"], state2
     )
     assert epoch == 2
+    assert position == {"epoch": 3, "next_batch": 0}  # epoch-end cursor
     np.testing.assert_allclose(tr, result["train_loss"])
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
@@ -377,7 +378,10 @@ with open({str(tmp_path)!r} + f"/ckptname_{{pid}}.txt", "w") as f:
     names = {(tmp_path / f"ckptname_{i}.txt").read_text() for i in range(2)}
     assert len(names) == 1
     ckpt = names.pop()
-    assert os.path.isdir(ckpt) and os.path.isdir(os.path.join(ckpt, "params"))
+    from ncnet_tpu.models.checkpoint import resolve_checkpoint_dir
+
+    latest = resolve_checkpoint_dir(ckpt)  # newest complete step_<N> version
+    assert os.path.isdir(latest) and os.path.isdir(os.path.join(latest, "params"))
 
 
 def test_auto_accum_chunks():
