@@ -217,9 +217,132 @@ def bench_jax(res=None):
                 )
                 res["forward_bf16_tflops"] = round(tflops, 2)
                 res["forward_bf16_mfu_pct"] = round(100 * tflops / peak, 2)
+                # EXECUTED-FLOPs MFU: numerator = XLA's cost analysis of the
+                # compiled program, so it moves whenever a formulation
+                # change cuts executed work (r4→r5 it DROPPED while the
+                # forward got 1.8× faster).  Kept, explicitly named; the
+                # cross-round-comparable companion (constant algorithmic
+                # numerator) is emitted in the roofline block below.
+                # Definitions: README "MFU accounting".  (VERDICT r5 #5)
+                res["forward_bf16_mfu_executed_pct"] = \
+                    res["forward_bf16_mfu_pct"]
                 res["device_kind"] = kind
         except Exception:
             pass
+
+    # per-stage decomposition of the fused NC stack (ISSUE r6): time the
+    # layout conversion and the layer prefixes of the SAME kernels the
+    # production filter runs, so the residual roofline gap is attributed
+    # (layout-in/out vs per-layer) instead of guessed.  Prefix chains carry
+    # a wider-than-production final output (the probe relaxation), so the
+    # last layer's delta slightly UNDERstates it vs the 16-channel prefix
+    # write it is differenced against — noted in README.
+    def _filter_stages():
+        from ncnet_tpu.models.ncnet import extract_features as _ef
+        from ncnet_tpu.ops.nc_fused_lane import (
+            fused_layout_in,
+            fused_layout_out,
+            nc_stack_fused_lane,
+            nc_stack_resident,
+        )
+
+        feat_shape = jax.eval_shape(
+            lambda p, x: _ef(cfg16, p, x),
+            params,
+            jax.ShapeDtypeStruct((1, IMAGE, IMAGE, 3), jnp.float32),
+        ).shape
+        s = feat_shape[1]
+        nv = 2 * BATCH  # symmetric batch-fold: 2 volumes per pair
+        k = KERNELS[0]
+        params16 = [
+            {"w": layer["w"].astype(jnp.bfloat16),
+             "b": layer["b"].astype(jnp.bfloat16)}
+            for layer in params["nc"]
+        ]
+
+        def vol_input(key):
+            return (jax.random.normal(
+                key, (nv, s, s, s, s, 1), jnp.bfloat16) * 0.1,)
+
+        def eps_step(fn):
+            def step(carry):
+                (x,) = carry
+                out = fn(x)
+                return (x + (jnp.sum(out.astype(jnp.float32)) * 1e-12
+                             ).astype(x.dtype),)
+            return step
+
+        stages = {}
+        # layout-in and layout-out in isolation (cheap scalar-volume ops)
+        stages["layout_in"] = _timeit_scan(
+            eps_step(lambda x: fused_layout_in(x, k)), vol_input,
+            per=BATCH, n_long=64)
+        h = k - 1
+
+        def out_input(key):
+            return (jax.random.normal(
+                key, (nv, s, s, 1, (s + h) * (s + h)), jnp.bfloat16) * 0.1,)
+
+        stages["layout_out"] = _timeit_scan(
+            eps_step(lambda o: fused_layout_out(o, s, s, k)), out_input,
+            per=BATCH, n_long=64)
+        # layer prefixes through the production fused stack.  ONE tier is
+        # picked for every prefix — by compile-probing ALL prefix lengths of
+        # the resident kernel first, else the per-layer chain — so the
+        # per-layer deltas never difference timings of two different
+        # implementations (Mosaic legality is shape-dependent, so a
+        # per-prefix choice could mix tiers and emit negative/meaningless
+        # attributions).  The chosen tier is recorded so readers know which
+        # implementation the deltas describe.
+        def tier_compiles(fn):
+            try:
+                for n in range(1, len(params16) + 1):
+                    xs = jax.ShapeDtypeStruct(
+                        (1, s, s, s, s, 1), jnp.bfloat16)
+                    jax.jit(
+                        lambda x, fn=fn, n=n: fn(
+                            params16[:n], x, _allow_wide_final=True)
+                    ).lower(xs).compile()
+                return True
+            except Exception:
+                return False
+
+        fn = next(
+            (f for f in (nc_stack_resident, nc_stack_fused_lane)
+             if tier_compiles(f)), None)
+        if fn is None:
+            return stages
+        stages["tier"] = (
+            "resident" if fn is nc_stack_resident else "perlayer")
+        prev = None
+        for n in range(1, len(params16) + 1):
+            t = _with_retries(
+                lambda n=n: _timeit_scan(
+                    eps_step(lambda x, n=n: fn(
+                        params16[:n], x, _allow_wide_final=True)),
+                    vol_input, per=BATCH, n_long=8),
+                label=f"filter_stage_prefix{n}",
+            )
+            if t is None:
+                return stages  # keep whatever stages succeeded
+            stages[f"stack_prefix{n}"] = t
+            # layer1's delta subtracts the measured scalar-volume layout
+            # conversion — exact for the resident tier; the per-layer
+            # chain's own conversion also packs a _MIN_CB channel pad, so
+            # there layer1 slightly overstates (noted via the tier field)
+            stages[f"layer{n}"] = t - (
+                prev if prev is not None
+                else stages["layout_in"] + stages["layout_out"])
+            prev = t
+        return stages
+
+    if res.get("filter_stage_layer1_ms") is None:
+        st = _with_retries(_filter_stages, label="filter_stages") or {}
+        for name, val in st.items():
+            if name == "tier":
+                res["filter_stage_tier"] = val
+            else:
+                res[f"filter_stage_{name}_ms"] = round(val, 4)
 
     # composed-forward roofline (VERDICT r3 item 6): measure the bf16 NC
     # FILTER stage alone (volume born from the production einsum), then set
@@ -294,17 +417,32 @@ def bench_jax(res=None):
                     algo_bytes / (peak_b * 1e9) * 1e3, 3)
                 res["roofline_filter_pct_of_mxu_bound"] = round(
                     100 * mxu_ms / meas, 1)
+                # ALGORITHMIC-FLOPs MFU (VERDICT r5 #5): constant numerator
+                # = the true NC-stack FLOPs of the fixed bench arch
+                # (~281.2 GFLOP/pair, the `flops` above), so these numbers
+                # compare across rounds no matter how the lowering
+                # reformulates the executed work.  filter_…_algorithmic is
+                # arithmetically identical to roofline_filter_pct_of_
+                # mxu_bound (same ratio, MFU-named); forward_…_algorithmic
+                # uses the same numerator over the whole forward.
+                res["filter_bf16_mfu_algorithmic_pct"] = round(
+                    100 * (flops / (meas * 1e-3) / 1e12) / peak_f, 2)
+                if res.get("forward_ms_per_pair_bf16"):
+                    res["forward_bf16_mfu_algorithmic_pct"] = round(
+                        100 * (flops / (res["forward_ms_per_pair_bf16"]
+                                        * 1e-3) / 1e12) / peak_f, 2)
                 # the binding constraint is whichever analytic bound is
                 # larger.  On v5e the MXU bound (1.43 ms) exceeds the HBM
                 # bound (0.48 ms as-formulated) — the filter is NOT
                 # bandwidth-bound.  r4 measured ~7.9 ms (18% of the MXU
                 # bound): XLA's conv lowering of the 4D-decomposed shapes.
-                # r5 closes most of that gap with the fused-(hB·wB)-lane
-                # Pallas stack (ops/nc_fused_lane.py): ~4.2 ms (~34% of
-                # bound; the kernel's own dot measures ~88% of peak — the
-                # residual is the A-operand build, a structural 25× tap
-                # copy, plus corr/mm seams; see tools/pallas_l2_probe.py
-                # ablations and tools/filter_stage_probe.py)
+                # r5: ~4.5 ms (~32%) with the per-layer fused-(hB·wB)-lane
+                # Pallas chain.  r6 attacks the remainder with the RESIDENT
+                # whole-stack kernel (nc_stack_resident: intermediates in
+                # VMEM rings — no inter-layer HBM round trips or k× row
+                # refetch — and exact thin-layer K/N widths, ~20% fewer
+                # executed dot FLOPs); the filter_stage_* extras above
+                # attribute whatever gap remains (layout vs per-layer)
                 res["roofline_verdict"] = (
                     "mxu-lowering-bound" if mxu_ms >= hbm_ms else "hbm-bound"
                 )
@@ -351,9 +489,37 @@ def bench_jax(res=None):
     if res.get("forward_ms_per_pair_bs1") is not None:
         res["forward_device_ms_per_pair_bs1"] = res["forward_ms_per_pair_bs1"]
 
-    # single-dispatch WALL at bs1 (what a serial caller actually waits
-    # through the tunnel: dispatch + upload + device + download)
+    # single-dispatch WALL at bs1: what a serial caller actually waits
+    # through the tunnel per pair.  Since r6 this measures the DEMO PATH
+    # (models/ncnet.py make_point_matcher): persistent warm program with
+    # pre-staged weights, raw uint8 upload (~1 MB/pair vs 3.8 fp32),
+    # device-side normalization, and the compact corr_to_matches table
+    # downloaded (~15 KB) instead of the fp32 volume (~1.6 MB) — the same
+    # fp32 model config as the device-time basis it is compared against.
+    # The old full-volume wall stays as …_fullcorr for cross-round
+    # comparability (r5: 681 ms against 15.4 ms of device time).
     def _bs1_wall():
+        from ncnet_tpu.models import make_point_matcher
+
+        matcher = make_point_matcher(cfg, params, do_softmax=True)
+        rng = np.random.default_rng(3)
+
+        def fresh_pair():
+            return (rng.integers(0, 255, (1, IMAGE, IMAGE, 3), dtype=np.uint8),
+                    rng.integers(0, 255, (1, IMAGE, IMAGE, 3), dtype=np.uint8))
+
+        matcher(*fresh_pair())  # compile + weight staging
+        walls = []
+        for _ in range(5):
+            s, t = fresh_pair()
+            t0 = time.perf_counter()
+            matcher(s, t)
+            walls.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(walls))
+
+    put("forward_wall_ms_per_pair_bs1", _bs1_wall, label="forward_bs1_wall")
+
+    def _bs1_wall_fullcorr():
         fwd1 = jax.jit(
             lambda p, s, t: models.ncnet_forward(cfg, p, s, t).corr
         )
@@ -374,7 +540,8 @@ def bench_jax(res=None):
             walls.append((time.perf_counter() - t0) * 1e3)
         return float(np.median(walls))
 
-    put("forward_wall_ms_per_pair_bs1", _bs1_wall, label="forward_bs1_wall")
+    put("forward_wall_ms_per_pair_bs1_fullcorr", _bs1_wall_fullcorr,
+        label="forward_bs1_wall_fullcorr")
 
     # bs1 on the bf16 path: the fused-lane filter's per-volume cost is
     # batch-independent, so the fp32 bs1 penalty (the fp32 filter at conv
@@ -426,6 +593,16 @@ def bench_jax(res=None):
             dt = time.perf_counter() - t0
             if out["total"] != 299:
                 raise RuntimeError(f"eval saw {out['total']} pairs, not 299")
+            # wall attribution (VERDICT r5 #2): decode = waiting on the
+            # loader, dispatch = upload + async enqueue, fetch = blocking
+            # result pulls; the residual is host-side collation/python.
+            # Device-time estimate from the scan-differenced bf16 forward.
+            for key, val in out["timing"].items():
+                res[f"pf_pascal_eval_s_{key.removesuffix('_s')}"] = round(
+                    val, 2)
+            if res.get("forward_ms_per_pair_bf16"):
+                res["pf_pascal_eval_s_device_est"] = round(
+                    res["forward_ms_per_pair_bf16"] * out["total"] / 1e3, 2)
             return round(dt, 2)
         finally:
             shutil.rmtree(root, ignore_errors=True)

@@ -23,6 +23,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backbone_weights", type=str, default="",
                    help="torchvision state_dict (.pth) for the trunk when no "
                         "checkpoint is given")
+    p.add_argument("--pipeline_depth", type=int, default=0,
+                   help="dispatch/fetch queue depth; 0 = adaptive (the "
+                        "InLoc controller, per-batch wall caps)")
+    p.add_argument("--host_normalize", action="store_true",
+                   help="upload host-normalized float images instead of the "
+                        "default resized-uint8 + on-device normalization "
+                        "(exact reference numerics; 4x the transfer bytes)")
     return p
 
 
@@ -44,6 +51,8 @@ def main(argv=None) -> int:
                                  backbone_weights=args.backbone_weights),
         batch_size=args.batch_size,
         num_workers=args.num_workers,
+        device_normalize=not args.host_normalize,
+        pipeline_depth=args.pipeline_depth,
     )
     print("Total: " + str(stats["total"]))
     print("Valid: " + str(stats["valid"]))

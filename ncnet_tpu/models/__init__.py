@@ -11,6 +11,7 @@ from ncnet_tpu.models.ncnet import (
     NCNetOutput,
     extract_features,
     init_ncnet,
+    make_point_matcher,
     ncnet_filter,
     ncnet_forward,
     ncnet_forward_from_features,
@@ -33,6 +34,7 @@ __all__ = [
     "import_torch_checkpoint",
     "init_ncnet",
     "load_params",
+    "make_point_matcher",
     "ncnet_filter",
     "ncnet_forward",
     "ncnet_forward_from_features",

@@ -19,14 +19,17 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ncnet_tpu.config import ModelConfig
 from ncnet_tpu.models import backbone as bb
 from ncnet_tpu.ops import (
+    Matches,
     choose_conv4d_variant,
     conv4d,
     conv4d_init,
     conv4d_same,
+    corr_to_matches,
     correlation_4d,
     feature_l2_norm,
     maxpool4d_with_argmax,
@@ -152,6 +155,28 @@ def tap_swap_fused_layers(nc_params):
     return fused_l1, nc_params[1], sw[1]
 
 
+def tap_swap_chain(nc_params):
+    """The tap-swapped symmetric pass as ONE 2-layer chain for the resident
+    fused stack: ``[fused L1 (1 → 2C), block-diagonal L2 (2C → 2)]``.
+
+    The block-diagonal final layer applies the plain L2 to channels ``:C``
+    (→ output channel 0) and the tap-swapped L2 to channels ``C:`` (→ output
+    channel 1) with per-stack biases, so the kernel's bias+ReLU epilogue
+    applies to each stack SEPARATELY — summing the two output channels
+    afterwards reproduces ``relu(L2(y_a)) + relu(L2ᵀ(y_b))`` exactly
+    (a single 2C → 1 conv would wrongly ReLU the sum).  Built from
+    :func:`tap_swap_fused_layers` so the fusion arithmetic has one home."""
+    fused_l1, l2, l2s = tap_swap_fused_layers(nc_params)
+    zero = jnp.zeros_like(l2["w"])
+    w_bd = jnp.concatenate(
+        [jnp.concatenate([l2["w"], zero], axis=4),
+         jnp.concatenate([zero, l2s["w"]], axis=4)],
+        axis=5,
+    )  # (k, k, k, k, 2C, 2)
+    b_bd = jnp.concatenate([l2["b"], l2s["b"]])
+    return [fused_l1, {"w": w_bd, "b": b_bd}]
+
+
 def neigh_consensus(
     nc_params: List[Dict[str, jnp.ndarray]],
     corr: jnp.ndarray,
@@ -188,8 +213,12 @@ def neigh_consensus(
     ``allow_pallas``: permit routing the whole stack through the fused-lane
     Pallas kernels (ops/nc_fused_lane.py) when the shape class fits —
     bfloat16, cubic uniform odd kernels, VMEM-feasible volume, Mosaic
-    compile-probe green.  Measured 2.0 vs 3.95 ms/volume against the XLA
-    stack at the PF-Pascal bench workload (v5e, tools/nc_fused_lane_probe).
+    compile-probe green.  ``choose_fused_stack`` picks the tier per shape:
+    the RESIDENT whole-stack kernel (one pallas_call, intermediates in VMEM
+    rings — round 6), else the r5 per-layer chain (measured 2.0 vs 3.95
+    ms/volume against the XLA stack, tools/nc_fused_lane_probe), else XLA.
+    The tap-swapped symmetric pass routes through the resident kernel as a
+    2-layer block-diagonal chain (:func:`tap_swap_chain`) when it compiles.
     Training paths pass ``False``: the kernels are forward-fast but their
     VJP replays the XLA stack (one extra forward), a bad trade under
     ``value_and_grad``.
@@ -224,22 +253,32 @@ def neigh_consensus(
 
     x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
 
+    # params must already be bf16 (ncnet_filter casts them): mixed
+    # fp32-params/bf16-volume calls keep the XLA path, where XLA's own
+    # promotion rules apply, instead of a silent bf16 downcast
+    pallas_eligible = (
+        allow_pallas and not remat_layers and custom_grad is False
+        and x.dtype == jnp.bfloat16
+        and all(layer["w"].dtype == jnp.bfloat16 for layer in nc_params)
+    )
     use_fused = False
-    if allow_pallas and not remat_layers and custom_grad is False \
-            and x.dtype == jnp.bfloat16 \
-            and all(layer["w"].dtype == jnp.bfloat16 for layer in nc_params):
-        # params must already be bf16 (ncnet_filter casts them): mixed
-        # fp32-params/bf16-volume calls keep the XLA path, where XLA's own
-        # promotion rules apply, instead of a silent bf16 downcast
-        from ncnet_tpu.ops.conv4d import _pallas_available
-        from ncnet_tpu.ops.nc_fused_lane import (
-            fused_lane_compiles,
-            fused_lane_feasible,
-        )
+    fused_tap_swap = False
+    if pallas_eligible:
+        from ncnet_tpu.ops import choose_fused_stack
 
         b, ha, wa, hb, wb = corr.shape
         kernels = tuple(layer["w"].shape[0] for layer in nc_params)
         channels = tuple(layer["w"].shape[5] for layer in nc_params)
+        if symmetric and (ha, wa) != (hb, wb) and tap_swap_fusable(nc_params):
+            # the tap-swapped symmetric pass is itself a 2-layer chain
+            # (1 → 2C fused first layer, then a BLOCK-DIAGONAL 2C → 2 final
+            # layer whose two output channels are the two stacks' outputs,
+            # summed after the kernel's per-stack ReLUs) — the resident
+            # whole-stack kernel runs it when the shape class compiles
+            c = nc_params[0]["w"].shape[5]
+            fused_tap_swap = choose_fused_stack(
+                ha, wa, hb, wb, kernels, (2 * c, 2)
+            ) == "resident"
         shapes = {(ha, wa, hb, wb)}
         if symmetric and (ha, wa) != (hb, wb) \
                 and not tap_swap_fusable(nc_params):
@@ -248,9 +287,8 @@ def neigh_consensus(
             # will actually execute (a square volume batch-folds and the
             # tap-swap class never transposes)
             shapes.add((hb, wb, ha, wa))
-        use_fused = _pallas_available() and all(
-            fused_lane_feasible(*s, kernels, channels)
-            and fused_lane_compiles(*s, kernels, channels)
+        use_fused = all(
+            choose_fused_stack(*s, kernels, channels) is not None
             for s in shapes
         )
 
@@ -276,7 +314,10 @@ def neigh_consensus(
         # folding formulation at the doubled batch; otherwise run the two
         # passes sequentially (their buffer lifetimes then barely overlap)
         b, ha, wa, hb, wb = corr.shape
-        fold_ok = all(
+        # the fused Pallas tiers stream one row at a time (per-volume VMEM
+        # working set, batch only widens the grid), so the XLA chooser's
+        # fold-memory demotion does not apply to them
+        fold_ok = use_fused or all(
             choose_conv4d_variant(
                 layer["w"].shape[4], layer["w"].shape[5], hb, wb,
                 shape_a=(ha, wa), kernel=tuple(layer["w"].shape[:4]),
@@ -306,13 +347,19 @@ def neigh_consensus(
             # composition further); the unfused tap-swap alone is SLOWER
             # (123), so only the measured 2-layer shape class takes this
             # path (deeper stacks keep the transpose form).
-            fused_l1, l2, l2s = tap_swap_fused_layers(nc_params)
-            y = layers[0](fused_l1["w"], fused_l1["b"], x)  # 1 → 2C, one pass
-            c = nc_params[0]["w"].shape[5]
-            out = (
-                layers[1](l2["w"], l2["b"], y[..., :c])
-                + layers[1](l2s["w"], l2s["b"], y[..., c:])
-            )
+            if fused_tap_swap:
+                from ncnet_tpu.ops import nc_stack_fused
+
+                y2 = nc_stack_fused(tap_swap_chain(nc_params), x)
+                out = y2[..., :1] + y2[..., 1:]
+            else:
+                fused_l1, l2, l2s = tap_swap_fused_layers(nc_params)
+                y = layers[0](fused_l1["w"], fused_l1["b"], x)  # 1→2C, one pass
+                c = nc_params[0]["w"].shape[5]
+                out = (
+                    layers[1](l2["w"], l2["b"], y[..., :c])
+                    + layers[1](l2s["w"], l2s["b"], y[..., c:])
+                )
         else:
             xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
             out = stack(x) + jnp.transpose(stack(xt), (0, 3, 4, 1, 2, 5))
@@ -416,6 +463,60 @@ def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
                            allow_pallas=nc_pallas)
     corr = mutual_matching(corr)
     return NCNetOutput(corr, delta4d)
+
+
+def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
+                       scale: str = "centered"):
+    """Persistent warm single-pair matcher — the demo / batch-1 serving path.
+
+    The bench measured the naive bs1 wall at ~44× device time (VERDICT r5
+    #4): a serial caller uploads two fp32 400² images (~3.8 MB) and pulls
+    the fp32 25⁴ volume (~1.6 MB) through the tunnel per pair.  This wraps
+    the same forward the demo runs into the InLoc pipeline shape: ONE jitted
+    program (weights staged on device at build, program cached after the
+    first call) taking raw uint8 ``(1, H, W, 3)`` pairs, normalizing on
+    device, and returning the compact ``corr_to_matches`` table instead of
+    the volume — ~4× fewer upload bytes and ~100× fewer download bytes.
+    ``dispatch``/``fetch`` expose the async split so a caller with several
+    pairs can pipeline them exactly like the InLoc eval loop.
+
+    Returns ``matcher(src_u8, tgt_u8) ->``
+    :class:`~ncnet_tpu.ops.matching.Matches` of numpy arrays.
+    """
+    from ncnet_tpu.ops.image import normalize_imagenet
+
+    params = jax.device_put(params)  # pre-staged once, reused every pair
+
+    def run(p, src, tgt):
+        src = normalize_imagenet(src.astype(jnp.float32))
+        tgt = normalize_imagenet(tgt.astype(jnp.float32))
+        out = ncnet_forward(config, p, src, tgt)
+        # relocalization configs pool the volume and carry delta4d — apply
+        # it so matches land on the fine grid (as extract_match_table does)
+        m = corr_to_matches(
+            out.corr, delta4d=out.delta4d,
+            k_size=max(config.relocalization_k_size, 1),
+            do_softmax=do_softmax, scale=scale,
+        )
+        # one stacked result: a single device→host pull instead of five
+        return jnp.stack([v.astype(jnp.float32) for v in m])
+
+    jitted = jax.jit(run)
+
+    def dispatch(src, tgt):
+        """Enqueue upload + forward + match extraction without blocking."""
+        return jitted(params, jnp.asarray(src), jnp.asarray(tgt))
+
+    def fetch(handle) -> "Matches":
+        table = np.asarray(handle, dtype=np.float32)
+        return Matches(*(table[i] for i in range(5)))
+
+    def matcher(src, tgt) -> "Matches":
+        return fetch(dispatch(src, tgt))
+
+    matcher.dispatch = dispatch
+    matcher.fetch = fetch
+    return matcher
 
 
 class NCNet:

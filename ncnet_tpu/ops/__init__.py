@@ -20,7 +20,10 @@ from ncnet_tpu.ops.conv4d import (
     make_conv4d_same,
     conv4d_transpose_weights,
 )
-from ncnet_tpu.ops.nc_fused_lane import (
+from ncnet_tpu.ops.nc_fused_lane import (  # noqa: F401
+    choose_fused_stack,
+    fused_resident_feasible,
+    nc_stack_resident,
     fused_lane_feasible,
     nc_stack_fused,
     nc_stack_fused_lane,
@@ -57,9 +60,12 @@ __all__ = [
     "conv4d_same",
     "make_conv4d_same",
     "conv4d_transpose_weights",
+    "choose_fused_stack",
     "fused_lane_feasible",
+    "fused_resident_feasible",
     "nc_stack_fused",
     "nc_stack_fused_lane",
+    "nc_stack_resident",
     "maxpool4d_with_argmax",
     "mutual_matching",
     "corr_to_matches",

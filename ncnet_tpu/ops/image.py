@@ -108,3 +108,11 @@ def normalize_imagenet(img, *, scale_255: bool = True):
     xp = jnp if isinstance(img, jnp.ndarray) else np
     x = img / 255.0 if scale_255 else img
     return (x - xp.asarray(IMAGENET_MEAN)) / xp.asarray(IMAGENET_STD)
+
+
+def quantize_u8(img: np.ndarray) -> np.ndarray:
+    """0-255 float image → uint8 by round-to-nearest (≤0.5/255 error before
+    normalization) — the ONE quantization contract of the uint8-upload fast
+    paths (evaluation/pf_pascal.py, point_transfer_demo.py): the transfer
+    carries raw bytes and :func:`normalize_imagenet` runs on device."""
+    return np.clip(np.rint(img), 0, 255).astype(np.uint8)

@@ -32,6 +32,15 @@ Measured at the bench workload (v5e, bf16, 8 batch-folded volumes,
 tools/pallas_l2_probe.py): 16→16 layer 1.87 ms/volume including the layout
 conversion vs XLA coutfold 2.52 in the same process.
 
+Round 6 adds the RESIDENT tier (``nc_stack_resident``): the whole composed
+stack as one ``pallas_call`` whose intermediate volumes live in VMEM ring
+buffers across grid steps — no inter-layer HBM round trips, no k× row
+refetch of 16-channel volumes, exact (unpadded) contraction/output widths
+for the thin 1→16 / 16→1 layers, and the layout conversion reduced to one
+scalar-volume pad in / minor-dim slice out.  ``choose_fused_stack`` is the
+tier authority: resident → per-layer chain → XLA, each Pallas tier gated by
+a real-compile probe (see the resident section below for the design).
+
 Reference semantics match ``ops/conv4d.py`` 'same' conv (cross-correlation,
 zero padding) + bias + ReLU — the reference's NeighConsensus layer
 (/root/reference/lib/model.py:122-153 with lib/conv4d.py:39-48).
@@ -148,10 +157,11 @@ def _pad_c(c: int) -> int:
     return max(c, _MIN_CB)
 
 
-def _pack_weight(w, k, c_in, c_out):
-    """(k,k,k,k,C_in,C_out) -> (k²·cinP, k²·coutP) [(p,q,c),(r,s,o)], with
-    thin channel dims zero-padded to _MIN_CB sublanes."""
-    ci, co = _pad_c(c_in), _pad_c(c_out)
+def _pack_weight(w, k, c_in, c_out, pad: bool = True):
+    """(k,k,k,k,C_in,C_out) -> (k²·ci, k²·co) [(p,q,c),(r,s,o)].  With
+    ``pad`` (the per-layer chain) thin channel dims are zero-padded to
+    ``_MIN_CB`` sublanes; the resident kernel packs exact widths."""
+    ci, co = (_pad_c(c_in), _pad_c(c_out)) if pad else (c_in, c_out)
     wp = jnp.pad(
         w, ((0, 0),) * 4 + ((0, ci - c_in), (0, co - c_out))
     )
@@ -223,7 +233,8 @@ def fused_lane_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
 
 
 def nc_stack_fused_lane(nc_params: List[dict], x: jnp.ndarray,
-                        interpret: bool = False) -> jnp.ndarray:
+                        interpret: bool = False,
+                        _allow_wide_final: bool = False) -> jnp.ndarray:
     """The full [conv4d_same + bias + ReLU]×N stack on ``x``
     ``(B, hA, wA, hB, wB, 1)``, chained through the fused-lane layout.
 
@@ -233,9 +244,11 @@ def nc_stack_fused_lane(nc_params: List[dict], x: jnp.ndarray,
     only routes eval/forward here — see neigh_consensus).
     """
     b, ha, wa, hb, wb, _ = x.shape
-    assert nc_params[-1]["w"].shape[5] == 1, (
+    assert _allow_wide_final or nc_params[-1]["w"].shape[5] == 1, (
         "nc_stack_fused_lane requires a 1-channel final layer (the NC-stack "
-        "shape class); wider stacks must use the XLA formulations"
+        "shape class); wider stacks must use the XLA formulations "
+        "(_allow_wide_final: bench prefix probes only — the un-fuse step "
+        "still returns channel 0)"
     )
     # the lane packing below keeps only channel 0 of the input (x[..., 0]):
     # reject wider inputs loudly instead of silently dropping channels
@@ -287,6 +300,395 @@ def nc_stack_fused_lane(nc_params: List[dict], x: jnp.ndarray,
     return out[..., None]
 
 
+# ---------------------------------------------------------------------------
+# resident whole-stack kernel (round 6)
+#
+# The r5 per-layer chain above still round-trips every intermediate volume
+# through HBM — and because each grid step fetches its k input rows via
+# overlapping row BlockSpecs, every inter-layer volume is READ k times (the
+# 16-channel PF-Pascal volume is ~22.6 MB/volume, so the middle layers alone
+# move ~0.7 GB/pair where the algorithmic minimum is ~20 MB/pair).  The
+# resident kernel below runs the ENTIRE composed stack inside ONE
+# ``pallas_call``: a wavefront over hA rows where layer ``l`` emits volume
+# row ``ii − l·(k−1)/2`` at grid step ``ii``, with each intermediate layer's
+# live rows held in a k-slot VMEM ring buffer (scratch persists across grid
+# steps; the TPU grid is sequential).  Intermediate activations never touch
+# HBM, the inter-layer re-pads disappear (ring rows are written pre-padded
+# with zeroed halos), and the layout conversion shrinks to one cheap XLA pad
+# of the SCALAR input volume in and one minor-dim slice of the scalar output
+# out — fused into the first/last rows' producing/consuming kernel steps in
+# the sense that no 16-channel tensor ever exists outside the kernel.
+#
+# Thin-layer lowering: the r5 per-layer kernel pads the 1-channel first
+# layer's contraction to ``_MIN_CB`` sublanes (2× its dot FLOPs) because a
+# thin EPILOGUE block is the costlier currency there; in the resident kernel
+# the first layer contracts K = k² exactly (c_in = 1, no padding — its
+# epilogue is over c_out = 16 full rows), and the last layer runs N = k²·C_out
+# exactly (C_out ∈ {1, 2}) instead of padding C_out up — together removing
+# ~20% of the stack's executed dot FLOPs at the PF-Pascal arch.
+#
+# Ring protocol (d = (k−1)/2, slot(r) = (r + k) mod k):
+#   * step 0 primes rows −d..−1 of every ring with zeros (bottom i-halo);
+#   * at step ii, layer l computes row r = ii − l·d when 0 ≤ r < hA, reading
+#     previous-layer rows r−d..r+d from the ring (layer 0 reads the k
+#     halo-padded input rows the BlockSpecs stage);
+#   * when r lands in the top halo [hA, hA+d) the producing step writes a
+#     zero row instead, so consumers never mask: out-of-range reads are
+#     zeros by construction (also across batch items — the priming and halo
+#     writes re-establish the invariant at every ii == 0).
+# Only primitives from the r5 Mosaic legality battery are used (sublane
+# concat/slices, lane slices/pads at any offset, dynamic leading-dim ring
+# indexing, both dot orientations); the tier is still gated by a real
+# compile probe and falls back to the per-layer chain, then XLA.
+# ---------------------------------------------------------------------------
+
+# j-chunk candidates for the resident kernel's per-row loop, largest first;
+# the chooser takes the largest that fits the VMEM budget (env-overridable
+# for probes: NCNET_FUSED_RES_JCH pins it)
+_RES_JCH = tuple(
+    int(v) for v in _os.environ.get("NCNET_FUSED_RES_JCH", "5 4 3 2 1").split()
+)
+
+
+def _resident_kernel(*refs, k, chans, s_i, s_j, sp_j, kl, sp_l, je_list):
+    """One wavefront step: layer ``l`` emits volume row ``ii − l·d``.
+
+    refs = (x_0..x_{k-1}, w_0, b_0, ..., w_{L-1}, b_{L-1}, mask, out,
+            ring_0..ring_{L-2}):
+      x_p:    (1, 1, sp_j, 1, kl) — halo-padded input row ii+p (clamped).
+      w_l:    (k²·c_in_l, k²·c_out_l) = w4d[(p,q,c), (r,s,o)], exact widths.
+      b_l:    (1, c_out_l, 1); mask: (1, 1, kl) lane-halo zeroing.
+      out:    (1, 1, s_j, c_out_last, kl) — final-layer row ii − (L−1)·d.
+      ring_l: (k, sp_j, c_out_l, kl) scratch ring of layer l's padded rows.
+    """
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    n_layers = len(chans)
+    h = k - 1
+    d = h // 2
+    x_refs = refs[:k]
+    wb_refs = refs[k:k + 2 * n_layers]
+    m_ref = refs[k + 2 * n_layers]
+    out_ref = refs[k + 2 * n_layers + 1]
+    rings = refs[k + 2 * n_layers + 2:]
+
+    ii = pl.program_id(1)
+    n_lane = kl - sp_l * h - h
+    pad_lo = d * sp_l + d
+    mask = m_ref[:].astype(jnp.float32)
+
+    def slot(r):
+        return lax.rem(r + k, k)  # r ≥ −d > −k, so the +k keeps rem ≥ 0
+
+    def zero_row(ring_ref, r, c_out):
+        ring_ref[pl.ds(slot(r), 1)] = jnp.zeros(
+            (1, sp_j, c_out, kl), ring_ref.dtype
+        )
+
+    @pl.when(ii == 0)
+    def _prime():
+        for l in range(n_layers - 1):
+            for r in range(-d, 0):
+                zero_row(rings[l], r, chans[l][1])
+
+    def compute_row(l, r):
+        c_in, c_out = chans[l]
+        w = wb_refs[2 * l][:]
+        bias = wb_refs[2 * l + 1][:].astype(jnp.float32)
+        last = l == n_layers - 1
+        if l > 0:
+            slots = [slot(r - d + p) for p in range(k)]
+        if not last and d:
+            # j-halo columns: re-zeroed on every write (the slot's previous
+            # occupant — possibly from the previous batch item, or raw
+            # scratch garbage on the very first pass — is overwritten)
+            rings[l][pl.ds(slot(r), 1), :d] = jnp.zeros(
+                (1, d, c_out, kl), rings[l].dtype)
+            rings[l][pl.ds(slot(r), 1), d + s_j:] = jnp.zeros(
+                (1, sp_j - d - s_j, c_out, kl), rings[l].dtype)
+        for j0, je in je_list:
+            if l == 0:
+                slabs = [
+                    x_refs[p][0, 0, j0 + q:j0 + q + je, :, :]
+                    for p in range(k) for q in range(k)
+                ]
+            else:
+                slabs = [
+                    rings[l - 1][pl.ds(slots[p], 1), j0 + q:j0 + q + je][0]
+                    for p in range(k) for q in range(k)
+                ]
+            a3 = jnp.concatenate(slabs, axis=1)  # (je, k²·c_in, kl)
+            ys = []
+            for j in range(je):
+                y = jax.lax.dot_general(
+                    w, a3[j], (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # (k²·c_out, kl) f32, rows ordered (r, s, o)
+                ys.append(y.astype(jnp.bfloat16))
+            ybuf = jnp.stack(ys, axis=0)
+            acc = jnp.zeros((je, c_out, n_lane), jnp.float32)
+            for rr in range(k):
+                for ss in range(k):
+                    blk = (rr * k + ss) * c_out
+                    off = rr * sp_l + ss
+                    acc = acc + ybuf[
+                        :, blk:blk + c_out, off:off + n_lane
+                    ].astype(jnp.float32)
+            acc = jnp.maximum(acc + bias, 0.0)
+            full = jnp.pad(
+                acc, ((0, 0), (0, 0), (pad_lo, kl - pad_lo - n_lane))
+            ) * mask
+            if last:
+                out_ref[0, 0, j0:j0 + je] = full.astype(out_ref.dtype)
+            else:
+                rings[l][pl.ds(slot(r), 1), d + j0:d + j0 + je] = (
+                    full[None].astype(rings[l].dtype))
+
+    for l in range(n_layers):
+        r = ii - l * d if d else ii  # d == 0 ⇒ k == 1: no wavefront delay
+        if n_layers == 1:
+            compute_row(l, r)  # grid is exactly s_i: r is always in range
+            continue
+
+        @pl.when((r >= 0) & (r < s_i))
+        def _(l=l, r=r):
+            compute_row(l, r)
+
+        if l < n_layers - 1 and d:
+
+            @pl.when((r >= s_i) & (r < s_i + d))
+            def _(l=l, r=r):
+                zero_row(rings[l], r, chans[l][1])
+
+
+def _resident_vmem_bytes(wa, hb, wb, kernels, channels, je) -> int:
+    """Worst-step VMEM working set of the resident kernel (bytes)."""
+    k = kernels[0]
+    h = k - 1
+    sp_j = wa + h
+    sp_l = wb + h
+    kl = (hb + h) * sp_l
+    n_lane = kl - sp_l * h - h
+    chans = list(zip((1,) + tuple(channels[:-1]), channels))
+    rings = sum(k * sp_j * co * kl * 2 for _, co in chans[:-1])
+    weights = sum((k * k * ci) * (k * k * co) * 2 for ci, co in chans)
+    inputs = 2 * k * sp_j * 1 * kl * 2          # k row blocks, double-buffered
+    out = 2 * wa * chans[-1][1] * kl * 2
+    temps = max(
+        je * k * k * ci * kl * 2                # a3 build
+        + k * k * co * kl * 4                   # one f32 dot output
+        + je * k * k * co * kl * 2              # bf16 ybuf
+        + je * co * n_lane * 4                  # f32 accumulator
+        + je * co * kl * 4                      # padded/masked row chunk
+        for ci, co in chans
+    )
+    return rings + weights + inputs + out + temps
+
+
+def _resident_shape_class(kernels, channels) -> bool:
+    ks = set(kernels)
+    if len(ks) != 1 or kernels[0] % 2 == 0:
+        return False
+    if channels[-1] > 4:
+        # the chain returns a thin final volume (the NC-stack shape class:
+        # 1 channel, or 2 for the tap-swap block-diagonal chain)
+        return False
+    return True
+
+
+def _resident_je(ha, wa, hb, wb, kernels, channels) -> int:
+    for je in _RES_JCH:
+        je = min(je, wa)
+        if _resident_vmem_bytes(wa, hb, wb, kernels, channels, je) \
+                <= _VMEM_BUDGET:
+            return je
+    return 0
+
+
+def fused_resident_feasible(ha, wa, hb, wb, kernels, channels) -> bool:
+    """Whether the resident whole-stack kernel fits this shape class: cubic
+    odd uniform kernels, thin final layer, and a VMEM working set (rings +
+    weights + worst-layer temps) inside the budget at some j-chunk size."""
+    if not _resident_shape_class(kernels, channels):
+        return False
+    return _resident_je(ha, wa, hb, wb, kernels, channels) > 0
+
+
+@functools.lru_cache(maxsize=8)
+def fused_resident_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
+    """Real-compile probe for the resident kernel (cached per shape class) —
+    same discipline as :func:`fused_lane_compiles`: Mosaic legality depends
+    on concrete shapes, so the chooser verifies an actual compile and any
+    failure falls back to the per-layer chain / XLA formulations."""
+    try:
+        x = jax.ShapeDtypeStruct((1, ha, wa, hb, wb, 1), jnp.bfloat16)
+        ws, bs = [], []
+        c_in = 1
+        for kk, c_out in zip(kernels, channels):
+            ws.append(jax.ShapeDtypeStruct(
+                (kk,) * 4 + (c_in, c_out), jnp.bfloat16))
+            bs.append(jax.ShapeDtypeStruct((c_out,), jnp.bfloat16))
+            c_in = c_out
+
+        def run(x, ws, bs):
+            params = [{"w": w, "b": b} for w, b in zip(ws, bs)]
+            return nc_stack_resident(params, x)
+
+        jax.jit(run).lower(x, ws, bs).compile()
+        return True
+    except Exception:
+        return False
+
+
+def fused_layout_in(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """The resident path's whole layout-in: halo-pad the SCALAR volume
+    ``(B, hA, wA, hB, wB, 1)`` on all four spatial dims and fuse the minor
+    pair — ``(B, hA+h, wA+h, 1, (hB+h)·(wB+h))`` bf16.  Exposed so the bench
+    can time the conversion stage in isolation."""
+    b, ha, wa, hb, wb, _ = x.shape
+    h = k - 1
+    d = h // 2
+    return jnp.pad(
+        x[..., 0], ((0, 0),) + ((d, d),) * 4
+    ).reshape(b, ha + h, wa + h, 1, (hb + h) * (wb + h)).astype(jnp.bfloat16)
+
+
+def fused_layout_out(out: jnp.ndarray, hb: int, wb: int, k: int) -> jnp.ndarray:
+    """The resident path's layout-out: unfuse the minor lane pair of the
+    kernel output ``(B, hA, wA, C_out, kl)``, crop the lane halo, move the
+    channel dim last — ``(B, hA, wA, hB, wB, C_out)``."""
+    b, ha, wa, co, _ = out.shape
+    h = k - 1
+    d = h // 2
+    out = out.reshape(b, ha, wa, co, hb + h, wb + h)
+    out = out[:, :, :, :, d:d + hb, d:d + wb]
+    return jnp.moveaxis(out, 3, 5)
+
+
+def nc_stack_resident(nc_params: List[dict], x: jnp.ndarray,
+                      interpret: bool = False,
+                      _allow_wide_final: bool = False) -> jnp.ndarray:
+    """The full [conv4d_same + bias + ReLU]×N stack on ``x``
+    ``(B, hA, wA, hB, wB, 1)`` as ONE resident Pallas program.
+
+    Returns ``(B, hA, wA, hB, wB, C_out_last)`` — unlike
+    :func:`nc_stack_fused_lane` the final layer may be up to 4 channels wide
+    (the tap-swap block-diagonal chain uses 2).  Numerically equivalent to
+    the XLA stack up to bf16 rounding (f32 dot accumulation, bf16 ring
+    activations — the same inter-layer precision as the per-layer chain).
+    Forward-only; see :func:`nc_stack_fused` for the differentiable wrapper.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, ha, wa, hb, wb, _ = x.shape
+    assert x.shape[-1] == 1 and nc_params[0]["w"].shape[4] == 1, (
+        "nc_stack_resident requires a 1-channel input volume and first "
+        "layer (the NC-stack shape class)"
+    )
+    kernels = tuple(layer["w"].shape[0] for layer in nc_params)
+    channels = tuple(layer["w"].shape[5] for layer in nc_params)
+    assert _resident_shape_class(kernels, channels) or (
+        _allow_wide_final
+        and _resident_shape_class(kernels, channels[:-1] + (1,))
+    ), (
+        f"resident stack does not support kernels={kernels} "
+        f"channels={channels}"
+    )  # _allow_wide_final: bench prefix probes time truncated chains whose
+    # final layer is wide — same kernel, bigger output block; not a
+    # production shape class
+    k = kernels[0]
+    h = k - 1
+    d = h // 2
+    n_layers = len(nc_params)
+    sp_l = wb + h
+    kl = (hb + h) * sp_l
+    sp_j = wa + h
+    sp_i = ha + h
+    chans = tuple(zip((1,) + channels[:-1], channels))
+    je = _resident_je(ha, wa, hb, wb, kernels, channels)
+    assert je > 0, "resident stack infeasible; gate with fused_resident_feasible"
+    je_list = tuple((j0, min(je, wa - j0)) for j0 in range(0, wa, je))
+    mask = jnp.asarray(_make_mask((hb, wb), k), jnp.bfloat16)
+
+    # layout-in: ONE pad of the scalar volume (no 16-channel tensor ever
+    # exists outside the kernel) + minor-dim reshape into the fused frame
+    xp = fused_layout_in(x, k)
+
+    ops = [xp] * k
+    for (ci, co), layer in zip(chans, nc_params):
+        ops.append(_pack_weight(
+            layer["w"].astype(jnp.bfloat16), k, ci, co, pad=False))
+        ops.append(layer["b"].astype(jnp.bfloat16).reshape(1, co, 1))
+    ops.append(mask)
+
+    kern = functools.partial(
+        _resident_kernel, k=k, chans=chans, s_i=ha, s_j=wa, sp_j=sp_j, kl=kl,
+        sp_l=sp_l, je_list=je_list,
+    )
+    row_spec = lambda p: pl.BlockSpec(  # noqa: E731
+        (1, 1, sp_j, 1, kl),
+        lambda bi, ii, p=p: (bi, jnp.minimum(ii + p, sp_i - 1), 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    full_spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
+    co_last = channels[-1]
+    delay = (n_layers - 1) * d
+    out = pl.pallas_call(
+        kern,
+        grid=(b, ha + delay),
+        in_specs=[row_spec(p) for p in range(k)]
+        + [full_spec() for _ in range(2 * n_layers + 1)],
+        out_specs=pl.BlockSpec(
+            (1, 1, wa, co_last, kl),
+            lambda bi, ii: (bi, jnp.maximum(ii - delay, 0), 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (b, ha, wa, co_last, kl), jnp.bfloat16),
+        scratch_shapes=[
+            pltpu.VMEM((k, sp_j, co, kl), jnp.bfloat16)
+            for _, co in chans[:-1]
+        ],
+        interpret=interpret,
+    )(*ops)
+    # layout-out: minor-dim unfuse of the thin output + halo crop
+    return fused_layout_out(out, hb, wb, k)
+
+
+def choose_fused_stack(ha, wa, hb, wb, kernels, channels):
+    """The one authority for the fused-stack tier at a shape class:
+    ``'resident'`` (whole-stack kernel), ``'perlayer'`` (r5 chain), or
+    ``None`` (XLA formulations).  Both Pallas tiers require a real TPU
+    backend and a green compile probe."""
+    from ncnet_tpu.ops.conv4d import _pallas_available
+
+    if not _pallas_available():
+        return None
+    if fused_resident_feasible(ha, wa, hb, wb, kernels, channels) \
+            and fused_resident_compiles(ha, wa, hb, wb, kernels, channels):
+        return "resident"
+    if channels[-1] == 1 \
+            and fused_lane_feasible(ha, wa, hb, wb, kernels, channels) \
+            and fused_lane_compiles(ha, wa, hb, wb, kernels, channels):
+        return "perlayer"
+    return None
+
+
+def _fused_stack_impl(nc_params, x):
+    """Dispatch the forward to the best available tier for this shape."""
+    b, ha, wa, hb, wb, _ = x.shape
+    kernels = tuple(layer["w"].shape[0] for layer in nc_params)
+    channels = tuple(layer["w"].shape[5] for layer in nc_params)
+    tier = choose_fused_stack(ha, wa, hb, wb, kernels, channels)
+    if tier == "resident":
+        return nc_stack_resident(nc_params, x)
+    if tier == "perlayer":
+        return nc_stack_fused_lane(nc_params, x)
+    return _xla_stack(nc_params, x)
+
+
 def _xla_stack(nc_params, x):
     """The equivalent XLA stack (conv4d auto) — the custom-VJP backward."""
     from ncnet_tpu.ops.conv4d import conv4d
@@ -298,18 +700,20 @@ def _xla_stack(nc_params, x):
 
 @jax.custom_vjp
 def nc_stack_fused(nc_params, x):
-    """:func:`nc_stack_fused_lane` with an XLA-fallback backward.
+    """The fused NC stack (resident kernel when the shape class compiles,
+    else the per-layer chain, else the XLA stack) with an XLA-fallback
+    backward.
 
     Pallas kernels have no AD rule; differentiating this op replays the
     equivalent XLA stack's VJP (one extra XLA forward).  Training paths
     route to the XLA stack directly (``allow_pallas=False`` in
     models/ncnet.py) — this VJP exists so a user-level ``jax.grad`` over
     the eval forward stays correct rather than erroring."""
-    return nc_stack_fused_lane(nc_params, x)
+    return _fused_stack_impl(nc_params, x)
 
 
 def _fused_fwd(nc_params, x):
-    return nc_stack_fused_lane(nc_params, x), (nc_params, x)
+    return _fused_stack_impl(nc_params, x), (nc_params, x)
 
 
 def _fused_bwd(res, g):

@@ -80,6 +80,39 @@ def test_run_eval_bf16_trunk_upload_path(tmp_path):
     assert stats["pck"] > 0.7, stats
 
 
+def test_run_eval_device_normalize_matches_host_path(tmp_path, identity_tiny_net):
+    """The uint8-upload path (resized image quantized to uint8, ImageNet
+    normalization inside the jitted step — 4× fewer tunnel bytes) scores the
+    same per-pair PCK as the exact host-normalized float path on the
+    synthetic fixture: at the square eval size the resize is identity on
+    decoded uint8 pixels, so the quantization is lossless there and the
+    only residual is normalize-order float rounding."""
+    root = str(tmp_path)
+    write_pf_pascal_like(root, n_pairs=3, image_hw=(96, 96), shift=(16, 0), seed=5)
+    config = EvalPFPascalConfig(image_size=96, eval_dataset_path=root)
+    dev = run_eval(config, net=identity_tiny_net, batch_size=3,
+                   progress=False, device_normalize=True)
+    host = run_eval(config, net=identity_tiny_net, batch_size=3,
+                    progress=False, device_normalize=False)
+    np.testing.assert_allclose(dev["per_pair"], host["per_pair"], atol=1e-6)
+    for key in ("decode_s", "dispatch_s", "fetch_s"):
+        assert dev["timing"][key] >= 0.0
+
+
+def test_run_eval_pinned_pipeline_depth(tmp_path, identity_tiny_net):
+    """A pinned dispatch/fetch depth bypasses the adaptive band and still
+    produces the serial loop's results in order."""
+    root = str(tmp_path)
+    write_pf_pascal_like(root, n_pairs=4, image_hw=(96, 96), shift=(16, 0), seed=6)
+    config = EvalPFPascalConfig(image_size=96, eval_dataset_path=root)
+    deep = run_eval(config, net=identity_tiny_net, batch_size=1,
+                    progress=False, pipeline_depth=4)
+    flat = run_eval(config, net=identity_tiny_net, batch_size=1,
+                    progress=False, pipeline_depth=1)
+    np.testing.assert_allclose(deep["per_pair"], flat["per_pair"],
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_cli_smoke(tmp_path, capsys):
     from ncnet_tpu.cli.eval_pf_pascal import main
 

@@ -388,9 +388,10 @@ def test_pipeline_depth_controller_adapts(monkeypatch):
     return to 2 when the tunnel recovers; gaps excluded; never adapt when
     pinned."""
     import ncnet_tpu.evaluation.inloc as inloc_mod
+    import ncnet_tpu.evaluation.pipeline as pipeline_mod
 
     now = [0.0]
-    monkeypatch.setattr(inloc_mod.time, "perf_counter", lambda: now[0])
+    monkeypatch.setattr(pipeline_mod.time, "perf_counter", lambda: now[0])
 
     ctl = inloc_mod._PipelineDepthController(0, high=0.7, low=0.45)
     assert ctl.depth == 2
@@ -435,9 +436,10 @@ def test_pipeline_depth_controller_derived_thresholds(monkeypatch):
     walls set best=0.35, so 1.0 s walls (2.9x best) probe-deepen, an
     improved wall confirms the probe, and recovery to ~best shrinks back."""
     import ncnet_tpu.evaluation.inloc as inloc_mod
+    import ncnet_tpu.evaluation.pipeline as pipeline_mod
 
     now = [0.0]
-    monkeypatch.setattr(inloc_mod.time, "perf_counter", lambda: now[0])
+    monkeypatch.setattr(pipeline_mod.time, "perf_counter", lambda: now[0])
 
     ctl = inloc_mod._PipelineDepthController(0)
     assert ctl.depth == 2
@@ -469,9 +471,10 @@ def test_pipeline_depth_controller_cold_start_and_outlier(monkeypatch):
     (b) one anomalously short wall causes at most one speculative probe —
     it cannot pin depth 4 for the whole run."""
     import ncnet_tpu.evaluation.inloc as inloc_mod
+    import ncnet_tpu.evaluation.pipeline as pipeline_mod
 
     now = [0.0]
-    monkeypatch.setattr(inloc_mod.time, "perf_counter", lambda: now[0])
+    monkeypatch.setattr(pipeline_mod.time, "perf_counter", lambda: now[0])
 
     # (a) cold start at 0.99 s/pair (the r3 high-latency day): best == 0.99
     # so 2*best never triggers, but the 0.7 cap does
@@ -509,9 +512,10 @@ def test_pipeline_depth_controller_compute_bound_probe(monkeypatch):
     reverts, and blocks until the EWMA leaves that regime — at which point
     a genuinely worse (latency) regime may probe again."""
     import ncnet_tpu.evaluation.inloc as inloc_mod
+    import ncnet_tpu.evaluation.pipeline as pipeline_mod
 
     now = [0.0]
-    monkeypatch.setattr(inloc_mod.time, "perf_counter", lambda: now[0])
+    monkeypatch.setattr(pipeline_mod.time, "perf_counter", lambda: now[0])
 
     ctl = inloc_mod._PipelineDepthController(0)
     ctl.note_drain()
@@ -540,9 +544,10 @@ def test_pipeline_depth_controller_block_lifts_on_recovery(monkeypatch):
     latency regime (above high but below 1.3x the old failed-probe wall)
     can probe again."""
     import ncnet_tpu.evaluation.inloc as inloc_mod
+    import ncnet_tpu.evaluation.pipeline as pipeline_mod
 
     now = [0.0]
-    monkeypatch.setattr(inloc_mod.time, "perf_counter", lambda: now[0])
+    monkeypatch.setattr(pipeline_mod.time, "perf_counter", lambda: now[0])
 
     ctl = inloc_mod._PipelineDepthController(0)
     ctl.note_drain()

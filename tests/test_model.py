@@ -161,6 +161,61 @@ def test_ncnet_wrapper_jit(tiny_cfg, rng):
     assert out.corr.shape == (1, 2, 2, 2, 2)
 
 
+def test_point_matcher_matches_direct_forward(tiny_cfg, rng):
+    """The warm demo/bs1 path (make_point_matcher: uint8 upload, device
+    normalize, on-device match extraction) produces the same matches as the
+    direct forward + corr_to_matches composition on the equivalently
+    normalized float input."""
+    from ncnet_tpu.ops import corr_to_matches
+    from ncnet_tpu.ops.image import normalize_imagenet
+
+    params = models.init_ncnet(tiny_cfg, jax.random.key(0))
+    src_u8 = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)
+    tgt_u8 = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)
+
+    matcher = models.make_point_matcher(tiny_cfg, params, do_softmax=True)
+    got = matcher(src_u8, tgt_u8)
+
+    src = normalize_imagenet(jnp.asarray(src_u8).astype(jnp.float32))
+    tgt = normalize_imagenet(jnp.asarray(tgt_u8).astype(jnp.float32))
+    out = jax.jit(
+        lambda p, s, t: models.ncnet_forward(tiny_cfg, p, s, t).corr
+    )(params, src, tgt)
+    want = corr_to_matches(out, do_softmax=True)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w, np.float32), rtol=1e-5, atol=1e-5)
+
+
+def test_point_matcher_applies_relocalization_deltas(rng):
+    """A relocalization config (k>1) must return FINE-grid matches from the
+    warm matcher — delta4d applied exactly as the direct composition does,
+    not silently dropped."""
+    from ncnet_tpu.ops import corr_to_matches
+    from ncnet_tpu.ops.image import normalize_imagenet
+
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,), relocalization_k_size=2)
+    params = models.init_ncnet(cfg, jax.random.key(1))
+    src_u8 = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)
+    tgt_u8 = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)
+
+    matcher = models.make_point_matcher(cfg, params, do_softmax=True)
+    got = matcher(src_u8, tgt_u8)
+
+    src = normalize_imagenet(jnp.asarray(src_u8).astype(jnp.float32))
+    tgt = normalize_imagenet(jnp.asarray(tgt_u8).astype(jnp.float32))
+    out = jax.jit(
+        lambda p, s, t: models.ncnet_forward(cfg, p, s, t)
+    )(params, src, tgt)
+    assert out.delta4d is not None
+    want = corr_to_matches(out.corr, delta4d=out.delta4d, k_size=2,
+                           do_softmax=True)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w, np.float32), rtol=1e-5, atol=1e-5)
+
+
 def test_import_torch_checkpoint(rng):
     """Synthetic reference-format .pth.tar dict → our pytree, including the
     Sequential-index remap and the pre-permuted Conv4d weight layout."""

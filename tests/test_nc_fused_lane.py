@@ -14,9 +14,12 @@ import pytest
 
 from ncnet_tpu.ops.conv4d import conv4d
 from ncnet_tpu.ops.nc_fused_lane import (
+    choose_fused_stack,
     fused_lane_feasible,
+    fused_resident_feasible,
     nc_stack_fused,
     nc_stack_fused_lane,
+    nc_stack_resident,
 )
 
 
@@ -59,6 +62,87 @@ def test_interpret_parity(shape, kernels, channels):
     got = np.asarray(
         nc_stack_fused_lane(params, x, interpret=True), np.float32
     )
+    scale = max(1e-6, float(np.max(np.abs(ref))))
+    np.testing.assert_allclose(got / scale, ref / scale, atol=3e-2)
+
+
+@pytest.mark.parametrize("shape,kernels,channels", [
+    ((2, 7, 7, 7, 7), (3, 3), (4, 1)),            # IVD-like 2-layer
+    ((1, 6, 5, 7, 6), (3, 3, 3), (4, 4, 1)),      # rectangular, 3-layer
+    ((1, 9, 9, 9, 9), (5, 5, 5), (4, 4, 1)),      # PF-Pascal k=5 class
+    ((2, 6, 7, 5, 8), (3, 3), (4, 2)),            # 2-ch final (tap-swap)
+    ((1, 7, 7, 7, 7), (3,), (1,)),                # single layer, no rings
+    ((1, 5, 5, 5, 5), (5, 5, 5), (2, 2, 1)),      # hA == k: halo-heavy
+])
+def test_resident_interpret_parity(shape, kernels, channels):
+    """Interpret-mode RESIDENT chain == XLA stack: locks the wavefront
+    schedule (layer l emits row ii − l·d), the ring-slot zero protocol
+    (bottom-halo priming, top-halo zero rows, j-halo rewrites), the exact
+    thin-layer K/N widths, and the fused layout in/out."""
+    key = jax.random.key(0)
+    params = make_params(key, kernels, channels, dtype=jnp.bfloat16)
+    x = (jax.random.normal(jax.random.key(7), shape + (1,)) * 0.5
+         ).astype(jnp.bfloat16)
+
+    ref = np.asarray(xla_stack(params, x), np.float32)
+    got = np.asarray(nc_stack_resident(params, x, interpret=True), np.float32)
+    assert got.shape == ref.shape
+    scale = max(1e-6, float(np.max(np.abs(ref))))
+    np.testing.assert_allclose(got / scale, ref / scale, atol=3e-2)
+
+
+def test_resident_ring_state_resets_across_batch_items():
+    """The ring scratch persists across grid steps AND batch items: the
+    step-0 priming + halo-write protocol must fully mask the previous batch
+    item's rows, so per-item outputs match the item run alone."""
+    params = make_params(jax.random.key(1), (3, 3), (4, 1),
+                         dtype=jnp.bfloat16)
+    x = (jax.random.normal(jax.random.key(2), (3, 6, 6, 6, 6, 1))
+         ).astype(jnp.bfloat16)
+    full = np.asarray(
+        nc_stack_resident(params, x, interpret=True), np.float32)
+    for i in range(3):
+        alone = np.asarray(
+            nc_stack_resident(params, x[i:i + 1], interpret=True), np.float32)
+        np.testing.assert_array_equal(full[i:i + 1], alone)
+
+
+def test_resident_feasibility_gate():
+    assert fused_resident_feasible(25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+    assert fused_resident_feasible(13, 13, 13, 13, (3, 3), (16, 1))
+    # tap-swap block-diagonal chain shape class
+    assert fused_resident_feasible(13, 17, 13, 17, (3, 3), (32, 2))
+    # InLoc fine grid: the fused kl dim alone is ~30k lanes
+    assert not fused_resident_feasible(100, 75, 150, 200, (3, 3), (16, 1))
+    assert not fused_resident_feasible(25, 25, 25, 25, (5, 3, 5), (16, 16, 1))
+    assert not fused_resident_feasible(25, 25, 25, 25, (4, 4, 4), (16, 16, 1))
+    # wide final volumes are not the NC-stack shape class
+    assert not fused_resident_feasible(25, 25, 25, 25, (5, 5), (16, 16))
+
+
+def test_choose_fused_stack_is_none_on_cpu():
+    """Both Pallas tiers need a real TPU backend; the CPU chooser must send
+    every shape to the XLA formulations."""
+    assert choose_fused_stack(25, 25, 25, 25, (5, 5, 5), (16, 16, 1)) is None
+
+
+def test_resident_tap_swap_chain_matches_symmetric_reference():
+    """The tap-swap block-diagonal chain (models/ncnet.py tap_swap_chain)
+    through the RESIDENT kernel == the stack-level symmetric reference
+    NC(x) + NC(xᵀ)ᵀ — the algebraic identity plus the per-stack ReLU
+    separation that the 2-channel final layer preserves."""
+    from ncnet_tpu.models.ncnet import tap_swap_chain
+
+    params = make_params(jax.random.key(3), (3, 3), (4, 1),
+                         dtype=jnp.bfloat16)
+    x = (jax.random.normal(jax.random.key(4), (1, 5, 7, 6, 4, 1)) * 0.5
+         ).astype(jnp.bfloat16)
+    xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))
+    ref = xla_stack(params, x) + jnp.transpose(
+        xla_stack(params, xt), (0, 3, 4, 1, 2, 5))
+    y2 = nc_stack_resident(tap_swap_chain(params), x, interpret=True)
+    got = np.asarray(y2[..., :1] + y2[..., 1:], np.float32)
+    ref = np.asarray(ref, np.float32)
     scale = max(1e-6, float(np.max(np.abs(ref))))
     np.testing.assert_allclose(got / scale, ref / scale, atol=3e-2)
 
@@ -129,8 +213,9 @@ def test_mixed_precision_params_keep_xla_path():
 def test_custom_vjp_matches_xla_grads(monkeypatch):
     """User-level jax.vjp THROUGH nc_stack_fused (the registered custom_vjp,
     not its private pieces) must produce the XLA stack's gradients — this
-    exercises the defvjp wiring end-to-end.  The primal runs in interpret
-    mode on CPU via monkeypatching the forward the rule calls."""
+    exercises the defvjp wiring end-to-end.  The primal runs the RESIDENT
+    kernel in interpret mode on CPU via monkeypatching the dispatcher the
+    rule calls (the CPU chooser would otherwise route to XLA)."""
     import ncnet_tpu.ops.nc_fused_lane as mod
 
     key = jax.random.key(3)
@@ -138,10 +223,9 @@ def test_custom_vjp_matches_xla_grads(monkeypatch):
     x = (jax.random.normal(jax.random.key(4), (1, 5, 5, 5, 5, 1)) * 0.5
          ).astype(jnp.bfloat16)
 
-    real = mod.nc_stack_fused_lane
     monkeypatch.setattr(
-        mod, "nc_stack_fused_lane",
-        lambda p, xx, interpret=True: real(p, xx, interpret=True),
+        mod, "_fused_stack_impl",
+        lambda p, xx: mod.nc_stack_resident(p, xx, interpret=True),
     )
 
     out_f, vjp_f = jax.vjp(mod.nc_stack_fused, params, x)

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Parity + composed timing of the RESIDENT fused NC stack vs the per-layer
+chain and the XLA stack, with layer-prefix differencing for attribution.
+
+Usage: python tools/nc_resident_probe.py [batch_volumes]
+
+Run on a TPU backend: the resident tier needs Mosaic (parity on CPU is
+covered by interpret-mode tests).  This is the measurement companion of
+ops/nc_fused_lane.py's round-6 resident kernel — the per-stage numbers here
+are what the bench's filter_stage_* extras automate.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+S = 25
+DT = jnp.bfloat16
+
+
+def make_params(ks):
+    chans = [(1, 16), (16, 16), (16, 1)]
+    params = []
+    for kk, (ci, co) in zip(ks, chans):
+        k1, k2, kk2 = jax.random.split(kk, 3)
+        params.append({
+            "w": jax.random.normal(k1, (5, 5, 5, 5, ci, co), DT) * 0.05,
+            "b": jax.random.normal(k2, (co,), DT) * 0.1,
+        })
+    return params
+
+
+def xla_stack(params, x):
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    for layer in params:
+        x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
+    return x
+
+
+def main():
+    from ncnet_tpu.ops.nc_fused_lane import (
+        fused_resident_compiles,
+        fused_resident_feasible,
+        nc_stack_fused_lane,
+        nc_stack_resident,
+    )
+
+    print(f"device={jax.devices()[0].device_kind} n_volumes={B}")
+    print("feasible:",
+          fused_resident_feasible(S, S, S, S, (5, 5, 5), (16, 16, 1)))
+    print("compiles:",
+          fused_resident_compiles(S, S, S, S, (5, 5, 5), (16, 16, 1)))
+
+    key = jax.random.key(0)
+    params = make_params(jax.random.split(key, 3))
+    x = jax.random.normal(jax.random.key(9), (2, S, S, S, S, 1), DT) * 0.1
+
+    ref = np.asarray(jax.jit(xla_stack)(params, x), np.float32)
+    got = np.asarray(jax.jit(nc_stack_resident)(params, x), np.float32)
+    err = np.max(np.abs(got - ref))
+    rel = err / max(1e-6, float(np.max(np.abs(ref))))
+    print(f"parity: max abs err {err:.4g} (rel {rel:.3%})")
+    assert rel < 0.05
+
+    def make_input(key):
+        k1, *ks = jax.random.split(key, 4)
+        return (
+            jax.random.normal(k1, (B, S, S, S, S, 1), DT) * 0.1,
+            make_params(ks),
+        )
+
+    def step_of(fn):
+        def step(carry):
+            x, params = carry
+            out = fn(params, x)
+            eps = (jnp.sum(out.astype(jnp.float32)) * 1e-12).astype(x.dtype)
+            return x + eps, params
+        return step
+
+    ms_r = timeit(step_of(nc_stack_resident), make_input, per=B, n_long=8)
+    ms_p = timeit(step_of(nc_stack_fused_lane), make_input, per=B, n_long=8)
+    ms_x = timeit(step_of(xla_stack), make_input, per=B, n_long=8)
+    print(f"resident stack : {ms_r:7.3f} ms/volume")
+    print(f"per-layer chain: {ms_p:7.3f} ms/volume")
+    print(f"xla stack      : {ms_x:7.3f} ms/volume")
+
+    # layer-prefix differencing on the resident kernel (wide-final probe
+    # relaxation for the truncated chains)
+    prev = 0.0
+    for n in (1, 2, 3):
+        def fn(params, x, n=n):
+            return nc_stack_resident(params[:n], x, _allow_wide_final=True)
+
+        t = timeit(step_of(fn), make_input, per=B, n_long=8)
+        print(f"prefix[:{n}]    : {t:7.3f} ms/volume  (+{t - prev:6.3f})")
+        prev = t
+
+
+if __name__ == "__main__":
+    main()
