@@ -9,13 +9,7 @@ import argparse
 
 import numpy as np
 
-
-def _str_to_bool(v: str) -> bool:
-    if v.lower() in ("yes", "true", "t", "y", "1"):
-        return True
-    if v.lower() in ("no", "false", "f", "n", "0"):
-        return False
-    raise argparse.ArgumentTypeError("Boolean value expected.")
+from ncnet_tpu.cli.common import str_to_bool as _str_to_bool
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -51,6 +45,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="process-pool width for the PnP (per-query) and "
                         "pose-verification (per-scan) stages — the "
                         "reference's two parfor loops; 0 = in-process")
+    p.add_argument("--query_retries", type=int, default=2,
+                   help="per-query PnP retries after the first failure, "
+                        "before quarantine")
+    p.add_argument("--retry_backoff_s", type=float, default=0.5,
+                   help="retry backoff seconds, doubled per attempt")
+    p.add_argument("--quarantine", type=_str_to_bool, default=True,
+                   help="exhausted retries quarantine the query into the "
+                        "stage manifest (it scores as not-localized) "
+                        "instead of aborting the stage")
     return p
 
 
@@ -78,6 +81,9 @@ def main(argv=None) -> int:
         n_queries=args.n_queries,
         seed=args.seed,
         num_workers=args.num_workers,
+        query_retries=args.query_retries,
+        retry_backoff_s=args.retry_backoff_s,
+        quarantine=args.quarantine,
     )
     print(args)
     curves = run_localization(config)
@@ -89,6 +95,14 @@ def main(argv=None) -> int:
         print(f"{desc}: localized @0.5m {at_05 * 100:.1f}%  "
               f"@1.0m {at_10 * 100:.1f}%")
     print("Outputs in " + config.output_dir)
+    from ncnet_tpu.localization.driver import pnp_stage_degraded
+
+    if pnp_stage_degraded(config):
+        # degraded result (quarantined PnP queries): exit nonzero so CI /
+        # schedulers notice; a rerun retries them
+        print("warning: PnP stage has quarantined queries (see its "
+              "manifest.json); curves are partial")
+        return 2
     return 0
 
 

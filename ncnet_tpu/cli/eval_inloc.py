@@ -9,14 +9,7 @@ from __future__ import annotations
 
 import argparse
 
-
-def _str_to_bool(v: str) -> bool:
-    # reference lib/torch_util.py:64-70 semantics
-    if v.lower() in ("yes", "true", "t", "y", "1"):
-        return True
-    if v.lower() in ("no", "false", "f", "n", "0"):
-        return False
-    raise argparse.ArgumentTypeError("Boolean value expected.")
+from ncnet_tpu.cli.common import str_to_bool as _str_to_bool
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -49,6 +42,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="total hosts striping queries (0 = auto)")
     p.add_argument("--skip_existing", type=_str_to_bool, default=True,
                    help="resume: skip queries whose output .mat exists")
+    p.add_argument("--validate_existing", type=_str_to_bool, default=True,
+                   help="loadmat-validate an existing .mat before skipping "
+                        "it, so a foreign/truncated artifact is recomputed")
+    p.add_argument("--query_retries", type=int, default=2,
+                   help="per-query retries after the first failure, before "
+                        "quarantine")
+    p.add_argument("--retry_backoff_s", type=float, default=0.5,
+                   help="retry backoff seconds, doubled per attempt")
+    p.add_argument("--quarantine", type=_str_to_bool, default=True,
+                   help="exhausted retries quarantine the query into "
+                        "manifest.json instead of aborting the run")
+    p.add_argument("--fetch_timeout_s", type=float, default=0.0,
+                   help="watchdog around each pair fetch; a hung tunnel "
+                        "becomes a retryable timeout (0 = off)")
     return p
 
 
@@ -77,11 +84,27 @@ def main(argv=None) -> int:
         host_index=args.host_index,
         host_count=args.host_count,
         skip_existing=args.skip_existing,
+        validate_existing=args.validate_existing,
+        query_retries=args.query_retries,
+        retry_backoff_s=args.retry_backoff_s,
+        quarantine=args.quarantine,
+        fetch_timeout_s=args.fetch_timeout_s,
     )
     print(args)
     print("Output matches folder: " + output_folder_name(config))
     out_dir = run_inloc_eval(config)
     print("Wrote matches to " + out_dir)
+    # degraded result (quarantined queries in THIS host's manifest — not a
+    # glob, which would read sibling stripes' or stale prior runs' files):
+    # exit nonzero so CI / schedulers notice even though the run survived
+    import os as _os
+
+    from ncnet_tpu.evaluation.inloc import manifest_name, resolve_host_stripe
+    from ncnet_tpu.evaluation.resilience import manifest_has_quarantined
+
+    if config.write_manifest and manifest_has_quarantined(
+            _os.path.join(out_dir, manifest_name(*resolve_host_stripe(config)))):
+        return 2
     return 0
 
 

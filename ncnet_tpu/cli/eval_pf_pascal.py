@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import argparse
 
+from ncnet_tpu.cli.common import str_to_bool as _str_to_bool
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="Compute PF Pascal matches")
@@ -30,6 +32,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="upload host-normalized float images instead of the "
                         "default resized-uint8 + on-device normalization "
                         "(exact reference numerics; 4x the transfer bytes)")
+    p.add_argument("--journal_dir", type=str, default="",
+                   help="journal per-batch PCK contributions + run manifest "
+                        "here; a rerun with the same settings resumes "
+                        "mid-eval to a bitwise-identical result")
+    p.add_argument("--query_retries", type=int, default=2,
+                   help="per-batch retries after the first dispatch/fetch "
+                        "failure, before quarantine")
+    p.add_argument("--retry_backoff_s", type=float, default=0.5,
+                   help="retry backoff seconds, doubled per attempt")
+    p.add_argument("--decode_retries", type=int, default=1,
+                   help="per-image transient decode retries (the eval twin "
+                        "of train.py's flag)")
+    p.add_argument("--quarantine", type=_str_to_bool, default=True,
+                   help="exhausted retries quarantine the batch (its pairs "
+                        "score invalid) instead of aborting the run")
+    p.add_argument("--fetch_timeout_s", type=float, default=0.0,
+                   help="watchdog around each result fetch; a hung tunnel "
+                        "becomes a retryable timeout (0 = off)")
     return p
 
 
@@ -44,6 +64,12 @@ def main(argv=None) -> int:
         checkpoint=args.checkpoint,
         image_size=args.image_size,
         eval_dataset_path=args.eval_dataset_path,
+        journal_dir=args.journal_dir,
+        query_retries=args.query_retries,
+        retry_backoff_s=args.retry_backoff_s,
+        quarantine=args.quarantine,
+        fetch_timeout_s=args.fetch_timeout_s,
+        decode_retries=args.decode_retries,
     )
     stats = run_eval(
         config,
@@ -57,7 +83,17 @@ def main(argv=None) -> int:
     print("Total: " + str(stats["total"]))
     print("Valid: " + str(stats["valid"]))
     print("PCK:", "{:.2%}".format(stats["pck"]))
-    return 0
+    degraded = False
+    if stats.get("quarantined_batches"):
+        print("Quarantined batches: " + str(stats["quarantined_batches"]))
+        degraded = True
+    if stats.get("decode_quarantined"):
+        print("Undecodable images (pairs scored invalid): "
+              + str(stats["decode_quarantined"]))
+        degraded = True
+    # degraded result: exit nonzero so CI / schedulers notice even though
+    # the run itself survived
+    return 2 if degraded else 0
 
 
 if __name__ == "__main__":

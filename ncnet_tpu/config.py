@@ -146,6 +146,27 @@ class EvalPFPascalConfig:
     eval_dataset_path: str = "datasets/pf-pascal/"
     pck_alpha: float = 0.1
     pck_procedure: str = "scnet"
+    # fault tolerance (evaluation/resilience.py; README "Resilient
+    # inference" — no reference analog: the reference loses all accumulated
+    # PCK on any crash):
+    journal_dir: str = ""                # journal per-batch PCK contributions
+                                         # + run manifest here; a rerun with
+                                         # the same settings resumes mid-eval
+                                         # to a bitwise-identical result.
+                                         # "" = no journal (in-memory only)
+    query_retries: int = 2               # per-batch retry attempts after the
+                                         # first dispatch/fetch failure
+    retry_backoff_s: float = 0.5         # seconds, doubled per attempt
+    quarantine: bool = True              # exhausted retries: record the batch
+                                         # in the manifest and keep going
+                                         # (its pairs score NaN = invalid)
+                                         # instead of aborting the run
+    fetch_timeout_s: float = 0.0         # watchdog around each result fetch;
+                                         # a hung tunnel becomes a retryable
+                                         # timeout. 0 = no watchdog
+    decode_retries: int = 1              # per-image transient decode retries
+                                         # (the eval twin of
+                                         # TrainConfig.decode_retries)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -182,6 +203,27 @@ class EvalInLocConfig:
     # resume-by-artifact: skip queries whose output .mat already exists (the
     # folder name encodes checkpoint + settings, so hits cannot be stale)
     skip_existing: bool = True
+    # fault tolerance (evaluation/resilience.py; README "Resilient
+    # inference" — no reference analog: the reference aborts the whole
+    # multi-hour run on the first bad query):
+    validate_existing: bool = True       # before skipping, loadmat-validate
+                                         # the artifact (expected keys +
+                                         # table shape) so a foreign or
+                                         # truncated file is recomputed, not
+                                         # silently fed to the PnP stage
+    query_retries: int = 2               # per-query retry attempts after the
+                                         # first failure (decode, device,
+                                         # savemat, timeout)
+    retry_backoff_s: float = 0.5         # seconds, doubled per attempt
+    quarantine: bool = True              # exhausted retries: record the query
+                                         # in manifest.json and keep going
+                                         # instead of aborting the run
+    fetch_timeout_s: float = 0.0         # watchdog around each pair fetch;
+                                         # a hung tunnel becomes a retryable
+                                         # timeout. 0 = no watchdog
+    write_manifest: bool = True          # journal completed / quarantined /
+                                         # in-flight queries to
+                                         # <out_dir>/manifest.json
 
 
 @dataclasses.dataclass(frozen=True)
@@ -213,6 +255,13 @@ class LocalizationConfig:
                                          # verification (per scan) fan out
                                          # over spawn process pools — the
                                          # reference's two parfor loops
+    # fault tolerance (evaluation/resilience.py): per-query isolation of the
+    # PnP stage — a query whose matches/.mat/cutout data is broken is
+    # retried, then quarantined into the stage manifest (it scores as
+    # not-localized downstream), instead of aborting the stage
+    query_retries: int = 2
+    retry_backoff_s: float = 0.5
+    quarantine: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

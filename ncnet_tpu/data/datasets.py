@@ -44,6 +44,20 @@ class SampleDecodeError(RuntimeError):
         self.path = path
 
 
+def load_image_with_retry(path: str, retries: int) -> np.ndarray:
+    """``load_image`` with bounded transient-error retry, raising
+    :class:`SampleDecodeError` (which carries the path for quarantine) after
+    the budget — the one decode-resilience primitive both the training
+    dataset and the eval datasets share."""
+    err: Optional[Exception] = None
+    for _ in range(max(retries, 0) + 1):
+        try:
+            return load_image(path)
+        except Exception as e:  # PIL raises OSError/ValueError variants
+            err = e
+    raise SampleDecodeError(path, err)
+
+
 def load_image(path: str) -> np.ndarray:
     """Decode to (H, W, 3) uint8; grayscale replicated to 3 channels
     (im_pair_dataset.py:64-65)."""
@@ -115,13 +129,7 @@ class ImagePairDataset:
         return len(self.img_a_names)
 
     def _load_with_retry(self, path: str) -> np.ndarray:
-        err: Optional[Exception] = None
-        for _ in range(max(self.decode_retries, 0) + 1):
-            try:
-                return load_image(path)
-            except Exception as e:  # PIL raises OSError/ValueError variants
-                err = e
-        raise SampleDecodeError(path, err)
+        return load_image_with_retry(path, self.decode_retries)
 
     def _get_image(self, name: str, flip: int, rng) -> Tuple[np.ndarray, np.ndarray]:
         image = self._load_with_retry(os.path.join(self.image_path, name))
@@ -185,10 +193,12 @@ class PFPascalDataset:
         normalize: bool = True,
         category: Optional[int] = None,
         pck_procedure: str = "pf",
+        decode_retries: int = 1,
     ):
         self.out_h, self.out_w = output_size
         self.normalize = normalize
         self.pck_procedure = pck_procedure
+        self.decode_retries = decode_retries
         df = pd.read_csv(csv_file)
         self.category = df.iloc[:, 2].to_numpy().astype(np.float32)
         if category is not None:
@@ -205,8 +215,15 @@ class PFPascalDataset:
         return len(self.img_a_names)
 
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
-        image_a = load_image(os.path.join(self.dataset_path, self.img_a_names[idx]))
-        image_b = load_image(os.path.join(self.dataset_path, self.img_b_names[idx]))
+        # SampleDecodeError-wrapped (with bounded transient retry) so the
+        # loader's quarantine policy can isolate a corrupt eval image
+        # instead of the decode aborting the whole PCK run
+        image_a = load_image_with_retry(
+            os.path.join(self.dataset_path, self.img_a_names[idx]),
+            self.decode_retries)
+        image_b = load_image_with_retry(
+            os.path.join(self.dataset_path, self.img_b_names[idx]),
+            self.decode_retries)
         image_a, size_a = _preprocess(image_a, self.out_h, self.out_w, self.normalize)
         image_b, size_b = _preprocess(image_b, self.out_h, self.out_w, self.normalize)
 

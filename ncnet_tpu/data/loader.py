@@ -104,12 +104,29 @@ class DataLoader:
         self.on_decode_error = on_decode_error
         self.quarantined: Set[str] = set()   # bad image paths, for reporting
         self._bad_indices: Set[int] = set()  # dataset indices to skip over
+        # guards quarantine-state WRITES and snapshot reads: _quarantine
+        # runs on prefetch worker threads while eval consumers snapshot
+        # bad_indices on the main thread (membership tests stay lock-free —
+        # atomic under the GIL)
+        self._quarantine_lock = threading.Lock()
         self.epoch = 0  # bump (or pass to set_epoch) to reshuffle
         self.start_batch = 0
 
     def set_epoch(self, epoch: int, start_batch: int = 0) -> None:
         self.epoch = epoch
         self.start_batch = start_batch
+
+    @property
+    def bad_indices(self) -> frozenset:
+        """Dataset indices whose OWN samples failed decode (and were
+        substituted under the quarantine policy).  Eval consumers key their
+        invalid-scoring on this, not on ``quarantined`` paths: an image can
+        be shared across samples and fail transiently for one of them —
+        path-level matching would wrongly invalidate the healthy ones.
+        Snapshot under the quarantine lock: prefetch workers mutate the set
+        concurrently, and an unguarded frozenset() can raise mid-iteration."""
+        with self._quarantine_lock:
+            return frozenset(self._bad_indices)
 
     def _shard_len(self) -> int:
         n = len(self.dataset)
@@ -144,9 +161,11 @@ class DataLoader:
             yield chunk
 
     def _quarantine(self, err: SampleDecodeError, idx: int) -> None:
-        self._bad_indices.add(idx)
-        if err.path not in self.quarantined:
+        with self._quarantine_lock:
+            self._bad_indices.add(idx)
+            fresh = err.path not in self.quarantined
             self.quarantined.add(err.path)
+        if fresh:
             print(f"[fault-tolerance] quarantined undecodable sample "
                   f"{err.path!r}: {err}")
 

@@ -5,11 +5,31 @@ from ncnet_tpu.evaluation.inloc import (
     make_pair_matcher,
     run_inloc_eval,
     sort_and_dedup,
+    validate_matches_mat,
 )
 from ncnet_tpu.evaluation.pck import pck, pck_metric
 from ncnet_tpu.evaluation.pf_pascal import make_eval_step, run_eval
+from ncnet_tpu.evaluation.pipeline import (
+    FetchTimeoutError,
+    PipelineDepthController,
+    call_with_watchdog,
+)
+from ncnet_tpu.evaluation.resilience import (
+    EvalJournal,
+    FaultPolicy,
+    RunManifest,
+    classify_failure,
+    run_isolated,
+)
 
 __all__ = [
+    "EvalJournal",
+    "FaultPolicy",
+    "FetchTimeoutError",
+    "PipelineDepthController",
+    "RunManifest",
+    "call_with_watchdog",
+    "classify_failure",
     "extract_match_table",
     "make_eval_step",
     "make_pair_matcher",
@@ -17,5 +37,7 @@ __all__ = [
     "pck_metric",
     "run_eval",
     "run_inloc_eval",
+    "run_isolated",
     "sort_and_dedup",
+    "validate_matches_mat",
 ]

@@ -210,15 +210,20 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
             return spatial_forward(config, p, src, tgt, mesh)
         return ncnet_forward(config, p, src, tgt)
 
+    from ncnet_tpu.models.ncnet import ResilientJit
+
     # preprocessing is its OWN jitted stage (not part of the forward
     # program): both the sharded and unsharded forward then consume
     # bit-identical preprocessed tensors, so tie-breaking in the score sort
     # cannot depend on which forward program compiled the resize
-    prep = jax.jit(
-        device_preprocess, static_argnames=("image_size", "k_size")
+    prep = ResilientJit(
+        device_preprocess, hook=False,
+        static_argnames=("image_size", "k_size"),
     )
 
-    feats = jax.jit(lambda p, x: extract_features(config, p, x))
+    feats = ResilientJit(
+        lambda p, x: extract_features(config, p, x), hook=False
+    )
 
     def prep_input(img) -> jnp.ndarray:
         """The ONE preprocessing call both input paths share — a divergence
@@ -251,7 +256,14 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
             both_directions=both_directions, flip_direction=flip_direction,
         )
 
-    jitted = jax.jit(run, static_argnames=("sharded", "src_is_features"))
+    # the device-error injection hook lives on the pair program only (one
+    # hook per dispatched PAIR keeps injected-call ordinals predictable);
+    # prep/feats failures still reach the per-query isolation as plain
+    # device errors and get the same demote-retrace recovery
+    jitted = ResilientJit(
+        run, label="inloc_pair",
+        static_argnames=("sharded", "src_is_features"),
+    )
 
     warned_shapes = set()
 
@@ -325,9 +337,18 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         ``matcher.dispatch`` / ``matcher.fetch``."""
         return fetch(dispatch(src, tgt))
 
+    def retrace():
+        """Drop every cached executable (prep, trunk, pair program) so the
+        next dispatch re-traces — the tier-degradation seam
+        (models/ncnet.recover_from_device_failure) after a mid-run Pallas
+        failure demoted the fused-stack tier."""
+        for r in (prep, feats, jitted):
+            r.retrace()
+
     matcher.preprocess = preprocess
     matcher.dispatch = dispatch
     matcher.fetch = fetch
+    matcher.retrace = retrace
     return matcher
 
 
@@ -343,6 +364,61 @@ def sort_and_dedup(xa, ya, xb, yb, score):
     coords = np.stack([xa, ya, xb, yb], axis=0)
     _, unique_index = np.unique(coords, axis=1, return_index=True)
     return tuple(v[unique_index] for v in (xa, ya, xb, yb, score))
+
+
+def manifest_name(host_index: int, host_count: int) -> str:
+    """The run-manifest filename for one host stripe.  One manifest per
+    stripe: concurrent hosts share the output dir, and a shared manifest's
+    read-modify-write transitions would clobber each other.  The CLI's
+    degraded-run exit check must read exactly THIS file — globbing
+    manifest*.json would pick up other stripes' (or stale prior runs')
+    manifests and fail a clean run forever."""
+    if host_count == 1:
+        return "manifest.json"
+    return f"manifest.host{host_index}_of_{host_count}.json"
+
+
+def resolve_host_stripe(config: EvalInLocConfig) -> Tuple[int, int]:
+    """(host_index, host_count) with -1/0 auto-resolved from the jax
+    process topology — the ONE resolution both the eval loop and the CLI's
+    post-run manifest check use.  Raises on incoherent explicit stripes
+    (index without count, index out of range): a misconfigured stripe
+    silently drops/duplicates queries."""
+    host_count = config.host_count or jax.process_count()
+    host_index = (
+        config.host_index if config.host_index >= 0 else jax.process_index()
+    )
+    if config.host_index >= 0 and not config.host_count:
+        raise ValueError("host_index given without host_count")
+    if not 0 <= host_index < host_count:
+        raise ValueError(
+            f"host_index {host_index} out of range for host_count {host_count}"
+        )
+    return host_index, host_count
+
+
+def validate_matches_mat(path: str, n_panos: int, n_cap: int) -> bool:
+    """Whether an existing per-query artifact is a loadable matches .mat
+    with the expected keys and table shape.
+
+    ``skip_existing`` treats existence as completion; that contract holds
+    for OUR atomically-renamed artifacts, but a foreign file (a different
+    n_panos run manually copied in, a file truncated by a full disk outside
+    this writer) would otherwise be skipped and silently poison the
+    downstream PnP stage.  Validation failure means "recompute", never
+    "crash"."""
+    try:
+        from scipy.io import loadmat
+
+        mat = loadmat(path)
+    except Exception:
+        return False
+    m = mat.get("matches")
+    if m is None or "query_fn" not in mat or "pano_fn" not in mat:
+        return False
+    if n_panos == 0:  # a zero-dim table roundtrips through .mat as empty
+        return m.size == 0
+    return m.shape == (1, n_panos, n_cap, 5)
 
 
 def output_folder_name(config: EvalInLocConfig) -> str:
@@ -401,7 +477,23 @@ def run_inloc_eval(
     Reference flow (eval_inloc.py:124-221): per query, match against its
     top-``n_panos`` shortlisted images and write one compressed .mat with the
     fixed-capacity match table.
+
+    Fault tolerance (round 7; ``config`` knobs, README "Resilient
+    inference"): each query runs under per-query isolation — bounded retry
+    with backoff, runtime fused-tier demotion on device errors, watchdogged
+    fetches — and an exhausted budget quarantines the query into
+    ``<out_dir>/manifest.json`` instead of aborting the run.  ``skip_existing``
+    additionally validates the artifact before trusting it
+    (:func:`validate_matches_mat`).
     """
+    from ncnet_tpu.evaluation.pipeline import call_with_watchdog
+    from ncnet_tpu.evaluation.resilience import (
+        FaultPolicy,
+        QuarantineBreaker,
+        RunManifest,
+        run_isolated,
+    )
+    from ncnet_tpu.models.ncnet import recover_from_device_failure
     from ncnet_tpu.utils.io import atomic_savemat
 
     if params is None:
@@ -461,20 +553,10 @@ def run_inloc_eval(
     )
 
     n_queries = min(config.n_queries, len(query_fns))
-    # multi-host: stripe queries across processes (per-query output files are
-    # independent, so hosts never contend; -1/0 → auto-detect, single-host
-    # runs get the identity stripe).  Explicit index/count must be coherent,
-    # or a misconfigured stripe silently drops/duplicates queries.
-    host_count = config.host_count or jax.process_count()
-    host_index = (
-        config.host_index if config.host_index >= 0 else jax.process_index()
-    )
-    if config.host_index >= 0 and not config.host_count:
-        raise ValueError("host_index given without host_count")
-    if not 0 <= host_index < host_count:
-        raise ValueError(
-            f"host_index {host_index} out of range for host_count {host_count}"
-        )
+    # multi-host: stripe queries across processes (per-query output files
+    # are independent, so hosts never contend; -1/0 → auto-detect,
+    # single-host runs get the identity stripe)
+    host_index, host_count = resolve_host_stripe(config)
     # one decode-ahead worker: the next pano decodes while the device chews
     # on the current pair (and the first pano while the query preprocesses)
     # — the eval twin of the training loader's prefetch (the reference
@@ -490,15 +572,6 @@ def run_inloc_eval(
 
     def process_query(q, io_pool):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
-        if config.skip_existing and os.path.exists(out_path):
-            # resume-by-artifact: the per-query .mat is written via temp-file
-            # + os.replace at the end of its pano loop, so its existence means
-            # the query is done.  The folder name encodes checkpoint +
-            # settings, making a stale hit impossible short of swapping
-            # checkpoint contents under an unchanged name.
-            if progress:
-                print(f"{q} (exists, skipped)")
-            return
         if progress:
             print(q)
         matches = np.zeros((1, config.n_panos, n_cap, 5))
@@ -520,7 +593,13 @@ def run_inloc_eval(
 
         def drain_one(sample: bool = True):
             idx0, handle = in_flight.pop(0)
-            xa, ya, xb, yb, score = matcher.fetch(handle)
+            # the watchdog converts a hung tunnel fetch into a retryable
+            # FetchTimeoutError that the per-query isolation absorbs
+            xa, ya, xb, yb, score = call_with_watchdog(
+                matcher.fetch, (handle,),
+                timeout=config.fetch_timeout_s,
+                label=f"InLoc query {q + 1} pair {idx0}",
+            )
             if sample:
                 depth_ctl.note_drain()
             else:
@@ -577,8 +656,87 @@ def run_inloc_eval(
             do_compression=True,
         )
 
+    manifest = None
+    if config.write_manifest:
+        manifest = RunManifest(
+            os.path.join(out_dir, manifest_name(host_index, host_count)),
+            meta={
+                "experiment": output_folder_name(config),
+                "n_queries": n_queries,
+                "n_panos": config.n_panos,
+                "host_index": host_index,
+                "host_count": host_count,
+            },
+        )
+    policy = FaultPolicy(retries=config.query_retries,
+                         backoff_s=config.retry_backoff_s,
+                         quarantine=config.quarantine)
+    breaker = QuarantineBreaker(policy.max_consecutive_quarantines)
+
     depth_ctl = _PipelineDepthController(config.pipeline_depth)
     with ThreadPoolExecutor(max_workers=1) as io_pool:
         for q in range(host_index, n_queries, host_count):
-            process_query(q, io_pool)
+            qid = f"query_{q + 1}"
+            out_path = os.path.join(out_dir, f"{q + 1}.mat")
+            if config.skip_existing and os.path.exists(out_path):
+                # resume-by-artifact: the per-query .mat is written via
+                # temp-file + os.replace at the end of its pano loop, so its
+                # existence means the query is done.  The folder name encodes
+                # checkpoint + settings, making a stale hit impossible short
+                # of swapping checkpoint contents under an unchanged name —
+                # but a FOREIGN or truncated file (copied in by hand, a
+                # non-atomic writer) is caught by validation and recomputed
+                # rather than poisoning the downstream PnP stage.
+                # loadmat-validating hundreds of completed multi-MB tables
+                # on every resume is wasteful when the manifest already
+                # proves THIS writer completed the query (its transitions
+                # commit atomically) — validation guards artifacts of
+                # UNKNOWN provenance, i.e. ones the manifest cannot vouch
+                # for.  The manifest only vouches for what it OBSERVED: a
+                # write this run/resume completed, or a validation that
+                # actually passed — skipping with validate_existing=False
+                # records nothing, or a later validating run would trust it.
+                vouched = manifest is not None and manifest.is_completed(qid)
+                if vouched or not config.validate_existing \
+                        or validate_matches_mat(out_path, config.n_panos, n_cap):
+                    if progress:
+                        print(f"{q} (exists, skipped)")
+                    if manifest is not None and not vouched \
+                            and config.validate_existing:
+                        manifest.complete(qid, skipped=True)
+                    # a skipped unit is a COMPLETED unit: it must reset the
+                    # breaker streak, or a resume over a mostly-done run
+                    # would see only the persistently-broken queries
+                    # back-to-back and falsely abort as systemic
+                    breaker.note(False)
+                    continue
+                print(f"warning: {out_path} exists but failed validation "
+                      "(foreign or truncated artifact); recomputing")
+
+            def on_failure(exc, kind):
+                # an aborted drain leaves the controller's interval anchor
+                # pointing at a torn cadence — clear it before the retry
+                depth_ctl.note_failure()
+                if kind == "device":
+                    # demote the fused tier + re-trace: the retry (granted
+                    # off-budget when this returns a tier name) runs on the
+                    # surviving tier
+                    return recover_from_device_failure(exc, matcher)
+                return None
+
+            ok, _ = run_isolated(
+                qid,
+                lambda q=q: process_query(q, io_pool),
+                policy=policy,
+                manifest=manifest,
+                on_failure=on_failure,
+                label=f"InLoc query {q + 1}",
+            )
+            # N consecutive quarantines = the rig, not the queries, is
+            # broken: abort loudly (SystemicEvalError) instead of
+            # quarantining the rest of an hours-long run one by one
+            breaker.note(not ok)
+    if manifest is not None and manifest.quarantined_ids:
+        print("warning: quarantined queries (see manifest.json): "
+              + ", ".join(manifest.quarantined_ids))
     return out_dir

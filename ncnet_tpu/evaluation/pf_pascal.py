@@ -26,10 +26,21 @@ Numerics note: the uint8 upload rounds the resized image to the nearest
 0-255 step before the device-side normalization (≤0.5/255 per pixel,
 ~20× below bf16 feature rounding).  ``device_normalize=False`` restores
 the exact host-normalized float path.
+
+Round-7 resilience (the inference twin of PR 1's training layer — see
+README "Resilient inference"): per-BATCH fault isolation (bounded retry →
+quarantine into a run manifest, via ``evaluation/resilience.run_isolated``),
+an optional watchdog around each fetch (``config.fetch_timeout_s``), runtime
+fused-tier demotion on device errors
+(``models/ncnet.recover_from_device_failure``), and — when
+``config.journal_dir`` is set — an append-only journal of per-batch PCK
+contributions so a killed run resumes mid-eval and reproduces the
+uninterrupted result bitwise.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, Optional
 
@@ -40,7 +51,10 @@ import numpy as np
 from ncnet_tpu.config import EvalPFPascalConfig, ModelConfig
 from ncnet_tpu.data import DataLoader, PFPascalDataset
 from ncnet_tpu.evaluation.pck import pck_metric
-from ncnet_tpu.evaluation.pipeline import PipelineDepthController
+from ncnet_tpu.evaluation.pipeline import (
+    PipelineDepthController,
+    call_with_watchdog,
+)
 from ncnet_tpu.models import NCNet
 from ncnet_tpu.ops import corr_to_matches
 from ncnet_tpu.ops.image import normalize_imagenet, quantize_u8
@@ -52,7 +66,12 @@ def make_eval_step(net: NCNet, alpha: float, device_normalize: bool = False):
 
     ``device_normalize``: the batch's images arrive as raw resized uint8 and
     the ImageNet normalization runs on device (the uint8-upload fast path);
-    otherwise images are already host-normalized floats."""
+    otherwise images are already host-normalized floats.
+
+    The jit is a :class:`~ncnet_tpu.models.ncnet.ResilientJit`: the returned
+    function carries ``.retrace()`` so the eval loop's tier-degradation
+    recovery can drop poisoned executables after a mid-run device failure."""
+    from ncnet_tpu.models.ncnet import ResilientJit
 
     def step(params, batch):
         src, tgt = batch["source_image"], batch["target_image"]
@@ -66,12 +85,13 @@ def make_eval_step(net: NCNet, alpha: float, device_normalize: bool = False):
         matches = corr_to_matches(out.corr, do_softmax=True)
         return pck_metric(batch, matches, alpha)
 
-    jitted = jax.jit(step)
+    jitted = ResilientJit(step, label="pf_pascal_step")
 
     def annotated(params, batch):
         with annotate("pf_pascal_eval_step"):
             return jitted(params, batch)
 
+    annotated.retrace = jitted.retrace
     return annotated
 
 
@@ -89,12 +109,31 @@ def run_eval(
 
     Returns ``{"pck": mean over valid pairs, "total": N, "valid": N_valid}``
     — the same three numbers the reference prints (eval_pf_pascal.py:84-89) —
-    plus ``per_pair`` and a ``timing`` wall split (decode / dispatch / fetch
-    seconds, summed over the loop).
+    plus ``per_pair``, a ``timing`` wall split (decode / dispatch / fetch
+    seconds, summed over the loop), and the resilience report
+    (``quarantined_batches``: batch indices given up on after retries, their
+    pairs scored NaN=invalid; ``decode_quarantined``: undecodable image paths
+    the loader substituted).
 
     ``pipeline_depth``: 0 = adaptive (see module docstring), >0 pins the
     dispatch/fetch queue depth.
+
+    Fault tolerance (``config`` knobs; see module docstring): when
+    ``config.journal_dir`` is set, every completed batch's per-pair PCK is
+    appended to ``<journal_dir>/pck_journal.jsonl`` and a run manifest is
+    kept beside it; a rerun skips journaled batches (their decoded batches
+    are still iterated — the loader's the cheap half — but nothing is
+    dispatched) and reproduces the uninterrupted result bitwise.
     """
+    from ncnet_tpu.evaluation.resilience import (
+        EvalJournal,
+        FaultPolicy,
+        QuarantineBreaker,
+        RunManifest,
+        run_isolated,
+    )
+    from ncnet_tpu.models.ncnet import recover_from_device_failure
+
     if net is None:
         mc = (model_config or ModelConfig()).replace(checkpoint=config.checkpoint)
         net = NCNet(mc)
@@ -104,16 +143,44 @@ def run_eval(
         dataset_path=config.eval_dataset_path,
         output_size=(config.image_size, config.image_size),
         pck_procedure=config.pck_procedure,
+        decode_retries=config.decode_retries,
         # uint8-upload path: the dataset emits the resized image UNnormalized
         # (0-255 floats) so the loop can quantize to uint8 for the transfer
         normalize=not device_normalize,
     )
-    loader = DataLoader(dataset, batch_size=batch_size, shuffle=False,
-                        num_workers=num_workers)
+    loader = DataLoader(
+        dataset, batch_size=batch_size, shuffle=False,
+        num_workers=num_workers,
+        # one corrupt image must not abort the run: the loader substitutes
+        # the next healthy sample (index-deterministic, so reruns — and the
+        # journal's bitwise-resume contract — are unaffected) and reports it
+        on_decode_error="quarantine" if config.quarantine else "raise",
+    )
     step = make_eval_step(net, config.pck_alpha,
                           device_normalize=device_normalize)
+    policy = FaultPolicy(retries=config.query_retries,
+                         backoff_s=config.retry_backoff_s,
+                         quarantine=config.quarantine)
+    breaker = QuarantineBreaker(policy.max_consecutive_quarantines)
+    journal = manifest = None
+    if config.journal_dir:
+        os.makedirs(config.journal_dir, exist_ok=True)
+        header = {
+            "image_size": config.image_size,
+            "pck_alpha": config.pck_alpha,
+            "pck_procedure": config.pck_procedure,
+            "checkpoint": config.checkpoint,
+            "batch_size": batch_size,
+            "device_normalize": bool(device_normalize),
+            "n_pairs": len(dataset),
+        }
+        journal = EvalJournal(
+            os.path.join(config.journal_dir, "pck_journal.jsonl"), header)
+        manifest = RunManifest(
+            os.path.join(config.journal_dir, "manifest.json"), meta=header)
 
     results = []
+    quarantined_batches = []
     n_batches = len(loader)
     # upload precision (host-normalized path only): when the trunk runs bf16
     # (backbone_bf16), its first act is casting the images to bf16 — so
@@ -130,10 +197,75 @@ def run_eval(
     )
     in_flight: list = []
 
+    def nan_decode_quarantined(bi, arr) -> np.ndarray:
+        """Score this batch's pairs NaN where THEIR OWN decode failed: the
+        loader substituted the next healthy sample so the RUN survives, but
+        a reported METRIC must not count the substitute twice.  Keyed on the
+        loader's per-index bad set, not on quarantined paths (an image shared
+        across pairs may fail transiently for a different pair).  Applied at
+        RESOLVE time, before journaling — the override is then part of the
+        journaled record, so a resume replays it even if the image's
+        decodability changed between kill and rerun (the bitwise contract
+        binds to what run 1 measured)."""
+        bad = loader.bad_indices
+        if not bad:
+            return arr
+        arr = arr.copy()
+        for j in range(len(arr)):
+            if bi * batch_size + j in bad:
+                arr[j] = np.nan
+        return arr
+
+    def resolve_batch(bi, jb, n0, handle) -> np.ndarray:
+        """Fetch one batch's per-sample PCK under per-batch fault isolation:
+        watchdogged fetch, bounded retry (re-dispatching from the kept host
+        batch when the handle is poisoned), tier demotion on device errors,
+        quarantine (NaN scores) when the budget runs out."""
+        state = {"handle": handle}
+
+        def work():
+            if state["handle"] is None:
+                state["handle"] = step(net.params, jb)
+            h = state["handle"]
+            arr = np.asarray(
+                call_with_watchdog(
+                    lambda: np.asarray(h),
+                    timeout=config.fetch_timeout_s,
+                    label=f"pf_pascal batch {bi}",
+                ),
+                dtype=np.float32,
+            )[:n0]
+            arr = nan_decode_quarantined(bi, arr)
+            if journal is not None:
+                # journal BEFORE the manifest's completed transition (which
+                # run_isolated applies on return): at any kill point the
+                # journal — the source of truth for resume — is never behind
+                # a manifest that claims completion
+                journal.append(bi, arr)
+            return arr
+
+        def on_failure(exc, kind):
+            state["handle"] = None  # poisoned (or never produced): re-dispatch
+            depth_ctl.note_failure()
+            if kind == "device":
+                return recover_from_device_failure(exc, step)
+            return None
+
+        ok, arr = run_isolated(
+            f"batch_{bi}", work, policy=policy, manifest=manifest,
+            on_failure=on_failure, label=f"PF-Pascal batch {bi}",
+        )
+        # N consecutive quarantines = systemic: abort (SystemicEvalError)
+        breaker.note(not ok)
+        if not ok:
+            quarantined_batches.append(bi)
+            return np.full((n0,), np.nan, dtype=np.float32)
+        return arr
+
     def drain_one(sample: bool = True):
-        handle, n0 = in_flight.pop(0)
+        handle, n0, bi, jb = in_flight.pop(0)
         t0 = time.perf_counter()
-        results.append(np.asarray(handle)[:n0])
+        results.append(resolve_batch(bi, jb, n0, handle))
         timing["fetch_s"] += time.perf_counter() - t0
         if sample:
             depth_ctl.note_drain()
@@ -145,6 +277,24 @@ def run_eval(
     t_decode = time.perf_counter()
     for i, batch in enumerate(loader):
         timing["decode_s"] += time.perf_counter() - t_decode
+        if journal is not None and i in journal.entries:
+            # resume: this batch's contribution is already journaled.  Flush
+            # the pipeline first so the results list keeps batch order, then
+            # reuse the stored (bitwise-exact) values without dispatching.
+            while in_flight:
+                drain_one(sample=False)
+            results.append(journal.entries[i])
+            if manifest is not None:
+                manifest.complete(f"batch_{i}", journaled=True)
+            # a replayed unit is a completed unit: reset the breaker streak
+            # (a resume must not see only the broken batches back-to-back
+            # and falsely abort as systemic)
+            breaker.note(False)
+            depth_ctl.note_gap()
+            if progress:
+                print(f"Batch: [{i}/{n_batches}] (journaled, skipped)")
+            t_decode = time.perf_counter()
+            continue
         t0 = time.perf_counter()
         jb = {
             k: np.asarray(v)
@@ -173,8 +323,24 @@ def run_eval(
         # pipelined dispatch: jax's async dispatch lets batch i+1's upload +
         # forward overlap batch i's device compute and result download.
         # Results are fetched in dispatch order, so output order matches
-        # the serial loop.
-        in_flight.append((step(net.params, jb), n_real))
+        # the serial loop.  A dispatch-time failure (an injected or real
+        # device error raised before the handle exists) is deferred to the
+        # drain's isolation path: demote/re-trace now if device-shaped,
+        # enqueue handle=None, and resolve_batch re-dispatches under its
+        # retry budget.
+        try:
+            handle = step(net.params, jb)
+        except Exception as e:
+            from ncnet_tpu.evaluation.resilience import classify_failure
+
+            kind = classify_failure(e)
+            print(f"warning: PF-Pascal batch {i}: {kind} failure at "
+                  f"dispatch: {type(e).__name__}: {e}")
+            depth_ctl.note_failure()
+            if kind == "device":
+                recover_from_device_failure(e, step)
+            handle = None
+        in_flight.append((handle, n_real, i, jb))
         timing["dispatch_s"] += time.perf_counter() - t0
         while len(in_flight) >= depth_ctl.depth:
             drain_one()
@@ -183,10 +349,14 @@ def run_eval(
         t_decode = time.perf_counter()
     while in_flight:
         drain_one(sample=False)
+    if journal is not None:
+        journal.close()
 
     results = np.concatenate(results)
-    # NaN = zero valid keypoints (the reference also had a -1 sentinel in its
-    # preallocated stats array; pck() here never produces one)
+    # NaN = zero valid keypoints, a quarantined batch, or a pair with an
+    # undecodable image (nan_decode_quarantined above; the reference also
+    # had a -1 sentinel in its preallocated stats array — pck() here never
+    # produces one)
     good = np.flatnonzero(~np.isnan(results))
     return {
         "pck": float(np.mean(results[good])) if good.size else float("nan"),
@@ -194,4 +364,6 @@ def run_eval(
         "valid": int(good.size),
         "per_pair": results,
         "timing": timing,
+        "quarantined_batches": quarantined_batches,
+        "decode_quarantined": sorted(loader.quarantined),
     }

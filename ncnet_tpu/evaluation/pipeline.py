@@ -8,10 +8,17 @@ PF-Pascal loop (`evaluation/pf_pascal.py`) reuses it instead of a pinned
 depth — the depth-control problem is identical, only the wall-time scale
 differs (a PF-Pascal drain is one BATCH of pairs, an InLoc drain is one
 pair), which the ``high_cap``/``low_cap`` knobs absorb.
+
+Round 7 adds the fault-isolation hooks the resilient eval loops
+(evaluation/resilience.py) need: :meth:`PipelineDepthController.note_failure`
+(an aborted drain must not poison the controller's wall statistics) and
+:func:`call_with_watchdog` (a hung tunnel fetch becomes a retryable
+:class:`FetchTimeoutError` instead of an eternal stall).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Optional
@@ -143,3 +150,71 @@ class PipelineDepthController:
 
     def note_gap(self) -> None:
         self._t_last = None
+
+    def note_failure(self) -> None:
+        """An aborted drain (exception or watchdog timeout mid-fetch): the
+        dispatch/drain cadence is broken, and the retried query's first
+        interval would span the retry's backoff + queue refill — the same
+        refill-spanning wall a depth change produces (ADVICE r4) — so clear
+        the anchor AND the EWMA decision window.  A pending speculative
+        probe is dropped unjudged (its judgment window was torn; the kept
+        depth re-judges itself against fresh walls).  The min-wall window
+        deliberately survives: device compute is unchanged by a failed
+        query, and it is the device-compute estimate the thresholds derive
+        from."""
+        self._probe = None
+        self._reset_ewma()
+
+
+class FetchTimeoutError(RuntimeError):
+    """A dispatch/fetch exceeded its watchdog budget — a hung tunnel or
+    wedged device surfaced as a *retryable* per-query failure (classified
+    'timeout' by evaluation/resilience.classify_failure) instead of stalling
+    the eval loop forever."""
+
+
+def call_with_watchdog(fn, args=(), timeout: float = 0.0, label: str = ""):
+    """Run blocking ``fn(*args)`` under a wall-clock watchdog.
+
+    ``timeout <= 0`` disables the watchdog (direct call — the default, since
+    a healthy rig should not pay a thread handoff per fetch).  Otherwise the
+    call runs in a daemon worker thread; if it has not returned within
+    ``timeout`` seconds a :class:`FetchTimeoutError` is raised.  The stuck
+    worker thread cannot be killed — it is abandoned (daemonized, so process
+    exit is not blocked); the leak is bounded by the caller's retry budget,
+    and an actually-hung tunnel leaves the process within a few retries via
+    quarantine anyway.
+
+    The injected-hang hook (``faults.hang_fetch_hook``) runs inside the
+    worker, so a test-armed hang exercises the REAL timeout path rather than
+    a simulated exception.
+    """
+    from ncnet_tpu.utils import faults
+
+    if timeout <= 0:
+        return fn(*args)
+    result = {}
+    done = threading.Event()
+
+    def target():
+        try:
+            faults.hang_fetch_hook(label)
+            result["value"] = fn(*args)
+        except BaseException as e:  # re-raised in the caller below
+            result["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(
+        target=target, daemon=True,
+        name=f"watchdog-{label or 'fetch'}",
+    )
+    worker.start()
+    if not done.wait(timeout):
+        raise FetchTimeoutError(
+            f"{label or 'fetch'} exceeded its {timeout:.1f}s watchdog "
+            "(hung tunnel or wedged device?)"
+        )
+    if "error" in result:
+        raise result["error"]
+    return result["value"]

@@ -5,7 +5,10 @@ Consumes the ``matches/<experiment>/<q>.mat`` tables written by
 LO-RANSAC P3P, optionally re-ranks the candidates by synthetic-view pose
 verification, and emits the localization-rate curves against the reference
 poses.  Every stage persists .mat artifacts and resumes from them — the
-reference's resume-by-artifact failure story (SURVEY §5.3).
+reference's resume-by-artifact failure story (SURVEY §5.3) — and the PnP
+stage adds per-query fault isolation (retry → quarantine into a stage
+manifest, evaluation/resilience.py) so one broken query's inputs cannot
+abort the whole localization run.
 """
 
 from __future__ import annotations
@@ -132,7 +135,12 @@ def _worker_init() -> None:
 
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception as e:  # pragma: no cover - depends on jax internals
+    except (AttributeError, RuntimeError, ValueError) as e:
+        # the known failure shapes: unknown option (AttributeError /
+        # ValueError across jax versions) or a backend already initialized
+        # (RuntimeError).  Anything else is a bug that should surface, not
+        # be swallowed — per-query failures are isolated at the stage level
+        # (run_pnp_stage's run_isolated + manifest), not here.
         print(f"warning: pool worker could not pin the CPU backend ({e}); "
               "workers may contend for the accelerator", file=sys.stderr)
 
@@ -202,8 +210,24 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
     ``config.num_workers > 0`` fans queries out over a spawn-based process
     pool — the Python equivalent of the reference's MATLAB ``parfor`` over
     queries; the per-pair artifact files make retries/collisions safe.
+
+    Per-query fault isolation (round 7): a query whose inputs are broken —
+    unreadable matches .mat, missing cutout depth, undecodable query image —
+    is retried with backoff and then QUARANTINED into the stage manifest
+    (``<pnp_dir>/manifest.json``) with a classified failure record, instead
+    of aborting the whole stage as the previous ``pool.map`` did on the
+    first worker exception.  A quarantined query is excluded from the
+    ImgList; downstream curve scoring already treats a missing query as
+    not-localized (``pose_errors`` fills inf), so the run's result stays
+    well-defined.
     """
     from ncnet_tpu.evaluation.inloc import _as_str, load_shortlist
+    from ncnet_tpu.evaluation.resilience import (
+        FaultPolicy,
+        QuarantineBreaker,
+        RunManifest,
+        run_isolated,
+    )
 
     out_path = os.path.join(config.output_dir, _pnp_matname(config))
     if os.path.exists(out_path):
@@ -218,11 +242,68 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
          [_as_str(n) for n in np.asarray(pano_fns[qi]).ravel()])
         for qi in range(n_queries)
     ]
+    pnp_dir = os.path.join(config.output_dir, _pnp_dirname(config))
+    os.makedirs(pnp_dir, exist_ok=True)
+    manifest = RunManifest(
+        os.path.join(pnp_dir, "manifest.json"),
+        meta={"stage": "pnp", "n_queries": n_queries,
+              "matches_dir": config.matches_dir},
+    )
+    policy = FaultPolicy(retries=config.query_retries,
+                         backoff_s=config.retry_backoff_s,
+                         quarantine=config.quarantine)
+    # N consecutive quarantines = systemic (bad matches_dir, dead pool
+    # survivor): abort loudly instead of quarantining every query
+    breaker = QuarantineBreaker(policy.max_consecutive_quarantines)
+    imglist: List[dict] = []
     if config.num_workers > 0:
         with _spawn_pool(config.num_workers) as pool:
-            imglist = list(pool.map(_pnp_one_query, *zip(*args)))
+            futures = [pool.submit(_pnp_one_query, *a) for a in args]
+            try:
+                for a, fut in zip(args, futures):
+                    first = {"fut": fut}
+
+                    def work(a=a, first=first):
+                        f = first["fut"]
+                        first["fut"] = None
+                        if f is None:  # retry: resubmit to the pool
+                            f = pool.submit(_pnp_one_query, *a)
+                        return f.result()
+
+                    ok, entry = run_isolated(
+                        a[2], work, policy=policy, manifest=manifest,
+                        label=f"PnP query {a[2]}",
+                    )
+                    breaker.note(not ok)
+                    if ok:
+                        imglist.append(entry)
+            except BaseException:
+                # abort paths (SystemicEvalError, quarantine=False) must
+                # surface NOW: without cancelling, the pool's __exit__
+                # would first wait out every pending future's discarded work
+                pool.shutdown(wait=False, cancel_futures=True)
+                raise
     else:
-        imglist = [_pnp_one_query(*a) for a in args]
+        for a in args:
+            ok, entry = run_isolated(
+                a[2], lambda a=a: _pnp_one_query(*a),
+                policy=policy, manifest=manifest,
+                label=f"PnP query {a[2]}",
+            )
+            breaker.note(not ok)
+            if ok:
+                imglist.append(entry)
+    if manifest.quarantined_ids:
+        # a degraded ImgList must NOT become the stage's resume artifact —
+        # the exists-guard above would pin it forever.  Return this run's
+        # partial result, but let the next run retry the quarantined
+        # queries; the per-pair artifacts in pnp_dir make the recompute of
+        # the completed queries cheap (run_pair_pnp resumes from them).
+        print("warning: PnP stage completed with quarantined queries "
+              f"({', '.join(manifest.quarantined_ids)}); the stage .mat is "
+              "NOT written so a rerun retries them (completed queries "
+              "resume from their per-pair artifacts)")
+        return imglist
     os.makedirs(config.output_dir, exist_ok=True)
     _save_imglist(out_path, imglist)
     return imglist
@@ -253,7 +334,8 @@ def _pv_run_items(config: LocalizationConfig, items_ser,
 
 
 def run_pv_stage(
-    config: LocalizationConfig, imglist: List[dict]
+    config: LocalizationConfig, imglist: List[dict],
+    pin_resume: bool = True,
 ) -> List[dict]:
     """Pose-verification rerank of each query's candidates
     (ht_top10_NC4D_PV_localization.m); writes/reloads the densePV ImgList.
@@ -261,11 +343,17 @@ def run_pv_stage(
     ``config.num_workers > 0`` fans the unique-scan groups out over a spawn
     process pool — the reference's ``parfor`` over scans; per-item .pv.mat
     artifacts keep pooled reruns collision-safe.
+
+    ``pin_resume=False`` (used when the upstream PnP stage ran degraded —
+    quarantined queries): neither reload nor write the stage-level resume
+    .mat, so a degraded rerank can never be pinned as the experiment's
+    final answer; the per-item .pv.mat artifacts still make the eventual
+    clean rerun cheap.
     """
     from ncnet_tpu.localization.verification import group_items_by_scan
 
     out_path = os.path.join(config.output_dir, _pv_matname(config))
-    if os.path.exists(out_path):
+    if pin_resume and os.path.exists(out_path):
         return _load_imglist(out_path)
 
     items = [
@@ -330,14 +418,31 @@ def run_pv_stage(
                 "P": poses,
             }
         )
-    _save_imglist(out_path, reranked)
+    if pin_resume:
+        _save_imglist(out_path, reranked)
+    else:
+        print("warning: densePV stage ran on a degraded (quarantined) PnP "
+              "result; its stage .mat is NOT written so a rerun recomputes "
+              "from the retried PnP stage")
     return reranked
+
+
+def pnp_stage_degraded(config: LocalizationConfig) -> bool:
+    """Whether the PnP stage's manifest records quarantined queries — the
+    downstream signal that this run's ImgList is partial and no stage may
+    pin a resume artifact built from it."""
+    from ncnet_tpu.evaluation.resilience import manifest_has_quarantined
+
+    return manifest_has_quarantined(
+        os.path.join(config.output_dir, _pnp_dirname(config), "manifest.json")
+    )
 
 
 def run_localization(config: LocalizationConfig) -> Dict[str, np.ndarray]:
     """The full L6 pipeline; returns ``{method description: curve}`` and
     writes curves/figures/error txts into ``config.output_dir``."""
     imglist = run_pnp_stage(config)
+    degraded = pnp_stage_degraded(config)
     methods = [
         MethodResult(
             "DensePE + NCNet",
@@ -345,7 +450,7 @@ def run_localization(config: LocalizationConfig) -> Dict[str, np.ndarray]:
         )
     ]
     if config.do_pose_verification:
-        reranked = run_pv_stage(config, imglist)
+        reranked = run_pv_stage(config, imglist, pin_resume=not degraded)
         methods.append(
             MethodResult(
                 "InLoc + NCNet",

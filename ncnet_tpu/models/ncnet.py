@@ -35,6 +35,120 @@ from ncnet_tpu.ops import (
     maxpool4d_with_argmax,
     mutual_matching,
 )
+from ncnet_tpu.utils import faults
+
+
+def _runtime_device_error_types() -> Tuple[type, ...]:
+    """Exception types that mean 'the compiled program / device runtime
+    failed', as opposed to a bug in host code: jax's runtime error (OOM,
+    Mosaic faults, tunnel resets surface as XlaRuntimeError subclasses of
+    it) plus the deterministic test stand-in."""
+    errs = [faults.InjectedDeviceError]
+    try:
+        errs.append(jax.errors.JaxRuntimeError)
+    except AttributeError:  # pragma: no cover - older jax
+        pass
+    try:  # pragma: no cover - defensive: not all jaxlibs alias it under errors
+        from jax._src.lib import xla_client
+
+        errs.append(xla_client.XlaRuntimeError)
+    except Exception:
+        pass
+    return tuple(errs)
+
+
+RUNTIME_DEVICE_ERRORS = _runtime_device_error_types()
+
+
+class ResilientJit:
+    """``jax.jit`` whose compiled-program cache can be dropped mid-run.
+
+    The eval paths' tier-degradation recovery needs two things a bare
+    ``jax.jit`` cannot give: (1) a host-side dispatch seam where an injected
+    runtime device error can be raised deterministically
+    (``faults.device_error_hook`` — one ``is None`` check when unarmed), and
+    (2) :meth:`retrace`, which discards every cached executable so that after
+    ``ops.demote_fused_tier`` disabled a Pallas tier the next call re-traces
+    through ``choose_fused_stack`` and lands on the surviving tier —
+    without it, jit's per-shape cache would keep replaying the poisoned
+    executable for every shape bucket already seen."""
+
+    def __init__(self, fn, *, label: str = "", hook: bool = True, **jit_kwargs):
+        self._fn = fn
+        self._label = label
+        self._hook = hook
+        self._jit_kwargs = jit_kwargs
+        self._jitted = jax.jit(fn, **jit_kwargs)
+
+    def __call__(self, *args, **kwargs):
+        if self._hook:
+            faults.device_error_hook(self._label)
+        return self._jitted(*args, **kwargs)
+
+    def retrace(self) -> None:
+        """Drop all cached executables; the next call re-traces (and
+        re-consults the fused-stack tier chooser).
+
+        ``jax.jit(self._fn)`` again would NOT do this: jax's tracing cache
+        is keyed on the callable's identity, so re-jitting the same function
+        object replays the cached jaxpr — the poisoned tier included —
+        without ever re-running the Python trace (verified on jax 0.4.37).
+        A fresh ``functools.wraps``-ed closure changes the cache key while
+        preserving the signature that ``static_argnames`` resolves against.
+        """
+        import functools
+
+        fn = self._fn
+        wrapper = functools.wraps(fn)(lambda *a, **kw: fn(*a, **kw))
+        self._jitted = jax.jit(wrapper, **self._jit_kwargs)
+
+
+def recover_from_device_failure(exc: BaseException, *retraceables) -> Optional[str]:
+    """The runtime tier-degradation policy, in one place.
+
+    If ``exc`` is a runtime device error (``RUNTIME_DEVICE_ERRORS``): demote
+    the highest still-enabled fused-stack Pallas tier
+    (``ops.demote_fused_tier``), call ``.retrace()`` on every given object so
+    their cached executables are rebuilt on the surviving tier, and return
+    the demoted tier's name — the caller should retry the failed query
+    WITHOUT consuming its bounded retry budget (the retry runs a genuinely
+    different program).  Returns None when there is nothing left to demote
+    (already on plain XLA — the failure is real) or the error is not
+    device-shaped; the caller falls back to its plain retry/quarantine
+    policy.
+
+    Policy note: the tier actually executing is chosen per SHAPE inside the
+    traced program, so this recovery cannot know it — it demotes the ladder
+    top-down instead.  When the failing shape was already below the demoted
+    tier the free retry re-runs the same program once per remaining rung (at
+    most two retrace cycles, after which every failure counts against the
+    plain budget); that bounded over-demotion is the price of keeping the
+    chooser the single authority on tier selection."""
+    if not isinstance(exc, RUNTIME_DEVICE_ERRORS):
+        return None
+    if not isinstance(exc, faults.InjectedDeviceError):
+        # a REAL device error on a backend with no Pallas at all cannot be
+        # tier-related: demoting would only grant pointless off-budget
+        # retries of a bit-identical program.  (Injected errors bypass the
+        # gate — they exist to simulate a Pallas-capable rig's failure on
+        # the CPU test backend.)
+        from ncnet_tpu.ops.conv4d import _pallas_available
+
+        if not _pallas_available():
+            return None
+    from ncnet_tpu.ops import demote_fused_tier
+
+    tier = demote_fused_tier()
+    if tier is None:
+        return None
+    print(
+        f"warning: runtime device failure ({type(exc).__name__}: {exc}); "
+        f"demoting fused NC tier '{tier}' and re-tracing the eval programs "
+        "— the run continues on the next tier"
+    )
+    for r in retraceables:
+        r.retrace()
+    return tier
 
 
 class NCNetOutput(NamedTuple):
@@ -501,7 +615,7 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
         # one stacked result: a single device→host pull instead of five
         return jnp.stack([v.astype(jnp.float32) for v in m])
 
-    jitted = jax.jit(run)
+    jitted = ResilientJit(run, label="point_matcher")
 
     def dispatch(src, tgt):
         """Enqueue upload + forward + match extraction without blocking."""
@@ -516,6 +630,8 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
 
     matcher.dispatch = dispatch
     matcher.fetch = fetch
+    # tier-degradation seam: recover_from_device_failure(exc, matcher)
+    matcher.retrace = jitted.retrace
     return matcher
 
 

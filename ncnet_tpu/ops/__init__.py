@@ -22,11 +22,14 @@ from ncnet_tpu.ops.conv4d import (
 )
 from ncnet_tpu.ops.nc_fused_lane import (  # noqa: F401
     choose_fused_stack,
+    demote_fused_tier,
+    demoted_fused_tiers,
     fused_resident_feasible,
     nc_stack_resident,
     fused_lane_feasible,
     nc_stack_fused,
     nc_stack_fused_lane,
+    reset_fused_tier_demotions,
 )
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
 from ncnet_tpu.ops.matching import (
@@ -61,11 +64,14 @@ __all__ = [
     "make_conv4d_same",
     "conv4d_transpose_weights",
     "choose_fused_stack",
+    "demote_fused_tier",
+    "demoted_fused_tiers",
     "fused_lane_feasible",
     "fused_resident_feasible",
     "nc_stack_fused",
     "nc_stack_fused_lane",
     "nc_stack_resident",
+    "reset_fused_tier_demotions",
     "maxpool4d_with_argmax",
     "mutual_matching",
     "corr_to_matches",

@@ -49,7 +49,7 @@ zero padding) + bias + ReLU — the reference's NeighConsensus layer
 from __future__ import annotations
 
 import functools
-from typing import List
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -657,19 +657,69 @@ def nc_stack_resident(nc_params: List[dict], x: jnp.ndarray,
     return fused_layout_out(out, hb, wb, k)
 
 
+# Tiers disabled at RUNTIME, after a compiled program failed mid-run
+# (XlaRuntimeError / RESOURCE_EXHAUSTED under eval-loop memory pressure —
+# conditions the compile-time probe cannot see).  Process-global by design:
+# a Pallas kernel that just OOMed will OOM again on the next shape bucket
+# too, so the demotion applies to every subsequent trace, and only an
+# explicit reset (a fresh process, or reset_fused_tier_demotions) re-arms
+# the tier.  See models/ncnet.recover_from_device_failure for the
+# demote-retrace-retry recovery that writes into this registry.
+_runtime_demoted: set = set()
+
+_TIER_ORDER = ("resident", "perlayer")
+
+
+def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
+    """Disable a fused-stack tier for the rest of the process.
+
+    ``tier=None`` demotes the highest still-enabled tier (the one
+    ``choose_fused_stack`` would have picked first); returns the tier
+    demoted, or None when every Pallas tier is already disabled (the caller
+    is on plain XLA — a failure there is a real error, not a tier problem).
+    """
+    if tier is None:
+        for t in _TIER_ORDER:
+            if t not in _runtime_demoted:
+                tier = t
+                break
+        else:
+            return None
+    elif tier not in _TIER_ORDER or tier in _runtime_demoted:
+        return None
+    _runtime_demoted.add(tier)
+    return tier
+
+
+def demoted_fused_tiers() -> frozenset:
+    """The tiers currently disabled by runtime demotion."""
+    return frozenset(_runtime_demoted)
+
+
+def reset_fused_tier_demotions() -> None:
+    """Re-arm all runtime-demoted tiers (tests; or a deliberate re-probe)."""
+    _runtime_demoted.clear()
+
+
 def choose_fused_stack(ha, wa, hb, wb, kernels, channels):
     """The one authority for the fused-stack tier at a shape class:
     ``'resident'`` (whole-stack kernel), ``'perlayer'`` (r5 chain), or
     ``None`` (XLA formulations).  Both Pallas tiers require a real TPU
-    backend and a green compile probe."""
+    backend and a green compile probe — and no runtime demotion: a tier
+    that failed MID-RUN (``demote_fused_tier``) is skipped even where its
+    compile probe stays green, because the failure mode (OOM under
+    eval-loop memory pressure, Mosaic runtime faults) is invisible to the
+    probe."""
     from ncnet_tpu.ops.conv4d import _pallas_available
 
     if not _pallas_available():
         return None
-    if fused_resident_feasible(ha, wa, hb, wb, kernels, channels) \
+    if "resident" not in _runtime_demoted \
+            and fused_resident_feasible(ha, wa, hb, wb, kernels, channels) \
             and fused_resident_compiles(ha, wa, hb, wb, kernels, channels):
         return "resident"
-    if channels[-1] == 1 \
+    if "perlayer" not in _runtime_demoted \
+            and channels[-1] == 1 \
             and fused_lane_feasible(ha, wa, hb, wb, kernels, channels) \
             and fused_lane_compiles(ha, wa, hb, wb, kernels, channels):
         return "perlayer"

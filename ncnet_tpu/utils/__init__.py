@@ -2,13 +2,14 @@
 (``ncnet_tpu.utils.faults`` — stdlib+numpy only; its hooks are no-ops
 unless a test arms a plan)."""
 
-from ncnet_tpu.utils.io import atomic_savemat
+from ncnet_tpu.utils.io import atomic_savemat, atomic_write_json
 from ncnet_tpu.utils.profiling import annotate, maybe_trace
 from ncnet_tpu.utils.seeding import global_seed, worker_rng
 
 __all__ = [
     "annotate",
     "atomic_savemat",
+    "atomic_write_json",
     "maybe_trace",
     "global_seed",
     "worker_rng",

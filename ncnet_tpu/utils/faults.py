@@ -3,8 +3,8 @@
 The training stack (ncnet_tpu/training/train.py) claims to survive four
 real-world failure modes: undecodable images, non-finite losses, a process
 killed mid-checkpoint-save, and SIGTERM preemption.  Claims about crash paths
-rot unless they are executed, so the production code carries four tiny hook
-call sites and this module arms them deterministically from tests:
+rot unless they are executed, so the production code carries tiny hook call
+sites and this module arms them deterministically from tests:
 
   * ``decode_hook(path)``         — data/datasets.load_image: raises
     :class:`InjectedFault` (an OSError) for matching image paths, optionally
@@ -22,6 +22,29 @@ call sites and this module arms them deterministically from tests:
     the process after a given global step (exercises the preemption handler
     end-to-end, including the final boundary checkpoint).
 
+The inference/eval fault-tolerance layer (evaluation/resilience.py) adds the
+serving-shaped failure modes — a query must be retried/quarantined rather
+than abort an hours-long eval run:
+
+  * ``savemat_hook(path)``        — utils/io.atomic_savemat: raises
+    :class:`InjectedFault` for matching artifact paths (optionally only the
+    first k attempts per path), exercising per-query retry around artifact
+    writes.
+  * ``savemat_kill_hook(path)``   — utils/io.atomic_savemat: SIGKILLs the
+    process between the temp-file write and the commit rename — the
+    resume-by-artifact crash window (a ``.tmp`` carcass, no final file).
+  * ``device_error_hook(label)``  — models/ncnet.ResilientJit dispatch:
+    raises :class:`InjectedDeviceError` on selected dispatch-call ordinals
+    (a process-global counter), standing in for a mid-run
+    ``XlaRuntimeError``/OOM so the runtime tier-demotion path executes.
+  * ``hang_fetch_hook(label)``    — evaluation/pipeline.call_with_watchdog:
+    sleeps on selected watchdog-call ordinals, standing in for a hung
+    tunnel fetch that the watchdog must convert into a retryable timeout.
+  * ``journal_kill_hook(n, w)``   — evaluation/resilience.EvalJournal:
+    SIGKILLs mid-append of the Nth journal record, after flushing a TORN
+    prefix of the line via ``w()`` — the resumed run must prove
+    partial-trailing-line tolerance.
+
 Arming: programmatic via :func:`install`/:func:`clear` (or the
 :func:`injected` context manager) in-process, or the ``NCNET_TPU_FAULTS``
 environment variable (a JSON object of :class:`FaultPlan` fields) for
@@ -38,7 +61,8 @@ import json
 import os
 import signal
 import threading
-from typing import Dict, Optional, Tuple
+import time
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -46,6 +70,13 @@ import numpy as np
 class InjectedFault(OSError):
     """An injected I/O failure.  Subclasses OSError so production retry and
     quarantine paths treat it exactly like a real decode error."""
+
+
+class InjectedDeviceError(RuntimeError):
+    """An injected runtime device failure (the test stand-in for a mid-run
+    ``XlaRuntimeError`` / ``RESOURCE_EXHAUSTED``).  Listed in
+    ``models/ncnet.RUNTIME_DEVICE_ERRORS`` so the production tier-demotion
+    path treats it exactly like the real thing."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,12 +96,44 @@ class FaultPlan:
     kill_at_version: int = -1
     # SIGTERM self after this global train step (1-based)
     sigterm_at_step: int = -1
+    # --- eval-path faults (evaluation/resilience.py layer) ---
+    # artifact paths containing this substring raise InjectedFault inside
+    # atomic_savemat (before any bytes are written)
+    savemat_fail_substring: str = ""
+    # -1: every matching savemat fails; k >= 0: only the first k attempts
+    # per path fail (a transient error that per-query retry should absorb)
+    savemat_fail_times: int = -1
+    # SIGKILL self inside atomic_savemat for matching paths, between the
+    # temp-file write and the commit rename (.tmp carcass, no final file)
+    kill_in_savemat_substring: str = ""
+    # dispatch-call ordinals (1-based, process-global counter over
+    # ResilientJit dispatches) that raise InjectedDeviceError
+    device_fail_calls: Tuple[int, ...] = ()
+    # watchdog-call ordinals (1-based, process-global counter over
+    # call_with_watchdog invocations) whose wrapped call sleeps
+    # hang_fetch_seconds — simulating a hung tunnel fetch
+    hang_fetch_calls: Tuple[int, ...] = ()
+    hang_fetch_seconds: float = 30.0
+    # SIGKILL self mid-append of the Nth EvalJournal record (1-based),
+    # flushing a torn prefix of the line first
+    kill_at_journal_append: int = -1
 
 
 _plan: Optional[FaultPlan] = None
 _env_read = False
 _decode_attempts: Dict[str, int] = {}
+_savemat_attempts: Dict[str, int] = {}
+_device_calls = 0
+_watchdog_calls = 0
 _lock = threading.Lock()
+
+
+def _reset_counters_locked() -> None:
+    global _device_calls, _watchdog_calls
+    _decode_attempts.clear()
+    _savemat_attempts.clear()
+    _device_calls = 0
+    _watchdog_calls = 0
 
 
 def install(plan: FaultPlan) -> None:
@@ -78,7 +141,7 @@ def install(plan: FaultPlan) -> None:
     global _plan
     with _lock:
         _plan = plan
-        _decode_attempts.clear()
+        _reset_counters_locked()
 
 
 def clear() -> None:
@@ -87,7 +150,7 @@ def clear() -> None:
     with _lock:
         _plan = None
         _env_read = True  # an explicit clear also wins over the env var
-        _decode_attempts.clear()
+        _reset_counters_locked()
 
 
 @contextlib.contextmanager
@@ -108,9 +171,10 @@ def _active() -> Optional[FaultPlan]:
                 _env_read = True
                 env = os.environ.get("NCNET_TPU_FAULTS", "")
                 if env:
-                    fields = json.loads(env)
-                    if "nan_loss_steps" in fields:
-                        fields["nan_loss_steps"] = tuple(fields["nan_loss_steps"])
+                    fields = {
+                        k: tuple(v) if isinstance(v, list) else v
+                        for k, v in json.loads(env).items()
+                    }
                     _plan = FaultPlan(**fields)
     return _plan
 
@@ -161,3 +225,78 @@ def sigterm_hook(step: int) -> None:
     if p is None or p.sigterm_at_step < 0 or step != p.sigterm_at_step:
         return
     os.kill(os.getpid(), signal.SIGTERM)
+
+
+# ---------------------------------------------------------------------------
+# eval-path hooks
+# ---------------------------------------------------------------------------
+
+
+def savemat_hook(path: str) -> None:
+    """Raise :class:`InjectedFault` when ``path``'s savemat is scheduled to
+    fail (before any bytes reach disk, so no carcass is left)."""
+    p = _active()
+    if p is None or not p.savemat_fail_substring:
+        return
+    if p.savemat_fail_substring not in path:
+        return
+    if p.savemat_fail_times >= 0:
+        with _lock:
+            n = _savemat_attempts.get(path, 0)
+            _savemat_attempts[path] = n + 1
+        if n >= p.savemat_fail_times:
+            return  # transient fault already absorbed by earlier attempts
+    raise InjectedFault(f"injected savemat failure for {path!r}")
+
+
+def savemat_kill_hook(path: str) -> None:
+    """SIGKILL self between the temp write and the commit rename of a
+    matching atomic_savemat (if armed)."""
+    p = _active()
+    if p is None or not p.kill_in_savemat_substring:
+        return
+    if p.kill_in_savemat_substring in path:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def device_error_hook(label: str = "") -> None:
+    """Raise :class:`InjectedDeviceError` on armed dispatch-call ordinals."""
+    p = _active()
+    if p is None or not p.device_fail_calls:
+        return
+    global _device_calls
+    with _lock:
+        _device_calls += 1
+        n = _device_calls
+    if n in p.device_fail_calls:
+        raise InjectedDeviceError(
+            f"injected runtime device failure (dispatch call {n}"
+            + (f", {label}" if label else "") + ")"
+        )
+
+
+def hang_fetch_hook(label: str = "") -> None:
+    """Sleep ``hang_fetch_seconds`` on armed watchdog-call ordinals — the
+    wrapped fetch then overruns its watchdog timeout, which must surface the
+    hang as a retryable FetchTimeoutError."""
+    p = _active()
+    if p is None or not p.hang_fetch_calls:
+        return
+    global _watchdog_calls
+    with _lock:
+        _watchdog_calls += 1
+        n = _watchdog_calls
+    if n in p.hang_fetch_calls:
+        time.sleep(p.hang_fetch_seconds)
+
+
+def journal_kill_hook(n_append: int, write_partial: Callable[[], None]) -> None:
+    """SIGKILL self mid-append of journal record ``n_append`` (if armed),
+    flushing a torn prefix of the record via ``write_partial`` first so the
+    resumed run must tolerate a partial trailing line."""
+    p = _active()
+    if p is None or p.kill_at_journal_append < 0 \
+            or n_append != p.kill_at_journal_append:
+        return
+    write_partial()
+    os.kill(os.getpid(), signal.SIGKILL)

@@ -4,10 +4,16 @@ The eval/localization stages treat an artifact's *existence* as proof its
 work unit completed (the reference's ``exist(...)~=2`` guards, SURVEY §5.3).
 That contract only holds if artifacts appear atomically — a process killed
 mid-``savemat`` must not leave a truncated file that a rerun then skips.
+
+``atomic_write_json`` is the manifest twin: the per-experiment run manifests
+(evaluation/resilience.py) journal completed / quarantined / in-flight work
+units through the same temp-file + ``os.replace`` commit, so a manifest read
+never sees a half-written document.
 """
 
 from __future__ import annotations
 
+import json
 import os
 
 
@@ -16,9 +22,33 @@ def atomic_savemat(path: str, mdict: dict, **kwargs) -> None:
     ``os.replace``, so the file exists only once fully written."""
     from scipy.io import savemat
 
+    from ncnet_tpu.utils import faults
+
+    faults.savemat_hook(path)  # no-op unless a test armed an injected fault
     tmp = path + ".tmp"
     try:
         savemat(tmp, mdict, **kwargs)
+        # injected SIGKILL lands HERE — the resume-by-artifact crash window
+        # (.tmp carcass written, commit rename never runs)
+        faults.savemat_kill_hook(path)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """``json.dump`` to ``path`` via a same-directory temp file +
+    ``os.replace`` — atomicity (a reader never sees a partial document), not
+    durability (no fsync: a lost-but-consistent manifest only costs redone
+    work, which the per-artifact resume already tolerates)."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
         os.replace(tmp, path)
     except BaseException:
         try:

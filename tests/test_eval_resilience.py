@@ -1,0 +1,812 @@
+"""Resilient-inference tests: every eval-path failure mode the round-7 layer
+claims to survive — per-query decode failures, injected runtime device
+errors (tier demotion), hung fetches (watchdog), savemat failures, SIGKILL
+mid-run — is executed deterministically through the ncnet_tpu/utils/faults.py
+harness, whose hooks live inside the production code paths themselves.
+
+The acceptance bars (ISSUE 3):
+  (a) a quarantined query never aborts an eval run and appears in the
+      manifest,
+  (b) SIGKILL at an arbitrary step of PF-Pascal eval resumes to a
+      bitwise-identical PCK result,
+  (c) an injected mid-run Pallas/device runtime failure demotes the tier
+      and the run completes with parity-correct outputs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import jax
+
+from ncnet_tpu import ops
+from ncnet_tpu.config import (
+    EvalInLocConfig,
+    EvalPFPascalConfig,
+    LocalizationConfig,
+    ModelConfig,
+)
+from ncnet_tpu.data.synthetic import write_inloc_like, write_pf_pascal_like
+from ncnet_tpu.evaluation import run_eval, run_inloc_eval
+from ncnet_tpu.evaluation.inloc import match_capacity, validate_matches_mat
+from ncnet_tpu.evaluation.pipeline import (
+    FetchTimeoutError,
+    PipelineDepthController,
+    call_with_watchdog,
+)
+from ncnet_tpu.evaluation.resilience import (
+    EvalJournal,
+    FaultPolicy,
+    RunManifest,
+    classify_failure,
+    run_isolated,
+)
+from ncnet_tpu.models.ncnet import init_ncnet
+from ncnet_tpu.utils import faults
+from ncnet_tpu.utils.faults import FaultPlan
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,), ncons_channels=(1,))
+TINY_INLOC = TINY.replace(half_precision=True, relocalization_k_size=2)
+
+# retry fast in tests: no real backoff sleeps
+FAST = dict(query_retries=1, retry_backoff_s=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts and ends with no armed faults and no demoted
+    tiers — the demotion registry is process-global by design."""
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+
+
+# ---------------------------------------------------------------------------
+# unit: classification, policy loop, manifest, journal, watchdog, controller
+# ---------------------------------------------------------------------------
+
+
+def test_classify_failure_kinds():
+    from ncnet_tpu.data.datasets import SampleDecodeError
+
+    assert classify_failure(FetchTimeoutError("x")) == "timeout"
+    assert classify_failure(faults.InjectedDeviceError("x")) == "device"
+    assert classify_failure(
+        SampleDecodeError("x.jpg", OSError("bad header"))) == "decode"
+    assert classify_failure(faults.InjectedFault("decode failure")) == "decode"
+    assert classify_failure(FileNotFoundError("no such file")) == "io"
+    assert classify_failure(ValueError("boom")) == "other"
+
+
+def test_run_manifest_transitions_and_reload(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    m = RunManifest(path, meta={"experiment": "e1"})
+    m.begin("q1")
+    assert "q1" in m.data["in_flight"]
+    m.complete("q1", skipped=False)
+    m.begin("q2")
+    m.quarantine("q2", "decode", "bad pano", attempts=3)
+    assert m.is_completed("q1") and not m.is_completed("q2")
+
+    # reload from disk: a fresh process sees the same state
+    m2 = RunManifest(path)
+    assert m2.is_completed("q1")
+    assert m2.data["quarantined"]["q2"]["kind"] == "decode"
+    assert m2.data["in_flight"] == []
+    # a re-run to completion leaves quarantine
+    m2.complete("q2")
+    assert not RunManifest(path).data["quarantined"]
+
+    # a manifest whose meta fingerprints a DIFFERENT configuration is not
+    # adopted (same guard as the journal header)
+    m_other = RunManifest(path, meta={"experiment": "e2"})
+    assert m_other.data["completed"] == {}
+    # ...while the matching configuration still resumes it
+    assert RunManifest(path, meta={"experiment": "e1"}).is_completed("q1")
+
+    # an unreadable manifest starts fresh instead of crashing the run
+    with open(path, "w") as f:
+        f.write("{ torn json")
+    m3 = RunManifest(path, meta={"experiment": "e1"})
+    assert m3.data["completed"] == {}
+
+
+def test_run_isolated_retries_then_quarantines(tmp_path):
+    m = RunManifest(str(tmp_path / "m.json"))
+    calls = []
+
+    def flaky_then_ok():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("transient")
+        return 42
+
+    ok, out = run_isolated("u1", flaky_then_ok,
+                           policy=FaultPolicy(1, 0.0, True), manifest=m)
+    assert (ok, out) == (True, 42) and m.is_completed("u1")
+
+    def always_bad():
+        raise OSError("permanent")
+
+    ok, out = run_isolated("u2", always_bad,
+                           policy=FaultPolicy(1, 0.0, True), manifest=m)
+    assert (ok, out) == (False, None)
+    assert m.data["quarantined"]["u2"]["kind"] == "io"
+    assert m.data["quarantined"]["u2"]["attempts"] == 2  # 1 + 1 retry
+
+    # quarantine=False restores fail-fast
+    with pytest.raises(OSError, match="permanent"):
+        run_isolated("u3", always_bad, policy=FaultPolicy(0, 0.0, False))
+
+
+def test_run_isolated_free_retry_on_recovery():
+    """An on_failure recovery (tier demotion) grants an off-budget retry:
+    with retries=0, one recovered failure must still reach success."""
+    calls = []
+    recoveries = []
+
+    def work():
+        calls.append(1)
+        if len(calls) < 2:
+            raise faults.InjectedDeviceError("oom")
+        return "done"
+
+    def on_failure(exc, kind):
+        recoveries.append(kind)
+        return "resident" if len(recoveries) == 1 else None
+
+    ok, out = run_isolated("u", work, policy=FaultPolicy(0, 0.0, True),
+                           on_failure=on_failure)
+    assert (ok, out) == (True, "done")
+    assert recoveries == ["device"]
+
+
+def test_eval_journal_roundtrip_torn_tail_and_header_mismatch(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    header = {"batch_size": 2, "alpha": 0.1}
+    j = EvalJournal(path, header)
+    a0 = np.asarray([0.25, 0.5], dtype=np.float32)
+    a1 = np.asarray([1.0 / 3.0], dtype=np.float32)  # not exactly representable
+    j.append(0, a0)
+    j.append(1, a1)
+    j.close()
+
+    # torn tail: a partial trailing line must be dropped, earlier entries kept
+    with open(path, "a") as f:
+        f.write('{"batch": 2, "pck"')
+    j2 = EvalJournal(path, header)
+    assert sorted(j2.entries) == [0, 1]
+    np.testing.assert_array_equal(j2.entries[0], a0)
+    np.testing.assert_array_equal(j2.entries[1], a1)  # bitwise, not approx
+    # the torn bytes were truncated, so a post-resume append starts on a
+    # fresh line — a SECOND kill/resume cycle must still see every record
+    # (append-onto-partial-line would corrupt the file mid-way)
+    a2 = np.asarray([0.75], dtype=np.float32)
+    j2.append(2, a2)
+    j2.close()
+    j2b = EvalJournal(path, header)
+    assert sorted(j2b.entries) == [0, 1, 2]
+    np.testing.assert_array_equal(j2b.entries[2], a2)
+    j2b.close()
+
+    # a PARSEABLE but newline-less final record (write torn exactly at the
+    # '\n' boundary) is dropped too: accepting it would let the next append
+    # fuse onto it, corrupting the record for every later resume
+    with open(path, "rb") as f:
+        intact = f.read()
+    assert intact.endswith(b"\n")
+    with open(path, "wb") as f:
+        f.write(intact[:-1])
+    j2c = EvalJournal(path, header)
+    assert sorted(j2c.entries) == [0, 1]  # record 2 recomputes
+    j2c.append(2, a2)
+    j2c.close()
+    assert sorted(EvalJournal(path, header).entries) == [0, 1, 2]
+
+    # header mismatch (different settings): fresh start, but the displaced
+    # run's journal is SET ASIDE (.stale), never destroyed at construction
+    j3 = EvalJournal(path, {"batch_size": 4, "alpha": 0.1})
+    assert j3.entries == {}
+    j3.close()
+    stale = EvalJournal(path + ".stale", header)
+    assert sorted(stale.entries) == [0, 1, 2]  # the old run survived intact
+    stale.close()
+
+
+def test_resilient_jit_retrace_actually_retraces():
+    """retrace() must produce a NEW trace (re-consulting the tier chooser),
+    not replay jax's identity-keyed cached jaxpr — re-jitting the same
+    function object silently no-ops (jax 0.4.37), which would make the
+    whole tier-degradation recovery a dead path on a real TPU."""
+    import jax.numpy as jnp
+
+    from ncnet_tpu.models.ncnet import ResilientJit
+
+    traces = [0]
+
+    def f(x, *, flag=False):
+        traces[0] += 1  # counts Python traces, not executions
+        return x + (1 if flag else 2)
+
+    rj = ResilientJit(f, hook=False, static_argnames=("flag",))
+    np.testing.assert_array_equal(np.asarray(rj(jnp.zeros(2), flag=True)),
+                                  [1.0, 1.0])
+    assert traces[0] == 1
+    rj(jnp.zeros(2), flag=True)  # cached: no new trace
+    assert traces[0] == 1
+    rj.retrace()
+    np.testing.assert_array_equal(np.asarray(rj(jnp.zeros(2), flag=True)),
+                                  [1.0, 1.0])
+    assert traces[0] == 2  # the retrace really re-traced
+    rj(jnp.zeros(2), flag=False)  # static_argnames still resolves
+    assert traces[0] == 3
+
+
+def test_quarantine_breaker_trips_on_streak():
+    from ncnet_tpu.evaluation.resilience import (
+        QuarantineBreaker,
+        SystemicEvalError,
+    )
+
+    b = QuarantineBreaker(3)
+    b.note(True)
+    b.note(True)
+    b.note(False)  # a completed unit resets the streak
+    b.note(True)
+    b.note(True)
+    with pytest.raises(SystemicEvalError, match="systemic"):
+        b.note(True)
+    disabled = QuarantineBreaker(0)
+    for _ in range(20):
+        disabled.note(True)  # limit <= 0: never trips
+
+
+def test_eval_journal_torn_write_sealed_before_next_append(tmp_path):
+    """A write that failed part-way (ENOSPC) leaves a torn prefix; the next
+    append must seal it with a newline so the retried record — and every
+    later one — survives the next resume (only the torn line is skipped)."""
+    path = str(tmp_path / "j.jsonl")
+    header = {"v": 1}
+    j = EvalJournal(path, header)
+    a0 = np.asarray([0.5], dtype=np.float32)
+    j.append(0, a0)
+    # simulate the failed-write crash window: torn bytes on disk, dirty flag
+    # set (as _write_raw leaves it when write/flush raises mid-way)
+    j._f.write('{"batch": 1, "pck')
+    j._f.flush()
+    j._dirty = True
+    a1 = np.asarray([0.25], dtype=np.float32)
+    j.append(1, a1)  # the retry after the failed write
+    j.close()
+    j2 = EvalJournal(path, header)
+    assert sorted(j2.entries) == [0, 1]
+    np.testing.assert_array_equal(j2.entries[1], a1)
+    j2.close()
+
+
+def test_inloc_systemic_failure_aborts_not_mass_quarantine(tmp_path):
+    """When EVERY query fails (dead link, wrong dataset root), the run must
+    abort after the consecutive-quarantine limit instead of quarantining an
+    hours-long run one query at a time and exiting 'successfully'."""
+    from ncnet_tpu.evaluation.resilience import SystemicEvalError
+
+    root, params, kw = _inloc_setup(tmp_path, n_queries=6)
+    config = EvalInLocConfig(output_root=os.path.join(root, "m"),
+                             **FAST, **kw)
+    with faults.injected(FaultPlan(decode_fail_substring="query/iphone7")):
+        with pytest.raises(SystemicEvalError, match="consecutive"):
+            run_inloc_eval(config, model_config=TINY_INLOC, params=params,
+                           progress=False)
+
+
+def test_call_with_watchdog_paths():
+    assert call_with_watchdog(lambda x: x + 1, (1,), timeout=0.0) == 2
+    assert call_with_watchdog(lambda: "ok", timeout=5.0) == "ok"
+    with pytest.raises(ValueError, match="inner"):
+        call_with_watchdog(lambda: (_ for _ in ()).throw(ValueError("inner")),
+                           timeout=5.0)
+    with pytest.raises(FetchTimeoutError, match="watchdog"):
+        call_with_watchdog(time.sleep, (5.0,), timeout=0.1, label="hung")
+
+
+def test_controller_note_failure_clears_anchor_and_window(monkeypatch):
+    """After an aborted drain, the next drain must re-anchor instead of
+    recording a refill-spanning wall that could trigger a spurious deepen
+    (the ADVICE r4 bug class, now on the retry path)."""
+    import ncnet_tpu.evaluation.pipeline as pipeline_mod
+
+    now = [0.0]
+    monkeypatch.setattr(pipeline_mod.time, "perf_counter", lambda: now[0])
+    ctl = PipelineDepthController(0, high=0.7, low=0.45)
+    ctl.note_drain()
+    for _ in range(3):
+        now[0] += 0.3
+        ctl.note_drain()
+    assert ctl._ewma == pytest.approx(0.3)
+
+    ctl.note_failure()  # aborted drain: retry + backoff follow
+    assert ctl._t_last is None and ctl._ewma is None
+    assert ctl.best == pytest.approx(0.3)  # device-compute estimate survives
+    now[0] += 100.0  # the retry's refill gap
+    ctl.note_drain()  # re-anchors; must NOT record 100 s
+    assert ctl._ewma is None
+    for _ in range(4):
+        now[0] += 0.3
+        ctl.note_drain()
+    assert ctl.depth == 2  # no spurious deepen from the failure
+
+
+def test_demotion_registry_and_choose_fused_stack(monkeypatch):
+    """demote_fused_tier walks resident → perlayer → None, and
+    choose_fused_stack skips demoted tiers even where the compile probes
+    stay green."""
+    import importlib
+
+    import ncnet_tpu.ops.nc_fused_lane as lane
+
+    # the package re-exports a FUNCTION named conv4d, shadowing the module
+    # attribute — resolve the module through importlib
+    conv4d_mod = importlib.import_module("ncnet_tpu.ops.conv4d")
+    monkeypatch.setattr(conv4d_mod, "_pallas_available", lambda: True)
+    for name in ("fused_resident_feasible", "fused_resident_compiles",
+                 "fused_lane_feasible", "fused_lane_compiles"):
+        monkeypatch.setattr(lane, name, lambda *a, **k: True)
+
+    args = (25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+    assert lane.choose_fused_stack(*args) == "resident"
+    assert lane.demote_fused_tier() == "resident"
+    assert lane.choose_fused_stack(*args) == "perlayer"
+    assert lane.demote_fused_tier() == "perlayer"
+    assert lane.choose_fused_stack(*args) is None
+    assert lane.demote_fused_tier() is None  # nothing left: real error
+    assert lane.demoted_fused_tiers() == {"resident", "perlayer"}
+    lane.reset_fused_tier_demotions()
+    assert lane.choose_fused_stack(*args) == "resident"
+
+
+# ---------------------------------------------------------------------------
+# InLoc eval: per-query isolation end to end
+# ---------------------------------------------------------------------------
+
+
+def _inloc_setup(tmp_path, n_queries=3, n_panos=1):
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=n_queries, n_panos=n_panos,
+                                 image_hw=(96, 128))
+    params = init_ncnet(TINY_INLOC, jax.random.key(0))
+    kw = dict(
+        inloc_shortlist=shortlist, k_size=2, image_size=128,
+        n_queries=n_queries, n_panos=n_panos,
+        pano_path=os.path.join(root, "pano"),
+        query_path=os.path.join(root, "query", "iphone7"),
+    )
+    return root, params, kw
+
+
+def _load_all_matches(out_dir):
+    from scipy.io import loadmat
+
+    out = {}
+    for name in sorted(os.listdir(out_dir)):
+        if name.endswith(".mat"):
+            out[name] = loadmat(os.path.join(out_dir, name))["matches"]
+    return out
+
+
+def test_inloc_permanent_decode_failure_quarantines_not_aborts(tmp_path):
+    """Acceptance (a): a query whose image never decodes is retried, then
+    quarantined into the manifest; the OTHER queries' .mat files are
+    written and the run returns normally."""
+    root, params, kw = _inloc_setup(tmp_path)
+    config = EvalInLocConfig(
+        output_root=os.path.join(root, "m"), **FAST, **kw)
+    with faults.injected(FaultPlan(decode_fail_substring="query_1.jpg")):
+        out_dir = run_inloc_eval(config, model_config=TINY_INLOC,
+                                 params=params, progress=False)
+    names = sorted(n for n in os.listdir(out_dir) if n.endswith(".mat"))
+    assert names == ["1.mat", "3.mat"]  # query 2 (file 2.mat) given up on
+    manifest = json.load(open(os.path.join(out_dir, "manifest.json")))
+    assert manifest["quarantined"]["query_2"]["kind"] == "decode"
+    assert manifest["quarantined"]["query_2"]["attempts"] == 2  # 1 + 1 retry
+    assert set(manifest["completed"]) == {"query_1", "query_3"}
+    assert manifest["in_flight"] == []
+
+
+def test_inloc_transient_decode_failure_absorbed_by_retry(tmp_path):
+    """A decode fault that clears on the second attempt costs one retry and
+    nothing else — every query completes identically to a clean run."""
+    root, params, kw = _inloc_setup(tmp_path, n_queries=2)
+    clean = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "clean"), **FAST, **kw),
+        model_config=TINY_INLOC, params=params, progress=False)
+    with faults.injected(FaultPlan(decode_fail_substring="query_0.jpg",
+                                   decode_fail_times=1)):
+        faulty = run_inloc_eval(
+            EvalInLocConfig(output_root=os.path.join(root, "f"), **FAST, **kw),
+            model_config=TINY_INLOC, params=params, progress=False)
+    a, b = _load_all_matches(clean), _load_all_matches(faulty)
+    assert sorted(a) == sorted(b) == ["1.mat", "2.mat"]
+    for name in a:
+        np.testing.assert_array_equal(a[name], b[name])
+    manifest = json.load(open(os.path.join(faulty, "manifest.json")))
+    assert not manifest["quarantined"]
+
+
+def test_inloc_device_error_demotes_tier_and_completes_parity(tmp_path):
+    """Acceptance (c): an injected runtime device failure on the first pair
+    dispatch demotes the fused tier, re-traces, and the run completes with
+    outputs identical to a clean run (on CPU both runs execute the XLA
+    stack; the demotion is registry-visible)."""
+    root, params, kw = _inloc_setup(tmp_path, n_queries=2)
+    clean = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "clean"), **FAST, **kw),
+        model_config=TINY_INLOC, params=params, progress=False)
+    assert ops.demoted_fused_tiers() == frozenset()
+    with faults.injected(FaultPlan(device_fail_calls=(1,))):
+        faulty = run_inloc_eval(
+            EvalInLocConfig(output_root=os.path.join(root, "f"), **FAST, **kw),
+            model_config=TINY_INLOC, params=params, progress=False)
+    assert ops.demoted_fused_tiers() == {"resident"}
+    a, b = _load_all_matches(clean), _load_all_matches(faulty)
+    for name in a:
+        np.testing.assert_array_equal(a[name], b[name])
+    manifest = json.load(open(os.path.join(faulty, "manifest.json")))
+    assert set(manifest["completed"]) == {"query_1", "query_2"}
+    assert not manifest["quarantined"]
+
+
+def test_inloc_hung_fetch_becomes_retryable_timeout(tmp_path):
+    """A hung fetch (injected sleep > watchdog budget) surfaces as a
+    FetchTimeoutError, the query retries, and the run completes with
+    parity-correct outputs."""
+    root, params, kw = _inloc_setup(tmp_path, n_queries=1)
+    clean = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "clean"), **FAST, **kw),
+        model_config=TINY_INLOC, params=params, progress=False)
+    with faults.injected(FaultPlan(hang_fetch_calls=(1,),
+                                   hang_fetch_seconds=10.0)):
+        faulty = run_inloc_eval(
+            EvalInLocConfig(output_root=os.path.join(root, "f"),
+                            fetch_timeout_s=0.5, **FAST, **kw),
+            model_config=TINY_INLOC, params=params, progress=False)
+    a, b = _load_all_matches(clean), _load_all_matches(faulty)
+    np.testing.assert_array_equal(a["1.mat"], b["1.mat"])
+    manifest = json.load(open(os.path.join(faulty, "manifest.json")))
+    assert set(manifest["completed"]) == {"query_1"}
+
+
+def test_inloc_transient_savemat_failure_retried(tmp_path):
+    """An artifact write that fails once (flaky NFS) is absorbed by the
+    per-query retry; the artifact appears and validates."""
+    root, params, kw = _inloc_setup(tmp_path, n_queries=1)
+    config = EvalInLocConfig(output_root=os.path.join(root, "m"), **FAST, **kw)
+    with faults.injected(FaultPlan(savemat_fail_substring="1.mat",
+                                   savemat_fail_times=1)):
+        out_dir = run_inloc_eval(config, model_config=TINY_INLOC,
+                                 params=params, progress=False)
+    n_cap = match_capacity(128, 2, both_directions=True)
+    assert validate_matches_mat(os.path.join(out_dir, "1.mat"), 1, n_cap)
+    manifest = json.load(open(os.path.join(out_dir, "manifest.json")))
+    assert manifest["completed"]["query_1"] == {}
+    assert not manifest["quarantined"]
+
+
+def test_inloc_skip_existing_validates_artifact(tmp_path):
+    """A foreign/truncated .mat under skip_existing is recomputed instead of
+    silently poisoning the downstream PnP stage; a VALID artifact is still
+    skipped untouched.  'Foreign' means the run manifest cannot vouch for
+    it — manifest-vouched artifacts skip the per-resume loadmat validation
+    entirely (our writer commits atomically, so they cannot be torn)."""
+    root, params, kw = _inloc_setup(tmp_path, n_queries=2)
+    config = EvalInLocConfig(output_root=os.path.join(root, "m"), **FAST, **kw)
+    out_dir = run_inloc_eval(config, model_config=TINY_INLOC, params=params,
+                             progress=False)
+    good = _load_all_matches(out_dir)
+    p1, p2 = (os.path.join(out_dir, n) for n in ("1.mat", "2.mat"))
+    # foreign provenance: artifacts present but no manifest vouches for them
+    # (e.g. hand-copied into a fresh experiment directory)
+    os.remove(os.path.join(out_dir, "manifest.json"))
+    with open(p1, "wb") as f:
+        f.write(b"MATLAB 5.0 -- truncated garbage")
+    mtime2 = os.path.getmtime(p2)
+    n_cap = match_capacity(128, 2, both_directions=True)
+    assert not validate_matches_mat(p1, 2, n_cap)
+
+    out_dir2 = run_inloc_eval(config, model_config=TINY_INLOC, params=params,
+                              progress=False)
+    assert out_dir2 == out_dir
+    recomputed = _load_all_matches(out_dir)
+    np.testing.assert_array_equal(recomputed["1.mat"], good["1.mat"])
+    assert os.path.getmtime(p2) == mtime2  # valid artifact untouched
+
+
+def test_inloc_quarantine_false_restores_fail_fast(tmp_path):
+    root, params, kw = _inloc_setup(tmp_path, n_queries=1)
+    config = EvalInLocConfig(output_root=os.path.join(root, "m"),
+                             quarantine=False, query_retries=0,
+                             retry_backoff_s=0.0, **kw)
+    with faults.injected(FaultPlan(decode_fail_substring="query_0.jpg")):
+        with pytest.raises(faults.InjectedFault):
+            run_inloc_eval(config, model_config=TINY_INLOC, params=params,
+                           progress=False)
+
+
+def test_inloc_kill_mid_savemat_then_resume_is_bitwise_identical(tmp_path):
+    """SIGKILL between a per-query artifact's temp write and its commit
+    rename: the rerun must skip the intact query-1 artifact untouched,
+    recompute the torn query, and end with a .mat set bitwise-identical to
+    an uninterrupted run."""
+    root, params, kw = _inloc_setup(tmp_path, n_queries=3)
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {_REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ncnet_tpu.config import EvalInLocConfig, ModelConfig
+from ncnet_tpu.evaluation import run_inloc_eval
+from ncnet_tpu.models.ncnet import init_ncnet
+
+model_config = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                           ncons_channels=(1,), half_precision=True,
+                           relocalization_k_size=2)
+params = init_ncnet(model_config, jax.random.key(0))
+config = EvalInLocConfig(
+    inloc_shortlist={kw['inloc_shortlist']!r},
+    k_size=2, image_size=128, n_queries=3, n_panos=1,
+    pano_path={kw['pano_path']!r},
+    query_path={kw['query_path']!r},
+    output_root={os.path.join(root, 'm')!r},
+    query_retries=1, retry_backoff_s=0.0,
+)
+run_inloc_eval(config, model_config=model_config, params=params,
+               progress=False)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # identical device topology to the in-process runs (conftest's 8 virtual
+    # CPU devices): the bitwise bar tolerates no reassociation
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["NCNET_TPU_FAULTS"] = json.dumps(
+        {"kill_in_savemat_substring": os.sep + "2.mat"})
+    proc = subprocess.run(
+        [sys.executable, str(worker)], env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == -9, f"expected SIGKILL, got:\n{proc.stdout[-3000:]}"
+
+    out_dir = os.path.join(root, "m",
+                           next(os.walk(os.path.join(root, "m")))[1][0])
+    names = sorted(n for n in os.listdir(out_dir) if n.endswith(".mat"))
+    assert names == ["1.mat"]  # 2.mat torn mid-commit, 3.mat never reached
+    assert os.path.exists(os.path.join(out_dir, "2.mat.tmp"))
+    mtime1 = os.path.getmtime(os.path.join(out_dir, "1.mat"))
+
+    # the rerun (same output root) resumes: skips 1, recomputes 2 and 3
+    config = EvalInLocConfig(output_root=os.path.join(root, "m"),
+                             **FAST, **kw)
+    resumed_dir = run_inloc_eval(config, model_config=TINY_INLOC,
+                                 params=params, progress=False)
+    assert resumed_dir == out_dir
+    assert os.path.getmtime(os.path.join(out_dir, "1.mat")) == mtime1
+
+    # the uninterrupted twin
+    full_dir = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "full"), **FAST, **kw),
+        model_config=TINY_INLOC, params=params, progress=False)
+    a, b = _load_all_matches(resumed_dir), _load_all_matches(full_dir)
+    assert sorted(a) == sorted(b) == ["1.mat", "2.mat", "3.mat"]
+    for name in a:
+        np.testing.assert_array_equal(a[name], b[name])
+
+
+# ---------------------------------------------------------------------------
+# PF-Pascal eval: journaled resume + per-batch isolation
+# ---------------------------------------------------------------------------
+
+
+def _pf_setup(tmp_path, n_pairs=5, seed=7):
+    root = str(tmp_path / "data")
+    write_pf_pascal_like(root, n_pairs=n_pairs, image_hw=(96, 96),
+                         shift=(16, 16), seed=seed)
+    return root
+
+
+def _pf_run(root, journal_dir="", net=None, fetch_timeout_s=0.0, **kw):
+    from ncnet_tpu import models
+
+    config = EvalPFPascalConfig(image_size=96, eval_dataset_path=root,
+                                journal_dir=journal_dir, query_retries=1,
+                                retry_backoff_s=0.0,
+                                fetch_timeout_s=fetch_timeout_s)
+    if net is None:
+        net = models.NCNet(TINY, seed=0)
+    return run_eval(config, net=net, batch_size=1, num_workers=0,
+                    progress=False, **kw)
+
+
+def test_pf_pascal_quarantined_batch_never_aborts(tmp_path):
+    """Acceptance (a), PF-Pascal shape: a batch whose dispatch keeps
+    failing after every recovery (both tiers demoted, retries exhausted) is
+    quarantined — its pairs score invalid — and the rest of the run
+    completes."""
+    root = _pf_setup(tmp_path)
+    journal_dir = str(tmp_path / "j")
+    # calls 1-4: batch 0's dispatch + its retries (two demotion free
+    # retries, then the counted budget); call 5+ (later batches) succeed
+    with faults.injected(FaultPlan(device_fail_calls=(1, 2, 3, 4))):
+        stats = _pf_run(root, journal_dir=journal_dir, pipeline_depth=1)
+    assert stats["quarantined_batches"] == [0]
+    assert stats["total"] == 5 and stats["valid"] == 4
+    assert np.isnan(stats["per_pair"][0])
+    assert np.isfinite(stats["per_pair"][1:]).all()
+    manifest = json.load(open(os.path.join(journal_dir, "manifest.json")))
+    assert manifest["quarantined"]["batch_0"]["kind"] == "device"
+    assert ops.demoted_fused_tiers() == {"resident", "perlayer"}
+
+
+def test_pf_pascal_device_error_demotes_and_completes_parity(tmp_path):
+    """Acceptance (c), PF-Pascal shape: one injected device failure →
+    demote + re-trace + free retry; the per-pair PCK matches a clean run
+    exactly."""
+    root = _pf_setup(tmp_path)
+    clean = _pf_run(root)
+    with faults.injected(FaultPlan(device_fail_calls=(1,))):
+        faulty = _pf_run(root, pipeline_depth=1)
+    np.testing.assert_array_equal(clean["per_pair"], faulty["per_pair"])
+    assert faulty["quarantined_batches"] == []
+    assert ops.demoted_fused_tiers() == {"resident"}
+
+
+def test_pf_pascal_hung_fetch_retried_with_parity(tmp_path):
+    root = _pf_setup(tmp_path)
+    clean = _pf_run(root)
+    with faults.injected(FaultPlan(hang_fetch_calls=(1,),
+                                   hang_fetch_seconds=10.0)):
+        faulty = _pf_run(root, pipeline_depth=1, fetch_timeout_s=0.5)
+    np.testing.assert_array_equal(clean["per_pair"], faulty["per_pair"])
+    assert faulty["quarantined_batches"] == []
+
+
+def test_pf_pascal_journal_rerun_reuses_results_bitwise(tmp_path):
+    """A completed journaled run re-invoked with the same settings replays
+    every batch from the journal (nothing re-dispatched) and returns the
+    identical result; a different-settings journal is discarded."""
+    root = _pf_setup(tmp_path)
+    journal_dir = str(tmp_path / "j")
+    first = _pf_run(root, journal_dir=journal_dir)
+    journal_path = os.path.join(journal_dir, "pck_journal.jsonl")
+    n_lines = len(open(journal_path).read().splitlines())
+    assert n_lines == 1 + 5  # header + one record per batch
+
+    second = _pf_run(root, journal_dir=journal_dir)
+    np.testing.assert_array_equal(first["per_pair"], second["per_pair"])
+    # nothing re-dispatched → nothing re-journaled
+    assert len(open(journal_path).read().splitlines()) == n_lines
+
+    # a batch_size change invalidates the journal (header mismatch)
+    config = EvalPFPascalConfig(image_size=96, eval_dataset_path=root,
+                                journal_dir=journal_dir)
+    from ncnet_tpu import models
+
+    stats = run_eval(config, net=models.NCNet(TINY, seed=0), batch_size=5,
+                     num_workers=0, progress=False)
+    assert stats["total"] == 5
+
+
+def test_pf_pascal_kill_mid_eval_resumes_bitwise(tmp_path):
+    """Acceptance (b): SIGKILL mid-journal-append at an arbitrary step of
+    PF-Pascal eval (a torn trailing record on disk); the rerun resumes from
+    the journal and the final per-pair PCK — and its mean — is
+    bitwise-identical to an uninterrupted run."""
+    root = _pf_setup(tmp_path)
+    journal_dir = str(tmp_path / "j")
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {_REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ncnet_tpu import models
+from ncnet_tpu.config import EvalPFPascalConfig, ModelConfig
+from ncnet_tpu.evaluation import run_eval
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                   ncons_channels=(1,))
+config = EvalPFPascalConfig(image_size=96, eval_dataset_path={root!r},
+                            journal_dir={journal_dir!r})
+run_eval(config, net=models.NCNet(TINY, seed=0), batch_size=1,
+         num_workers=0, progress=False)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["NCNET_TPU_FAULTS"] = json.dumps({"kill_at_journal_append": 3})
+    proc = subprocess.run(
+        [sys.executable, str(worker)], env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == -9, f"expected SIGKILL, got:\n{proc.stdout[-3000:]}"
+
+    journal_path = os.path.join(journal_dir, "pck_journal.jsonl")
+    lines = open(journal_path).read().splitlines()
+    assert len(lines) == 1 + 3  # header + 2 complete records + 1 TORN record
+    with pytest.raises(ValueError):
+        json.loads(lines[-1])  # the torn mid-append prefix
+
+    resumed = _pf_run(root, journal_dir=journal_dir)
+    full = _pf_run(root)
+    np.testing.assert_array_equal(resumed["per_pair"], full["per_pair"])
+    assert resumed["pck"] == full["pck"]
+    assert resumed["valid"] == full["valid"] == 5
+
+
+def test_pf_pascal_corrupt_image_scores_invalid_not_double_counted(tmp_path):
+    """A corrupt eval image must not abort the run — the loader substitutes
+    the next healthy sample so the pipeline keeps flowing — but the metric
+    must not count the substitute twice: the corrupt PAIR scores
+    NaN=invalid, and the reported PCK equals the clean pairs' mean."""
+    root = _pf_setup(tmp_path)
+    bad = os.path.join(root, "images", "test_0_a.jpg")
+    with open(bad, "wb") as f:
+        f.write(b"\xff\xd8garbage")
+    stats = _pf_run(root)
+    assert stats["decode_quarantined"] == [bad]
+    assert stats["total"] == 5 and stats["valid"] == 4
+    assert np.isnan(stats["per_pair"][0])
+    assert np.isfinite(stats["per_pair"][1:]).all()
+    assert stats["pck"] == pytest.approx(float(np.mean(stats["per_pair"][1:])))
+
+
+# ---------------------------------------------------------------------------
+# localization driver: classified per-query PnP failure handling
+# ---------------------------------------------------------------------------
+
+
+def test_pnp_stage_quarantines_query_with_broken_matches(tmp_path):
+    """A query whose matches .mat is missing is classified ('io'),
+    quarantined into the stage manifest, and excluded from the ImgList —
+    the stage completes instead of aborting at the first worker exception.
+    A degraded run must NOT write the stage-level resume .mat (the
+    exists-guard would pin the partial ImgList forever); the rerun retries
+    the quarantined query instead of reloading the degraded artifact."""
+    from ncnet_tpu.localization.driver import (
+        _pnp_dirname,
+        _pnp_matname,
+        run_pnp_stage,
+    )
+
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=1, n_panos=1,
+                                 image_hw=(96, 128))
+    config = LocalizationConfig(
+        matches_dir=os.path.join(root, "missing_matches"),
+        shortlist=shortlist,
+        query_path=os.path.join(root, "query", "iphone7"),
+        output_dir=os.path.join(root, "out"),
+        query_retries=1, retry_backoff_s=0.0, progress=False,
+    )
+    imglist = run_pnp_stage(config)
+    assert imglist == []
+    manifest_path = os.path.join(
+        root, "out", _pnp_dirname(config), "manifest.json")
+    manifest = json.load(open(manifest_path))
+    assert manifest["quarantined"]["query_0.jpg"]["kind"] == "io"
+    assert manifest["quarantined"]["query_0.jpg"]["attempts"] == 2
+    # no stage resume artifact was pinned; the rerun retries the query
+    assert not os.path.exists(os.path.join(root, "out", _pnp_matname(config)))
+    assert run_pnp_stage(config) == []
+    manifest = json.load(open(manifest_path))
+    assert manifest["quarantined"]["query_0.jpg"]["attempts"] == 2  # retried

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Exercise REAL runtime tier demotion on a TPU backend.
+
+The tier-1 tests prove the demote-retrace-retry machinery with an INJECTED
+device error on CPU (where every tier resolves to XLA anyway).  This probe is
+for the next TPU-attached session: it runs the InLoc-shaped forward at a
+resident-eligible shape, confirms which tier ``choose_fused_stack`` picks,
+then demotes tiers one at a time and verifies (1) the re-traced program
+really lands on the next tier, (2) outputs stay parity-correct across tiers
+(the guarantee the eval loops' mid-run recovery relies on), and (3) an
+injected dispatch failure routed through ``recover_from_device_failure``
+produces the same demotion end-to-end.
+
+Usage: python tools/eval_faults_probe.py [side]
+
+(side: square volume side, default 25 — the PF-Pascal shape class; the
+InLoc rectangular class is covered by the resident kernel's own probes,
+tools/nc_resident_probe.py.)
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+DT = jnp.bfloat16
+
+
+def make_params(key):
+    params = []
+    for (ci, co) in [(1, 16), (16, 16), (16, 1)]:
+        key, k1, k2 = jax.random.split(key, 3)
+        params.append({
+            "w": jax.random.normal(k1, (5, 5, 5, 5, ci, co), DT) * 0.05,
+            "b": jax.random.normal(k2, (co,), DT) * 0.1,
+        })
+    return params
+
+
+def main():
+    from ncnet_tpu.models.ncnet import (
+        ResilientJit,
+        recover_from_device_failure,
+    )
+    from ncnet_tpu.ops import (
+        choose_fused_stack,
+        demoted_fused_tiers,
+        nc_stack_fused,
+        reset_fused_tier_demotions,
+    )
+    from ncnet_tpu.utils import faults
+
+    print(f"device={jax.devices()[0].device_kind} S={S}")
+    params = make_params(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (1, S, S, S, S, 1), DT)
+    kernels, channels = (5, 5, 5), (16, 16, 1)
+
+    # the counter increments at TRACE time: after every retrace it must
+    # move, or the demotion path is replaying a stale cached executable and
+    # the "tier" printed below is a lie (the jit identity-cache trap)
+    traces = [0]
+
+    def body(p, v):
+        traces[0] += 1
+        return nc_stack_fused(p, v)
+
+    fwd = ResilientJit(body, label="probe")
+
+    def tier():
+        return choose_fused_stack(S, S, S, S, kernels, channels)
+
+    reset_fused_tier_demotions()
+    outputs = {}
+    seen = []
+    # walk the ladder: whatever tier the chooser picks now, demote it, and
+    # confirm the re-traced program still agrees numerically
+    from ncnet_tpu.ops import demote_fused_tier
+
+    while True:
+        t = tier() or "xla"
+        seen.append(t)
+        n_traces = traces[0]
+        outputs[t] = np.asarray(fwd(params, x), np.float32)
+        assert traces[0] == n_traces + 1, (
+            "dispatch replayed a stale cached executable — retrace() did "
+            "not actually re-trace; the printed tier is not what ran"
+        )
+        print(f"tier={t}: ran OK "
+              f"(demoted so far: {sorted(demoted_fused_tiers())})")
+        if t == "xla":
+            break
+        demote_fused_tier(t)
+        fwd.retrace()
+    print("tier ladder:", " -> ".join(seen))
+    ref = outputs["xla"]
+    for t, out in outputs.items():
+        err = float(np.max(np.abs(out - ref)))
+        print(f"parity {t} vs xla: max|diff|={err:.3e}")
+        assert err < 0.1, f"tier {t} diverged from XLA"
+
+    # end-to-end: an injected dispatch failure routed through the production
+    # recovery demotes exactly one tier and the retry completes
+    reset_fused_tier_demotions()
+    fwd.retrace()
+    start = tier() or "xla"
+    faults.install(faults.FaultPlan(device_fail_calls=(2,)))
+    try:
+        fwd(params, x)  # call 1: fine
+        try:
+            fwd(params, x)  # call 2: injected failure
+            raise AssertionError("injected device error did not fire")
+        except faults.InjectedDeviceError as e:
+            demoted = recover_from_device_failure(e, fwd)
+        out = np.asarray(fwd(params, x), np.float32)  # call 3: next tier
+    finally:
+        faults.clear()
+        reset_fused_tier_demotions()
+    err = float(np.max(np.abs(out - ref)))
+    print(f"recovery: started on '{start}', demoted '{demoted}', "
+          f"retry completed with max|diff|={err:.3e} vs xla")
+    assert start == "xla" or demoted == start
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
