@@ -44,6 +44,18 @@ _PEAK_HBM_GBPS = {
 }
 
 
+def _arch_filter_flops(feat_side: int) -> float:
+    """True per-pair FLOPs of the SYMMETRIC NC filter at the bench arch
+    (~281.2 GFLOP at the 25⁴ volume) — the constant algorithmic-MFU
+    numerator shared by the roofline block and the train-step MFU
+    (correlation + mutual matching are <1% each)."""
+    cells = (feat_side * feat_side) ** 2
+    chans = list(zip((1,) + CHANNELS[:-1], CHANNELS))
+    return 2 * cells * sum(
+        2 * (k ** 4) * ci * co for k, (ci, co) in zip(KERNELS, chans)
+    )
+
+
 def _timeit_scan(step_fn, make_input, per=1, n_long=6, reps=3):
     """Steady-state ms/iteration via scan-length differencing.
 
@@ -383,13 +395,9 @@ def bench_jax(res=None):
                 jax.ShapeDtypeStruct((1, IMAGE, IMAGE, 3), jnp.float32),
             ).shape
             cells = (feat_shape[1] * feat_shape[2]) ** 2  # 25^4 volume
-            # per-pair FLOPs of the symmetric NC stack: 2 passes x
-            # sum_layers 2*k^4*ci*co per cell (correlation+mm are <1% each)
             sym = 2
             chans = list(zip((1,) + CHANNELS[:-1], CHANNELS))
-            flops = sym * cells * sum(
-                2 * (k**4) * ci * co for k, (ci, co) in zip(KERNELS, chans)
-            )
+            flops = _arch_filter_flops(feat_shape[1])
             # bf16 bytes: algorithmic minimum = each layer reads/writes the
             # whole volume at its channel widths, + 2 mutual-matching passes
             bpv = 2 * cells  # bytes per 1-channel bf16 volume
@@ -639,10 +647,22 @@ def bench_jax(res=None):
     # accumulation path (training/loss.py weak_loss_and_grads, r4) caps live
     # memory at one chunk, so the full reference batch fits one 16G chip in
     # BOTH precisions — the ladder is only a compile-failure fallback.
-    def measure_train(bs_try, half):
+    # Since r7 the bf16 step routes the NC filter through the resident
+    # Pallas forward + backward where the compile probes pass
+    # (ops/nc_fused_lane_vjp.py): r6 measured 1148.9 ms at bs=16 (~72
+    # ms/pair fp32, 17.2 pairs/s bf16) with the backward on the XLA conv4d
+    # formulations — ~10× the ~6 forward-equivalents a pos+neg weak step
+    # should cost; the fwd/bwd/update decomposition and train_bf16_mfu_pct
+    # below attribute whatever gap remains.
+    def measure_train(bs_try, half, fold_pos_neg=None):
+        """Full-step ms; ``fold_pos_neg`` not None pins the WHOLE-BATCH
+        backward (accum_chunks=0) with/without the pos+neg fold — the
+        evidence pair for flipping the fold default next TPU session."""
         tcfg = TrainConfig(
             model=cfg.replace(half_precision=half), batch_size=bs_try,
             data_parallel=False,
+            **({} if fold_pos_neg is None
+               else {"accum_chunks": 0, "fold_pos_neg": fold_pos_neg}),
         )
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
@@ -650,6 +670,7 @@ def bench_jax(res=None):
         step = training.make_train_step(
             mcfg, optimizer, donate=False, stop_backbone_grad=True,
             accum_chunks=tcfg.accum_chunks,
+            fold_pos_neg=tcfg.fold_pos_neg,
         )
 
         def train_out(src, tgt):
@@ -697,12 +718,95 @@ def bench_jax(res=None):
             try:
                 ms = measure_train(bs_try, half=True)
                 res["train_pairs_per_sec_bf16"] = bs_try / (ms * 1e-3)
+                res["train_step_ms_bf16"] = ms
+                res["train_batch_size_bf16"] = bs_try
                 break
             except Exception as e:
                 import sys
 
                 print(f"train bench bf16 bs={bs_try} failed: {str(e)[:200]}",
                       file=sys.stderr)
+
+    # fwd/bwd/update device-wall decomposition of the bf16 step (ISSUE r7)
+    # by prefix differencing, like the filter_stage_* metrics: forward =
+    # the loss value alone, backward = (loss+grads) − forward, update =
+    # full step − (loss+grads).  Plus train_bf16_mfu_pct on the
+    # 6×-filter-FLOP algorithmic basis (a pos+neg weak step is 2 symmetric
+    # filter forwards + a ~2×-forward backward = 6 filter-equivalents;
+    # backbone/correlation/score are <5% of that).  TPU-gated like the
+    # InLoc metric — two extra steady-state compiles; NCNET_BENCH_TRAIN_
+    # BREAKDOWN=1 forces it elsewhere.
+    flag = os.environ.get("NCNET_BENCH_TRAIN_BREAKDOWN")
+    want_breakdown = (flag not in ("0", "") if flag is not None
+                      else "TPU" in jax.devices()[0].device_kind)
+    if want_breakdown and res.get("train_step_ms_bf16") is not None \
+            and res.get("train_bwd_ms_bf16") is None:
+        def _train_parts():
+            from ncnet_tpu.training.loss import weak_loss, weak_loss_and_grads
+
+            bs = res["train_batch_size_bf16"]
+            tcfg = TrainConfig(
+                model=cfg.replace(half_precision=True), batch_size=bs,
+                data_parallel=False,
+            )
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                state, _, mcfg, _ = training.create_train_state(tcfg)
+
+            def fwd_out(src, tgt):
+                return weak_loss(
+                    mcfg, state.params,
+                    {"source_image": src, "target_image": tgt},
+                    stop_backbone_grad=True,
+                )[None]
+
+            def grads_out(src, tgt):
+                loss, g = weak_loss_and_grads(
+                    mcfg, state.params,
+                    {"source_image": src, "target_image": tgt},
+                )
+                dep = sum(
+                    jnp.sum(leaf.astype(jnp.float32))
+                    for layer in g["nc"] for leaf in layer.values()
+                )
+                return (loss.astype(jnp.float32) + dep * 1e-6)[None]
+
+            fwd_ms = _timeit_scan(
+                chain_step(fwd_out), image_pair_input(bs), n_long=4, reps=3)
+            grads_ms = _timeit_scan(
+                chain_step(grads_out), image_pair_input(bs), n_long=4, reps=3)
+            step_ms = res["train_step_ms_bf16"]
+            res["train_fwd_ms_bf16"] = round(fwd_ms, 2)
+            res["train_bwd_ms_bf16"] = round(max(grads_ms - fwd_ms, 0.0), 2)
+            res["train_update_ms_bf16"] = round(max(step_ms - grads_ms, 0.0), 2)
+            feat_shape = jax.eval_shape(
+                lambda p, x: extract_features(cfg, p, x),
+                params, jax.ShapeDtypeStruct((1, IMAGE, IMAGE, 3), jnp.float32),
+            ).shape
+            peak = _PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+            if peak:
+                step_flops = 6 * _arch_filter_flops(feat_shape[1])
+                res["train_bf16_mfu_pct"] = round(
+                    100 * (step_flops / (step_ms / bs * 1e-3) / 1e12) / peak,
+                    2)
+            return True
+
+        _with_retries(_train_parts, label="train_breakdown")
+
+    # folded vs unfolded whole-batch backward (ISSUE r7 satellite): the
+    # fold measured NO faster on the r4 XLA backward; the resident Pallas
+    # VJP changes the trade (one 2B-volume backward program per chunk), so
+    # re-measure both so the fold_pos_neg default can flip on evidence.
+    # Whole-batch backward programs historically stressed the tunnel
+    # compile-helper, hence the small batch and per-metric retries.
+    if want_breakdown and res.get("train_step_ms_bf16") is not None:
+        fold_bs = min(res.get("train_batch_size_bf16", 8), 8)
+        for key_name, fold in (("train_step_ms_bf16_foldpn", True),
+                               ("train_step_ms_bf16_unfolded", False)):
+            put(key_name,
+                lambda fold=fold: measure_train(fold_bs, half=True,
+                                                fold_pos_neg=fold),
+                label=key_name)
     return res
 
 

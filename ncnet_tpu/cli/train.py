@@ -50,6 +50,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "only): -1 auto (default, the fastest measured "
                         "backward — any batch fits one 16G chip), 0 "
                         "whole-batch backward, >1 explicit chunk count")
+    p.add_argument("--fold_pos_neg", action="store_true",
+                   help="run the positive+negative volumes through ONE "
+                        "2B-batch NC-filter call instead of two B-sized "
+                        "calls (identical math; only applies with "
+                        "--accum_chunks 0 — the chunked path already folds "
+                        "the 2B volume batch).  Measured NO faster on the "
+                        "r4 XLA backward; bench.py now measures it on the "
+                        "Pallas-VJP path so the default can flip on "
+                        "evidence")
+    p.add_argument("--no_nc_pallas_vjp", action="store_true",
+                   help="disable the resident Pallas NC backward (round 7 "
+                        "training default where the shape class compiles) "
+                        "and keep the XLA conv4d formulations under "
+                        "value_and_grad")
     # fault tolerance (see the training/train.py module docstring)
     p.add_argument("--checkpoint_steps", type=int, default=0,
                    help="also checkpoint every N train steps (atomic "
@@ -107,6 +121,8 @@ def main(argv=None) -> int:
         remat_nc_layers=args.remat_nc_layers,
         nc_custom_grad=args.nc_custom_grad,
         accum_chunks=args.accum_chunks,
+        fold_pos_neg=args.fold_pos_neg,
+        nc_pallas_vjp=not args.no_nc_pallas_vjp,
         checkpoint_steps=args.checkpoint_steps,
         keep_checkpoints=args.keep_checkpoints,
         max_bad_steps=args.max_bad_steps,

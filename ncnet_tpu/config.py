@@ -83,11 +83,25 @@ class TrainConfig:
     fold_pos_neg: bool = False           # one 2B-batch NC-filter call for the
                                          # positive+negative volumes instead
                                          # of two B-sized calls — identical
-                                         # math but measured NO faster (r4)
-                                         # and the larger program crashes the
-                                         # tunnel compile-helper at bs8 fp32;
-                                         # kept as an explicit knob only
-                                         # (training/loss.py)
+                                         # math but measured NO faster (r4,
+                                         # XLA backward) and the larger
+                                         # program crashes the tunnel
+                                         # compile-helper at bs8 fp32.  Only
+                                         # applies with accum_chunks=0; now
+                                         # a CLI flag (--fold_pos_neg) and
+                                         # bench.py measures folded vs
+                                         # unfolded on the r7 Pallas-VJP
+                                         # path so the default can flip on
+                                         # evidence (training/loss.py)
+    nc_pallas_vjp: bool = True           # route the NC filter through the
+                                         # fused Pallas forward + RESIDENT
+                                         # Pallas backward where the shape
+                                         # class compiles (round 7,
+                                         # ops/nc_fused_lane_vjp.py);
+                                         # ineligible configs (fp32, CPU,
+                                         # remat/custom-grad escape hatches)
+                                         # keep the XLA formulations.
+                                         # --no_nc_pallas_vjp disables
     remat_filter: bool = True            # jax.checkpoint around the NC filter
                                          # (recompute volumes in the backward)
     accum_chunks: int = -1               # frozen trunk only: exact

@@ -103,7 +103,8 @@ class ResilientJit:
         self._jitted = jax.jit(wrapper, **self._jit_kwargs)
 
 
-def recover_from_device_failure(exc: BaseException, *retraceables) -> Optional[str]:
+def recover_from_device_failure(exc: BaseException, *retraceables,
+                                prefer_tier: Optional[str] = None) -> Optional[str]:
     """The runtime tier-degradation policy, in one place.
 
     If ``exc`` is a runtime device error (``RUNTIME_DEVICE_ERRORS``): demote
@@ -116,6 +117,12 @@ def recover_from_device_failure(exc: BaseException, *retraceables) -> Optional[s
     (already on plain XLA — the failure is real) or the error is not
     device-shaped; the caller falls back to its plain retry/quarantine
     policy.
+
+    ``prefer_tier`` names a tier to demote FIRST if it is still enabled —
+    the training loop passes ``"resident_vjp"`` so a device failure inside a
+    train step disables the Pallas backward (the tier only training runs)
+    before it starts eating into the forward ladder; eval callers leave it
+    None and walk the forward ladder exactly as before.
 
     Policy note: the tier actually executing is chosen per SHAPE inside the
     traced program, so this recovery cannot know it — it demotes the ladder
@@ -138,7 +145,9 @@ def recover_from_device_failure(exc: BaseException, *retraceables) -> Optional[s
             return None
     from ncnet_tpu.ops import demote_fused_tier
 
-    tier = demote_fused_tier()
+    tier = demote_fused_tier(prefer_tier) if prefer_tier is not None else None
+    if tier is None:
+        tier = demote_fused_tier()
     if tier is None:
         return None
     print(
@@ -299,6 +308,7 @@ def neigh_consensus(
     remat_layers: bool = False,
     custom_grad: "bool | Sequence[Dict[str, str]]" = False,
     allow_pallas: bool = True,
+    require_vjp: bool = False,
 ) -> jnp.ndarray:
     """Neighbourhood-consensus filtering of the 4D volume.
 
@@ -333,9 +343,15 @@ def neigh_consensus(
     ms/volume against the XLA stack, tools/nc_fused_lane_probe), else XLA.
     The tap-swapped symmetric pass routes through the resident kernel as a
     2-layer block-diagonal chain (:func:`tap_swap_chain`) when it compiles.
-    Training paths pass ``False``: the kernels are forward-fast but their
-    VJP replays the XLA stack (one extra forward), a bad trade under
-    ``value_and_grad``.
+
+    ``require_vjp``: the TRAINING gate (round 7).  Route to the fused stack
+    only when ``choose_fused_vjp`` (ops/nc_fused_lane_vjp.py) confirms the
+    resident Pallas BACKWARD engages for every shape this call will run —
+    under ``value_and_grad`` a fused forward whose VJP replays the XLA
+    stack is a net loss (the pre-r7 reason training pinned
+    ``nc_pallas=False``), so the forward must not outrun its backward.
+    Where the VJP tier is unavailable the call keeps the plain XLA stack,
+    exactly the pre-r7 training path.
     """
     if custom_grad is True:
         convs = [conv4d_same] * len(nc_params)
@@ -378,7 +394,7 @@ def neigh_consensus(
     use_fused = False
     fused_tap_swap = False
     if pallas_eligible:
-        from ncnet_tpu.ops import choose_fused_stack
+        from ncnet_tpu.ops import choose_fused_stack, choose_fused_vjp
 
         b, ha, wa, hb, wb = corr.shape
         kernels = tuple(layer["w"].shape[0] for layer in nc_params)
@@ -393,6 +409,12 @@ def neigh_consensus(
             fused_tap_swap = choose_fused_stack(
                 ha, wa, hb, wb, kernels, (2 * c, 2)
             ) == "resident"
+            if require_vjp:
+                # training on this class additionally needs the Pallas
+                # backward of the block-diagonal chain
+                fused_tap_swap = fused_tap_swap and choose_fused_vjp(
+                    ha, wa, hb, wb, kernels, (2 * c, 2)
+                ) is not None
         shapes = {(ha, wa, hb, wb)}
         if symmetric and (ha, wa) != (hb, wb) \
                 and not tap_swap_fusable(nc_params):
@@ -401,9 +423,13 @@ def neigh_consensus(
             # will actually execute (a square volume batch-folds and the
             # tap-swap class never transposes)
             shapes.add((hb, wb, ha, wa))
+        # the require_vjp (TRAINING) gate fuses only where the resident
+        # BACKWARD engages — a fused forward whose VJP replays XLA is a net
+        # loss under value_and_grad; its forward side needs no extra check
+        # (nc_stack_fused's impl dispatcher falls back per shape anyway)
+        chooser = choose_fused_vjp if require_vjp else choose_fused_stack
         use_fused = all(
-            choose_fused_stack(*s, kernels, channels) is not None
-            for s in shapes
+            chooser(*s, kernels, channels) is not None for s in shapes
         )
 
     def stack(x: jnp.ndarray) -> jnp.ndarray:
@@ -556,13 +582,17 @@ def ncnet_forward_from_features(
 def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
                  remat_nc_layers: bool = False,
                  nc_custom_grad: bool = False,
-                 nc_pallas: bool = True) -> NCNetOutput:
+                 nc_pallas: bool = True,
+                 nc_pallas_vjp: bool = False) -> NCNetOutput:
     """The post-correlation half of the forward pass: [maxpool4d] →
     MutualMatching → NeighConsensus → MutualMatching.  Split out so the
     high-res/sharded paths can feed their own correlation volume.
     ``remat_nc_layers`` / ``nc_custom_grad``: see :func:`neigh_consensus`
     (training memory knobs).  ``nc_pallas``: permit the fused-lane Pallas
-    stack on the forward (training passes False — see ``allow_pallas``)."""
+    stack on the forward.  ``nc_pallas_vjp``: the TRAINING form of that
+    permission — fuse only where the resident Pallas BACKWARD also engages
+    (``require_vjp`` in :func:`neigh_consensus`); training/loss.py passes
+    both True since round 7."""
     nc_params = params["nc"]
     if config.half_precision:
         nc_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), nc_params)
@@ -574,7 +604,8 @@ def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
     corr = neigh_consensus(nc_params, corr, symmetric=config.symmetric_mode,
                            remat_layers=remat_nc_layers,
                            custom_grad=nc_custom_grad,
-                           allow_pallas=nc_pallas)
+                           allow_pallas=nc_pallas,
+                           require_vjp=nc_pallas_vjp)
     corr = mutual_matching(corr)
     return NCNetOutput(corr, delta4d)
 

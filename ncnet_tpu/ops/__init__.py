@@ -31,6 +31,11 @@ from ncnet_tpu.ops.nc_fused_lane import (  # noqa: F401
     nc_stack_fused_lane,
     reset_fused_tier_demotions,
 )
+from ncnet_tpu.ops.nc_fused_lane_vjp import (  # noqa: F401
+    choose_fused_vjp,
+    fused_vjp_feasible,
+    nc_stack_fused_vjp,
+)
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
 from ncnet_tpu.ops.matching import (
     Matches,
@@ -64,10 +69,13 @@ __all__ = [
     "make_conv4d_same",
     "conv4d_transpose_weights",
     "choose_fused_stack",
+    "choose_fused_vjp",
     "demote_fused_tier",
     "demoted_fused_tiers",
     "fused_lane_feasible",
     "fused_resident_feasible",
+    "fused_vjp_feasible",
+    "nc_stack_fused_vjp",
     "nc_stack_fused",
     "nc_stack_fused_lane",
     "nc_stack_resident",

@@ -350,6 +350,36 @@ _RES_JCH = tuple(
 )
 
 
+def _tap_reduce_conv(slabs, w, *, je, c_out, k, sp_l, n_lane):
+    """The fused-lane conv row-chunk shared by the resident forward and its
+    VJP kernels (ops/nc_fused_lane_vjp.py): concatenate the k² shifted row
+    slabs into the A operand, dot against the packed weight, and reduce the
+    B-side taps as pure lane offsets.
+
+    ``slabs``: k² arrays ``(je, c_in, kl)`` ordered ``(p, q)`` row-major
+    (matching ``_pack_weight``'s ``(p, q, c)`` row order).
+    Returns ``(acc, a3)``: the pre-bias f32 row chunk ``(je, c_out, n_lane)``
+    and the A operand ``(je, k²·c_in, kl)`` (the VJP's dW contraction reuses
+    it, so it is returned rather than rebuilt)."""
+    a3 = jnp.concatenate(slabs, axis=1)  # (je, k²·c_in, kl)
+    ys = []
+    for j in range(je):
+        y = jax.lax.dot_general(
+            w, a3[j], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (k²·c_out, kl) f32, rows ordered (r, s, o)
+        ys.append(y.astype(jnp.bfloat16))
+    ybuf = jnp.stack(ys, axis=0)
+    acc = jnp.zeros((je, c_out, n_lane), jnp.float32)
+    for rr in range(k):
+        for ss in range(k):
+            blk = (rr * k + ss) * c_out
+            off = rr * sp_l + ss
+            acc = acc + ybuf[:, blk:blk + c_out, off:off + n_lane].astype(
+                jnp.float32)
+    return acc, a3
+
+
 def _resident_kernel(*refs, k, chans, s_i, s_j, sp_j, kl, sp_l, je_list):
     """One wavefront step: layer ``l`` emits volume row ``ii − l·d``.
 
@@ -418,23 +448,8 @@ def _resident_kernel(*refs, k, chans, s_i, s_j, sp_j, kl, sp_l, je_list):
                     rings[l - 1][pl.ds(slots[p], 1), j0 + q:j0 + q + je][0]
                     for p in range(k) for q in range(k)
                 ]
-            a3 = jnp.concatenate(slabs, axis=1)  # (je, k²·c_in, kl)
-            ys = []
-            for j in range(je):
-                y = jax.lax.dot_general(
-                    w, a3[j], (((0,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )  # (k²·c_out, kl) f32, rows ordered (r, s, o)
-                ys.append(y.astype(jnp.bfloat16))
-            ybuf = jnp.stack(ys, axis=0)
-            acc = jnp.zeros((je, c_out, n_lane), jnp.float32)
-            for rr in range(k):
-                for ss in range(k):
-                    blk = (rr * k + ss) * c_out
-                    off = rr * sp_l + ss
-                    acc = acc + ybuf[
-                        :, blk:blk + c_out, off:off + n_lane
-                    ].astype(jnp.float32)
+            acc, _ = _tap_reduce_conv(
+                slabs, w, je=je, c_out=c_out, k=k, sp_l=sp_l, n_lane=n_lane)
             acc = jnp.maximum(acc + bias, 0.0)
             full = jnp.pad(
                 acc, ((0, 0), (0, 0), (pad_lo, kl - pad_lo - n_lane))
@@ -667,16 +682,24 @@ def nc_stack_resident(nc_params: List[dict], x: jnp.ndarray,
 # demote-retrace-retry recovery that writes into this registry.
 _runtime_demoted: set = set()
 
+# the FORWARD tier ladder walked by tier=None demotion (eval recovery);
+# "resident_vjp" — the training backward tier (ops/nc_fused_lane_vjp.py) —
+# is demotable only by NAME (training's recovery passes it explicitly via
+# recover_from_device_failure(prefer_tier=...)), so an eval-loop device
+# failure never wastes a demotion cycle on a tier the eval path cannot run
 _TIER_ORDER = ("resident", "perlayer")
+_ALL_TIERS = ("resident_vjp",) + _TIER_ORDER
 
 
 def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
     """Disable a fused-stack tier for the rest of the process.
 
-    ``tier=None`` demotes the highest still-enabled tier (the one
+    ``tier=None`` demotes the highest still-enabled FORWARD tier (the one
     ``choose_fused_stack`` would have picked first); returns the tier
     demoted, or None when every Pallas tier is already disabled (the caller
     is on plain XLA — a failure there is a real error, not a tier problem).
+    The training backward tier ``"resident_vjp"`` must be named explicitly
+    (see ``_TIER_ORDER`` note above).
     """
     if tier is None:
         for t in _TIER_ORDER:
@@ -685,7 +708,7 @@ def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
                 break
         else:
             return None
-    elif tier not in _TIER_ORDER or tier in _runtime_demoted:
+    elif tier not in _ALL_TIERS or tier in _runtime_demoted:
         return None
     _runtime_demoted.add(tier)
     return tier
@@ -751,14 +774,16 @@ def _xla_stack(nc_params, x):
 @jax.custom_vjp
 def nc_stack_fused(nc_params, x):
     """The fused NC stack (resident kernel when the shape class compiles,
-    else the per-layer chain, else the XLA stack) with an XLA-fallback
-    backward.
+    else the per-layer chain, else the XLA stack) with a tiered backward.
 
-    Pallas kernels have no AD rule; differentiating this op replays the
-    equivalent XLA stack's VJP (one extra XLA forward).  Training paths
-    route to the XLA stack directly (``allow_pallas=False`` in
-    models/ncnet.py) — this VJP exists so a user-level ``jax.grad`` over
-    the eval forward stays correct rather than erroring."""
+    Pallas kernels have no AD rule, so this op carries its own VJP.  The
+    backward dispatches through ``choose_fused_vjp``
+    (ops/nc_fused_lane_vjp.py): the RESIDENT staged Pallas backward —
+    in-kernel forward replay for the ReLU masks, true dX/dW kernels, f32
+    accumulators — when the shape class compiles, else a replay of the
+    equivalent XLA stack's VJP (one extra XLA forward).  The residuals are
+    only ``(nc_params, x)``: no activation is ever saved to HBM in either
+    tier."""
     return _fused_stack_impl(nc_params, x)
 
 
@@ -768,6 +793,15 @@ def _fused_fwd(nc_params, x):
 
 def _fused_bwd(res, g):
     nc_params, x = res
+    from ncnet_tpu.ops import nc_fused_lane_vjp as vjp_mod
+
+    b, ha, wa, hb, wb, _ = x.shape
+    kernels = tuple(layer["w"].shape[0] for layer in nc_params)
+    channels = tuple(layer["w"].shape[5] for layer in nc_params)
+    tier = vjp_mod.choose_fused_vjp(ha, wa, hb, wb, kernels, channels)
+    if tier is not None:
+        return vjp_mod.nc_stack_fused_vjp(
+            nc_params, x, g, interpret=tier == "interpret")
     _, vjp = jax.vjp(_xla_stack, nc_params, x)
     return vjp(g.astype(x.dtype))
 

@@ -1,0 +1,575 @@
+"""Resident Pallas BACKWARD for the fused-lane NC stack (round 7).
+
+PR 2's resident forward left training as the last hot path on the XLA
+conv4d formulations: under ``value_and_grad`` the fused kernels had no AD
+rule, so ``training/loss.py`` pinned ``nc_pallas=False`` and the backward
+ran XLA's transposed convs — ~10× the ~6 forward-equivalents a pos+neg
+weakly-supervised step should cost (ISSUE r7; *Fast Training of
+Convolutional Networks through FFTs*: conv training time is
+backward-dominated, so the backward needs its own kernel, not an autodiff
+replay).  This module is that kernel set.
+
+Design — a staged reverse chain of resident wavefront kernels
+=============================================================
+
+The backward of ``[conv4d_same + bias + ReLU]×L`` at layer ``l`` needs
+three things per volume row:
+
+  * the ReLU mask ``z_l > 0`` — RECOMPUTED in-kernel by replaying the
+    forward wavefront (layers ``0..l`` in k-slot VMEM ring buffers, exactly
+    PR 2's residency protocol); the forward saves only the input volume and
+    the params, no activation ever touches HBM;
+  * ``dW_l = Σ_cells x_l ⊗ gz_l`` and ``db_l = Σ gz_l`` where
+    ``gz_l = Γ_l ⊙ (z_l > 0)`` — accumulated into RESIDENT f32 VMEM blocks
+    (constant-index outputs revisited across the whole grid, batch
+    included) by one MXU dot per row chunk: the B-side tap offsets of the
+    forward become pure LANE SHIFTS of the masked cotangent (``Gext``), so
+    dW contracts the full fused lane dim at forward-dot shape;
+  * ``Γ_{l-1} = conv4dᵀ(gz_l)`` — algebraically a plain fused-lane conv
+    with the taps flipped in all four dims and the channel roles swapped
+    (``w2b[(p,q,o),(r,s,c)] = w[k-1-p,…,c,o]``), so the transpose conv runs
+    the SAME row kernel as the forward at exact thin widths (the 16→1
+    layer's dX contracts K = k², the 1→16 layer's emits N = k²).
+
+One ``pallas_call`` per layer ("stage"), walked last→first; each stage's
+wavefront delay is ``(l+1)·(k−1)/2`` rows.  Why stages rather than ONE
+fused program: holding every layer's replay ring AND cotangent ring
+resident simultaneously needs ~22 MB of VMEM at the PF-Pascal shape
+(25⁴, k=5, 16 channels: four 16-channel k-slot rings alone are ~15.6 MB)
+— over the ~16 MiB a v5e core has.  The staged chain caps the working set
+at one layer's rings (~8–15 MB, ``_vjp_stage_vmem_bytes``) and bounds
+inter-stage cotangent traffic to ONE write + ONE staged read per layer
+boundary (rows staged via a single revolving BlockSpec, no k× refetch):
+~50 MB/volume total against the XLA backward's ~0.7 GB/pair, with zero
+activation traffic.
+
+Numerics: bf16 operands, f32 dot accumulation, bf16 ring rows — the same
+precision class as the forward kernels.  The ReLU mask is taken on the
+bf16-rounded pre-activation (``(acc + bias) → bf16 > 0``), matching the
+forward's stored activations, so mask decisions agree with what the
+forward actually computed.
+
+``choose_fused_vjp`` is the tier authority: ``'resident_vjp'`` gated by a
+shape-class check + per-stage VMEM accounting + a real-compile probe, and
+honoring the PR 3 runtime-demotion registry (``demote_fused_tier``) so a
+mid-run device failure demotes the backward tier too; ``None`` falls back
+to the XLA-replay backward in ``nc_stack_fused``'s VJP.  The test-only
+``NCNET_FUSED_VJP_FORCE=interpret`` env knob forces the chain in Pallas
+interpret mode on any backend (grad-parity tests, the SIGKILL-resume
+proof); ``=off`` pins the XLA replay.
+"""
+
+from __future__ import annotations
+
+import functools
+import os as _os
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu.ops.nc_fused_lane import (
+    _RES_JCH,
+    _make_mask,
+    _pack_weight,
+    _resident_shape_class,
+    _tap_reduce_conv,
+    demoted_fused_tiers,
+    fused_layout_in,
+    fused_layout_out,
+)
+
+# VMEM pre-gate for one backward stage.  Deliberately the PHYSICAL ~16 MiB
+# rather than the forward's conservative 13 MiB: Mosaic's VMEM allocation
+# is static, so a stage that does not fit FAILS TO COMPILE and the
+# real-compile probe (the authority, same discipline as
+# fused_resident_compiles) demotes the tier — this accounting exists only
+# to skip obviously doomed probe compiles, not to be the gate.  The
+# flagship PF-Pascal stage 1 accounts to ~15.7 MiB (three 16-channel
+# structures resident at once: the y₀ replay ring, the gz ring, and the
+# 400×400 dW accumulator + staging); whether v5e's Mosaic actually places
+# it is exactly what tools/nc_vjp_resident_probe.py records next
+# TPU-attached session.
+_VJP_VMEM_BUDGET = 16 * 2 ** 20
+
+
+def _flip_pack(w, k, c_in, c_out):
+    """Pack the TRANSPOSE-conv weight: all four tap dims flipped, channel
+    roles swapped — ``w2b[(p,q,o),(r,s,c)] = w[k-1-p,k-1-q,k-1-r,k-1-s,c,o]``
+    — so ``conv4dᵀ(gz, w) == fused_lane_conv(gz, w2b)`` exactly."""
+    wt = jnp.transpose(w[::-1, ::-1, ::-1, ::-1], (0, 1, 2, 3, 5, 4))
+    return _pack_weight(wt, k, c_out, c_in, pad=False)
+
+
+def _unpack_weight_grad(dw2, k, c_in, c_out):
+    """Inverse of ``_pack_weight(pad=False)``: ``(k²·ci, k²·co)`` →
+    ``(k, k, k, k, ci, co)``."""
+    return jnp.transpose(
+        dw2.reshape(k, k, c_in, k, k, c_out), (0, 1, 3, 4, 2, 5)
+    )
+
+
+def _lane_shift(x, off, kl):
+    """``y[:, m] = x[:, m - off]`` with zero fill (a pure lane pad+slice —
+    the Mosaic-legal primitive the whole fused-lane design rides on)."""
+    if off == 0:
+        return x
+    if off > 0:
+        return jnp.pad(x, ((0, 0), (off, 0)))[:, :kl]
+    return jnp.pad(x, ((0, 0), (0, -off)))[:, -off:]
+
+
+def cotangent_layout_in(g: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Inverse of :func:`fused_layout_out` for the incoming cotangent:
+    ``(B, hA, wA, hB, wB, C)`` → ``(B, hA, wA, C, (hB+h)(wB+h))`` bf16 with
+    zeroed halo lanes (one cheap pad of the thin top cotangent)."""
+    b, ha, wa, hb, wb, c = g.shape
+    d = (k - 1) // 2
+    g = jnp.moveaxis(g, 5, 3)
+    g = jnp.pad(g, ((0, 0),) * 4 + ((d, d), (d, d)))
+    return g.reshape(b, ha, wa, c, -1).astype(jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# the stage kernel: backward through ONE layer, forward replay in-kernel
+# ---------------------------------------------------------------------------
+
+
+def _vjp_stage_kernel(*refs, l, k, chans, co_out, s_i, s_j, sp_j, kl, sp_l,
+                      je_list):
+    """One wavefront step of backward stage ``l``.
+
+    Lanes (d = (k−1)/2):
+      * replay lane ``j < l``: forward layer j emits row ``ii − j·d`` into
+        its k-slot ring (PR 2's protocol verbatim: bottom-halo priming,
+        top-halo zero rows, j-halo rewrites);
+      * gz lane: at row ``r = ii − l·d`` recompute ``z_l`` from the replay
+        rings (layer 0 reads the staged input rows), mask the staged
+        ``Γ_l`` row with ``bf16(z) > 0``, write ``gz_l`` into its ring, and
+        accumulate ``dW_l``/``db_l`` into the resident f32 output blocks —
+        the A operand of the z dot is REUSED as the dW contraction operand;
+      * Γ lane: at row ``r = ii − (l+1)·d`` emit ``Γ_{l-1}`` (stage 0: dX)
+        = the fused-lane conv of the gz ring against the flipped/transposed
+        weight pack — no bias, no ReLU.
+
+    refs = (x_0..x_{k-1}, Γ_l, w2f_0, b_0, …, w2f_l, b_l, w2b, mask,
+            out_Γ, dW, db, ring_y_0..ring_y_{l-1}, ring_gz):
+      x_p:    (1, 1, sp_j, 1, kl) halo-padded input row ii+p (clamped).
+      Γ_l:    (1, 1, s_j, co_l, kl) staged cotangent row ii − l·d (clamped;
+              fetched ONCE per row — no k× refetch).
+      out_Γ:  (1, 1, s_j, co_out, kl) row ii − (l+1)·d.
+      dW:     (k²·ci_l, k²·co_l) f32; db: (1, co_l, kl) f32 — constant-index
+              blocks, resident across the whole grid (batch included),
+              zeroed at the first step.
+      ring_*: (k, sp_j, c, kl) bf16 scratch.
+    """
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    h = k - 1
+    d = h // 2
+    x_refs = refs[:k]
+    g_ref = refs[k]
+    wfb = refs[k + 1:k + 1 + 2 * (l + 1)]
+    w2b_ref = refs[k + 1 + 2 * (l + 1)]
+    m_ref = refs[k + 2 + 2 * (l + 1)]
+    out_ref, dw_ref, db_ref = refs[k + 3 + 2 * (l + 1):k + 6 + 2 * (l + 1)]
+    rings = refs[k + 6 + 2 * (l + 1):]
+    y_rings, gz_ring = rings[:-1], rings[-1]
+
+    bi = pl.program_id(0)
+    ii = pl.program_id(1)
+    n_lane = kl - sp_l * h - h
+    pad_lo = d * sp_l + d
+    mask = m_ref[:].astype(jnp.float32)
+    ci_l, co_l = chans[l]
+
+    def slot(r):
+        return lax.rem(r + k, k)  # r ≥ −d > −k keeps rem ≥ 0
+
+    def zero_row(ring_ref, r, c):
+        ring_ref[pl.ds(slot(r), 1)] = jnp.zeros(
+            (1, sp_j, c, kl), ring_ref.dtype)
+
+    if d:
+        @pl.when(ii == 0)
+        def _prime():
+            for ring, (_, co) in zip(y_rings, chans[:l]):
+                for r in range(-d, 0):
+                    zero_row(ring, r, co)
+            for r in range(-d, 0):
+                zero_row(gz_ring, r, co_l)
+
+    @pl.when((ii == 0) & (bi == 0))
+    def _init_accumulators():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    def ring_halo_zero(ring_ref, r, c):
+        # j-halo columns re-zeroed on every slot write (the slot's previous
+        # occupant — possibly the previous batch item's row, or raw scratch
+        # garbage on the very first pass — is overwritten)
+        if d:
+            ring_ref[pl.ds(slot(r), 1), :d] = jnp.zeros(
+                (1, d, c, kl), ring_ref.dtype)
+            ring_ref[pl.ds(slot(r), 1), d + s_j:] = jnp.zeros(
+                (1, sp_j - d - s_j, c, kl), ring_ref.dtype)
+
+    def x_slabs(j0, je):
+        return [
+            x_refs[p][0, 0, j0 + q:j0 + q + je, :, :]
+            for p in range(k) for q in range(k)
+        ]
+
+    def ring_slabs(ring_ref, slots, j0, je):
+        return [
+            ring_ref[pl.ds(slots[p], 1), j0 + q:j0 + q + je][0]
+            for p in range(k) for q in range(k)
+        ]
+
+    def replay_row(j, r):
+        """Forward layer ``j`` (ReLU'd, ring-resident) — PR 2's compute."""
+        c_in, c_out = chans[j]
+        w = wfb[2 * j][:]
+        bias = wfb[2 * j + 1][:].astype(jnp.float32)
+        if j > 0:
+            slots = [slot(r - d + p) for p in range(k)]
+        ring_halo_zero(y_rings[j], r, c_out)
+        for j0, je in je_list:
+            slabs = (x_slabs(j0, je) if j == 0
+                     else ring_slabs(y_rings[j - 1], slots, j0, je))
+            acc, _ = _tap_reduce_conv(
+                slabs, w, je=je, c_out=c_out, k=k, sp_l=sp_l, n_lane=n_lane)
+            acc = jnp.maximum(acc + bias, 0.0)
+            full = jnp.pad(
+                acc, ((0, 0), (0, 0), (pad_lo, kl - pad_lo - n_lane))
+            ) * mask
+            y_rings[j][pl.ds(slot(r), 1), d + j0:d + j0 + je] = (
+                full[None].astype(y_rings[j].dtype))
+
+    def gz_row(r):
+        """Recompute ``z_l`` row ``r``, mask the staged cotangent, ring the
+        result, and fold the row into the resident dW/db accumulators."""
+        w = wfb[2 * l][:]
+        bias = wfb[2 * l + 1][:].astype(jnp.float32)
+        if l > 0:
+            slots = [slot(r - d + p) for p in range(k)]
+        ring_halo_zero(gz_ring, r, co_l)
+        for j0, je in je_list:
+            slabs = (x_slabs(j0, je) if l == 0
+                     else ring_slabs(y_rings[l - 1], slots, j0, je))
+            acc, a3 = _tap_reduce_conv(
+                slabs, w, je=je, c_out=co_l, k=k, sp_l=sp_l, n_lane=n_lane)
+            # mask on the bf16-ROUNDED pre-activation: the forward stores
+            # bf16 rows, so a z that rounds to bf16 zero was a dead cell in
+            # the forward this backward must agree with
+            keep = (acc + bias).astype(jnp.bfloat16) > 0
+            gval = g_ref[0, 0, j0:j0 + je, :, pad_lo:pad_lo + n_lane]
+            gz = jnp.where(keep, gval.astype(jnp.float32), 0.0)
+            full = jnp.pad(
+                gz, ((0, 0), (0, 0), (pad_lo, kl - pad_lo - n_lane)))
+            gz_bf = full.astype(jnp.bfloat16)
+            gz_ring[pl.ds(slot(r), 1), d + j0:d + j0 + je] = gz_bf[None]
+            db_ref[:] = db_ref[:] + jnp.sum(full, axis=0)[None]
+            # dW: the forward's B-side tap offsets become lane shifts of the
+            # masked cotangent; one full-lane-depth dot per output column
+            # reuses the z dot's A operand
+            for j in range(je):
+                gext = jnp.concatenate(
+                    [_lane_shift(gz_bf[j], (rr - d) * sp_l + (ss - d), kl)
+                     for rr in range(k) for ss in range(k)],
+                    axis=0,
+                )  # (k²·co_l, kl), rows ordered (r, s, o)
+                dw_ref[:] = dw_ref[:] + jax.lax.dot_general(
+                    a3[j], gext, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+    def out_row(r):
+        """``Γ_{l-1}`` (stage 0: dX) row ``r``: the transpose conv as a
+        plain fused-lane conv of the gz ring — no bias, no ReLU."""
+        w2b = w2b_ref[:]
+        slots = [slot(r - d + p) for p in range(k)]
+        for j0, je in je_list:
+            slabs = ring_slabs(gz_ring, slots, j0, je)
+            acc, _ = _tap_reduce_conv(
+                slabs, w2b, je=je, c_out=co_out, k=k, sp_l=sp_l,
+                n_lane=n_lane)
+            # the valid-support window is CONTIGUOUS and so includes the
+            # inter-row halo columns of the fused frame; the next stage's
+            # gz slice reads them back, so they must be zeroed here (the
+            # invariant every Γ array carries: halo lanes are zero)
+            full = jnp.pad(
+                acc, ((0, 0), (0, 0), (pad_lo, kl - pad_lo - n_lane))
+            ) * mask
+            out_ref[0, 0, j0:j0 + je] = full.astype(out_ref.dtype)
+
+    for j in range(l):
+        r = ii - j * d if d else ii
+
+        @pl.when((r >= 0) & (r < s_i))
+        def _(j=j, r=r):
+            replay_row(j, r)
+
+        if d:
+            @pl.when((r >= s_i) & (r < s_i + d))
+            def _(j=j, r=r):
+                zero_row(y_rings[j], r, chans[j][1])
+
+    r = ii - l * d if d else ii
+    if d:
+        @pl.when((r >= 0) & (r < s_i))
+        def _(r=r):
+            gz_row(r)
+
+        @pl.when((r >= s_i) & (r < s_i + d))
+        def _(r=r):
+            zero_row(gz_ring, r, co_l)
+
+        r2 = ii - l * d - d
+
+        @pl.when((r2 >= 0) & (r2 < s_i))
+        def _(r2=r2):
+            out_row(r2)
+    else:
+        gz_row(r)
+        out_row(r)
+
+
+# ---------------------------------------------------------------------------
+# VMEM accounting + host-side stage driver
+# ---------------------------------------------------------------------------
+
+
+def _stage_chans(kernels, channels, l) -> Tuple[Tuple[int, int], ...]:
+    return tuple(zip((1,) + tuple(channels), channels))[:l + 1]
+
+
+def _vjp_stage_vmem_bytes(l, wa, hb, wb, kernels, channels, je) -> int:
+    """Worst-step VMEM working set of backward stage ``l`` (bytes)."""
+    k = kernels[0]
+    h = k - 1
+    sp_j = wa + h
+    sp_l = wb + h
+    kl = (hb + h) * sp_l
+    n_lane = kl - sp_l * h - h
+    chans = _stage_chans(kernels, channels, l)
+    ci_l, co_l = chans[l]
+    rings = sum(k * sp_j * co * kl * 2 for _, co in chans[:l]) \
+        + k * sp_j * co_l * kl * 2
+    weights = sum((k * k * ci) * (k * k * co) * 2 for ci, co in chans) \
+        + (k * k * co_l) * (k * k * ci_l) * 2
+    accs = (k * k * ci_l) * (k * k * co_l) * 4 + co_l * kl * 4
+    inputs = 2 * k * sp_j * 1 * kl * 2 + 2 * wa * co_l * kl * 2
+    out = 2 * wa * ci_l * kl * 2
+    temps = max(
+        je * k * k * ci * kl * 2                 # a3 build
+        + k * k * co * kl * 4                    # one f32 dot output
+        + je * k * k * co * kl * 2               # bf16 ybuf
+        + je * co * n_lane * 4                   # f32 accumulator
+        + je * co * kl * 4                       # padded row chunk
+        for ci, co in chans + ((co_l, ci_l),)  # + the Γ lane's dot
+    ) + k * k * co_l * kl * 2 \
+        + (k * k * ci_l) * (k * k * co_l) * 4    # Gext + the dW dot output
+    return rings + weights + accs + inputs + out + temps
+
+
+def _vjp_stage_je(l, ha, wa, hb, wb, kernels, channels) -> int:
+    for je in _RES_JCH:
+        je = min(je, wa)
+        if _vjp_stage_vmem_bytes(l, wa, hb, wb, kernels, channels, je) \
+                <= _VJP_VMEM_BUDGET:
+            return je
+    return 0
+
+
+def fused_vjp_feasible(ha, wa, hb, wb, kernels, channels) -> bool:
+    """Whether the staged resident backward fits this shape class: the
+    resident forward's shape class (cubic odd uniform kernels, thin final
+    layer) and EVERY stage's working set inside the budget at some j-chunk
+    size."""
+    if not _resident_shape_class(tuple(kernels), tuple(channels)):
+        return False
+    return all(
+        _vjp_stage_je(l, ha, wa, hb, wb, kernels, channels) > 0
+        for l in range(len(kernels))
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def fused_vjp_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
+    """Real-compile probe of the whole staged backward chain (cached per
+    shape class) — the authority over the VMEM pre-gate: Mosaic's static
+    VMEM allocation and lowering legality both surface as compile failures,
+    and any failure falls back to the XLA-replay backward."""
+    try:
+        x = jax.ShapeDtypeStruct((1, ha, wa, hb, wb, 1), jnp.bfloat16)
+        g = jax.ShapeDtypeStruct(
+            (1, ha, wa, hb, wb, channels[-1]), jnp.bfloat16)
+        ws, bs = [], []
+        c_in = 1
+        for kk, c_out in zip(kernels, channels):
+            ws.append(jax.ShapeDtypeStruct(
+                (kk,) * 4 + (c_in, c_out), jnp.bfloat16))
+            bs.append(jax.ShapeDtypeStruct((c_out,), jnp.bfloat16))
+            c_in = c_out
+
+        def run(x, g, ws, bs):
+            params = [{"w": w, "b": b} for w, b in zip(ws, bs)]
+            return nc_stack_fused_vjp(params, x, g)
+
+        jax.jit(run).lower(x, g, ws, bs).compile()
+        return True
+    except Exception:
+        return False
+
+
+def choose_fused_vjp(ha, wa, hb, wb, kernels, channels) -> Optional[str]:
+    """The one authority for the training-backward tier at a shape class:
+    ``'resident_vjp'`` (the staged Pallas chain), ``'interpret'`` (test-only
+    force), or ``None`` (XLA-replay backward).  Mirrors
+    ``choose_fused_stack``'s discipline — real TPU backend, green compile
+    probe, no runtime demotion (``demote_fused_tier('resident_vjp')`` after
+    a mid-run device failure sends every later trace back to XLA)."""
+    kernels, channels = tuple(kernels), tuple(channels)
+    force = _os.environ.get("NCNET_FUSED_VJP_FORCE", "")
+    if force == "interpret":
+        # still honor the shape/VMEM gate: the knob forces the BACKEND
+        # (interpret mode on any device), not an infeasible shape — which
+        # must keep degrading to the XLA-replay backward, not trip the
+        # kernel's trace-time asserts
+        if fused_vjp_feasible(ha, wa, hb, wb, kernels, channels):
+            return "interpret"
+        return None
+    if force == "off":
+        return None
+    from ncnet_tpu.ops.conv4d import _pallas_available
+
+    if not _pallas_available() or "resident_vjp" in demoted_fused_tiers():
+        return None
+    if fused_vjp_feasible(ha, wa, hb, wb, kernels, channels) \
+            and fused_vjp_compiles(ha, wa, hb, wb, kernels, channels):
+        return "resident_vjp"
+    return None
+
+
+def _vjp_stage(l, nc_params, xp, gamma, *, ha, wa, hb, wb, interpret):
+    """Backward stage ``l`` as one ``pallas_call``: returns
+    ``(Γ_{l-1} (B, hA, wA, ci_l, kl), dW2 f32, db_partial f32)``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = xp.shape[0]
+    kernels = tuple(layer["w"].shape[0] for layer in nc_params)
+    channels = tuple(layer["w"].shape[5] for layer in nc_params)
+    k = kernels[0]
+    h = k - 1
+    d = h // 2
+    sp_l = wb + h
+    kl = (hb + h) * sp_l
+    sp_j = wa + h
+    sp_i = ha + h
+    chans = _stage_chans(kernels, channels, l)
+    ci_l, co_l = chans[l]
+    je = _vjp_stage_je(l, ha, wa, hb, wb, kernels, channels)
+    assert je > 0, "vjp stage infeasible; gate with fused_vjp_feasible"
+    je_list = tuple((j0, min(je, wa - j0)) for j0 in range(0, wa, je))
+    mask = jnp.asarray(_make_mask((hb, wb), k), jnp.bfloat16)
+
+    ops = [xp] * k + [gamma]
+    for (ci, co), layer in zip(chans, nc_params):
+        ops.append(_pack_weight(
+            layer["w"].astype(jnp.bfloat16), k, ci, co, pad=False))
+        ops.append(layer["b"].astype(jnp.bfloat16).reshape(1, co, 1))
+    ops.append(_flip_pack(
+        nc_params[l]["w"].astype(jnp.bfloat16), k, ci_l, co_l))
+    ops.append(mask)
+
+    kern = functools.partial(
+        _vjp_stage_kernel, l=l, k=k, chans=chans, co_out=ci_l, s_i=ha,
+        s_j=wa, sp_j=sp_j, kl=kl, sp_l=sp_l, je_list=je_list,
+    )
+    row_spec = lambda p: pl.BlockSpec(  # noqa: E731
+        (1, 1, sp_j, 1, kl),
+        lambda bi, ii, p=p: (bi, jnp.minimum(ii + p, sp_i - 1), 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    g_spec = pl.BlockSpec(
+        (1, 1, wa, co_l, kl),
+        lambda bi, ii: (bi, jnp.clip(ii - l * d, 0, ha - 1), 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    full_spec = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)  # noqa: E731
+    delay = (l + 1) * d
+    out_gamma, dw2, db = pl.pallas_call(
+        kern,
+        grid=(b, ha + delay),
+        in_specs=[row_spec(p) for p in range(k)] + [g_spec]
+        + [full_spec() for _ in range(2 * (l + 1) + 2)],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, wa, ci_l, kl),
+                lambda bi, ii: (bi, jnp.clip(ii - delay, 0, ha - 1), 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (k * k * ci_l, k * k * co_l), lambda bi, ii: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, co_l, kl), lambda bi, ii: (0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, ha, wa, ci_l, kl), jnp.bfloat16),
+            jax.ShapeDtypeStruct((k * k * ci_l, k * k * co_l), jnp.float32),
+            jax.ShapeDtypeStruct((1, co_l, kl), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k, sp_j, co, kl), jnp.bfloat16)
+            for _, co in chans[:l]
+        ] + [pltpu.VMEM((k, sp_j, co_l, kl), jnp.bfloat16)],
+        interpret=interpret,
+    )(*ops)
+    return out_gamma, dw2, db
+
+
+def nc_stack_fused_vjp(
+    nc_params: List[dict], x: jnp.ndarray, g: jnp.ndarray,
+    interpret: bool = False,
+) -> Tuple[List[dict], jnp.ndarray]:
+    """The full stack VJP: ``(d_nc_params, dx)`` for cotangent ``g`` of
+    ``nc_stack_fused(nc_params, x)`` — the resident staged Pallas chain.
+
+    Matches ``jax.vjp`` of the equivalent XLA stack up to bf16 accumulation
+    order (the grad-parity suite in tests/test_nc_vjp.py locks every shape
+    class).  Only ``(nc_params, x)`` are consumed: activations and masks
+    are recomputed in-kernel.
+    """
+    b, ha, wa, hb, wb, _ = x.shape
+    assert x.shape[-1] == 1 and nc_params[0]["w"].shape[4] == 1, (
+        "nc_stack_fused_vjp requires a 1-channel input volume and first "
+        "layer (the NC-stack shape class)"
+    )
+    kernels = tuple(layer["w"].shape[0] for layer in nc_params)
+    k = kernels[0]
+    xp = fused_layout_in(x, k)
+    gamma = cotangent_layout_in(g.astype(jnp.bfloat16), k)
+    d_params: List[Optional[dict]] = [None] * len(nc_params)
+    for l in reversed(range(len(nc_params))):
+        gamma, dw2, dbp = _vjp_stage(
+            l, nc_params, xp, gamma, ha=ha, wa=wa, hb=hb, wb=wb,
+            interpret=interpret,
+        )
+        ci, co = _stage_chans(kernels,
+                              tuple(p["w"].shape[5] for p in nc_params), l)[l]
+        d_params[l] = {
+            "w": _unpack_weight_grad(dw2, k, ci, co).astype(
+                nc_params[l]["w"].dtype),
+            # halo lanes and j-halo columns of gz are zero by construction,
+            # so the lane sum counts each valid cell exactly once
+            "b": jnp.sum(dbp, axis=(0, 2)).astype(nc_params[l]["b"].dtype),
+        }
+    dx = fused_layout_out(gamma, hb, wb, k).astype(x.dtype)
+    return d_params, dx

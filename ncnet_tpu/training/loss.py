@@ -73,6 +73,7 @@ def weak_loss(
     nc_custom_grad: bool = False,
     fold_pos_neg: bool = False,
     remat_filter: bool = True,
+    nc_pallas_vjp: bool = True,
 ) -> jnp.ndarray:
     """score(negative) − score(positive) on an image-pair batch.
 
@@ -118,6 +119,17 @@ def weak_loss(
     ``remat_filter``: wrap the NC filter in ``jax.checkpoint`` so the
     backward recomputes the volume intermediates instead of storing them
     (the round-2 memory default).
+
+    ``nc_pallas_vjp`` (round 7, the training default): route the NC stack
+    through the fused Pallas forward + RESIDENT Pallas backward
+    (ops/nc_fused_lane_vjp.py) where ``choose_fused_vjp`` confirms the
+    whole pair engages — bf16 volumes + params, the resident shape class,
+    green compile probes, no runtime demotion.  Everywhere else (fp32,
+    CPU, InLoc-scale volumes, ``remat_nc_layers``/``nc_custom_grad``
+    escape hatches) the stack keeps the plain XLA formulations exactly as
+    before — pre-r7, training pinned ``nc_pallas=False`` because the
+    fused kernels' VJP replayed the XLA stack, a net loss under
+    ``value_and_grad``; the resident VJP removes that trade.
     """
     fa = extract_features(config, params, batch["source_image"])
     fb = extract_features(config, params, batch["target_image"])
@@ -129,11 +141,12 @@ def weak_loss(
         fb = fb.astype(jnp.bfloat16)
 
     def filt(p, corr):
-        # nc_pallas=False: under value_and_grad the fused-lane kernels'
-        # VJP replays the XLA stack (an extra forward) — a net loss
+        # nc_pallas_vjp gates BOTH directions together: the fused forward
+        # engages only where the resident Pallas backward does too
         return ncnet_filter(
             config, p, corr, remat_nc_layers=remat_nc_layers,
-            nc_custom_grad=nc_custom_grad, nc_pallas=False,
+            nc_custom_grad=nc_custom_grad, nc_pallas=nc_pallas_vjp,
+            nc_pallas_vjp=nc_pallas_vjp,
         ).corr
 
     if remat_filter:
@@ -180,6 +193,7 @@ def weak_loss_and_grads(
     accum_chunks: int = -1,
     remat_nc_layers: bool = False,
     nc_custom_grad: bool = False,
+    nc_pallas_vjp: bool = True,
 ) -> Tuple[jnp.ndarray, Dict]:
     """Exact :func:`weak_loss` value AND parameter gradients via
     volume-chunked gradient accumulation — the frozen-trunk fast path.
@@ -235,7 +249,10 @@ def weak_loss_and_grads(
         nc = ncnet_filter(
             config, p, correlation_4d(fac, fbc),
             remat_nc_layers=remat_nc_layers, nc_custom_grad=nc_custom_grad,
-            nc_pallas=False,  # see weak_loss: the fused VJP replays XLA
+            # the resident Pallas fwd+bwd pair where eligible (see
+            # weak_loss); the chunked scan composes — each chunk's backward
+            # runs the staged VJP chain at the chunk batch
+            nc_pallas=nc_pallas_vjp, nc_pallas_vjp=nc_pallas_vjp,
         ).corr
         return jnp.sum(match_score_per_pair(nc, normalization) * wc)
 

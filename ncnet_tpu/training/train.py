@@ -172,8 +172,13 @@ def make_train_step(
     remat_filter: bool = True,
     accum_chunks: int = 0,
     nan_guard: bool = False,
+    nc_pallas_vjp: bool = True,
 ):
-    """Jitted (state, batch) → (state, loss).
+    """Jitted (state, batch) → (state, loss).  Returned as a
+    :class:`~ncnet_tpu.models.ncnet.ResilientJit` so ``fit``'s device-
+    failure recovery can drop the compiled cache after a tier demotion
+    (and so the fault-injection harness has a dispatch seam,
+    label ``"train_step"``).
 
     ``nan_guard=True`` adds an in-graph non-finite detector over the loss
     AND the update tree (a backward overflow can produce non-finite grads
@@ -198,7 +203,12 @@ def make_train_step(
     :func:`ncnet_tpu.training.loss.weak_loss_and_grads` — exact
     volume-chunked gradient accumulation, the fastest path and the one that
     fits/compiles any batch size (see its docstring for the measurements);
-    ``-1`` = auto chunk choice."""
+    ``-1`` = auto chunk choice.
+
+    ``nc_pallas_vjp`` (round 7 default): route the NC filter through the
+    fused Pallas forward + resident Pallas backward where the shape class
+    compiles (see :func:`ncnet_tpu.training.loss.weak_loss`); ineligible
+    configurations keep the XLA formulations unchanged."""
 
     if accum_chunks != 0 and not stop_backbone_grad:
         raise ValueError(
@@ -214,6 +224,7 @@ def make_train_step(
                 model_config, state.params, batch, accum_chunks=accum_chunks,
                 remat_nc_layers=remat_nc_layers,
                 nc_custom_grad=nc_custom_grad,
+                nc_pallas_vjp=nc_pallas_vjp,
             )
         else:
             loss, grads = jax.value_and_grad(
@@ -224,6 +235,7 @@ def make_train_step(
                     nc_custom_grad=nc_custom_grad,
                     fold_pos_neg=fold_pos_neg,
                     remat_filter=remat_filter,
+                    nc_pallas_vjp=nc_pallas_vjp,
                 )
             )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -249,7 +261,10 @@ def make_train_step(
             loss = jnp.where(ok, loss, jnp.nan)
         return TrainState(params, opt_state, state.step + 1), loss
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    from ncnet_tpu.models.ncnet import ResilientJit
+
+    return ResilientJit(step, label="train_step",
+                        donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(model_config: ModelConfig):
@@ -284,6 +299,16 @@ def process_epoch(
     hooks without a device sync).  Non-finite losses are excluded from the
     epoch mean (and counted), so one guarded-away batch does not wipe out
     the epoch statistic.
+
+    Host→device transfer is DOUBLE-BUFFERED (round 7): batch N+1 is staged
+    (``put_batch`` — an async ``device_put`` on TPU) right after step N is
+    dispatched and BEFORE the per-step loss sync, so the upload rides
+    behind the device's step compute instead of serializing in front of
+    step N+1.  The staging order is the only change: logging, ``on_step``
+    accounting, and checkpoint positions still run per batch in order, and
+    an early stop (preemption) simply discards the staged batch — the
+    position cursor marks it unconsumed, so resume re-delivers it from the
+    epoch-keyed shuffle.
     """
     put_batch = put_batch or jnp.asarray
     n = len(loader)
@@ -297,19 +322,33 @@ def process_epoch(
         print(f"{mode.capitalize()} Epoch: {epoch} resuming at batch "
               f"{start_batch}/{n}")
     losses = []  # device scalars; only synced at log points / epoch end
-    for off, batch in enumerate(loader):
-        batch_idx = start_batch + off
+
+    def stage(off, batch):
         if mode == "train":
             batch = faults.corrupt_batch_hook(batch, step_base + off + 1)
-        images = {
+        return {
             "source_image": put_batch(batch["source_image"]),
             "target_image": put_batch(batch["target_image"]),
         }
+
+    it = enumerate(loader)
+    nxt = next(it, None)
+    staged = stage(*nxt) if nxt is not None else None
+    while nxt is not None:
+        off, _ = nxt
+        batch_idx = start_batch + off
+        images, staged = staged, None
         with annotate(f"{mode}_step"):
             if mode == "train":
                 state, loss = step_fn(state, images)
             else:
                 loss = step_fn(state.params, images)
+        # stage batch N+1 while step N runs on device (the loader's own
+        # prefetch thread has usually decoded it already; this overlaps the
+        # host→device leg too), then sync the loss for logging/guards
+        nxt = next(it, None)
+        if nxt is not None:
+            staged = stage(*nxt)
         losses.append(loss)
         if batch_idx % log_interval == 0:
             print(
@@ -783,7 +822,33 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         remat_filter=config.remat_filter,
         accum_chunks=accum,
         nan_guard=config.nan_guard,
+        nc_pallas_vjp=config.nc_pallas_vjp,
     )
+
+    def guarded_train_step(state, images):
+        """The training twin of the eval loops' tier-degradation recovery:
+        a runtime device failure inside the jitted step demotes the Pallas
+        BACKWARD tier first (``resident_vjp`` — the tier only training
+        runs), drops the compiled cache, and retries once per demotion so
+        the run continues on the surviving tier.  Caveat: with donated
+        state, a failure that fired mid-execution (not at the injection
+        seam) may have consumed the input buffers — the retry then raises
+        and the normal crash/resume machinery takes over; nothing is made
+        worse than the pre-recovery behavior."""
+        from ncnet_tpu.models.ncnet import (
+            RUNTIME_DEVICE_ERRORS,
+            recover_from_device_failure,
+        )
+
+        while True:
+            try:
+                return train_step(state, images)
+            except RUNTIME_DEVICE_ERRORS as e:
+                tier = recover_from_device_failure(
+                    e, train_step, prefer_tier="resident_vjp")
+                if tier is None:
+                    raise
+
     eval_step = make_eval_step(model_config)
 
     decode_policy = (
@@ -950,7 +1015,8 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
                 with maybe_trace(config.profile_dir,
                                  enabled=epoch == first_epoch):
                     state, train_loss[epoch - 1] = process_epoch(
-                        "train", epoch, state, train_step, train_loader,
+                        "train", epoch, state, guarded_train_step,
+                        train_loader,
                         config.log_interval, put_batch,
                         step_base=steps_done, on_step=on_step,
                     )

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Parity + timing of the RESIDENT Pallas NC backward (r7) on real hardware.
+
+Usage: python tools/nc_vjp_resident_probe.py [batch_volumes] [arch]
+  arch: 'pf' (default: 25⁴, k=5, (16,16,1)) or 'ivd' (25⁴, k=3, (16,1))
+
+Run on a TPU backend — this is the measurement companion of
+ops/nc_fused_lane_vjp.py, built so the next TPU-attached session can record:
+
+  * whether the per-stage compile probes are green at the flagship shape
+    (stage 1 accounts to ~15.7 MiB of VMEM — three 16-channel structures
+    resident at once — right at the v5e ceiling; if Mosaic rejects it the
+    chooser falls back to the XLA backward and THAT is the finding);
+  * the composed grad-step wall of the fused VJP vs the XLA autodiff
+    backward, plus each backward stage's isolated wall (the dX/dW split).
+
+Prior-probe findings folded in (what this kernel set replaces):
+
+  * tools/vjp_probe.py (r4, v5e, 25⁴ symmetric stack, fp32 bs8):
+    plain XLA AD 48.4 ms/pair / 12.7 GB temp; conv4d's custom dw-variant
+    VJP 56.9 ms/pair / 7.2 GB — every XLA-level dw reformulation was a
+    SPEED LOSS (dw_unroll blew memory to 20.9 GB via channel-minor
+    relayouts); the backward needed its own kernel, not another XLA
+    formulation.
+  * tools/nc_grad_split_probe.py (same rig): the backward splits roughly
+    2:1 dW-chain : dX-chain on top of a 1× forward — recompute-in-kernel
+    plus true dX/dW kernels is the ~3×-forward budget this module targets
+    (a pos+neg step ≈ 6 filter-forward-equivalents).
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from _timing import timeit  # noqa: E402
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+ARCH = sys.argv[2] if len(sys.argv) > 2 else "pf"
+S = 25
+KS, CHS = ((5, 5, 5), (16, 16, 1)) if ARCH == "pf" else ((3, 3), (16, 1))
+DT = jnp.bfloat16
+
+
+def make_params(key):
+    params, c_in = [], 1
+    for k, c_out in zip(KS, CHS):
+        k1, k2, key = jax.random.split(key, 3)
+        params.append({
+            "w": jax.random.normal(k1, (k,) * 4 + (c_in, c_out), DT) * 0.05,
+            "b": jax.random.normal(k2, (c_out,), DT) * 0.1,
+        })
+        c_in = c_out
+    return params
+
+
+def xla_stack(params, x):
+    from ncnet_tpu.ops.conv4d import conv4d
+
+    for layer in params:
+        x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
+    return x
+
+
+def main():
+    from ncnet_tpu.ops.nc_fused_lane import fused_layout_in
+    from ncnet_tpu.ops.nc_fused_lane_vjp import (
+        _vjp_stage,
+        _vjp_stage_je,
+        _vjp_stage_vmem_bytes,
+        choose_fused_vjp,
+        cotangent_layout_in,
+        fused_vjp_compiles,
+        fused_vjp_feasible,
+        nc_stack_fused_vjp,
+    )
+    from ncnet_tpu.ops.nc_fused_lane import nc_stack_fused
+
+    print(f"device={jax.devices()[0].device_kind} n_volumes={B} arch={ARCH}")
+    shape_args = (S, S, S, S, KS, CHS)
+    print("feasible:", fused_vjp_feasible(*shape_args))
+    for l in range(len(KS)):
+        je = _vjp_stage_je(l, *shape_args)
+        mb = _vjp_stage_vmem_bytes(l, S, S, S, KS, CHS, max(je, 1)) / 2 ** 20
+        print(f"  stage {l}: je={je}  vmem≈{mb:.2f} MiB")
+    print("compiles:", fused_vjp_compiles(*shape_args))
+    print("chooser :", choose_fused_vjp(*shape_args))
+
+    key = jax.random.key(0)
+    params = make_params(key)
+    x = jax.random.normal(jax.random.key(9), (2, S, S, S, S, 1), DT) * 0.1
+    out, vjp_ref = jax.vjp(xla_stack, params, x)
+    g = jax.random.normal(jax.random.key(3), out.shape, DT) * 0.1
+    dp_ref, dx_ref = vjp_ref(g)
+    dp, dx = jax.jit(nc_stack_fused_vjp)(params, x, g)
+    worst = 0.0
+    for a, b in zip(jax.tree.leaves((dp, dx)), jax.tree.leaves((dp_ref, dx_ref))):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        worst = max(worst, float(np.max(np.abs(a - b)))
+                    / max(1e-6, float(np.max(np.abs(b)))))
+    # boundary-cell mask flips (tests/test_nc_vjp.py module docstring)
+    # inflate this on random data; the margin-built test suite is the
+    # parity authority — this prints the raw field number
+    print(f"parity vs XLA AD (raw random data): worst rel {worst:.3%}")
+
+    def make_input(key):
+        k1, k2, kk = jax.random.split(key, 3)
+        return (
+            jax.random.normal(k1, (B, S, S, S, S, 1), DT) * 0.1,
+            jax.random.normal(k2, (B, S, S, S, S, CHS[-1]), DT) * 0.1,
+            make_params(kk),
+        )
+
+    def consume(carry, dp, dx):
+        x, g, params = carry
+        eps = sum(jnp.sum(leaf.astype(jnp.float32))
+                  for l_ in dp for leaf in l_.values())
+        x = x + (eps * 1e-12).astype(x.dtype) + dx.astype(x.dtype) * 1e-12
+        return (x, g, params)
+
+    def fused_grad_step(carry):
+        x, g, params = carry
+        _, vjp = jax.vjp(nc_stack_fused, params, x)
+        dp, dx = vjp(g)
+        return consume(carry, dp, dx)
+
+    def fused_direct_step(carry):
+        x, g, params = carry
+        dp, dx = nc_stack_fused_vjp(params, x, g)
+        return consume(carry, dp, dx)
+
+    def xla_grad_step(carry):
+        x, g, params = carry
+        _, vjp = jax.vjp(xla_stack, params, x)
+        dp, dx = vjp(g)
+        return consume(carry, dp, dx)
+
+    ms_f = timeit(fused_grad_step, make_input, per=B, n_long=6)
+    ms_d = timeit(fused_direct_step, make_input, per=B, n_long=6)
+    ms_x = timeit(xla_grad_step, make_input, per=B, n_long=6)
+    print(f"fused fwd+bwd (custom vjp): {ms_f:7.3f} ms/volume")
+    print(f"fused bwd alone           : {ms_d:7.3f} ms/volume")
+    print(f"xla   fwd+bwd (autodiff)  : {ms_x:7.3f} ms/volume")
+
+    # per-stage walls (the dX/dW attribution): time each backward stage in
+    # isolation on staged layouts
+    k = KS[0]
+    for l in reversed(range(len(KS))):
+        co_l = CHS[l]
+
+        def stage_step(carry, l=l, co_l=co_l):
+            x, g, params = carry
+            xp = fused_layout_in(x, k)
+            gamma = cotangent_layout_in(
+                jnp.broadcast_to(g[..., :1], x.shape[:-1] + (co_l,))
+                if g.shape[-1] != co_l else g, k)
+            gam, dw2, dbp = _vjp_stage(
+                l, params, xp, gamma, ha=S, wa=S, hb=S, wb=S,
+                interpret=False)
+            eps = (jnp.sum(dw2) + jnp.sum(dbp)
+                   + jnp.sum(gam.astype(jnp.float32))) * 1e-12
+            return (x + eps.astype(x.dtype), g, params)
+
+        try:
+            t = timeit(stage_step, make_input, per=B, n_long=6)
+            print(f"  stage {l} (gz+dW+db+Γ): {t:7.3f} ms/volume")
+        except Exception as e:  # noqa: BLE001
+            print(f"  stage {l}: FAILED {str(e)[:160]}")
+
+
+if __name__ == "__main__":
+    main()
