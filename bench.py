@@ -27,33 +27,23 @@ IMAGE = 400
 KERNELS = (5, 5, 5)
 CHANNELS = (16, 16, 1)
 
-# bf16 peak TFLOP/s by device kind, for the MFU estimate (public specs)
-_PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,   # v5e
-    "TPU v5": 459.0,        # v5p
-    "TPU v6 lite": 918.0,   # v6e (Trillium)
-}
-
-# HBM bandwidth GB/s by device kind, for the roofline (public specs)
-_PEAK_HBM_GBPS = {
-    "TPU v4": 1228.0,
-    "TPU v5 lite": 819.0,   # v5e
-    "TPU v5": 2765.0,       # v5p
-    "TPU v6 lite": 1640.0,  # v6e
-}
+# device peaks live in ONE place — ncnet_tpu/observability/metrics.py — so
+# the bench artifact and run telemetry can never disagree on the MFU /
+# roofline denominators
+from ncnet_tpu.observability.metrics import (  # noqa: E402
+    PEAK_BF16_TFLOPS as _PEAK_TFLOPS,
+    PEAK_HBM_GBPS as _PEAK_HBM_GBPS,
+    filter_flops as _shared_filter_flops,
+)
 
 
 def _arch_filter_flops(feat_side: int) -> float:
     """True per-pair FLOPs of the SYMMETRIC NC filter at the bench arch
     (~281.2 GFLOP at the 25⁴ volume) — the constant algorithmic-MFU
     numerator shared by the roofline block and the train-step MFU
-    (correlation + mutual matching are <1% each)."""
-    cells = (feat_side * feat_side) ** 2
-    chans = list(zip((1,) + CHANNELS[:-1], CHANNELS))
-    return 2 * cells * sum(
-        2 * (k ** 4) * ci * co for k, (ci, co) in zip(KERNELS, chans)
-    )
+    (correlation + mutual matching are <1% each).  Delegates to the shared
+    observability formula (metrics.filter_flops)."""
+    return _shared_filter_flops(feat_side, KERNELS, CHANNELS)
 
 
 def _timeit_scan(step_fn, make_input, per=1, n_long=6, reps=3):
@@ -1001,6 +991,27 @@ def main():
             return None
 
     extra = {k: j for k, v in res.items() if (j := jsonable(v)) is not None}
+    # schema envelope (round 8): the artifact carries the same run envelope
+    # as the observability event log — schema version, run id, host, device
+    # kind — plus the git rev, so BENCH_r*.json and run telemetry share one
+    # attributable format.  The metric/value/unit/vs_baseline/extra keys are
+    # unchanged (the harness's parse stays bit-compatible); the metrics also
+    # flow through a MetricsRegistry, so a bound event sink (a harness that
+    # wants bench runs in its event log) records them as a `metrics` event.
+    from ncnet_tpu.observability.events import git_revision, run_envelope
+    from ncnet_tpu.observability.metrics import MetricsRegistry
+
+    envelope = run_envelope()
+    rev = git_revision()
+    if rev:
+        envelope["git_rev"] = rev
+    registry = MetricsRegistry(scope="bench")
+    for k, v in extra.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            registry.gauge(k).set(v)
+    if headline is not None and jsonable(headline) is not None:
+        registry.gauge("pf_pascal_forward_ms_per_pair").set(jsonable(headline))
+    registry.flush(run_id=envelope["run_id"])
     print(
         json.dumps(
             {
@@ -1010,6 +1021,7 @@ def main():
                 "vs_baseline": jsonable(vs_baseline)
                 if vs_baseline is not None else None,
                 "extra": extra,
+                "envelope": envelope,
             }
         )
     )

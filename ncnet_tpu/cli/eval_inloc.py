@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fetch_timeout_s", type=float, default=0.0,
                    help="watchdog around each pair fetch; a hung tunnel "
                         "becomes a retryable timeout (0 = off)")
+    p.add_argument("--telemetry_dir", type=str, default="",
+                   help="open a structured event log here (per-query events "
+                        "+ metrics; replay with tools/run_report.py)")
     return p
 
 
@@ -89,6 +92,7 @@ def main(argv=None) -> int:
         retry_backoff_s=args.retry_backoff_s,
         quarantine=args.quarantine,
         fetch_timeout_s=args.fetch_timeout_s,
+        telemetry_dir=args.telemetry_dir,
     )
     print(args)
     print("Output matches folder: " + output_folder_name(config))

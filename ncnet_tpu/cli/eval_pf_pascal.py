@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fetch_timeout_s", type=float, default=0.0,
                    help="watchdog around each result fetch; a hung tunnel "
                         "becomes a retryable timeout (0 = off)")
+    p.add_argument("--telemetry_dir", type=str, default="",
+                   help="open a structured event log here (per-batch eval "
+                        "events + metrics; replay with tools/run_report.py)")
     return p
 
 
@@ -70,6 +73,7 @@ def main(argv=None) -> int:
         quarantine=args.quarantine,
         fetch_timeout_s=args.fetch_timeout_s,
         decode_retries=args.decode_retries,
+        telemetry_dir=args.telemetry_dir,
     )
     stats = run_eval(
         config,

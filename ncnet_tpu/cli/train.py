@@ -87,6 +87,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="crash on an undecodable image instead of "
                         "quarantining it and substituting the next healthy "
                         "sample")
+    # observability (README "Observability")
+    p.add_argument("--no_telemetry", action="store_true",
+                   help="disable the structured event log + heartbeat + "
+                        "device snapshots (on by default; replay the log "
+                        "with tools/run_report.py)")
+    p.add_argument("--telemetry_dir", type=str, default="",
+                   help="where the event log + heartbeat live (default: "
+                        "<checkpoint root>/telemetry, so crash/resume "
+                        "cycles of one lineage share one log)")
     return p
 
 
@@ -129,6 +138,8 @@ def main(argv=None) -> int:
         nan_guard=not args.no_nan_guard,
         decode_retries=args.decode_retries,
         quarantine_decode_errors=not args.fail_on_bad_samples,
+        telemetry=not args.no_telemetry,
+        telemetry_dir=args.telemetry_dir,
     )
     fit(config)
     print("Done!")

@@ -126,7 +126,23 @@ class TrainConfig:
     distributed: bool = False            # jax.distributed multi-host init +
                                          # per-host input sharding
     profile_dir: str = ""                # capture a jax profiler trace here
-                                         # (also honours $NCNET_TPU_PROFILE_DIR)
+                                         # (also honours $NCNET_TPU_PROFILE_DIR;
+                                         # $NCNET_TPU_PROFILE_STEPS=<a>:<b>
+                                         # bounds the capture to exactly
+                                         # global steps [a, b))
+    # observability (ncnet_tpu/observability/; README "Observability"):
+    telemetry: bool = True               # structured run telemetry: a
+                                         # schema-versioned JSONL event log
+                                         # (step/epoch/checkpoint/NaN-skip/
+                                         # tier/quarantine events), a
+                                         # heartbeat file bumped every step,
+                                         # and periodic device snapshots.
+                                         # Primary-process only; replay with
+                                         # tools/run_report.py
+    telemetry_dir: str = ""              # where the event log + heartbeat
+                                         # live; "" = <checkpoint root>/
+                                         # telemetry (so crash/resume cycles
+                                         # of one lineage share one log)
     # fault tolerance (training/train.py "Fault tolerance" docstring;
     # no reference analog — the reference can only restart at epoch 1):
     checkpoint_steps: int = 0            # ALSO save every N train steps
@@ -181,6 +197,10 @@ class EvalPFPascalConfig:
     decode_retries: int = 1              # per-image transient decode retries
                                          # (the eval twin of
                                          # TrainConfig.decode_retries)
+    # observability (README "Observability"): open a structured event log
+    # here for the run (per-batch eval events + an eval_summary metrics
+    # flush). "" = emit only to an already-bound global sink, if any
+    telemetry_dir: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +258,10 @@ class EvalInLocConfig:
     write_manifest: bool = True          # journal completed / quarantined /
                                          # in-flight queries to
                                          # <out_dir>/manifest.json
+    # observability (README "Observability"): open a structured event log
+    # here for the run (per-query events + an eval_summary metrics flush).
+    # "" = emit only to an already-bound global sink, if any
+    telemetry_dir: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

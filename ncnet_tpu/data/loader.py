@@ -166,8 +166,14 @@ class DataLoader:
             fresh = err.path not in self.quarantined
             self.quarantined.add(err.path)
         if fresh:
-            print(f"[fault-tolerance] quarantined undecodable sample "
-                  f"{err.path!r}: {err}")
+            from ncnet_tpu.observability import events as obs_events
+            from ncnet_tpu.observability import get_logger
+
+            get_logger("data").warning(
+                f"[fault-tolerance] quarantined undecodable sample "
+                f"{err.path!r}: {err}", kind="decode")
+            obs_events.emit("quarantine", unit=str(err.path), kind="decode",
+                            scope="sample", error=str(err)[:300])
 
     # fresh (not previously known-bad) decode failures tolerated within ONE
     # substitution scan before declaring the failure systemic: large enough

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import math
 import os
+import time
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -43,6 +44,10 @@ import numpy as np
 from ncnet_tpu.config import EvalInLocConfig, ModelConfig
 from ncnet_tpu.data.datasets import load_image
 from ncnet_tpu.evaluation.pipeline import PipelineDepthController
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability import get_logger
+
+log = get_logger("eval.inloc")
 from ncnet_tpu.models.ncnet import (
     extract_features,
     ncnet_forward,
@@ -287,9 +292,10 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
                           config.ncons_kernel_sizes)
         if not ok and tgt_shape not in warned_shapes:
             warned_shapes.add(tgt_shape)
-            print(f"warning: target shape {tuple(tgt_shape)} (fine hB={hb}) "
-                  f"does not shard over {n} devices; falling back to the "
-                  "single-device forward for this shape bucket")
+            log.warning(f"target shape {tuple(tgt_shape)} (fine hB={hb}) "
+                        f"does not shard over {n} devices; falling back to "
+                        "the single-device forward for this shape bucket",
+                        kind="validation")
         return ok
 
     def to_model_input(x):
@@ -573,7 +579,7 @@ def run_inloc_eval(
     def process_query(q, io_pool):
         out_path = os.path.join(out_dir, f"{q + 1}.mat")
         if progress:
-            print(q)
+            log.info(str(q))
         matches = np.zeros((1, config.n_panos, n_cap, 5))
         jobs = pano_jobs(q)
         # an empty shortlist row still writes its all-zeros table
@@ -619,8 +625,9 @@ def run_inloc_eval(
                 # non-3:4-aspect pano overflowing the nominal table (the
                 # reference would crash here): keep the n_cap highest-scoring
                 # rows, preserving their current order
-                print(f"warning: {len(xa)} matches exceed capacity {n_cap}; "
-                      "keeping highest-scoring rows")
+                log.warning(f"{len(xa)} matches exceed capacity {n_cap}; "
+                            "keeping highest-scoring rows",
+                            kind="validation")
                 sel = np.sort(np.argsort(-score, kind="stable")[:n_cap])
                 xa, ya, xb, yb, score = (v[sel] for v in (xa, ya, xb, yb, score))
             npts = len(xa)
@@ -630,7 +637,7 @@ def run_inloc_eval(
             matches[0, idx, :npts, 3] = yb[:npts]
             matches[0, idx, :npts, 4] = score[:npts]
             if progress and idx % 10 == 0:
-                print(">>>" + str(idx))
+                log.info(">>>" + str(idx))
 
         for idx in range(len(jobs)):
             tgt = pending.result()
@@ -673,8 +680,35 @@ def run_inloc_eval(
                          quarantine=config.quarantine)
     breaker = QuarantineBreaker(policy.max_consecutive_quarantines)
 
-    depth_ctl = _PipelineDepthController(config.pipeline_depth)
-    with ThreadPoolExecutor(max_workers=1) as io_pool:
+    # observability: an explicit telemetry dir opens (and globally binds) an
+    # event log for the run — per-query events here, retry/quarantine/tier
+    # events from the deep layers; otherwise events flow to any sink the
+    # caller already bound, or nowhere, for free
+    own_sink = prev_sink = None
+    n_done = 0
+    if config.telemetry_dir:
+        from ncnet_tpu.observability.events import EventLog
+
+        # one file PER HOST under striping (the PR 3 manifests' rule):
+        # EventLog's torn-tail sealing and fsynced appends assume a single
+        # writer, so hosts must never share an append fd; run_report takes
+        # multiple logs
+        log_name = ("events.jsonl" if host_count == 1
+                    else f"events.host{host_index}.jsonl")
+        own_sink = EventLog(
+            os.path.join(config.telemetry_dir, log_name),
+            run_meta={"eval": "inloc",
+                      "experiment": output_folder_name(config),
+                      "host_index": host_index,
+                      "host_count": host_count},
+        )
+        prev_sink = obs_events.set_global_sink(own_sink)
+        own_sink.emit("run_start",
+                      envelope=obs_events.run_envelope(own_sink.run_id),
+                      eval="inloc", n_queries=n_queries)
+
+    def _query_loop(io_pool):
+        nonlocal n_done
         for q in range(host_index, n_queries, host_count):
             qid = f"query_{q + 1}"
             out_path = os.path.join(out_dir, f"{q + 1}.mat")
@@ -700,7 +734,7 @@ def run_inloc_eval(
                 if vouched or not config.validate_existing \
                         or validate_matches_mat(out_path, config.n_panos, n_cap):
                     if progress:
-                        print(f"{q} (exists, skipped)")
+                        log.info(f"{q} (exists, skipped)")
                     if manifest is not None and not vouched \
                             and config.validate_existing:
                         manifest.complete(qid, skipped=True)
@@ -710,8 +744,9 @@ def run_inloc_eval(
                     # back-to-back and falsely abort as systemic
                     breaker.note(False)
                     continue
-                print(f"warning: {out_path} exists but failed validation "
-                      "(foreign or truncated artifact); recomputing")
+                log.warning(f"{out_path} exists but failed validation "
+                            "(foreign or truncated artifact); recomputing",
+                            kind="validation")
 
             def on_failure(exc, kind):
                 # an aborted drain leaves the controller's interval anchor
@@ -724,6 +759,7 @@ def run_inloc_eval(
                     return recover_from_device_failure(exc, matcher)
                 return None
 
+            t_q = time.perf_counter()
             ok, _ = run_isolated(
                 qid,
                 lambda q=q: process_query(q, io_pool),
@@ -736,7 +772,29 @@ def run_inloc_eval(
             # broken: abort loudly (SystemicEvalError) instead of
             # quarantining the rest of an hours-long run one by one
             breaker.note(not ok)
-    if manifest is not None and manifest.quarantined_ids:
-        print("warning: quarantined queries (see manifest.json): "
-              + ", ".join(manifest.quarantined_ids))
+            if ok:
+                n_done += 1
+            obs_events.emit(
+                "eval_query", query=q + 1, ok=bool(ok),
+                wall_s=round(time.perf_counter() - t_q, 6),
+                pipeline_depth=depth_ctl.depth,
+            )
+
+    try:
+        depth_ctl = _PipelineDepthController(config.pipeline_depth)
+        with ThreadPoolExecutor(max_workers=1) as io_pool:
+            _query_loop(io_pool)
+        if manifest is not None and manifest.quarantined_ids:
+            log.warning("quarantined queries (see manifest.json): "
+                        + ", ".join(manifest.quarantined_ids),
+                        kind="quarantine")
+        obs_events.emit(
+            "eval_summary", eval="inloc", completed=n_done,
+            quarantined=(list(manifest.quarantined_ids)
+                         if manifest is not None else []),
+        )
+    finally:
+        if own_sink is not None:
+            obs_events.set_global_sink(prev_sink)
+            own_sink.close()
     return out_dir

@@ -56,9 +56,14 @@ from ncnet_tpu.evaluation.pipeline import (
     call_with_watchdog,
 )
 from ncnet_tpu.models import NCNet
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability import get_logger
+from ncnet_tpu.observability.metrics import MetricsRegistry
 from ncnet_tpu.ops import corr_to_matches
 from ncnet_tpu.ops.image import normalize_imagenet, quantize_u8
 from ncnet_tpu.utils.profiling import annotate
+
+log = get_logger("eval.pf_pascal")
 
 
 def make_eval_step(net: NCNet, alpha: float, device_normalize: bool = False):
@@ -104,6 +109,50 @@ def run_eval(
     progress: bool = True,
     device_normalize: bool = True,
     pipeline_depth: int = 0,
+) -> Dict[str, float]:
+    """Evaluate PCK@alpha on the PF-Pascal test split.  See
+    :func:`_run_eval_impl` for the full contract; this wrapper owns the
+    observability scope: when ``config.telemetry_dir`` is set it opens an
+    event log there and binds it as the process-global sink for the run
+    (restored on every exit path), so the loop's ``eval_batch`` events and
+    the deep layers' retry/quarantine/tier events all land in one file."""
+    own_sink = prev_sink = None
+    if config.telemetry_dir:
+        from ncnet_tpu.observability.events import EventLog
+
+        own_sink = EventLog(
+            os.path.join(config.telemetry_dir, "events.jsonl"),
+            run_meta={"eval": "pf_pascal",
+                      "checkpoint": config.checkpoint,
+                      "image_size": config.image_size,
+                      "batch_size": batch_size},
+        )
+        prev_sink = obs_events.set_global_sink(own_sink)
+        own_sink.emit("run_start",
+                      envelope=obs_events.run_envelope(own_sink.run_id),
+                      eval="pf_pascal")
+    try:
+        return _run_eval_impl(
+            config, model_config, net, batch_size, num_workers, progress,
+            device_normalize, pipeline_depth,
+        )
+    finally:
+        if own_sink is not None:
+            obs_events.set_global_sink(prev_sink)
+            own_sink.close()
+
+
+def _run_eval_impl(
+    # defaults live on run_eval (the public wrapper) ONLY — keeping a
+    # second copy here would let the two drift apart silently
+    config: EvalPFPascalConfig,
+    model_config: Optional[ModelConfig],
+    net: Optional[NCNet],
+    batch_size: int,
+    num_workers: int,
+    progress: bool,
+    device_normalize: bool,
+    pipeline_depth: int,
 ) -> Dict[str, float]:
     """Evaluate PCK@alpha on the PF-Pascal test split.
 
@@ -179,6 +228,7 @@ def run_eval(
         manifest = RunManifest(
             os.path.join(config.journal_dir, "manifest.json"), meta=header)
 
+    registry = MetricsRegistry(scope="pf_pascal_eval")
     results = []
     quarantined_batches = []
     n_batches = len(loader)
@@ -265,8 +315,22 @@ def run_eval(
     def drain_one(sample: bool = True):
         handle, n0, bi, jb = in_flight.pop(0)
         t0 = time.perf_counter()
-        results.append(resolve_batch(bi, jb, n0, handle))
-        timing["fetch_s"] += time.perf_counter() - t0
+        arr = resolve_batch(bi, jb, n0, handle)
+        results.append(arr)
+        fetch_wall = time.perf_counter() - t0
+        timing["fetch_s"] += fetch_wall
+        registry.timer("fetch_wall").observe(fetch_wall)
+        registry.counter("batches").inc()
+        registry.gauge("pipeline_depth").set(depth_ctl.depth)
+        if obs_events.get_global_sink() is not None:
+            good = arr[~np.isnan(arr)]
+            obs_events.emit(
+                "eval_batch", batch=bi, n=int(arr.size),
+                valid=int(good.size),
+                pck=float(np.mean(good)) if good.size else None,
+                fetch_wall_s=round(fetch_wall, 6),
+                pipeline_depth=depth_ctl.depth,
+            )
         if sample:
             depth_ctl.note_drain()
         else:
@@ -292,7 +356,7 @@ def run_eval(
             breaker.note(False)
             depth_ctl.note_gap()
             if progress:
-                print(f"Batch: [{i}/{n_batches}] (journaled, skipped)")
+                log.info(f"Batch: [{i}/{n_batches}] (journaled, skipped)")
             t_decode = time.perf_counter()
             continue
         t0 = time.perf_counter()
@@ -334,8 +398,8 @@ def run_eval(
             from ncnet_tpu.evaluation.resilience import classify_failure
 
             kind = classify_failure(e)
-            print(f"warning: PF-Pascal batch {i}: {kind} failure at "
-                  f"dispatch: {type(e).__name__}: {e}")
+            log.warning(f"PF-Pascal batch {i}: {kind} failure at "
+                        f"dispatch: {type(e).__name__}: {e}", kind=kind)
             depth_ctl.note_failure()
             if kind == "device":
                 recover_from_device_failure(e, step)
@@ -345,7 +409,8 @@ def run_eval(
         while len(in_flight) >= depth_ctl.depth:
             drain_one()
         if progress:
-            print(f"Batch: [{i}/{n_batches} ({100.0 * i / n_batches:.0f}%)]")
+            log.info(f"Batch: [{i}/{n_batches} "
+                     f"({100.0 * i / n_batches:.0f}%)]")
         t_decode = time.perf_counter()
     while in_flight:
         drain_one(sample=False)
@@ -358,7 +423,7 @@ def run_eval(
     # had a -1 sentinel in its preallocated stats array — pck() here never
     # produces one)
     good = np.flatnonzero(~np.isnan(results))
-    return {
+    stats = {
         "pck": float(np.mean(results[good])) if good.size else float("nan"),
         "total": int(results.size),
         "valid": int(good.size),
@@ -367,3 +432,12 @@ def run_eval(
         "quarantined_batches": quarantined_batches,
         "decode_quarantined": sorted(loader.quarantined),
     }
+    registry.timer("decode_wall").observe(timing["decode_s"])
+    registry.timer("dispatch_wall").observe(timing["dispatch_s"])
+    registry.counter("quarantined_batches").inc(len(quarantined_batches))
+    registry.counter("decode_quarantined").inc(
+        len(stats["decode_quarantined"]))
+    registry.gauge("pck").set(stats["pck"])
+    registry.flush(event="eval_summary", total=stats["total"],
+                   valid=stats["valid"])
+    return stats

@@ -117,7 +117,7 @@ class PipelineDepthController:
             # judge the speculative deepen against the wall it tried to cut
             if self._ewma > 0.85 * self._probe:
                 # no improvement: the slowness is compute, not latency
-                self.depth -= 1
+                self._change_depth(self.depth - 1, "probe_reverted")
                 self._block = self._probe
                 self._probe = None
                 self._reset_ewma()
@@ -130,16 +130,22 @@ class PipelineDepthController:
                else min(1.3 * best, self._low_cap))
         if self._ewma > high and self.depth < 4 and self._block is None:
             self._probe = self._ewma
-            self.depth += 1
+            self._change_depth(self.depth + 1, "deepen_probe")
             self._reset_ewma()
         elif self._ewma < low:
             # regime recovered: lift any failed-probe block even at depth 2,
             # or a later genuine latency regime could never deepen
             self._block = None
             if self.depth > 2:
-                self.depth = 2
+                self._change_depth(2, "recovered")
                 self._probe = None
                 self._reset_ewma()
+
+    def _change_depth(self, new: int, reason: str) -> None:
+        old, self.depth = self.depth, new
+        from ncnet_tpu.observability import events as obs_events
+
+        obs_events.emit("pipeline_depth", depth=new, prev=old, reason=reason)
 
     def _reset_ewma(self) -> None:
         # resets the decision window + anchor only, NOT the min-wall window:
@@ -211,6 +217,10 @@ def call_with_watchdog(fn, args=(), timeout: float = 0.0, label: str = ""):
     )
     worker.start()
     if not done.wait(timeout):
+        from ncnet_tpu.observability import events as obs_events
+
+        obs_events.emit("watchdog_timeout", label=label or "fetch",
+                        timeout_s=float(timeout))
         raise FetchTimeoutError(
             f"{label or 'fetch'} exceeded its {timeout:.1f}s watchdog "
             "(hung tunnel or wedged device?)"

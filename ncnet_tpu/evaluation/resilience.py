@@ -42,7 +42,11 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability import get_logger
 from ncnet_tpu.utils.io import atomic_write_json
+
+log = get_logger("resilience")
 
 
 def classify_failure(exc: BaseException) -> str:
@@ -163,15 +167,17 @@ class RunManifest:
                 # atomic writes should make this impossible; a foreign or
                 # hand-edited file starts the manifest fresh rather than
                 # crashing the run it exists to protect
-                print(f"warning: unreadable run manifest {path}; starting fresh")
+                log.warning(f"unreadable run manifest {path}; starting fresh",
+                            kind="validation")
                 loaded = None
             if loaded and meta is not None and loaded.get("meta") != meta:
                 # the manifest belongs to a DIFFERENT configuration (same
                 # guard as EvalJournal's header): adopting its completed /
                 # quarantined maps would report another experiment's units
                 # as this run's
-                print(f"warning: run manifest {path} belongs to a different "
-                      "run configuration; starting fresh")
+                log.warning(f"run manifest {path} belongs to a different "
+                            "run configuration; starting fresh",
+                            kind="validation")
                 loaded = None
             if loaded:
                 for key in ("completed", "quarantined", "in_flight"):
@@ -268,19 +274,27 @@ def run_isolated(
                 # immediately, and do NOT count the attempt — the budget is
                 # for retrying the SAME program, and a post-recovery
                 # transient still deserves its full plain-retry allowance
-                print(f"warning: {name}: {kind} failure (recovered: "
-                      f"{recovered}; retrying off-budget): "
-                      f"{type(e).__name__}: {e}")
+                log.warning(f"{name}: {kind} failure (recovered: "
+                            f"{recovered}; retrying off-budget): "
+                            f"{type(e).__name__}: {e}", kind=kind)
+                obs_events.emit("retry", unit=str(unit_id), kind=kind,
+                                recovered=str(recovered), on_budget=False)
                 continue
             attempts += 1
-            print(f"warning: {name}: {kind} failure "
-                  f"(attempt {attempts}): {type(e).__name__}: {e}")
+            log.warning(f"{name}: {kind} failure "
+                        f"(attempt {attempts}): {type(e).__name__}: {e}",
+                        kind=kind)
             if attempts <= policy.retries:
+                obs_events.emit("retry", unit=str(unit_id), kind=kind,
+                                attempt=attempts, on_budget=True)
                 time.sleep(policy.backoff_s * 2 ** (attempts - 1))
                 continue
             if policy.quarantine:
-                print(f"warning: {name}: quarantined after {attempts} "
-                      f"attempt(s) — the run continues without it")
+                log.warning(f"{name}: quarantined after {attempts} "
+                            f"attempt(s) — the run continues without it",
+                            kind="quarantine")
+                obs_events.emit("quarantine", unit=str(unit_id), kind=kind,
+                                attempts=attempts, error=str(e)[:300])
                 if manifest is not None:
                     manifest.quarantine(unit_id, kind, str(e), attempts)
                 return False, None
@@ -345,8 +359,8 @@ class EvalJournal:
                 # the displaced run's accumulated results should survive it
                 stale = self.path + ".stale"
                 os.replace(self.path, stale)
-                print(f"warning: set the non-resumable journal aside as "
-                      f"{stale}")
+                log.warning(f"set the non-resumable journal aside as "
+                            f"{stale}", kind="validation")
             self._f = open(self.path, "w")
             self._write_raw(json.dumps({"header": self.header},
                                        sort_keys=True) + "\n")
@@ -377,8 +391,9 @@ class EvalJournal:
         except ValueError:
             head = None
         if not isinstance(head, dict) or head.get("header") != self.header:
-            print(f"warning: eval journal {self.path} belongs to a different "
-                  "run configuration; starting fresh")
+            log.warning(f"eval journal {self.path} belongs to a different "
+                        "run configuration; starting fresh",
+                        kind="validation")
             return None
         good_bytes = len(lines[0]) + 1
         # every element except the LAST was newline-terminated; the last is
@@ -400,8 +415,9 @@ class EvalJournal:
                 rec = json.loads(line)
                 self.entries[int(rec["batch"])] = _decode_f32(rec["pck"])
             except (ValueError, KeyError, TypeError):
-                print(f"warning: eval journal {self.path}: skipping "
-                      f"undecodable line {i} (its batch will recompute)")
+                log.warning(f"eval journal {self.path}: skipping "
+                            f"undecodable line {i} (its batch will "
+                            "recompute)", kind="validation")
         return good_bytes
 
     def _write_raw(self, text: str) -> None:

@@ -20,6 +20,9 @@ import numpy as np
 
 from ncnet_tpu.config import LocalizationConfig
 from ncnet_tpu.localization import geometry
+from ncnet_tpu.observability import get_logger
+
+log = get_logger("localization")
 from ncnet_tpu.localization.curves import (
     MethodResult,
     load_reference_poses,
@@ -141,8 +144,12 @@ def _worker_init() -> None:
         # (RuntimeError).  Anything else is a bug that should surface, not
         # be swallowed — per-query failures are isolated at the stage level
         # (run_pnp_stage's run_isolated + manifest), not here.
-        print(f"warning: pool worker could not pin the CPU backend ({e}); "
-              "workers may contend for the accelerator", file=sys.stderr)
+        # sys.stderr directly, not the logger: this runs in a freshly
+        # spawned pool worker whose stdout may be inherited mid-capture,
+        # and stderr is where the parent's diagnostics are collected
+        sys.stderr.write(
+            f"warning: pool worker could not pin the CPU backend ({e}); "
+            "workers may contend for the accelerator\n")
 
 
 def _spawn_pool(num_workers: int):
@@ -198,7 +205,7 @@ def _pnp_one_query(config: LocalizationConfig, qi: int, qname: str,
         )
         poses.append(P)
         if config.progress:
-            print(f"nc4dPE: {qname} vs {db_fn} DONE.")
+            log.info(f"nc4dPE: {qname} vs {db_fn} DONE.")
     return {"queryname": qname, "topNname": top_names, "P": poses}
 
 
@@ -299,10 +306,11 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
         # partial result, but let the next run retry the quarantined
         # queries; the per-pair artifacts in pnp_dir make the recompute of
         # the completed queries cheap (run_pair_pnp resumes from them).
-        print("warning: PnP stage completed with quarantined queries "
-              f"({', '.join(manifest.quarantined_ids)}); the stage .mat is "
-              "NOT written so a rerun retries them (completed queries "
-              "resume from their per-pair artifacts)")
+        log.warning("PnP stage completed with quarantined queries "
+                    f"({', '.join(manifest.quarantined_ids)}); the stage "
+                    ".mat is NOT written so a rerun retries them (completed "
+                    "queries resume from their per-pair artifacts)",
+                    kind="quarantine")
         return imglist
     os.makedirs(config.output_dir, exist_ok=True)
     _save_imglist(out_path, imglist)
@@ -399,8 +407,8 @@ def run_pv_stage(
             for gi, ((key, _), part) in enumerate(zip(group_map, results)):
                 scores.update(part)
                 if config.progress:
-                    print(f"ncnetPV: scan {key} ({gi + 1} / "
-                          f"{len(groups)}) done.")
+                    log.info(f"ncnetPV: scan {key} ({gi + 1} / "
+                             f"{len(groups)}) done.")
     else:
         scores = _pv_run_items(
             config, [(it.query_fn, it.db_fn, it.P) for it in items]
@@ -421,9 +429,10 @@ def run_pv_stage(
     if pin_resume:
         _save_imglist(out_path, reranked)
     else:
-        print("warning: densePV stage ran on a degraded (quarantined) PnP "
-              "result; its stage .mat is NOT written so a rerun recomputes "
-              "from the retried PnP stage")
+        log.warning("densePV stage ran on a degraded (quarantined) PnP "
+                    "result; its stage .mat is NOT written so a rerun "
+                    "recomputes from the retried PnP stage",
+                    kind="quarantine")
     return reranked
 
 

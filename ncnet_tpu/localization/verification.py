@@ -16,6 +16,9 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 import numpy as np
 
 from ncnet_tpu.localization import geometry
+from ncnet_tpu.observability import get_logger
+
+log = get_logger("localization")
 from ncnet_tpu.localization.dsift import pose_verification_score, rgb_to_gray
 from ncnet_tpu.localization.render import render_points_perspective
 from ncnet_tpu.localization.scan import (
@@ -177,7 +180,7 @@ def run_pose_verification(
                     do_compression=True,
                 )
         if progress:
-            print(f"ncnetPV: scan {key} ({gi + 1} / {len(groups)}) done.")
+            log.info(f"ncnetPV: scan {key} ({gi + 1} / {len(groups)}) done.")
     return scores
 
 

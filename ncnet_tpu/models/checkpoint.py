@@ -258,9 +258,15 @@ def with_io_retries(
             last = e
             if i + 1 < max(attempts, 1):
                 delay = backoff * (2 ** i)
-                print(f"[fault-tolerance] {what} failed "
-                      f"(attempt {i + 1}/{attempts}): {e}; retrying in "
-                      f"{delay:.1f}s")
+                from ncnet_tpu.observability import events as obs_events
+                from ncnet_tpu.observability import get_logger
+
+                get_logger("checkpoint").warning(
+                    f"[fault-tolerance] {what} failed "
+                    f"(attempt {i + 1}/{attempts}): {e}; retrying in "
+                    f"{delay:.1f}s", kind="io")
+                obs_events.emit("io_retry", what=what, attempt=i + 1,
+                                attempts=attempts, error=str(e)[:300])
                 time.sleep(delay)
     raise last  # type: ignore[misc]
 

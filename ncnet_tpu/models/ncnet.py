@@ -35,7 +35,10 @@ from ncnet_tpu.ops import (
     maxpool4d_with_argmax,
     mutual_matching,
 )
+from ncnet_tpu.observability import get_logger
 from ncnet_tpu.utils import faults
+
+log = get_logger("models")
 
 
 def _runtime_device_error_types() -> Tuple[type, ...]:
@@ -150,10 +153,10 @@ def recover_from_device_failure(exc: BaseException, *retraceables,
         tier = demote_fused_tier()
     if tier is None:
         return None
-    print(
-        f"warning: runtime device failure ({type(exc).__name__}: {exc}); "
+    log.warning(
+        f"runtime device failure ({type(exc).__name__}: {exc}); "
         f"demoting fused NC tier '{tier}' and re-tracing the eval programs "
-        "— the run continues on the next tier"
+        "— the run continues on the next tier", kind="device",
     )
     for r in retraceables:
         r.retrace()

@@ -1,0 +1,73 @@
+"""Unified run telemetry: event log, metrics registry, leveled logging,
+device introspection + heartbeat.
+
+Wired through training (``training/train.py``), eval
+(``evaluation/pf_pascal.py`` / ``inloc.py``), ops tiering
+(``ops/nc_fused_lane*.py``) and the resilience layer
+(``evaluation/resilience.py``).  ``tools/run_report.py`` replays the event
+logs into a run report; ``tools/check_no_bare_print.py`` (tier-1 enforced)
+keeps library modules on the structured logger.  See README
+"Observability" for the event schema and knobs.
+"""
+
+from ncnet_tpu.observability.events import (  # noqa: F401
+    SCHEMA_VERSION,
+    EventLog,
+    bound,
+    emit,
+    get_global_sink,
+    git_revision,
+    make_run_id,
+    replay_events,
+    run_envelope,
+    set_global_sink,
+)
+from ncnet_tpu.observability.logging import (  # noqa: F401
+    LOG_LEVEL_ENV,
+    Logger,
+    get_logger,
+)
+from ncnet_tpu.observability.metrics import (  # noqa: F401
+    PEAK_BF16_TFLOPS,
+    PEAK_HBM_GBPS,
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    Timer,
+    device_peak_tflops,
+    filter_flops,
+    train_step_flops,
+)
+from ncnet_tpu.observability.device import (  # noqa: F401
+    DeviceMonitor,
+    Heartbeat,
+    device_snapshot,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EventLog",
+    "bound",
+    "emit",
+    "get_global_sink",
+    "git_revision",
+    "make_run_id",
+    "replay_events",
+    "run_envelope",
+    "set_global_sink",
+    "LOG_LEVEL_ENV",
+    "Logger",
+    "get_logger",
+    "PEAK_BF16_TFLOPS",
+    "PEAK_HBM_GBPS",
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "Timer",
+    "device_peak_tflops",
+    "filter_flops",
+    "train_step_flops",
+    "DeviceMonitor",
+    "Heartbeat",
+    "device_snapshot",
+]

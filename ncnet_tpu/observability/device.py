@@ -1,0 +1,136 @@
+"""Device introspection + heartbeat: liveness signals for external watchdogs.
+
+Two complementary signals:
+
+  * :func:`device_snapshot` / :class:`DeviceMonitor` — periodic
+    ``jax.local_devices()`` + per-device ``memory_stats()`` snapshots
+    emitted as ``device_snapshot`` events, so a replayed run shows HBM
+    pressure alongside the step/tier timeline (a tier demotion under
+    RESOURCE_EXHAUSTED becomes attributable, not mysterious).
+  * :class:`Heartbeat` — a tiny JSON file whose mtime is bumped atomically
+    (temp + ``os.replace``) at every training step.  The contract for
+    external watchdogs: *mtime age > a few step walls ⇒ the process is
+    stalled or dead* — readable with ``stat`` alone, no JSON parse, no jax,
+    no shared memory with the watched process.  The payload (step, pid,
+    run id, time) is for the human who shows up next.
+
+Both are fail-open: a snapshot or beat that cannot be taken degrades to
+nothing — liveness reporting must never be the thing that kills the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ncnet_tpu.observability import events as _events
+from ncnet_tpu.utils.profiling import annotate
+
+
+def device_snapshot() -> List[Dict[str, Any]]:
+    """One dict per local device: id/kind/platform (+ memory stats where the
+    backend exposes them; CPU backends typically do not)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no initialized backend = no snapshot
+        return []
+    out: List[Dict[str, Any]] = []
+    for d in devices:
+        entry: Dict[str, Any] = {
+            "id": int(d.id),
+            "kind": str(d.device_kind),
+            "platform": str(d.platform),
+        }
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — optional per-backend API
+            stats = None
+        if stats:
+            # keep the two numbers watchdogs act on; the full dict is large
+            # and backend-specific
+            for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+                if key in stats:
+                    entry[key] = int(stats[key])
+        out.append(entry)
+    return out
+
+
+class DeviceMonitor:
+    """Rate-limited ``device_snapshot`` event emitter.
+
+    ``maybe_emit(step=...)`` snapshots at most once per ``every_s`` seconds
+    (the first call always emits, so every instrumented run records its
+    device inventory even if it dies young)."""
+
+    def __init__(self, every_s: float = 60.0):
+        self.every_s = float(every_s)
+        self._last: Optional[float] = None
+
+    def maybe_emit(self, step: Optional[int] = None) -> bool:
+        now = time.monotonic()
+        if self._last is not None and now - self._last < self.every_s:
+            return False
+        self._last = now
+        with annotate("device_snapshot"):
+            snap = device_snapshot()
+        _events.emit("device_snapshot", devices=snap,
+                     **({"step": step} if step is not None else {}))
+        return True
+
+
+class Heartbeat:
+    """Atomic-mtime heartbeat file (see the module docstring contract).
+
+    ``beat()`` writes ``{"time", "pid", "step", "run"}`` to a temp file and
+    ``os.replace``s it over ``path`` — the mtime bump and the payload are
+    one atomic unit, so a reader never sees a torn document and the mtime
+    never moves without a consistent payload behind it."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        self.path = path
+        self.run_id = run_id
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def beat(self, step: Optional[int] = None, **fields) -> None:
+        doc = {"time": time.time(), "pid": os.getpid()}
+        if step is not None:
+            doc["step"] = int(step)
+        if self.run_id:
+            doc["run"] = self.run_id
+        doc.update(fields)
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            # fail-open: a beat that cannot land (disk full) must not kill
+            # the step it reports on; the watchdog sees a stale mtime and
+            # that is the correct signal for a host whose disk is gone
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    @staticmethod
+    def read(path: str) -> Optional[Dict[str, Any]]:
+        """The last beat's payload, or None (missing/unreadable)."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def age_s(path: str) -> Optional[float]:
+        """Seconds since the last beat (mtime-based — the watchdog's one
+        syscall), or None when the file is missing."""
+        try:
+            return max(0.0, time.time() - os.stat(path).st_mtime)
+        except OSError:
+            return None

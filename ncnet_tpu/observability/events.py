@@ -1,0 +1,333 @@
+"""Structured event log: the machine-readable twin of the console output.
+
+PR 1-4 built fault-tolerant training, resilient eval, and a tiered Pallas
+stack — but every signal those layers emit (NaN-guard skips, tier demotions,
+quarantines, retries, step walls) was an unstructured stdout line that died
+with the terminal.  This module gives every run a durable, replayable trace:
+
+  * :class:`EventLog` — append-only JSONL.  Line 1 is a schema-versioned
+    header carrying the run envelope (run id, host, pid, device kinds);
+    every later line is one typed event ``{"t": ..., "run": ..., "seq": ...,
+    "event": ..., **fields}``.  Appends are flushed+fsynced and a process
+    killed mid-append leaves at worst a torn trailing line that
+    :func:`replay_events` detects and drops — the same discipline (and the
+    same fault-injection proof obligations) as
+    ``evaluation/resilience.EvalJournal``.
+  * Resume lineage: re-opening an existing log with a matching schema
+    APPENDS (each run/resume contributes its own ``run_start`` /
+    ``resume`` events under a fresh run id), so one file holds the whole
+    crash/resume history of a training root and
+    ``tools/run_report.py`` can reconstruct it.  A schema-mismatched or
+    foreign file is set aside as ``<path>.stale``, never destroyed.
+  * A process-global sink (:func:`set_global_sink` / :func:`emit` /
+    :func:`bound`) so deep layers — the ops tier registry, the resilience
+    retry loop, the data loader — can emit events without threading a log
+    handle through every signature.  ``emit`` is a no-op returning after one
+    ``is None`` check when no sink is bound: library code pays nothing in
+    un-instrumented processes (the ``utils/faults.py`` hook discipline).
+
+Telemetry must never kill the run it observes: a failing global-sink append
+(disk full, revoked mount) disables the sink and reports through stderr
+instead of raising into the training loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+_LOG_KIND = "ncnet_tpu_events"
+
+
+def make_run_id() -> str:
+    """Unique-enough run id: seconds + pid + random suffix (readable in the
+    log, collision-safe across hosts restarting in the same second)."""
+    import secrets
+
+    return f"{int(time.time()):x}-{os.getpid():x}-{secrets.token_hex(3)}"
+
+
+def run_envelope(run_id: Optional[str] = None) -> Dict[str, Any]:
+    """The who/where envelope stamped into headers, ``run_start`` events and
+    bench artifacts: schema version, run id, host, pid, and the device
+    kinds jax sees (absent when jax is not importable/initialized — the
+    envelope must be buildable from tools that never touch an accelerator).
+    """
+    env: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "run_id": run_id or make_run_id(),
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "time": time.time(),
+    }
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        env["device_kind"] = devices[0].device_kind if devices else None
+        env["device_count"] = len(devices)
+        env["process_index"] = jax.process_index()
+    except Exception:  # noqa: BLE001 — tools without jax still get an envelope
+        pass
+    return env
+
+
+def git_revision(repo_dir: Optional[str] = None) -> Optional[str]:
+    """Short git revision of ``repo_dir`` (default: this package's repo),
+    or None outside a work tree — bench stamps it into its envelope so a
+    metrics artifact is attributable to the exact code that produced it."""
+    import subprocess
+
+    if repo_dir is None:
+        repo_dir = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo_dir, "rev-parse", "--short", "HEAD"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rev = out.stdout.strip()
+    return rev if out.returncode == 0 and rev else None
+
+
+def _parse_lines(raw: bytes) -> Tuple[Optional[dict], List[dict], int]:
+    """Shared tail-tolerant JSONL parse: ``(header, records, good_bytes)``.
+
+    ``header`` is None when line 1 is missing/torn/foreign.  ``good_bytes``
+    is the offset of the end of the last newline-TERMINATED line — the
+    truncation point for an appender (a newline-less tail is dropped even if
+    it parses; see EvalJournal._load for the full argument).  Undecodable
+    terminated lines mid-file are skipped, not fatal: records are
+    independent and a torn-then-sealed write must not poison later events.
+    """
+    lines = raw.split(b"\n")
+    if len(lines) < 2 or not lines[0]:
+        return None, [], 0
+    try:
+        head = json.loads(lines[0])
+    except ValueError:
+        head = None
+    if not isinstance(head, dict) or head.get("kind") != _LOG_KIND:
+        return None, [], 0
+    good_bytes = len(lines[0]) + 1
+    records: List[dict] = []
+    for i, line in enumerate(lines[1:], start=2):
+        if i == len(lines):
+            break  # the unterminated tail (or the clean-file b"")
+        good_bytes += len(line) + 1
+        if not line:
+            continue  # a sealing newline after a repaired torn write
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn-but-terminated line: skip, keep later records
+        if isinstance(rec, dict):
+            records.append(rec)
+    return head, records, good_bytes
+
+
+def replay_events(path: str) -> Tuple[Dict[str, Any], List[dict]]:
+    """Replay an event log from disk: ``(header, events)``.
+
+    Torn-tail tolerant (a process killed mid-append loses at most the
+    partial trailing line).  Raises ``FileNotFoundError`` for a missing
+    file and ``ValueError`` for a file that is not an ncnet_tpu event log
+    or whose schema version this code does not read.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    head, records, _ = _parse_lines(raw)
+    if head is None:
+        raise ValueError(f"{path} is not an ncnet_tpu event log")
+    if head.get("schema", 0) > SCHEMA_VERSION:
+        raise ValueError(
+            f"{path} has schema {head.get('schema')}, newer than this "
+            f"reader ({SCHEMA_VERSION})"
+        )
+    return head, records
+
+
+class EventLog:
+    """Append-only, schema-versioned, crash-safe event log (JSONL).
+
+    Opening a path that already holds a compatible log APPENDS under a new
+    run id (the resume lineage); a foreign/newer-schema file is set aside
+    as ``<path>.stale`` and a fresh log started.  Every append is
+    flushed+fsynced and seals any torn previous write with a newline first,
+    exactly like ``EvalJournal`` — the kill-mid-append fault hook
+    (``faults.event_kill_hook``) proves the replay contract in-test.
+    """
+
+    def __init__(self, path: str, run_meta: Optional[dict] = None,
+                 run_id: Optional[str] = None):
+        self.path = path
+        self.run_id = run_id or make_run_id()
+        self._seq = 0
+        self._appends = 0
+        self._dirty = False
+        self._lock = threading.Lock()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        good_bytes = 0
+        if os.path.exists(path) and os.path.getsize(path):
+            with open(path, "rb") as f:
+                raw = f.read()
+            head, _, good_bytes = _parse_lines(raw)
+            if head is None or head.get("schema", 0) > SCHEMA_VERSION:
+                # never destroy what might be another run's data: set the
+                # unreadable file aside and start fresh
+                stale = path + ".stale"
+                os.replace(path, stale)
+                _warn_stderr(f"event log {path} is foreign or "
+                             f"newer-schema; set aside as {stale}")
+                good_bytes = 0
+        if good_bytes:
+            # truncate the torn tail BEFORE appending so the next record
+            # starts on a fresh line (same contract as EvalJournal)
+            with open(path, "rb+") as f:
+                f.truncate(good_bytes)
+            self._f = open(path, "a")
+        else:
+            self._f = open(path, "w")
+            header = {
+                "kind": _LOG_KIND,
+                "header": {**run_envelope(self.run_id),
+                           **({"meta": run_meta} if run_meta else {})},
+            }
+            self._write_raw(json.dumps(header, sort_keys=True) + "\n")
+
+    def _write_raw(self, text: str) -> None:
+        # _dirty spans the write: a failure part-way may land a torn prefix
+        # on disk, and the NEXT append must start on a fresh line
+        self._dirty = True
+        self._f.write(text)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._dirty = text[-1:] != "\n"
+
+    def emit(self, event: str, **fields) -> None:
+        """Append one typed event.  Crash-safe: the record is either fully
+        on disk (fsynced) or detectably torn on replay."""
+        from ncnet_tpu.utils import faults
+
+        rec = {"t": time.time(), "run": self.run_id, "seq": self._seq,
+               "event": str(event)}
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        line = json.dumps(rec, sort_keys=True)
+        with self._lock:
+            if self._dirty:
+                self._write_raw("\n")  # seal a torn previous write
+            self._seq += 1
+            self._appends += 1
+            # injected SIGKILL mid-append: a torn prefix is flushed first,
+            # so the replayed log must prove partial-trailing-line tolerance
+            faults.event_kill_hook(
+                self._appends,
+                lambda: self._write_raw(line[: max(1, len(line) // 2)]),
+            )
+            self._write_raw(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _jsonable(v):
+    """Coerce one field to a JSON-serializable value.  Numpy scalars/arrays
+    and other exotic types must degrade to something representable rather
+    than abort the append (telemetry never kills the run)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        # a non-finite float is valid Python but not strict JSON
+        if isinstance(v, float) and v != v:
+            return "nan"
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    try:
+        import numpy as np
+
+        if isinstance(v, np.generic):
+            return _jsonable(v.item())
+        if isinstance(v, np.ndarray):
+            return [_jsonable(x) for x in v.tolist()]
+    except ImportError:  # pragma: no cover - numpy is a hard dep in-repo
+        pass
+    try:
+        f = float(v)  # jax scalars land here without importing jax
+        return _jsonable(f)
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# process-global sink: deep layers (ops tiering, resilience, the loader)
+# emit without a log handle; no-op when nothing is bound
+# ---------------------------------------------------------------------------
+
+
+_sink: Optional[EventLog] = None
+
+
+def set_global_sink(log: Optional[EventLog]) -> Optional[EventLog]:
+    """Bind ``log`` as the process-global event sink; returns the previous
+    sink (callers restore it — or use :func:`bound`)."""
+    global _sink
+    prev = _sink
+    _sink = log
+    return prev
+
+
+def get_global_sink() -> Optional[EventLog]:
+    return _sink
+
+
+def _warn_stderr(msg: str) -> None:
+    import sys
+
+    sys.stderr.write(f"[telemetry] {msg}\n")
+
+
+def emit(event: str, **fields) -> None:
+    """Emit to the global sink, if bound.  A failing append (disk full,
+    revoked mount) unbinds the sink and reports to stderr — telemetry must
+    never crash the run it observes."""
+    global _sink
+    if _sink is None:
+        return
+    try:
+        _sink.emit(event, **fields)
+    except (OSError, ValueError) as e:
+        # OSError: disk full / revoked mount; ValueError: a closed file
+        # (I/O on closed file) — either way the sink is unusable
+        _sink = None
+        _warn_stderr(f"event sink failed ({e}); telemetry disabled for the "
+                     "rest of the process")
+
+
+@contextlib.contextmanager
+def bound(log: Optional[EventLog]) -> Iterator[Optional[EventLog]]:
+    """``with bound(log):`` — global sink bound inside, restored after."""
+    prev = set_global_sink(log)
+    try:
+        yield log
+    finally:
+        set_global_sink(prev)

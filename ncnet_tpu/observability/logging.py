@@ -1,0 +1,91 @@
+"""Leveled logger: one console sink + a structured tee into the event log.
+
+Replaces the ~60 bare ``print()`` calls that used to be the framework's
+only output (SURVEY §5.1 — the reference is print-only and our reproduction
+inherited it).  Design constraints, in order:
+
+  1. **Console compatibility** — the rendered lines keep the text the
+     prints produced (tests capture stdout and assert substrings; operators
+     grep the same phrases).  ``info`` renders the message verbatim;
+     ``warning``/``error`` prefix ``warning: `` / ``error: `` exactly once —
+     which also FIXES the old inconsistency where some recoverable failures
+     carried the prefix and others did not: the level now decides, not the
+     call site.
+  2. **Structured tee** — every rendered line is also emitted to the
+     process-global event sink (``events.emit``) as a ``log`` record with a
+     single ``kind`` classification field (decode/device/timeout/io/
+     quarantine/...), so a replayed run can aggregate recoverable failures
+     without parsing message strings.  No sink bound → the tee is free.
+  3. **No bare print** — the console write goes through ``sys.stdout``
+     directly; ``tools/check_no_bare_print.py`` (tier-1 enforced) keeps
+     library modules off ``print()`` so this stays the one sink.
+
+``NCNET_TPU_LOG_LEVEL`` (debug|info|warning|error) filters both the console
+and the tee; default ``info``.  ``sys.stdout`` is looked up per call so
+pytest's capture and operator redirections both see the output.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Optional
+
+from ncnet_tpu.observability import events as _events
+
+LOG_LEVEL_ENV = "NCNET_TPU_LOG_LEVEL"
+
+_LEVELS: Dict[str, int] = {"debug": 10, "info": 20, "warning": 30,
+                           "error": 40}
+_PREFIXES = {"warning": "warning: ", "error": "error: "}
+
+
+def _threshold() -> int:
+    name = os.environ.get(LOG_LEVEL_ENV, "").strip().lower()
+    return _LEVELS.get(name, _LEVELS["info"])
+
+
+class Logger:
+    """One named channel.  ``kind`` is the classification field: recoverable
+    failures pass the same kinds ``resilience.classify_failure`` produces
+    (decode/device/timeout/io/other) plus layer-specific ones (nan_guard,
+    quarantine, tier, preemption, validation)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _log(self, level: str, msg: str, kind: Optional[str],
+             console: bool = True) -> None:
+        if _LEVELS[level] < _threshold():
+            return
+        if console:
+            # per-call lookup: pytest capture / redirection must both work
+            sys.stdout.write(_PREFIXES.get(level, "") + msg + "\n")
+        fields = {"level": level, "logger": self.name, "msg": msg}
+        if kind is not None:
+            fields["kind"] = kind
+        _events.emit("log", **fields)
+
+    def debug(self, msg: str, kind: Optional[str] = None) -> None:
+        self._log("debug", msg, kind)
+
+    def info(self, msg: str, kind: Optional[str] = None) -> None:
+        self._log("info", msg, kind)
+
+    def warning(self, msg: str, kind: Optional[str] = None) -> None:
+        self._log("warning", msg, kind)
+
+    def error(self, msg: str, kind: Optional[str] = None) -> None:
+        self._log("error", msg, kind)
+
+
+_loggers: Dict[str, Logger] = {}
+
+
+def get_logger(name: str) -> Logger:
+    """Named loggers are cached (cheap identity for the tee's ``logger``
+    field; there is no per-logger state to configure)."""
+    log = _loggers.get(name)
+    if log is None:
+        log = _loggers[name] = Logger(name)
+    return log

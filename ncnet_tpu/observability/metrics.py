@@ -1,0 +1,201 @@
+"""Metrics registry: counters / gauges / timers flushed into the event log.
+
+One registry instance per run scope (a training run, an eval run, a bench
+invocation).  The registry is deliberately tiny — the durable format is the
+event log's ``metrics`` records (and bench's enveloped JSON line), not an
+in-process object model:
+
+  * :class:`Counter` — monotone event counts (NaN skips, retries,
+    quarantines, checkpoint commits);
+  * :class:`Gauge`   — last-value metrics (loss, MFU, pipeline depth);
+  * :class:`Timer`   — wall accumulation with count/total/last/min/max
+    (step walls, decode/dispatch/fetch splits, host→device staging).
+
+``snapshot()`` renders everything to plain floats/ints; ``flush()`` emits
+one ``metrics`` event carrying the snapshot (through a given
+:class:`~ncnet_tpu.observability.events.EventLog` or the global sink).
+
+The training MFU helpers live here too: ``train_step_flops`` is the
+6×-filter-FLOP algorithmic basis (a pos+neg weak step = 2 symmetric filter
+forwards + a ~2×-forward backward; backbone/correlation/score are <5%) and
+``PEAK_BF16_TFLOPS``/``PEAK_HBM_GBPS`` are the public per-device-kind peaks
+— shared with bench.py so the bench artifact and run telemetry can never
+disagree on the denominator.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+from ncnet_tpu.observability import events as _events
+
+# bf16 peak TFLOP/s by device kind (public specs) — THE MFU denominator,
+# shared by bench.py and the per-step training scope
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5": 459.0,        # v5p
+    "TPU v6 lite": 918.0,   # v6e (Trillium)
+}
+
+# HBM bandwidth GB/s by device kind (public specs), for rooflines
+PEAK_HBM_GBPS = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,   # v5e
+    "TPU v5": 2765.0,       # v5p
+    "TPU v6 lite": 1640.0,  # v6e
+}
+
+
+def filter_flops(feat_side: int, kernels: Sequence[int],
+                 channels: Sequence[int]) -> float:
+    """True per-pair FLOPs of the SYMMETRIC NC filter (both volume
+    directions) at a square ``feat_side`` — the constant algorithmic-MFU
+    numerator (README "MFU accounting"); ~281.2 GFLOP at the PF-Pascal
+    bench arch (25⁴ volume, k=5³, 16/16/1 channels)."""
+    cells = (feat_side * feat_side) ** 2
+    chans = list(zip((1,) + tuple(channels[:-1]), channels))
+    return 2 * cells * sum(
+        2 * (k ** 4) * ci * co for k, (ci, co) in zip(kernels, chans)
+    )
+
+
+def train_step_flops(feat_side: int, kernels: Sequence[int],
+                     channels: Sequence[int]) -> float:
+    """Per-pair FLOPs of one weak-supervision train step on the
+    6×-filter-FLOP algorithmic basis (2 filter forwards for pos+neg +
+    a ~2×-forward backward each)."""
+    return 6.0 * filter_flops(feat_side, kernels, channels)
+
+
+def device_peak_tflops() -> Optional[float]:
+    """bf16 peak of the local device kind, or None (CPU, unknown kinds) —
+    callers skip MFU metrics rather than emit garbage."""
+    try:
+        import jax
+
+        return PEAK_BF16_TFLOPS.get(jax.local_devices()[0].device_kind)
+    except Exception:  # noqa: BLE001 — no backend = no MFU, never a crash
+        return None
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> int:
+        self.value += int(n)
+        return self.value
+
+
+class Gauge:
+    def __init__(self):
+        self.value: Optional[float] = None
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Timer:
+    """Accumulates wall intervals; use as a context manager or feed measured
+    seconds via :meth:`observe` (the eval loops already hold their own
+    ``perf_counter`` deltas)."""
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.last_s: Optional[float] = None
+        self.min_s: Optional[float] = None
+        self.max_s: Optional[float] = None
+
+    def observe(self, seconds: float) -> None:
+        s = float(seconds)
+        self.count += 1
+        self.total_s += s
+        self.last_s = s
+        self.min_s = s if self.min_s is None else min(self.min_s, s)
+        self.max_s = s if self.max_s is None else max(self.max_s, s)
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.observe(time.perf_counter() - self._t0)
+
+    def snapshot(self) -> Dict[str, float]:
+        out = {"count": self.count, "total_s": round(self.total_s, 6)}
+        for k in ("last_s", "min_s", "max_s"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = round(v, 6)
+        if self.count:
+            out["mean_s"] = round(self.total_s / self.count, 6)
+        return out
+
+
+class MetricsRegistry:
+    """Named counters/gauges/timers for one run scope.
+
+    Thread-safe creation (the eval pipelines touch timers from drain
+    callbacks); metric objects themselves are updated from one loop each.
+    """
+
+    def __init__(self, scope: str = ""):
+        self.scope = scope
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls()
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Plain-data view: counters/gauges to their value, timers to their
+        stat dict.  Unset gauges are omitted (a null metric is noise)."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = m.value
+            elif isinstance(m, Gauge):
+                if m.value is not None:
+                    out[name] = m.value
+            elif isinstance(m, Timer):
+                if m.count:
+                    out[name] = m.snapshot()
+        return out
+
+    def flush(self, sink: Optional["_events.EventLog"] = None,
+              event: str = "metrics", **extra) -> Dict[str, object]:
+        """Emit one ``metrics`` event carrying the current snapshot (to
+        ``sink``, else the global sink) and return the snapshot."""
+        snap = self.snapshot()
+        fields = dict(extra)
+        if self.scope:
+            fields.setdefault("scope", self.scope)
+        if sink is not None:
+            sink.emit(event, metrics=snap, **fields)
+        else:
+            _events.emit(event, metrics=snap, **fields)
+        return snap

@@ -711,6 +711,10 @@ def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
     elif tier not in _ALL_TIERS or tier in _runtime_demoted:
         return None
     _runtime_demoted.add(tier)
+    from ncnet_tpu.observability import events as _obs_events
+
+    _obs_events.emit("tier_demoted", tier=tier,
+                     demoted=sorted(_runtime_demoted))
     return tier
 
 
@@ -722,6 +726,28 @@ def demoted_fused_tiers() -> frozenset:
 def reset_fused_tier_demotions() -> None:
     """Re-arm all runtime-demoted tiers (tests; or a deliberate re-probe)."""
     _runtime_demoted.clear()
+    _emitted_choices.clear()
+
+
+# last-emitted tier selection per shape signature: the telemetry event
+# fires only when the authority's DECISION changes for a shape class (first
+# trace, or a post-demotion retrace landing on a lower tier), not on every
+# retrace of an unchanged decision
+_emitted_choices: dict = {}
+
+
+def _emit_tier_selected(stage: str, sig, tier) -> None:
+    if _emitted_choices.get((stage, sig)) == tier:
+        return
+    _emitted_choices[(stage, sig)] = tier
+    from ncnet_tpu.observability import events as _obs_events
+
+    ha, wa, hb, wb, kernels, channels = sig
+    _obs_events.emit(
+        "tier_selected", stage=stage, tier=tier or "xla",
+        shape=[ha, wa, hb, wb], kernels=list(kernels),
+        channels=list(channels),
+    )
 
 
 def choose_fused_stack(ha, wa, hb, wb, kernels, channels):
@@ -733,6 +759,13 @@ def choose_fused_stack(ha, wa, hb, wb, kernels, channels):
     compile probe stays green, because the failure mode (OOM under
     eval-loop memory pressure, Mosaic runtime faults) is invisible to the
     probe."""
+    tier = _choose_fused_stack(ha, wa, hb, wb, kernels, channels)
+    _emit_tier_selected(
+        "forward", (ha, wa, hb, wb, tuple(kernels), tuple(channels)), tier)
+    return tier
+
+
+def _choose_fused_stack(ha, wa, hb, wb, kernels, channels):
     from ncnet_tpu.ops.conv4d import _pallas_available
 
     if not _pallas_available():

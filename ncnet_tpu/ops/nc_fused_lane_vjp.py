@@ -431,7 +431,16 @@ def choose_fused_vjp(ha, wa, hb, wb, kernels, channels) -> Optional[str]:
     ``choose_fused_stack``'s discipline — real TPU backend, green compile
     probe, no runtime demotion (``demote_fused_tier('resident_vjp')`` after
     a mid-run device failure sends every later trace back to XLA)."""
+    from ncnet_tpu.ops.nc_fused_lane import _emit_tier_selected
+
     kernels, channels = tuple(kernels), tuple(channels)
+    tier = _choose_fused_vjp(ha, wa, hb, wb, kernels, channels)
+    _emit_tier_selected(
+        "backward", (ha, wa, hb, wb, kernels, channels), tier)
+    return tier
+
+
+def _choose_fused_vjp(ha, wa, hb, wb, kernels, channels) -> Optional[str]:
     force = _os.environ.get("NCNET_FUSED_VJP_FORCE", "")
     if force == "interpret":
         # still honor the shape/VMEM gate: the knob forces the BACKEND

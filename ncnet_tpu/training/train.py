@@ -76,6 +76,7 @@ replicated values / at collective boundaries so all hosts agree.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import math
@@ -96,13 +97,29 @@ from ncnet_tpu.data import DataLoader, ImagePairDataset
 from ncnet_tpu.models import backbone as bb
 from ncnet_tpu.models import checkpoint as ckpt_io
 from ncnet_tpu.models.ncnet import init_ncnet
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability import get_logger
+from ncnet_tpu.observability.device import DeviceMonitor, Heartbeat
+from ncnet_tpu.observability.events import EventLog
+from ncnet_tpu.observability.metrics import (
+    MetricsRegistry,
+    device_peak_tflops,
+    train_step_flops,
+)
 from ncnet_tpu.training.loss import (
     auto_accum_chunks,
     weak_loss,
     weak_loss_and_grads,
 )
 from ncnet_tpu.utils import faults
-from ncnet_tpu.utils.profiling import annotate, maybe_trace
+from ncnet_tpu.utils.profiling import (
+    StepWindowTracer,
+    annotate,
+    maybe_trace,
+    profile_step_window,
+)
+
+log = get_logger("training")
 
 
 class TrainDivergedError(RuntimeError):
@@ -173,6 +190,7 @@ def make_train_step(
     accum_chunks: int = 0,
     nan_guard: bool = False,
     nc_pallas_vjp: bool = True,
+    with_grad_norm: bool = False,
 ):
     """Jitted (state, batch) → (state, loss).  Returned as a
     :class:`~ncnet_tpu.models.ncnet.ResilientJit` so ``fit``'s device-
@@ -208,7 +226,14 @@ def make_train_step(
     ``nc_pallas_vjp`` (round 7 default): route the NC filter through the
     fused Pallas forward + resident Pallas backward where the shape class
     compiles (see :func:`ncnet_tpu.training.loss.weak_loss`); ineligible
-    configurations keep the XLA formulations unchanged."""
+    configurations keep the XLA formulations unchanged.
+
+    ``with_grad_norm=True`` (telemetry, round 8): the step additionally
+    returns the global L2 grad norm — ``(state, loss, grad_norm)`` instead
+    of ``(state, loss)`` — computed in-graph (one extra reduction over the
+    grad tree, negligible next to the filter) so the per-step metrics scope
+    can record it without a second backward.  Default off: the two-tuple
+    signature is the public one."""
 
     if accum_chunks != 0 and not stop_backbone_grad:
         raise ValueError(
@@ -259,7 +284,10 @@ def make_train_step(
             # and the epoch-mean exclusion see EVERY skip, including the
             # finite-loss/non-finite-grads case
             loss = jnp.where(ok, loss, jnp.nan)
-        return TrainState(params, opt_state, state.step + 1), loss
+        new_state = TrainState(params, opt_state, state.step + 1)
+        if with_grad_norm:
+            return new_state, loss, optax.global_norm(grads)
+        return new_state, loss
 
     from ncnet_tpu.models.ncnet import ResilientJit
 
@@ -281,6 +309,7 @@ def process_epoch(
     put_batch=None,
     step_base: int = 0,
     on_step: Optional[Callable[[int, TrainState, jnp.ndarray], bool]] = None,
+    telemetry_ctx: Optional[Dict[str, Any]] = None,
 ) -> Tuple[TrainState, float]:
     """One pass over ``loader``; mirrors the reference's per-batch logging
     (train.py:161-181).  ``put_batch`` maps a host array onto devices
@@ -309,8 +338,24 @@ def process_epoch(
     an early stop (preemption) simply discards the staged batch — the
     position cursor marks it unconsumed, so resume re-delivers it from the
     epoch-keyed shuffle.
+
+    Telemetry (round 8): every train step emits a ``step`` event to the
+    bound observability sink — loss, step wall, host→device staging wall,
+    throughput pairs/s, and (when the step was built with
+    ``with_grad_norm``) the global grad norm.  ``telemetry_ctx`` carries
+    the optional extras ``fit`` precomputes: ``flops_per_pair`` +
+    ``peak_tflops`` (the 6×-filter-FLOP MFU basis — emitted as ``mfu_pct``
+    when both are known), a ``tracer`` (:class:`StepWindowTracer`, fed each
+    global step number), and a ``registry``
+    (:class:`~ncnet_tpu.observability.metrics.MetricsRegistry` accumulating
+    the same numbers for the epoch-end ``metrics`` flush).  With no sink
+    bound and no ctx the loop's only extra work is two ``perf_counter``
+    reads per step.
     """
     put_batch = put_batch or jnp.asarray
+    ctx = telemetry_ctx or {}
+    tracer: Optional[StepWindowTracer] = ctx.get("tracer")
+    registry: Optional[MetricsRegistry] = ctx.get("registry")
     n = len(loader)
     if n == 0:
         raise ValueError(
@@ -319,28 +364,42 @@ def process_epoch(
         )
     start_batch = getattr(loader, "start_batch", 0)
     if start_batch:
-        print(f"{mode.capitalize()} Epoch: {epoch} resuming at batch "
-              f"{start_batch}/{n}")
+        log.info(f"{mode.capitalize()} Epoch: {epoch} resuming at batch "
+                 f"{start_batch}/{n}")
     losses = []  # device scalars; only synced at log points / epoch end
 
     def stage(off, batch):
         if mode == "train":
             batch = faults.corrupt_batch_hook(batch, step_base + off + 1)
-        return {
+        t0 = time.perf_counter()
+        staged_batch = {
             "source_image": put_batch(batch["source_image"]),
             "target_image": put_batch(batch["target_image"]),
         }
+        stage_walls[0] = time.perf_counter() - t0
+        return staged_batch
 
+    stage_walls = [0.0]  # wall of the most recent stage() call
     it = enumerate(loader)
     nxt = next(it, None)
     staged = stage(*nxt) if nxt is not None else None
     while nxt is not None:
         off, _ = nxt
         batch_idx = start_batch + off
+        gstep = step_base + off + 1  # global step about to run (train mode)
+        stage_wall, stage_walls[0] = stage_walls[0], 0.0
         images, staged = staged, None
+        if tracer is not None and mode == "train":
+            tracer.at_step(gstep)
+        t_step = time.perf_counter()
+        grad_norm = None
         with annotate(f"{mode}_step"):
             if mode == "train":
-                state, loss = step_fn(state, images)
+                out = step_fn(state, images)
+                if len(out) == 3:
+                    state, loss, grad_norm = out
+                else:
+                    state, loss = out
             else:
                 loss = step_fn(state.params, images)
         # stage batch N+1 while step N runs on device (the loader's own
@@ -351,16 +410,52 @@ def process_epoch(
             staged = stage(*nxt)
         losses.append(loss)
         if batch_idx % log_interval == 0:
-            print(
+            log.info(
                 f"{mode.capitalize()} Epoch: {epoch} [{batch_idx}/{n} "
                 f"({100.0 * batch_idx / n:.0f}%)]\t\tLoss: {float(loss):.6f}"
             )
+        if mode == "train" and obs_events.get_global_sink() is not None:
+            # the loss sync above (or float() here) bounds the step wall;
+            # without the nan_guard's eager fetch this wall includes async
+            # dispatch only — still the honest host-side step cadence
+            loss_f = float(loss)
+            wall = time.perf_counter() - t_step
+            # .shape is the GLOBAL batch shape even for sharded/multi-host
+            # arrays — never materialize the batch on host just to count it
+            pairs = int(images["source_image"].shape[0]) \
+                if hasattr(images["source_image"], "shape") else 0
+            fields: Dict[str, Any] = {
+                "mode": mode, "epoch": epoch, "batch": batch_idx,
+                "step": gstep, "loss": loss_f,
+                "wall_s": round(wall, 6),
+                "stage_wall_s": round(stage_wall, 6),
+            }
+            if pairs and wall > 0:
+                fields["pairs_per_s"] = round(pairs / wall, 3)
+            if grad_norm is not None:
+                fields["grad_norm"] = float(grad_norm)
+            flops = ctx.get("flops_per_pair")
+            peak = ctx.get("peak_tflops")
+            if flops and peak and pairs and wall > 0:
+                fields["mfu_pct"] = round(
+                    100.0 * (flops * pairs / wall / 1e12) / peak, 2)
+            obs_events.emit("step", **fields)
+            if registry is not None:
+                registry.timer("step_wall").observe(wall)
+                registry.timer("stage_wall").observe(stage_wall)
+                registry.gauge("loss").set(loss_f)
+                if "pairs_per_s" in fields:
+                    registry.gauge("pairs_per_s").set(fields["pairs_per_s"])
+                if "mfu_pct" in fields:
+                    registry.gauge("mfu_pct").set(fields["mfu_pct"])
+                if grad_norm is not None:
+                    registry.gauge("grad_norm").set(float(grad_norm))
         if on_step is not None and on_step(batch_idx, state, loss):
             break
     if not losses:
         # a resume position at the very end of an epoch: nothing left to do
-        print(f"{mode.capitalize()} set: no batches past resume position "
-              f"{start_batch}/{n}")
+        log.info(f"{mode.capitalize()} set: no batches past resume position "
+                 f"{start_batch}/{n}")
         return state, float("nan")
     arr = jnp.stack(losses)
     if mode == "train":
@@ -371,12 +466,12 @@ def process_epoch(
         finite = jnp.isfinite(arr)
         n_bad = int(jnp.sum(~finite))
         if n_bad:
-            print(f"{mode.capitalize()} set: excluded {n_bad} non-finite "
-                  f"step loss(es) from the epoch mean")
+            log.info(f"{mode.capitalize()} set: excluded {n_bad} non-finite "
+                     f"step loss(es) from the epoch mean")
         epoch_loss = float(jnp.nanmean(jnp.where(finite, arr, jnp.nan)))
     else:
         epoch_loss = float(jnp.mean(arr))
-    print(f"{mode.capitalize()} set: Average loss: {epoch_loss:.4f}")
+    log.info(f"{mode.capitalize()} set: Average loss: {epoch_loss:.4f}")
     return state, epoch_loss
 
 
@@ -512,27 +607,32 @@ def save_train_checkpoint(
     # could publish a version that is still being written)
     _sync_processes(f"ncnet_ckpt_commit_{n}")
     if primary:
-        if os.path.isdir(final):
-            # re-save at the same step (an epoch-end save landing on a
-            # periodic-save step): replace the old version, still leaving a
-            # complete directory at every instant
-            stale = final + ".old"
-            shutil.rmtree(stale, ignore_errors=True)
-            os.rename(final, stale)
-            os.rename(tmp, final)
-            shutil.rmtree(stale, ignore_errors=True)
-        else:
-            os.rename(tmp, final)  # THE commit point
-        if keep > 0:
-            for _, old in ckpt_io.list_checkpoint_versions(root)[:-keep]:
-                shutil.rmtree(old, ignore_errors=True)
-        if is_best:
-            best = os.path.join(
-                os.path.dirname(root), "best_" + os.path.basename(root)
-            )
-            if os.path.isdir(best):
-                shutil.rmtree(best)
-            shutil.copytree(final, best)
+        with annotate("checkpoint_commit"):
+            if os.path.isdir(final):
+                # re-save at the same step (an epoch-end save landing on a
+                # periodic-save step): replace the old version, still
+                # leaving a complete directory at every instant
+                stale = final + ".old"
+                shutil.rmtree(stale, ignore_errors=True)
+                os.rename(final, stale)
+                os.rename(tmp, final)
+                shutil.rmtree(stale, ignore_errors=True)
+            else:
+                os.rename(tmp, final)  # THE commit point
+            if keep > 0:
+                for _, old in ckpt_io.list_checkpoint_versions(root)[:-keep]:
+                    shutil.rmtree(old, ignore_errors=True)
+            if is_best:
+                best = os.path.join(
+                    os.path.dirname(root), "best_" + os.path.basename(root)
+                )
+                if os.path.isdir(best):
+                    shutil.rmtree(best)
+                shutil.copytree(final, best)
+        obs_events.emit(
+            "checkpoint_commit", step=n, path=final, epoch=epoch,
+            position=position, best=bool(is_best),
+        )
     return final
 
 
@@ -724,8 +824,8 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
             # its slice and the global array is assembled across processes
             local_batch = config.batch_size // n_procs
         if progress:
-            print(f"Distributed: process {shard_kwargs['shard_index']} of "
-                  f"{n_procs}")
+            log.info(f"Distributed: process {shard_kwargs['shard_index']} of "
+                     f"{n_procs}")
 
     state, optimizer, model_config, labels = create_train_state(config)
 
@@ -759,9 +859,9 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         # into the SAME root (crash/preempt/restart cycles share one lineage)
         resume_root = ckpt_io.owning_checkpoint_root(resolved)
         if progress:
-            print(f"Resumed full train state from {resolved}: "
-                  f"{start_epoch} completed epoch(s), position epoch "
-                  f"{resume_epoch} batch {resume_batch}")
+            log.info(f"Resumed full train state from {resolved}: "
+                     f"{start_epoch} completed epoch(s), position epoch "
+                     f"{resume_epoch} batch {resume_batch}")
 
     n_trainable = sum(
         int(np.prod(np.asarray(x.shape)))
@@ -769,7 +869,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         if lbl == "trainable"
     )
     if progress:
-        print(f"Trainable parameters: {n_trainable:,}")
+        log.info(f"Trainable parameters: {n_trainable:,}")
 
     # data parallelism: shard the pair axis over every device, replicate
     # params; jit + shardings make XLA psum the grads and route the
@@ -807,12 +907,18 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         else:
             put_batch = lambda x: jax.device_put(jnp.asarray(x), sharding)  # noqa: E731
         if progress:
-            print(f"Data parallel over {n_dev} devices (mesh {mesh.shape})")
+            log.info(f"Data parallel over {n_dev} devices (mesh {mesh.shape})")
 
     accum = _resolve_accum_chunks(config, n_dev if config.data_parallel else 1)
     if progress and accum:
-        print(f"Gradient accumulation: {accum} chunks of "
-              f"{2 * config.batch_size // accum} volumes")
+        log.info(f"Gradient accumulation: {accum} chunks of "
+                 f"{2 * config.batch_size // accum} volumes")
+    # telemetry EMISSION is primary-only (one event log per run, not per
+    # process), but the grad-norm output is part of the jitted program,
+    # which must be identical on every process of a multi-controller run —
+    # so the step shape follows config.telemetry alone and non-primary
+    # processes drop the extra output unread
+    want_telemetry = config.telemetry and jax.process_index() == 0
     train_step = make_train_step(
         model_config, optimizer, donate=config.donate_state,
         stop_backbone_grad=config.fe_finetune_params == 0,
@@ -823,6 +929,7 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         accum_chunks=accum,
         nan_guard=config.nan_guard,
         nc_pallas_vjp=config.nc_pallas_vjp,
+        with_grad_norm=config.telemetry,
     )
 
     def guarded_train_step(state, images):
@@ -907,7 +1014,71 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
             + "_" + config.result_model_fn,
         )
     if progress:
-        print(f"Checkpoint name: {ckpt_name}")
+        log.info(f"Checkpoint name: {ckpt_name}")
+
+    # --- telemetry: event log + heartbeat + device monitor (primary only).
+    # The log lives under the checkpoint root by default, so crash/resume
+    # cycles of one training lineage append to ONE file (each run under its
+    # own run id) and tools/run_report.py reconstructs the whole history.
+    telemetry: Optional[EventLog] = None
+    prev_sink = None
+    heartbeat: Optional[Heartbeat] = None
+    dev_monitor: Optional[DeviceMonitor] = None
+    train_registry: Optional[MetricsRegistry] = None
+    step_tracer = StepWindowTracer(config.profile_dir)
+    # the tracer rides along even without an event log: the profile-window
+    # knob ($NCNET_TPU_PROFILE_STEPS) is orthogonal to telemetry
+    telemetry_ctx: Dict[str, Any] = {"tracer": step_tracer}
+    if want_telemetry:
+        tdir = config.telemetry_dir or os.path.join(ckpt_name, "telemetry")
+        try:
+            telemetry = EventLog(
+                os.path.join(tdir, "events.jsonl"),
+                run_meta={"config": dataclasses.asdict(config)},
+            )
+        except OSError as e:
+            # telemetry must never be the reason a run cannot start
+            log.warning(f"could not open the event log under {tdir} ({e}); "
+                        "continuing without telemetry", kind="io")
+    if telemetry is not None:
+        prev_sink = obs_events.set_global_sink(telemetry)
+        heartbeat = Heartbeat(os.path.join(
+            os.path.dirname(telemetry.path), "heartbeat.json"),
+            run_id=telemetry.run_id)
+        dev_monitor = DeviceMonitor()
+        train_registry = MetricsRegistry(scope="train_step")
+        telemetry_ctx.update(
+            registry=train_registry,
+            peak_tflops=device_peak_tflops(),
+        )
+        try:
+            from ncnet_tpu.models.ncnet import extract_features
+
+            feat = jax.eval_shape(
+                lambda p, x: extract_features(model_config, p, x),
+                state.params,
+                jax.ShapeDtypeStruct(
+                    (1, config.image_size, config.image_size, 3),
+                    jnp.float32),
+            )
+            telemetry_ctx["flops_per_pair"] = train_step_flops(
+                feat.shape[1], model_config.ncons_kernel_sizes,
+                model_config.ncons_channels)
+        except Exception:  # noqa: BLE001 — exotic trunks: no MFU, no crash
+            pass
+        # via the self-disabling global emit (the sink is bound above):
+        # a failing append must never be the reason a run cannot start
+        obs_events.emit(
+            "run_start", envelope=obs_events.run_envelope(telemetry.run_id),
+            checkpoint_root=ckpt_name, num_epochs=config.num_epochs,
+            batch_size=config.batch_size, resumed=bool(resume_root),
+        )
+        if resume_root:
+            obs_events.emit(
+                "resume", checkpoint=resolved, completed_epochs=start_epoch,
+                epoch=resume_epoch, batch=resume_batch,
+                step=int(jax.device_get(state.step)),
+            )
 
     train_loss = np.zeros(config.num_epochs)
     test_loss = np.zeros(config.num_epochs)
@@ -936,8 +1107,9 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         for n_v, p_v in ckpt_io.list_checkpoint_versions(resume_root):
             if n_v > steps_done:
                 shutil.rmtree(p_v, ignore_errors=True)
-                print(f"[fault-tolerance] pruned stale version {p_v} "
-                      f"(rolled back to step {steps_done})")
+                log.warning(f"[fault-tolerance] pruned stale version {p_v} "
+                            f"(rolled back to step {steps_done})",
+                            kind="validation")
     if resume_root:
         _sync_processes("ncnet_rollback_prune")
     nan_streak = nan_skipped = 0
@@ -947,7 +1119,33 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         io_retry_backoff=config.io_retry_backoff,
     )
 
-    with PreemptionHandler() as preempt:
+    @contextlib.contextmanager
+    def _telemetry_scope():
+        """run_end + sink restore + log close on EVERY exit path — normal
+        completion, preemption, TrainDivergedError, a crash.  The closure
+        reads the loop counters at exit time, so the final event records
+        where the run actually stopped."""
+        try:
+            yield
+        finally:
+            step_tracer.close()
+            if telemetry is not None:
+                if train_registry is not None:
+                    train_registry.flush(final=True)
+                # global emit, not telemetry.emit: a disk-full append in a
+                # finally block must not mask the real exit (or a clean
+                # return) with an OSError
+                obs_events.emit(
+                    "run_end", step=steps_done, preempted=preempted,
+                    nan_steps_skipped=nan_skipped,
+                )
+                obs_events.set_global_sink(prev_sink)
+                try:
+                    telemetry.close()
+                except OSError:  # best-effort: the log is already fsynced
+                    pass
+
+    with _telemetry_scope(), PreemptionHandler() as preempt:
         for epoch in range(first_epoch, config.num_epochs + 1):
             start_b = resume_batch if epoch == first_epoch else 0
             n_train = len(train_loader)
@@ -959,6 +1157,10 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
                         epoch=epoch, stop=stop_epoch):
                 nonlocal steps_done, nan_streak, nan_skipped
                 steps_done += 1
+                if heartbeat is not None:
+                    heartbeat.beat(step=steps_done)
+                if dev_monitor is not None:
+                    dev_monitor.maybe_emit(step=steps_done)
                 if config.nan_guard:
                     # the guard's one host sync per step; the loss is
                     # replicated (computed on the global batch), so every
@@ -966,10 +1168,20 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
                     if not math.isfinite(float(loss)):
                         nan_streak += 1
                         nan_skipped += 1
-                        print(f"[fault-tolerance] non-finite loss at step "
-                              f"{steps_done}: update skipped (streak "
-                              f"{nan_streak}/{config.max_bad_steps})")
+                        log.warning(f"[fault-tolerance] non-finite loss at "
+                                    f"step {steps_done}: update skipped "
+                                    f"(streak {nan_streak}/"
+                                    f"{config.max_bad_steps})",
+                                    kind="nan_guard")
+                        obs_events.emit("nan_skip", step=steps_done,
+                                        epoch=epoch, streak=nan_streak)
+                        if train_registry is not None:
+                            train_registry.counter("nan_skips").inc()
                         if nan_streak >= config.max_bad_steps:
+                            obs_events.emit(
+                                "diverged", step=steps_done, epoch=epoch,
+                                streak=nan_streak,
+                            )
                             raise TrainDivergedError(
                                 f"{nan_streak} consecutive non-finite losses "
                                 f"up to step {steps_done} (epoch {epoch}); "
@@ -1003,29 +1215,39 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
                         position={"epoch": epoch, "next_batch": batch_idx + 1},
                         **save_kwargs,
                     )
+                    if train_registry is not None:
+                        train_registry.counter("checkpoint_commits").inc()
                 if want_stop:
+                    obs_events.emit("preemption", step=steps_done,
+                                    epoch=epoch, batch=batch_idx)
                     stop["preempted"] = True
                     return True
                 return False
 
+            obs_events.emit("epoch_start", epoch=epoch,
+                            start_batch=min(start_b, n_train),
+                            n_batches=n_train)
             if train_loader.start_batch < n_train:
                 # trace only the first post-resume epoch: a bounded,
                 # representative capture (compile + steady-state steps)
-                # instead of a runaway file
+                # instead of a runaway file — unless a step-window tracer
+                # owns the one global profiler session
                 with maybe_trace(config.profile_dir,
-                                 enabled=epoch == first_epoch):
+                                 enabled=(epoch == first_epoch
+                                          and not step_tracer.enabled)):
                     state, train_loss[epoch - 1] = process_epoch(
                         "train", epoch, state, guarded_train_step,
                         train_loader,
                         config.log_interval, put_batch,
                         step_base=steps_done, on_step=on_step,
+                        telemetry_ctx=telemetry_ctx,
                     )
             else:
                 # resume position at the epoch's very end (killed between the
                 # last periodic save and the epoch-end save): nothing to
                 # recompute, but val + the epoch-end save still run
-                print(f"Train Epoch: {epoch} already fully consumed at the "
-                      "resume position; skipping to validation")
+                log.info(f"Train Epoch: {epoch} already fully consumed at "
+                         "the resume position; skipping to validation")
                 train_loss[epoch - 1] = float("nan")
             if stop_epoch["preempted"]:
                 preempted = True
@@ -1048,14 +1270,26 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
                 position={"epoch": epoch + 1, "next_batch": 0},
                 **save_kwargs,
             )
+            if train_registry is not None:
+                train_registry.counter("checkpoint_commits").inc()
+            obs_events.emit(
+                "epoch_end", epoch=epoch, step=steps_done,
+                train_loss=float(train_loss[epoch - 1]),
+                test_loss=float(test_loss[epoch - 1]), best=bool(is_best),
+            )
+            if train_registry is not None:
+                train_registry.flush(epoch=epoch)
             if _global_any(preempt.requested):
                 preempted = True
-                print("[fault-tolerance] stopping after the epoch "
-                      "checkpoint (preemption requested)")
+                log.info("[fault-tolerance] stopping after the epoch "
+                         "checkpoint (preemption requested)",
+                         kind="preemption")
+                obs_events.emit("preemption", step=steps_done, epoch=epoch,
+                                boundary="epoch")
                 break
     if preempted and progress:
-        print(f"Preemption checkpoint committed under {ckpt_name}; resume "
-              "by pointing --checkpoint at it")
+        log.info(f"Preemption checkpoint committed under {ckpt_name}; "
+                 "resume by pointing --checkpoint at it", kind="preemption")
     return {
         "state": state,
         "model_config": model_config,

@@ -45,6 +45,13 @@ than abort an hours-long eval run:
     prefix of the line via ``w()`` — the resumed run must prove
     partial-trailing-line tolerance.
 
+The observability layer (ncnet_tpu/observability/) makes the same crash
+claims about its event log, so it gets the same proof obligation:
+
+  * ``event_kill_hook(n, w)``     — observability/events.EventLog: SIGKILLs
+    mid-append of the Nth event record (per process), flushing a torn
+    prefix first — replay and re-open must tolerate the partial tail.
+
 Arming: programmatic via :func:`install`/:func:`clear` (or the
 :func:`injected` context manager) in-process, or the ``NCNET_TPU_FAULTS``
 environment variable (a JSON object of :class:`FaultPlan` fields) for
@@ -117,6 +124,9 @@ class FaultPlan:
     # SIGKILL self mid-append of the Nth EvalJournal record (1-based),
     # flushing a torn prefix of the line first
     kill_at_journal_append: int = -1
+    # SIGKILL self mid-append of the Nth observability EventLog record
+    # (1-based, per EventLog instance), flushing a torn prefix first
+    kill_at_event_append: int = -1
 
 
 _plan: Optional[FaultPlan] = None
@@ -297,6 +307,18 @@ def journal_kill_hook(n_append: int, write_partial: Callable[[], None]) -> None:
     p = _active()
     if p is None or p.kill_at_journal_append < 0 \
             or n_append != p.kill_at_journal_append:
+        return
+    write_partial()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def event_kill_hook(n_append: int, write_partial: Callable[[], None]) -> None:
+    """SIGKILL self mid-append of observability event record ``n_append``
+    (if armed), flushing a torn prefix via ``write_partial`` first so the
+    replayed log must tolerate a partial trailing line."""
+    p = _active()
+    if p is None or p.kill_at_event_append < 0 \
+            or n_append != p.kill_at_event_append:
         return
     write_partial()
     os.kill(os.getpid(), signal.SIGKILL)

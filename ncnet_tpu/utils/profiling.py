@@ -1,14 +1,24 @@
 """Profiling hooks: trace annotations + on-demand profiler capture.
 
 The reference has no tracing at all (SURVEY §5.1 — print() only); this is new
-TPU-native surface.  Two layers:
+TPU-native surface.  Three layers:
 
   * :func:`annotate` — a ``jax.profiler.TraceAnnotation`` context manager
-    used around the train/eval steps and the eval forward, so xprof/
-    TensorBoard traces show framework-level phases, not just XLA ops.
+    used around the train/eval steps, the eval forward, checkpoint commits
+    and device snapshots — the annotation names MATCH the observability
+    event types (``train_step``, ``pf_pascal_eval_step``,
+    ``checkpoint_commit``, ``device_snapshot``), so an xprof trace and a
+    replayed event log describe the same phases by the same names.
   * :func:`maybe_trace` — capture a profiler trace for a whole block when a
     directory is given (or the ``NCNET_TPU_PROFILE_DIR`` env var is set);
     no-ops otherwise, so production paths carry zero overhead.
+  * :class:`StepWindowTracer` — ``NCNET_TPU_PROFILE_STEPS=<a>:<b>`` bounds
+    the capture to exactly global train steps ``[a, b)`` instead of a whole
+    epoch: the training loop feeds it every step number and the trace
+    starts/stops at the window edges.  When the window knob is set,
+    ``fit`` hands the capture to the tracer and ``maybe_trace`` stands
+    down (a block capture AND a window capture would fight over the one
+    global profiler session).
 
 View captures with TensorBoard's profile plugin or xprof
 (``tensorboard --logdir <dir>``).
@@ -18,16 +28,79 @@ from __future__ import annotations
 
 import contextlib
 import os
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Tuple
 
 import jax
 
 PROFILE_DIR_ENV = "NCNET_TPU_PROFILE_DIR"
+PROFILE_STEPS_ENV = "NCNET_TPU_PROFILE_STEPS"
 
 
 def annotate(name: str):
     """Named region in the device trace (cheap; always on)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def profile_step_window() -> Optional[Tuple[int, int]]:
+    """Parse ``NCNET_TPU_PROFILE_STEPS=<a>:<b>`` into ``(a, b)`` — capture
+    exactly global train steps ``[a, b)``.  Unset/empty → None; a malformed
+    value raises (a silently ignored profiling request wastes the run it
+    was meant to measure)."""
+    raw = os.environ.get(PROFILE_STEPS_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        a_s, b_s = raw.split(":")
+        a, b = int(a_s), int(b_s)
+    except ValueError:
+        raise ValueError(
+            f"{PROFILE_STEPS_ENV}={raw!r}: expected '<a>:<b>' "
+            "(capture steps [a, b), 1-based)"
+        ) from None
+    if a < 1 or b <= a:
+        raise ValueError(
+            f"{PROFILE_STEPS_ENV}={raw!r}: need 1 <= a < b"
+        )
+    return a, b
+
+
+class StepWindowTracer:
+    """Start/stop the jax profiler around global train steps ``[a, b)``.
+
+    Inactive (every call a cheap no-op) unless BOTH a log dir (argument or
+    ``$NCNET_TPU_PROFILE_DIR``) and a window (argument or
+    ``$NCNET_TPU_PROFILE_STEPS``) are present.  ``at_step(g)`` is called
+    with each global step number just before that step dispatches;
+    ``close()`` (always call it — the window may outlive the run) stops a
+    capture left open by an early exit."""
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 window: Optional[Tuple[int, int]] = None):
+        self.log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV) or None
+        self.window = window if window is not None else profile_step_window()
+        self._active = False
+        self._done = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.log_dir and self.window and not self._done)
+
+    def at_step(self, global_step: int) -> None:
+        """Called before global step ``global_step`` dispatches."""
+        if not self.enabled:
+            return
+        a, b = self.window
+        if not self._active and a <= global_step < b:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and global_step >= b:
+            self.close()
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+        self._done = True
 
 
 @contextlib.contextmanager
@@ -37,7 +110,8 @@ def maybe_trace(
     """Capture a jax profiler trace into ``log_dir`` (or $NCNET_TPU_PROFILE_DIR)
     for the duration of the block; yields whether tracing is active.
     ``enabled=False`` forces a no-op regardless of the env var (callers use it
-    to bound the capture to one representative phase)."""
+    to bound the capture to one representative phase — or to stand down when
+    a :class:`StepWindowTracer` owns the capture instead)."""
     log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV) or None
     if not log_dir or not enabled:
         yield False

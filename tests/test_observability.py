@@ -1,0 +1,678 @@
+"""Tier-1 tests for the observability layer (PR 5).
+
+The event log makes the same crash-safety claims as the PR 3 EvalJournal
+(fsynced atomic appends, torn-tail-tolerant replay), so it carries the same
+proof obligations: every claim is executed by deterministic fault injection
+(``utils/faults.py``), not merely written.  Beyond the unit contracts, the
+acceptance scenario runs end-to-end: a training subprocess SIGKILLed
+mid-epoch, resumed in-process, must leave ONE event log that
+``tools/run_report.py`` replays without error and whose step / checkpoint /
+resume counters are consistent with what actually ran.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.config import ModelConfig, TrainConfig
+from ncnet_tpu.data.synthetic import write_pair_dataset
+from ncnet_tpu.models import checkpoint as ckpt_io
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability.device import Heartbeat
+from ncnet_tpu.observability.events import (
+    SCHEMA_VERSION,
+    EventLog,
+    replay_events,
+)
+from ncnet_tpu.observability.logging import get_logger
+from ncnet_tpu.observability.metrics import (
+    MetricsRegistry,
+    filter_flops,
+    train_step_flops,
+)
+from ncnet_tpu import training
+from ncnet_tpu.utils import faults
+from ncnet_tpu.utils.faults import FaultPlan
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import check_no_bare_print  # noqa: E402
+import run_report  # noqa: E402
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                   ncons_channels=(1,))
+
+
+@pytest.fixture(autouse=True)
+def _unbound_sink():
+    """Every test starts and ends with no global event sink (a leaked sink
+    would silently cross-couple tests)."""
+    obs_events.set_global_sink(None)
+    yield
+    obs_events.set_global_sink(None)
+
+
+# ---------------------------------------------------------------------------
+# event log: schema, replay, resume lineage, crash safety
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path, run_meta={"note": "t"}) as log:
+        log.emit("step", step=1, loss=0.5, shape=(2, 3))
+        log.emit("step", step=2, loss=float("nan"),
+                 arr=np.float32(1.5), vec=np.arange(2))
+    header, events = replay_events(path)
+    h = header["header"]
+    assert h["schema"] == SCHEMA_VERSION
+    assert h["run_id"] == log.run_id
+    assert h["meta"] == {"note": "t"}
+    assert [e["event"] for e in events] == ["step", "step"]
+    assert [e["seq"] for e in events] == [0, 1]
+    assert all(e["run"] == log.run_id for e in events)
+    assert events[0]["shape"] == [2, 3]          # tuple → list
+    assert events[1]["loss"] == "nan"            # strict-JSON safe
+    assert events[1]["arr"] == 1.5               # numpy scalar → float
+    assert events[1]["vec"] == [0, 1]            # ndarray → list
+
+
+def test_event_log_reopen_appends_under_new_run_id(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as log1:
+        log1.emit("run_start")
+        run1 = log1.run_id
+    with EventLog(path) as log2:
+        log2.emit("resume", step=3)
+        run2 = log2.run_id
+    assert run1 != run2
+    header, events = replay_events(path)
+    assert header["header"]["run_id"] == run1  # the original header survives
+    assert [e["run"] for e in events] == [run1, run2]
+
+
+def test_event_log_sets_foreign_file_aside(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(path, "w") as f:
+        f.write("this is not an event log\n")
+    with EventLog(path) as log:
+        log.emit("run_start")
+    assert os.path.exists(path + ".stale")
+    with open(path + ".stale") as f:
+        assert "not an event log" in f.read()
+    _, events = replay_events(path)
+    assert len(events) == 1
+
+
+def test_replay_tolerates_torn_tail_and_reopen_truncates(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as log:
+        log.emit("a", i=1)
+        log.emit("b", i=2)
+    with open(path, "a") as f:
+        f.write('{"t": 1, "run": "x", "seq": 2, "event": "torn')  # no \n
+    _, events = replay_events(path)
+    assert [e["event"] for e in events] == ["a", "b"]
+    # re-opening truncates the torn tail so the next record starts clean
+    with EventLog(path) as log2:
+        log2.emit("c", i=3)
+    _, events = replay_events(path)
+    assert [e["event"] for e in events] == ["a", "b", "c"]
+
+
+def test_replay_rejects_foreign_and_newer_schema(tmp_path):
+    missing = str(tmp_path / "nope.jsonl")
+    with pytest.raises(FileNotFoundError):
+        replay_events(missing)
+    foreign = str(tmp_path / "foreign.jsonl")
+    with open(foreign, "w") as f:
+        f.write('{"kind": "something_else"}\n')
+    with pytest.raises(ValueError):
+        replay_events(foreign)
+    newer = str(tmp_path / "newer.jsonl")
+    with open(newer, "w") as f:
+        f.write(json.dumps({"kind": "ncnet_tpu_events",
+                            "header": {"schema": SCHEMA_VERSION + 1},
+                            "schema": SCHEMA_VERSION + 1}) + "\n")
+    with pytest.raises(ValueError):
+        replay_events(newer)
+
+
+def test_sigkill_mid_event_append_replays_and_resumes(tmp_path):
+    """The EvalJournal proof obligation, ported: SIGKILL mid-append of the
+    3rd record (torn prefix flushed first) must cost at most that one
+    record — replay sees records 1-2, and a re-opened log appends cleanly
+    after truncating the torn tail."""
+    path = str(tmp_path / "events.jsonl")
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {_REPO!r})
+from ncnet_tpu.observability.events import EventLog
+
+log = EventLog({path!r})
+for i in range(5):
+    log.emit("tick", i=i)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NCNET_TPU_FAULTS"] = json.dumps({"kill_at_event_append": 3})
+    proc = subprocess.run(
+        [sys.executable, str(worker)], env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == -9, f"expected SIGKILL, got:\n{proc.stdout}"
+    with open(path, "rb") as f:
+        raw = f.read()
+    assert not raw.endswith(b"\n")  # the torn prefix really is on disk
+    header, events = replay_events(path)
+    assert [e["i"] for e in events] == [0, 1]
+    with EventLog(path) as log2:
+        log2.emit("resumed")
+    _, events = replay_events(path)
+    assert [e["event"] for e in events] == ["tick", "tick", "resumed"]
+
+
+# ---------------------------------------------------------------------------
+# global sink + leveled logger
+# ---------------------------------------------------------------------------
+
+
+def test_emit_is_noop_without_sink():
+    obs_events.emit("anything", x=1)  # must not raise
+
+
+def test_logger_console_rendering_and_structured_tee(tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    log = get_logger("test_channel")
+    with EventLog(path) as sink, obs_events.bound(sink):
+        log.info("plain line")
+        log.warning("recoverable thing", kind="decode")
+        log.error("bad thing")
+    out = capsys.readouterr().out
+    assert "plain line\n" in out
+    assert "warning: recoverable thing\n" in out  # prefixed exactly once
+    assert "error: bad thing\n" in out
+    _, events = replay_events(path)
+    assert [e["event"] for e in events] == ["log"] * 3
+    assert events[0]["level"] == "info" and events[0]["msg"] == "plain line"
+    assert events[1]["kind"] == "decode"
+    assert events[1]["logger"] == "test_channel"
+    assert "kind" not in events[0]
+
+
+def test_logger_level_filter(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("NCNET_TPU_LOG_LEVEL", "error")
+    path = str(tmp_path / "events.jsonl")
+    log = get_logger("test_filter")
+    with EventLog(path) as sink, obs_events.bound(sink):
+        log.info("suppressed")
+        log.warning("also suppressed")
+        log.error("kept")
+    out = capsys.readouterr().out
+    assert "suppressed" not in out and "error: kept" in out
+    _, events = replay_events(path)
+    assert [e["msg"] for e in events] == ["kept"]
+
+
+def test_failing_sink_disables_telemetry_not_the_run(tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    sink = EventLog(path)
+    with obs_events.bound(sink):
+        # closed file: the append raises; emit must absorb it and unbind
+        # (telemetry never kills the run it observes)
+        sink.close()
+        obs_events.emit("tick")
+        assert obs_events.get_global_sink() is None
+        obs_events.emit("tick")  # and stay a no-op afterwards
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_counters_gauges_timers(tmp_path):
+    reg = MetricsRegistry(scope="t")
+    assert reg.counter("n").inc() == 1
+    assert reg.counter("n").inc(2) == 3
+    reg.gauge("loss").set(0.25)
+    reg.timer("wall").observe(0.1)
+    reg.timer("wall").observe(0.3)
+    with reg.timer("wall"):
+        pass
+    snap = reg.snapshot()
+    assert snap["n"] == 3 and snap["loss"] == 0.25
+    assert snap["wall"]["count"] == 3
+    assert snap["wall"]["min_s"] <= snap["wall"]["max_s"] == 0.3
+    assert abs(snap["wall"]["total_s"]
+               - (0.4 + snap["wall"]["last_s"])) < 1e-9
+    with pytest.raises(TypeError):
+        reg.gauge("n")  # already a counter
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as sink:
+        out = reg.flush(sink=sink, epoch=2)
+    assert out == snap
+    _, events = replay_events(path)
+    assert events[0]["event"] == "metrics"
+    assert events[0]["scope"] == "t" and events[0]["epoch"] == 2
+    assert events[0]["metrics"]["n"] == 3
+
+
+def test_flops_bases_match_readme_constants():
+    # ~281.2 GFLOP symmetric filter at the PF-Pascal bench arch; the train
+    # step is exactly 6x that (pos+neg forwards + ~2x-forward backwards)
+    f = filter_flops(25, (5, 5, 5), (16, 16, 1))
+    assert abs(f / 1e9 - 281.2) < 1.0
+    assert train_step_flops(25, (5, 5, 5), (16, 16, 1)) == 6.0 * f
+
+
+# ---------------------------------------------------------------------------
+# heartbeat + device snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_mtime_progression_and_payload(tmp_path):
+    path = str(tmp_path / "hb" / "heartbeat.json")
+    hb = Heartbeat(path, run_id="r1")
+    assert Heartbeat.age_s(path) is None  # no beat yet
+    hb.beat(step=1)
+    m1 = os.stat(path).st_mtime_ns
+    age1 = Heartbeat.age_s(path)
+    assert age1 is not None and age1 < 60
+    time.sleep(0.02)
+    hb.beat(step=2, extra="x")
+    m2 = os.stat(path).st_mtime_ns
+    assert m2 > m1  # the watchdog's one signal: mtime strictly advances
+    doc = Heartbeat.read(path)
+    assert doc["step"] == 2 and doc["run"] == "r1" and doc["extra"] == "x"
+    assert doc["pid"] == os.getpid()
+    assert not os.path.exists(path + ".tmp")  # atomic: no droppings
+
+
+def test_device_monitor_rate_limit(tmp_path):
+    from ncnet_tpu.observability.device import DeviceMonitor
+
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as sink, obs_events.bound(sink):
+        mon = DeviceMonitor(every_s=3600.0)
+        assert mon.maybe_emit(step=1) is True   # first call always emits
+        assert mon.maybe_emit(step=2) is False  # rate-limited
+    _, events = replay_events(path)
+    snaps = [e for e in events if e["event"] == "device_snapshot"]
+    assert len(snaps) == 1 and snaps[0]["step"] == 1
+    assert isinstance(snaps[0]["devices"], list)  # CPU: ids/kinds at least
+
+
+# ---------------------------------------------------------------------------
+# deep-layer events: tier demotion, retry/quarantine isolation
+# ---------------------------------------------------------------------------
+
+
+def test_tier_demotion_emits_event(tmp_path):
+    from ncnet_tpu.ops import demote_fused_tier
+    from ncnet_tpu.ops.nc_fused_lane import reset_fused_tier_demotions
+
+    path = str(tmp_path / "events.jsonl")
+    try:
+        with EventLog(path) as sink, obs_events.bound(sink):
+            assert demote_fused_tier("resident_vjp") == "resident_vjp"
+            assert demote_fused_tier("resident_vjp") is None  # idempotent
+        _, events = replay_events(path)
+        demos = [e for e in events if e["event"] == "tier_demoted"]
+        assert len(demos) == 1
+        assert demos[0]["tier"] == "resident_vjp"
+        assert demos[0]["demoted"] == ["resident_vjp"]
+    finally:
+        reset_fused_tier_demotions()
+
+
+def test_run_isolated_emits_retry_and_quarantine_events(tmp_path):
+    from ncnet_tpu.evaluation.resilience import FaultPolicy, run_isolated
+
+    path = str(tmp_path / "events.jsonl")
+    calls = {"n": 0}
+
+    def work():
+        calls["n"] += 1
+        raise OSError("disk on fire")
+
+    with EventLog(path) as sink, obs_events.bound(sink):
+        ok, result = run_isolated(
+            "unit_1", work,
+            policy=FaultPolicy(retries=1, backoff_s=0.0, quarantine=True),
+        )
+    assert not ok and result is None and calls["n"] == 2
+    _, events = replay_events(path)
+    retries = [e for e in events if e["event"] == "retry"]
+    quars = [e for e in events if e["event"] == "quarantine"]
+    assert len(retries) == 1 and retries[0]["kind"] == "io"
+    assert retries[0]["on_budget"] is True
+    assert len(quars) == 1 and quars[0]["unit"] == "unit_1"
+    assert quars[0]["attempts"] == 2
+
+
+# ---------------------------------------------------------------------------
+# profiling window knob
+# ---------------------------------------------------------------------------
+
+
+def test_profile_step_window_parsing(monkeypatch):
+    from ncnet_tpu.utils.profiling import profile_step_window
+
+    monkeypatch.delenv("NCNET_TPU_PROFILE_STEPS", raising=False)
+    assert profile_step_window() is None
+    monkeypatch.setenv("NCNET_TPU_PROFILE_STEPS", "3:7")
+    assert profile_step_window() == (3, 7)
+    for bad in ("junk", "7:3", "0:4", "1:1", "1:2:3"):
+        monkeypatch.setenv("NCNET_TPU_PROFILE_STEPS", bad)
+        with pytest.raises(ValueError):
+            profile_step_window()
+
+
+def test_step_window_tracer_start_stop(monkeypatch, tmp_path):
+    import jax
+
+    from ncnet_tpu.utils.profiling import StepWindowTracer
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+
+    # no log dir → inert even with a window
+    t = StepWindowTracer(log_dir=None, window=(2, 4))
+    assert not t.enabled
+    t.at_step(2)
+    assert calls == []
+
+    d = str(tmp_path / "prof")
+    t = StepWindowTracer(log_dir=d, window=(2, 4))
+    assert t.enabled
+    t.at_step(1)
+    assert calls == []          # before the window
+    t.at_step(2)
+    assert calls == [("start", d)]
+    t.at_step(3)
+    assert calls == [("start", d)]  # still inside [2, 4)
+    t.at_step(4)
+    assert calls[-1] == ("stop",)   # window edge stops the capture
+    assert not t.enabled            # one window per run
+    t.close()
+    assert calls.count(("stop",)) == 1
+
+    # early exit: close() stops a capture left open mid-window
+    calls.clear()
+    t2 = StepWindowTracer(log_dir=d, window=(1, 10))
+    t2.at_step(1)
+    t2.close()
+    assert calls == [("start", d), ("stop",)]
+
+
+# ---------------------------------------------------------------------------
+# training integration: instrumented fit, counters, heartbeat
+# ---------------------------------------------------------------------------
+
+
+def _dataset(tmp_path, n_pairs=4, seed=1):
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=n_pairs, image_hw=(48, 48),
+                       shift=(16, 16), seed=seed)
+    return root
+
+
+def _cfg(root, out_dir, **kw):
+    base = dict(
+        model=TINY, image_size=48,
+        dataset_image_path=root, dataset_csv_path=root + "/image_pairs",
+        num_epochs=1, batch_size=2, lr=1e-3,
+        result_model_dir=str(out_dir), log_interval=10, data_parallel=False,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _read_events(ckpt_root):
+    return replay_events(os.path.join(ckpt_root, "telemetry",
+                                      "events.jsonl"))
+
+
+def test_fit_writes_consistent_event_log_and_heartbeat(tmp_path):
+    root = _dataset(tmp_path, n_pairs=4)  # 2 train batches at bs=2
+    r = training.fit(_cfg(root, tmp_path / "out"), progress=False)
+    ckpt_root = r["checkpoint"]
+    header, events = _read_events(ckpt_root)
+    kinds = [e["event"] for e in events]
+    assert kinds.count("run_start") == 1
+    steps = [e for e in events if e["event"] == "step"]
+    assert [e["step"] for e in steps] == [1, 2]
+    assert all(e["mode"] == "train" and e["wall_s"] > 0 for e in steps)
+    assert all(isinstance(e.get("grad_norm"), float) for e in steps)
+    assert kinds.count("epoch_start") == 1 and kinds.count("epoch_end") == 1
+    assert kinds.count("checkpoint_commit") == 1  # epoch-end save
+    assert kinds.count("run_end") == 1
+    assert kinds.index("run_end") == len(kinds) - 1
+    # per-epoch metrics flush carries the step timer + checkpoint counter
+    metrics = [e for e in events if e["event"] == "metrics"]
+    assert metrics and metrics[0]["metrics"]["step_wall"]["count"] == 2
+    assert metrics[0]["metrics"]["checkpoint_commits"] == 1
+    # heartbeat: last beat is the last step, atomically committed
+    hb = Heartbeat.read(os.path.join(ckpt_root, "telemetry",
+                                     "heartbeat.json"))
+    assert hb["step"] == 2
+    # the global sink is restored after fit
+    assert obs_events.get_global_sink() is None
+
+
+def test_fit_no_telemetry_writes_nothing(tmp_path):
+    root = _dataset(tmp_path, n_pairs=4)
+    r = training.fit(_cfg(root, tmp_path / "out", telemetry=False),
+                     progress=False)
+    assert not os.path.exists(os.path.join(r["checkpoint"], "telemetry"))
+
+
+def test_fit_nan_injection_counts_skips_in_telemetry(tmp_path):
+    root = _dataset(tmp_path, n_pairs=4)
+    cfg = _cfg(root, tmp_path / "out", max_bad_steps=3)
+    with faults.injected(FaultPlan(nan_loss_steps=(1,))):
+        r = training.fit(cfg, progress=False)
+    assert r["nan_steps_skipped"] == 1
+    _, events = _read_events(r["checkpoint"])
+    skips = [e for e in events if e["event"] == "nan_skip"]
+    assert len(skips) == 1 and skips[0]["step"] == 1
+    metrics = [e for e in events if e["event"] == "metrics"]
+    assert metrics[-1]["metrics"]["nan_skips"] == 1
+    report = run_report.build_report(
+        [os.path.join(r["checkpoint"], "telemetry", "events.jsonl")])
+    assert report["counts"]["nan_skips"] == 1
+
+
+def test_fit_divergence_emits_postmortem_trail(tmp_path):
+    root = _dataset(tmp_path, n_pairs=4)
+    cfg = _cfg(root, tmp_path / "out", max_bad_steps=2)
+    with faults.injected(FaultPlan(nan_loss_steps=(1, 2))):
+        with pytest.raises(training.TrainDivergedError):
+            training.fit(cfg, progress=False)
+    ckpt_root = os.path.join(
+        tmp_path / "out", os.listdir(tmp_path / "out")[0])
+    _, events = _read_events(ckpt_root)
+    kinds = [e["event"] for e in events]
+    assert "diverged" in kinds
+    assert kinds.count("run_end") == 1  # the scope closes on the error path
+    report = run_report.build_report(
+        [os.path.join(ckpt_root, "telemetry", "events.jsonl")])
+    pm = report["divergence_postmortem"]
+    assert pm["died_at_step"] == 2 and pm["streak"] == 2
+    assert [e["step"] for e in pm["last_steps"]] == [1, 2]
+
+
+def test_sigkill_mid_epoch_resume_yields_replayable_consistent_log(tmp_path):
+    """THE acceptance scenario: a training run SIGKILLed mid-epoch (during
+    the save of version 3) and resumed must leave one event log holding
+    both runs' lineage, which run_report replays without error and whose
+    counters are consistent: the re-executed step appears once per run that
+    executed it, checkpoint commits match the versions on disk, and the
+    resume is recorded with its position."""
+    root = _dataset(tmp_path, n_pairs=8)  # 4 train batches at bs=2
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {_REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ncnet_tpu.config import ModelConfig, TrainConfig
+from ncnet_tpu import training
+
+cfg = TrainConfig(
+    model=ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,)),
+    image_size=48,
+    dataset_image_path={root!r},
+    dataset_csv_path={root + "/image_pairs"!r},
+    num_epochs=1, batch_size=2, lr=1e-3,
+    result_model_dir={str(tmp_path / "killed")!r},
+    log_interval=10, data_parallel=False,
+    checkpoint_steps=1, keep_checkpoints=10,
+)
+training.fit(cfg, progress=False)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["NCNET_TPU_FAULTS"] = json.dumps({"kill_at_version": 3})
+    proc = subprocess.run(
+        [sys.executable, str(worker)], env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == -9, f"expected SIGKILL, got:\n{proc.stdout[-3000:]}"
+
+    (ckpt_root,) = [
+        os.path.join(tmp_path / "killed", d)
+        for d in os.listdir(tmp_path / "killed")
+    ]
+    events_path = os.path.join(ckpt_root, "telemetry", "events.jsonl")
+    # the killed run's log replays on its own (torn tail tolerated)
+    _, killed_events = replay_events(events_path)
+    killed_steps = [e["step"] for e in killed_events
+                    if e["event"] == "step"]
+    assert killed_steps == [1, 2, 3]  # step 3 ran; its save was killed
+
+    # resume in-process into the same root → the log must APPEND
+    cfg_resume = _cfg(root, tmp_path / "killed",
+                      model=TINY.replace(checkpoint=ckpt_root),
+                      checkpoint_steps=1, keep_checkpoints=10)
+    r = training.fit(cfg_resume, progress=False)
+    assert r["checkpoint"] == ckpt_root
+
+    report = run_report.build_report([events_path])  # replays without error
+    c = report["counts"]
+    assert len(report["lineage"]) == 2      # killed run + resumed run
+    assert c["resumes"] == 1
+    assert c["run_ends"] == 1               # only the resumed run ended
+    # step events: killed run emitted 1,2,3; the resume re-executes 3
+    # (version 3 never committed) and finishes 4
+    _, events = replay_events(events_path)
+    step_counts = {}
+    for e in events:
+        if e["event"] == "step":
+            step_counts[e["step"]] = step_counts.get(e["step"], 0) + 1
+    assert step_counts == {1: 1, 2: 1, 3: 2, 4: 1}
+    # checkpoint commits in the log cover exactly the versions on disk
+    committed = {e["step"] for e in events
+                 if e["event"] == "checkpoint_commit"}
+    on_disk = {n for n, _ in ckpt_io.list_checkpoint_versions(ckpt_root)}
+    assert committed == on_disk == {1, 2, 3, 4}
+    # the resume event records where the run picked up
+    (resume_ev,) = [e for e in events if e["event"] == "resume"]
+    assert resume_ev["step"] == 2 and resume_ev["batch"] == 2
+    # render paths both work on the real artifact
+    assert "run lineage" in run_report.render_text(report)
+    # heartbeat reflects the final step
+    hb = Heartbeat.read(os.path.join(ckpt_root, "telemetry",
+                                     "heartbeat.json"))
+    assert hb["step"] == 4
+
+
+# ---------------------------------------------------------------------------
+# run_report on a synthetic log
+# ---------------------------------------------------------------------------
+
+
+def test_run_report_synthetic_log(tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as log:
+        log.emit("run_start", envelope={"device_kind": "TPU v5 lite"})
+        for i, wall in enumerate([0.1, 0.2, 0.3, 0.4, 0.5], start=1):
+            log.emit("step", mode="train", step=i, loss=1.0 / i,
+                     wall_s=wall, stage_wall_s=0.01, pairs_per_s=16 / wall,
+                     mfu_pct=10.0 * i, grad_norm=1.0)
+        log.emit("tier_selected", stage="forward", tier="resident",
+                 shape=[25, 25, 25, 25])
+        log.emit("tier_demoted", tier="resident", demoted=["resident"])
+        log.emit("retry", unit="q1", kind="device", attempt=1,
+                 on_budget=True)
+        log.emit("quarantine", unit="q1", kind="device", attempts=3)
+        log.emit("watchdog_timeout", label="fetch q1", timeout_s=5.0)
+        log.emit("checkpoint_commit", step=5, epoch=1, best=True)
+        log.emit("run_end", step=5, preempted=False, nan_steps_skipped=0)
+    report = run_report.build_report([path])
+    assert report["counts"]["steps"] == 5
+    assert report["counts"]["quarantines"] == 1
+    assert report["counts"]["tier_demotions"] == 1
+    assert report["counts"]["watchdog_timeouts"] == 1
+    assert abs(report["step_wall_s"]["p50"] - 0.3) < 1e-9
+    assert report["step_wall_s"]["n"] == 5
+    assert report["retries_by_kind"] == {"device": 1}
+    assert report["mfu_trajectory"][-1] == {"step": 5, "mfu_pct": 50.0}
+    assert [t["event"] for t in report["tier_timeline"]] \
+        == ["tier_selected", "tier_demoted"]
+    assert report["divergence_postmortem"] is None
+
+    text = run_report.render_text(report)
+    assert "DEMOTED resident" in text
+    assert "quarantined units" in text
+    assert "device=1" in text
+
+    # the CLI surface: text and --json both exit 0, and the JSON doc parses
+    assert run_report.main([path]) == 0
+    capsys.readouterr()
+    assert run_report.main([path, "--json"]) == 0
+    json.loads(capsys.readouterr().out)
+
+
+# ---------------------------------------------------------------------------
+# no-bare-print enforcement (the logger migration, locked in)
+# ---------------------------------------------------------------------------
+
+
+def test_library_modules_have_no_bare_print(tmp_path):
+    hits = check_no_bare_print.find_bare_prints(
+        os.path.join(_REPO, "ncnet_tpu"))
+    assert hits == [], f"bare print() in library modules: {hits}"
+
+    # the checker itself must actually detect violations (no vacuous pass):
+    bad = tmp_path / "pkg"
+    (bad / "sub").mkdir(parents=True)
+    (bad / "mod.py").write_text(
+        '"""print() in a docstring does not count."""\n'
+        "# print() in a comment does not count\n"
+        "def f():\n"
+        "    print('caught')\n"
+    )
+    (bad / "cli").mkdir()
+    (bad / "cli" / "main.py").write_text("print('exempt')\n")
+    (bad / "sub" / "ok.py").write_text("x = 1\n")
+    hits = check_no_bare_print.find_bare_prints(str(bad))
+    assert [(os.path.basename(p), ln) for p, ln in hits] == [("mod.py", 4)]
+    assert check_no_bare_print.main([str(bad)]) == 1
+    assert check_no_bare_print.main([str(bad / "sub")]) == 0

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Fail if any ncnet_tpu LIBRARY module calls bare ``print()``.
+
+The observability layer (``ncnet_tpu/observability/logging.py``) is the one
+console sink: library code must log through ``get_logger(...)`` so every
+rendered line is also teed into the structured event log.  A bare
+``print()`` silently reopens the side channel the PR 5 migration closed —
+this checker (run as a tier-1 test, ``tests/test_observability.py``) locks
+the migration in.
+
+Exemptions:
+  * ``ncnet_tpu/cli/`` — CLI entry points ARE the console; their banner /
+    result prints are user interface, not run telemetry;
+  * docstrings/comments — the scan is AST-based, so ``print()`` mentioned
+    in prose never trips it;
+  * ``sys.stdout.write`` in the logger itself (that is the sink).
+
+Usage: ``python tools/check_no_bare_print.py [package_dir]`` — prints one
+``path:line`` per violation and exits 1 if any were found.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+EXEMPT_DIRS = ("cli",)
+
+
+def find_bare_prints(package_dir: str) -> List[Tuple[str, int]]:
+    """``(path, lineno)`` for every ``print(...)`` call in a non-exempt
+    module under ``package_dir``.  AST-based: docstrings, comments and
+    attribute calls like ``pprint.print`` do not count."""
+    hits: List[Tuple[str, int]] = []
+    for root, dirs, files in os.walk(package_dir):
+        rel = os.path.relpath(root, package_dir)
+        parts = [] if rel == "." else rel.split(os.sep)
+        if any(p in EXEMPT_DIRS for p in parts):
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path, "r") as f:
+                try:
+                    tree = ast.parse(f.read(), path)
+                except SyntaxError as e:  # a broken module is its own bug
+                    hits.append((path, e.lineno or 0))
+                    continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "print"):
+                    hits.append((path, node.lineno))
+    return hits
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    package_dir = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ncnet_tpu",
+    )
+    hits = find_bare_prints(package_dir)
+    for path, lineno in hits:
+        print(f"{path}:{lineno}: bare print() in a library module "
+              "(use ncnet_tpu.observability.get_logger)")
+    if hits:
+        print(f"{len(hits)} bare print call(s) found under {package_dir} "
+              f"(exempt: {', '.join(EXEMPT_DIRS)}/)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Replay one or more ncnet_tpu event logs into a run report.
+
+The event log (``ncnet_tpu/observability/events.py``) is the durable,
+machine-readable trace of a run: step/epoch boundaries, checkpoint commits,
+NaN-guard skips, tier selections/demotions, retries, quarantines, watchdog
+timeouts, metrics flushes.  This tool turns one or more of those JSONL files
+(a resumed run appends to the same file; sharded runs write several) into
+the report an operator actually wants after a run ends — or dies:
+
+  * run/resume lineage (every run id in the file, with its envelope);
+  * step-time percentiles + throughput + the MFU trajectory;
+  * the tier timeline (selections and demotions, in order);
+  * failure accounting: NaN skips, retries by kind, quarantines, watchdog
+    timeouts, preemptions;
+  * a divergence postmortem when the run died of TrainDivergedError (the
+    last N steps before the fatal streak, with losses and grad norms);
+  * checkpoint/resume consistency (commits seen, resume positions).
+
+Usage::
+
+    python tools/run_report.py <events.jsonl> [more.jsonl ...] [--json]
+
+``--json`` emits the raw report dict (one JSON document) instead of text.
+Replay is torn-tail tolerant: a log whose writer was SIGKILLed mid-append
+still replays in full minus at most the torn trailing line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ncnet_tpu.observability.events import replay_events  # noqa: E402
+
+
+def _percentiles(xs: List[float], qs=(50, 90, 99)) -> Dict[str, float]:
+    if not xs:
+        return {}
+    xs = sorted(xs)
+    out: Dict[str, float] = {}
+    for q in qs:
+        # nearest-rank on the sorted walls: no numpy needed, exact enough
+        # for a report
+        i = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+        out[f"p{q}"] = xs[i]
+    out["mean"] = sum(xs) / len(xs)
+    out["n"] = len(xs)
+    return out
+
+
+def build_report(paths: List[str]) -> Dict[str, Any]:
+    """Aggregate one report dict over every given event log."""
+    runs: List[Dict[str, Any]] = []
+    events: List[dict] = []
+    for path in paths:
+        header, recs = replay_events(path)
+        runs.append({"path": path, "header": header.get("header", {})})
+        events.extend(recs)
+
+    steps = [e for e in events if e.get("event") == "step"]
+    step_walls = [e["wall_s"] for e in steps if isinstance(
+        e.get("wall_s"), (int, float))]
+    stage_walls = [e["stage_wall_s"] for e in steps if isinstance(
+        e.get("stage_wall_s"), (int, float))]
+    mfu = [(e.get("step"), e["mfu_pct"]) for e in steps
+           if isinstance(e.get("mfu_pct"), (int, float))]
+    pairs_s = [e["pairs_per_s"] for e in steps
+               if isinstance(e.get("pairs_per_s"), (int, float))]
+
+    # run/resume lineage: order of first appearance of each run id
+    lineage: List[Dict[str, Any]] = []
+    seen_runs: Dict[str, int] = {}
+    for e in events:
+        rid = e.get("run")
+        if rid and rid not in seen_runs:
+            seen_runs[rid] = len(lineage)
+            lineage.append({"run_id": rid, "events": 0})
+        if rid:
+            lineage[seen_runs[rid]]["events"] += 1
+        if e.get("event") == "resume" and rid:
+            lineage[seen_runs[rid]]["resumed_from"] = {
+                "checkpoint": e.get("checkpoint"),
+                "epoch": e.get("epoch"), "batch": e.get("batch"),
+                "step": e.get("step"),
+            }
+
+    tier_timeline = [
+        {k: e.get(k) for k in
+         ("t", "event", "tier", "stage", "shape", "demoted") if k in e}
+        for e in events if e.get("event") in ("tier_selected", "tier_demoted")
+    ]
+
+    retries_by_kind: Dict[str, int] = {}
+    for e in events:
+        if e.get("event") == "retry":
+            k = str(e.get("kind", "other"))
+            retries_by_kind[k] = retries_by_kind.get(k, 0) + 1
+    quarantines = [
+        {"unit": e.get("unit"), "kind": e.get("kind"),
+         "attempts": e.get("attempts"), "scope": e.get("scope")}
+        for e in events if e.get("event") == "quarantine"
+    ]
+
+    checkpoints = [
+        {"step": e.get("step"), "epoch": e.get("epoch"),
+         "best": e.get("best"), "path": e.get("path")}
+        for e in events if e.get("event") == "checkpoint_commit"
+    ]
+    nan_skips = [e for e in events if e.get("event") == "nan_skip"]
+    diverged = [e for e in events if e.get("event") == "diverged"]
+    preemptions = [e for e in events if e.get("event") == "preemption"]
+    watchdogs = [e for e in events if e.get("event") == "watchdog_timeout"]
+    run_ends = [e for e in events if e.get("event") == "run_end"]
+
+    postmortem: Optional[Dict[str, Any]] = None
+    if diverged:
+        death = diverged[-1]
+        tail = [e for e in steps
+                if isinstance(e.get("step"), int)
+                and e["step"] <= (death.get("step") or 0)][-8:]
+        postmortem = {
+            "died_at_step": death.get("step"),
+            "epoch": death.get("epoch"),
+            "streak": death.get("streak"),
+            "last_steps": [
+                {k: e.get(k) for k in
+                 ("step", "loss", "grad_norm", "wall_s") if k in e}
+                for e in tail
+            ],
+        }
+
+    eval_batches = [e for e in events if e.get("event") == "eval_batch"]
+    eval_queries = [e for e in events if e.get("event") == "eval_query"]
+    eval_summaries = [e for e in events
+                      if e.get("event") == "eval_summary"]
+
+    report: Dict[str, Any] = {
+        "logs": runs,
+        "lineage": lineage,
+        "counts": {
+            "events": len(events),
+            "steps": len(steps),
+            "epochs_completed": sum(
+                1 for e in events if e.get("event") == "epoch_end"),
+            "checkpoint_commits": len(checkpoints),
+            "resumes": sum(
+                1 for e in events if e.get("event") == "resume"),
+            "nan_skips": len(nan_skips),
+            "preemptions": len(preemptions),
+            "watchdog_timeouts": len(watchdogs),
+            "quarantines": len(quarantines),
+            "tier_demotions": sum(
+                1 for e in events if e.get("event") == "tier_demoted"),
+            "run_ends": len(run_ends),
+        },
+        "step_wall_s": _percentiles(step_walls),
+        "stage_wall_s": _percentiles(stage_walls),
+        "pairs_per_s": _percentiles(pairs_s),
+        "mfu_trajectory": [{"step": s, "mfu_pct": m} for s, m in mfu],
+        "tier_timeline": tier_timeline,
+        "retries_by_kind": retries_by_kind,
+        "quarantines": quarantines,
+        "checkpoints": checkpoints,
+        "divergence_postmortem": postmortem,
+    }
+    if eval_batches or eval_queries or eval_summaries:
+        pcks = [e["pck"] for e in eval_batches
+                if isinstance(e.get("pck"), (int, float))]
+        report["eval"] = {
+            "batches": len(eval_batches),
+            "queries": len(eval_queries),
+            "queries_ok": sum(1 for e in eval_queries if e.get("ok")),
+            "batch_pck": _percentiles(pcks, qs=(50,)),
+            "fetch_wall_s": _percentiles(
+                [e["fetch_wall_s"] for e in eval_batches
+                 if isinstance(e.get("fetch_wall_s"), (int, float))]),
+            "summaries": eval_summaries,
+        }
+    return report
+
+
+def _fmt_stats(stats: Dict[str, float], unit: str = "s") -> str:
+    if not stats:
+        return "(no samples)"
+    parts = [f"{k}={stats[k]:.4f}{unit}" for k in ("p50", "p90", "p99")
+             if k in stats]
+    parts.append(f"mean={stats['mean']:.4f}{unit}")
+    parts.append(f"n={stats['n']}")
+    return "  ".join(parts)
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    add = lines.append
+    add("=== ncnet_tpu run report ===")
+    for lg in report["logs"]:
+        h = lg["header"]
+        add(f"log: {lg['path']}  (schema {h.get('schema')}, host "
+            f"{h.get('host')}, device {h.get('device_kind', 'n/a')})")
+    add("")
+    add("run lineage:")
+    for r in report["lineage"]:
+        line = f"  {r['run_id']}  events={r['events']}"
+        if "resumed_from" in r:
+            rf = r["resumed_from"]
+            line += (f"  resumed from step {rf.get('step')} "
+                     f"(epoch {rf.get('epoch')}, batch {rf.get('batch')})")
+        add(line)
+    add("")
+    c = report["counts"]
+    add(f"steps={c['steps']}  epochs={c['epochs_completed']}  "
+        f"checkpoints={c['checkpoint_commits']}  resumes={c['resumes']}")
+    add(f"nan_skips={c['nan_skips']}  preemptions={c['preemptions']}  "
+        f"quarantines={c['quarantines']}  "
+        f"tier_demotions={c['tier_demotions']}  "
+        f"watchdog_timeouts={c['watchdog_timeouts']}")
+    add("")
+    add(f"step wall:   {_fmt_stats(report['step_wall_s'])}")
+    add(f"stage wall:  {_fmt_stats(report['stage_wall_s'])}")
+    add(f"throughput:  {_fmt_stats(report['pairs_per_s'], ' pairs/s')}")
+    traj = report["mfu_trajectory"]
+    if traj:
+        first, last = traj[0], traj[-1]
+        peak = max(traj, key=lambda e: e["mfu_pct"])
+        add(f"MFU: first {first['mfu_pct']:.2f}% @ step {first['step']}, "
+            f"peak {peak['mfu_pct']:.2f}% @ step {peak['step']}, "
+            f"last {last['mfu_pct']:.2f}% @ step {last['step']}")
+    if report["tier_timeline"]:
+        add("")
+        add("tier timeline:")
+        for e in report["tier_timeline"]:
+            if e["event"] == "tier_demoted":
+                add(f"  DEMOTED {e.get('tier')}  "
+                    f"(now disabled: {e.get('demoted')})")
+            else:
+                add(f"  selected {e.get('tier')} for {e.get('stage')} "
+                    f"shape {e.get('shape')}")
+    if report["retries_by_kind"]:
+        add("")
+        add("retries by kind: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(report["retries_by_kind"].items())))
+    if report["quarantines"]:
+        add("")
+        add("quarantined units:")
+        for qn in report["quarantines"]:
+            add(f"  {qn['unit']}  kind={qn['kind']} "
+                f"attempts={qn.get('attempts')}")
+    pm = report["divergence_postmortem"]
+    if pm:
+        add("")
+        add(f"DIVERGED at step {pm['died_at_step']} (epoch {pm['epoch']}, "
+            f"streak {pm['streak']}); last steps:")
+        for e in pm["last_steps"]:
+            add(f"  step {e.get('step')}: loss={e.get('loss')} "
+                f"grad_norm={e.get('grad_norm')}")
+    ev = report.get("eval")
+    if ev:
+        add("")
+        add(f"eval: batches={ev['batches']} queries={ev['queries']} "
+            f"(ok={ev['queries_ok']})")
+        if ev["batch_pck"]:
+            add(f"  batch PCK: {_fmt_stats(ev['batch_pck'], '')}")
+        for s in ev["summaries"]:
+            m = s.get("metrics", {})
+            add("  summary: " + json.dumps(
+                {k: m[k] for k in sorted(m) if not isinstance(m[k], dict)}))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Replay ncnet_tpu event logs into a run report")
+    ap.add_argument("logs", nargs="+", help="events.jsonl file(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON document")
+    args = ap.parse_args(argv)
+    report = build_report(args.logs)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
