@@ -1012,6 +1012,23 @@ def main():
     if headline is not None and jsonable(headline) is not None:
         registry.gauge("pf_pascal_forward_ms_per_pair").set(jsonable(headline))
     registry.flush(run_id=envelope["run_id"])
+    # cross-run perf history (round 9): every bench run lands in the
+    # persistent store so tools/perf_regress.py can gate the next one
+    # against the trailing baseline.  Fail-open; NCNET_TPU_PERF_STORE=off
+    # disables.
+    from ncnet_tpu.observability import perfstore
+
+    history = {k: v for k, v in extra.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    if headline is not None and jsonable(headline) is not None:
+        history["pf_pascal_forward_ms_per_pair"] = jsonable(headline)
+    if vs_baseline is not None and jsonable(vs_baseline) is not None:
+        history["vs_baseline"] = jsonable(vs_baseline)
+    perfstore.maybe_record(
+        history, source="bench", run_id=envelope["run_id"],
+        device_kind=envelope.get("device_kind"),
+        git_rev=envelope.get("git_rev"),
+    )
     print(
         json.dumps(
             {

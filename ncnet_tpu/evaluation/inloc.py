@@ -46,6 +46,7 @@ from ncnet_tpu.data.datasets import load_image
 from ncnet_tpu.evaluation.pipeline import PipelineDepthController
 from ncnet_tpu.observability import events as obs_events
 from ncnet_tpu.observability import get_logger
+from ncnet_tpu.observability.tracing import span
 
 log = get_logger("eval.inloc")
 from ncnet_tpu.models.ncnet import (
@@ -601,11 +602,12 @@ def run_inloc_eval(
             idx0, handle = in_flight.pop(0)
             # the watchdog converts a hung tunnel fetch into a retryable
             # FetchTimeoutError that the per-query isolation absorbs
-            xa, ya, xb, yb, score = call_with_watchdog(
-                matcher.fetch, (handle,),
-                timeout=config.fetch_timeout_s,
-                label=f"InLoc query {q + 1} pair {idx0}",
-            )
+            with span("fetch", pair=idx0):
+                xa, ya, xb, yb, score = call_with_watchdog(
+                    matcher.fetch, (handle,),
+                    timeout=config.fetch_timeout_s,
+                    label=f"InLoc query {q + 1} pair {idx0}",
+                )
             if sample:
                 depth_ctl.note_drain()
             else:
@@ -640,10 +642,14 @@ def run_inloc_eval(
                 log.info(">>>" + str(idx))
 
         for idx in range(len(jobs)):
-            tgt = pending.result()
+            # decode span = the WAIT on the decode-ahead worker, i.e. the
+            # part of pano decode the pipeline failed to hide
+            with span("decode", pair=idx):
+                tgt = pending.result()
             if idx + 1 < len(jobs):
                 pending = io_pool.submit(load_raw, jobs[idx + 1])
-            in_flight.append((idx, matcher.dispatch(src, tgt)))
+            with span("dispatch", pair=idx):
+                in_flight.append((idx, matcher.dispatch(src, tgt)))
             # `while`, not `if`: when the controller SHRINKS the depth
             # mid-query the extra in-flight slots must actually drain, or
             # the old deeper queue (and its ~90 MB/slot pano buffers)
@@ -760,9 +766,17 @@ def run_inloc_eval(
                 return None
 
             t_q = time.perf_counter()
+
+            def _traced_query(q=q):
+                # one span per ATTEMPT (retries each get their own), so the
+                # trace shows retry cost where the eval_query event only
+                # shows the total wall
+                with span("inloc_query", query=q + 1):
+                    return process_query(q, io_pool)
+
             ok, _ = run_isolated(
                 qid,
-                lambda q=q: process_query(q, io_pool),
+                _traced_query,
                 policy=policy,
                 manifest=manifest,
                 on_failure=on_failure,

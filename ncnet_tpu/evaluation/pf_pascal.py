@@ -59,6 +59,7 @@ from ncnet_tpu.models import NCNet
 from ncnet_tpu.observability import events as obs_events
 from ncnet_tpu.observability import get_logger
 from ncnet_tpu.observability.metrics import MetricsRegistry
+from ncnet_tpu.observability.tracing import span
 from ncnet_tpu.ops import corr_to_matches
 from ncnet_tpu.ops.image import normalize_imagenet, quantize_u8
 from ncnet_tpu.utils.profiling import annotate
@@ -238,6 +239,8 @@ def _run_eval_impl(
     # byte cost on a tunneled device.  The uint8 path quarters it instead.
     img_dt = jnp.bfloat16 if net.config.backbone_bf16 else None
     timing = {"decode_s": 0.0, "dispatch_s": 0.0, "fetch_s": 0.0}
+    fresh_pairs = 0    # pairs actually dispatched THIS run
+    replayed_batches = 0  # batches a journal resume skipped
     # the controller's wall caps were measured per InLoc PAIR; a PF-Pascal
     # drain is one batch, so scale them by the batch's relative weight
     # (≥1×: a tiny batch still cannot drain faster than one dispatch RTT)
@@ -315,7 +318,8 @@ def _run_eval_impl(
     def drain_one(sample: bool = True):
         handle, n0, bi, jb = in_flight.pop(0)
         t0 = time.perf_counter()
-        arr = resolve_batch(bi, jb, n0, handle)
+        with span("fetch", batch=bi):
+            arr = resolve_batch(bi, jb, n0, handle)
         results.append(arr)
         fetch_wall = time.perf_counter() - t0
         timing["fetch_s"] += fetch_wall
@@ -338,8 +342,17 @@ def _run_eval_impl(
             # dispatch between them — not a per-drain wall sample
             depth_ctl.note_gap()
 
+    # explicit iterator: the decode wall (the loader's __next__, i.e. image
+    # decode + resize on the prefetch pool's completion order) gets its own
+    # span per batch instead of hiding in the for-statement
+    loader_it = enumerate(loader)
     t_decode = time.perf_counter()
-    for i, batch in enumerate(loader):
+    while True:
+        with span("decode"):
+            nxt_item = next(loader_it, None)
+        if nxt_item is None:
+            break
+        i, batch = nxt_item
         timing["decode_s"] += time.perf_counter() - t_decode
         if journal is not None and i in journal.entries:
             # resume: this batch's contribution is already journaled.  Flush
@@ -348,6 +361,7 @@ def _run_eval_impl(
             while in_flight:
                 drain_one(sample=False)
             results.append(journal.entries[i])
+            replayed_batches += 1
             if manifest is not None:
                 manifest.complete(f"batch_{i}", journaled=True)
             # a replayed unit is a completed unit: reset the breaker streak
@@ -360,51 +374,56 @@ def _run_eval_impl(
             t_decode = time.perf_counter()
             continue
         t0 = time.perf_counter()
-        jb = {
-            k: np.asarray(v)
-            for k, v in batch.items()
-            if k in ("source_image", "target_image", "source_points",
-                     "target_points", "source_im_size", "target_im_size", "L_pck")
-        }
-        # pad a trailing partial batch up to batch_size (repeating the last
-        # sample) so every step reuses the one compiled program, then crop
-        n_real = jb["source_image"].shape[0]
-        if n_real < batch_size:
-            reps = [1] * batch_size
-            reps[n_real - 1] = batch_size - n_real + 1
-            jb = {k: np.repeat(v, reps[: n_real], axis=0) for k, v in jb.items()}
+        with span("dispatch", batch=i):
+            jb = {
+                k: np.asarray(v)
+                for k, v in batch.items()
+                if k in ("source_image", "target_image", "source_points",
+                         "target_points", "source_im_size", "target_im_size",
+                         "L_pck")
+            }
+            # pad a trailing partial batch up to batch_size (repeating the
+            # last sample) so every step reuses the one compiled program,
+            # then crop
+            n_real = jb["source_image"].shape[0]
+            if n_real < batch_size:
+                reps = [1] * batch_size
+                reps[n_real - 1] = batch_size - n_real + 1
+                jb = {k: np.repeat(v, reps[: n_real], axis=0)
+                      for k, v in jb.items()}
 
-        def upload(k, v):
-            if not k.endswith("_image"):
-                return jnp.asarray(v)
-            if device_normalize:
-                # resized 0-255 floats → uint8 for the transfer (≤0.5/255
-                # rounding; the jitted step normalizes on device)
-                return jnp.asarray(quantize_u8(v))
-            return jnp.asarray(v, dtype=img_dt)
+            def upload(k, v):
+                if not k.endswith("_image"):
+                    return jnp.asarray(v)
+                if device_normalize:
+                    # resized 0-255 floats → uint8 for the transfer (≤0.5/255
+                    # rounding; the jitted step normalizes on device)
+                    return jnp.asarray(quantize_u8(v))
+                return jnp.asarray(v, dtype=img_dt)
 
-        jb = {k: upload(k, v) for k, v in jb.items()}
-        # pipelined dispatch: jax's async dispatch lets batch i+1's upload +
-        # forward overlap batch i's device compute and result download.
-        # Results are fetched in dispatch order, so output order matches
-        # the serial loop.  A dispatch-time failure (an injected or real
-        # device error raised before the handle exists) is deferred to the
-        # drain's isolation path: demote/re-trace now if device-shaped,
-        # enqueue handle=None, and resolve_batch re-dispatches under its
-        # retry budget.
-        try:
-            handle = step(net.params, jb)
-        except Exception as e:
-            from ncnet_tpu.evaluation.resilience import classify_failure
+            jb = {k: upload(k, v) for k, v in jb.items()}
+            # pipelined dispatch: jax's async dispatch lets batch i+1's
+            # upload + forward overlap batch i's device compute and result
+            # download.  Results are fetched in dispatch order, so output
+            # order matches the serial loop.  A dispatch-time failure (an
+            # injected or real device error raised before the handle exists)
+            # is deferred to the drain's isolation path: demote/re-trace now
+            # if device-shaped, enqueue handle=None, and resolve_batch
+            # re-dispatches under its retry budget.
+            try:
+                handle = step(net.params, jb)
+            except Exception as e:
+                from ncnet_tpu.evaluation.resilience import classify_failure
 
-            kind = classify_failure(e)
-            log.warning(f"PF-Pascal batch {i}: {kind} failure at "
-                        f"dispatch: {type(e).__name__}: {e}", kind=kind)
-            depth_ctl.note_failure()
-            if kind == "device":
-                recover_from_device_failure(e, step)
-            handle = None
-        in_flight.append((handle, n_real, i, jb))
+                kind = classify_failure(e)
+                log.warning(f"PF-Pascal batch {i}: {kind} failure at "
+                            f"dispatch: {type(e).__name__}: {e}", kind=kind)
+                depth_ctl.note_failure()
+                if kind == "device":
+                    recover_from_device_failure(e, step)
+                handle = None
+            in_flight.append((handle, n_real, i, jb))
+            fresh_pairs += n_real
         timing["dispatch_s"] += time.perf_counter() - t0
         while len(in_flight) >= depth_ctl.depth:
             drain_one()
@@ -440,4 +459,20 @@ def _run_eval_impl(
     registry.gauge("pck").set(stats["pck"])
     registry.flush(event="eval_summary", total=stats["total"],
                    valid=stats["valid"])
+    # cross-run perf history: PCK + the wall split land in the persistent
+    # store so tools/perf_regress.py can gate the next eval against them
+    # (fail-open; NaN PCK from an all-quarantined run is filtered there).
+    # Walls are normalized PER PAIR and ingested only from FULL runs — the
+    # totals depend on dataset size, and a journal resume decodes batches
+    # it never dispatches, so gating raw (or resumed-run) walls would flag
+    # every short/partial run as a regression.  A resumed run ingests PCK
+    # only (the journal makes it bitwise-equal to the full result).
+    from ncnet_tpu.observability import perfstore
+
+    history = {"pf_pascal_pck": stats["pck"]}
+    if fresh_pairs and not replayed_batches:
+        for k in ("decode", "dispatch", "fetch"):
+            history[f"pf_pascal_{k}_s_per_pair"] = (
+                timing[f"{k}_s"] / fresh_pairs)
+    perfstore.maybe_record(history, source="pf_pascal_eval")
     return stats

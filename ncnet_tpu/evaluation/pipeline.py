@@ -195,10 +195,12 @@ def call_with_watchdog(fn, args=(), timeout: float = 0.0, label: str = ""):
     worker, so a test-armed hang exercises the REAL timeout path rather than
     a simulated exception.
     """
+    from ncnet_tpu.observability.tracing import span
     from ncnet_tpu.utils import faults
 
     if timeout <= 0:
-        return fn(*args)
+        with span("watched_call", label=label or "fetch"):
+            return fn(*args)
     result = {}
     done = threading.Event()
 
@@ -215,16 +217,21 @@ def call_with_watchdog(fn, args=(), timeout: float = 0.0, label: str = ""):
         target=target, daemon=True,
         name=f"watchdog-{label or 'fetch'}",
     )
-    worker.start()
-    if not done.wait(timeout):
-        from ncnet_tpu.observability import events as obs_events
+    # the span lives on the CALLER's thread (the worker has its own span
+    # stack), so a timeout closes it with error=FetchTimeoutError and the
+    # trace shows the watchdog budget as the span's wall
+    with span("watched_call", label=label or "fetch",
+              timeout_s=float(timeout)):
+        worker.start()
+        if not done.wait(timeout):
+            from ncnet_tpu.observability import events as obs_events
 
-        obs_events.emit("watchdog_timeout", label=label or "fetch",
-                        timeout_s=float(timeout))
-        raise FetchTimeoutError(
-            f"{label or 'fetch'} exceeded its {timeout:.1f}s watchdog "
-            "(hung tunnel or wedged device?)"
-        )
-    if "error" in result:
-        raise result["error"]
-    return result["value"]
+            obs_events.emit("watchdog_timeout", label=label or "fetch",
+                            timeout_s=float(timeout))
+            raise FetchTimeoutError(
+                f"{label or 'fetch'} exceeded its {timeout:.1f}s watchdog "
+                "(hung tunnel or wedged device?)"
+            )
+        if "error" in result:
+            raise result["error"]
+        return result["value"]

@@ -21,6 +21,7 @@ import numpy as np
 from ncnet_tpu.config import LocalizationConfig
 from ncnet_tpu.localization import geometry
 from ncnet_tpu.observability import get_logger
+from ncnet_tpu.observability.tracing import span
 
 log = get_logger("localization")
 from ncnet_tpu.localization.curves import (
@@ -275,7 +276,12 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
                         first["fut"] = None
                         if f is None:  # retry: resubmit to the pool
                             f = pool.submit(_pnp_one_query, *a)
-                        return f.result()
+                        # the span is the parent's WAIT on the worker (the
+                        # spawned process has no event sink); per-query
+                        # compute beyond the first is hidden behind earlier
+                        # waits, exactly what the trace should show
+                        with span("pnp_query", query=a[2]):
+                            return f.result()
 
                     ok, entry = run_isolated(
                         a[2], work, policy=policy, manifest=manifest,
@@ -292,8 +298,13 @@ def run_pnp_stage(config: LocalizationConfig) -> List[dict]:
                 raise
     else:
         for a in args:
+
+            def _one(a=a):
+                with span("pnp_query", query=a[2]):
+                    return _pnp_one_query(*a)
+
             ok, entry = run_isolated(
-                a[2], lambda a=a: _pnp_one_query(*a),
+                a[2], _one,
                 policy=policy, manifest=manifest,
                 label=f"PnP query {a[2]}",
             )
@@ -410,9 +421,10 @@ def run_pv_stage(
                     log.info(f"ncnetPV: scan {key} ({gi + 1} / "
                              f"{len(groups)}) done.")
     else:
-        scores = _pv_run_items(
-            config, [(it.query_fn, it.db_fn, it.P) for it in items]
-        )
+        with span("pv_score", items=len(items)):
+            scores = _pv_run_items(
+                config, [(it.query_fn, it.db_fn, it.P) for it in items]
+            )
 
     reranked = []
     for e in imglist:

@@ -385,8 +385,10 @@ def finetune_labels(name: str, params, n_finetune_blocks: int):
     """
 
     def _unfreeze(subtree):
+        from ncnet_tpu.utils.compat import tree_map_with_path
+
         # conv weights + BN affine train; BN running stats never do.
-        return jax.tree.map_with_path(
+        return tree_map_with_path(
             lambda path, _: "frozen"
             if any(getattr(k, "key", None) in ("mean", "var") for k in path)
             else "trainable",

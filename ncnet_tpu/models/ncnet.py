@@ -101,9 +101,12 @@ class ResilientJit:
         """
         import functools
 
-        fn = self._fn
-        wrapper = functools.wraps(fn)(lambda *a, **kw: fn(*a, **kw))
-        self._jitted = jax.jit(wrapper, **self._jit_kwargs)
+        from ncnet_tpu.observability.tracing import span
+
+        with span("retrace", label=self._label):
+            fn = self._fn
+            wrapper = functools.wraps(fn)(lambda *a, **kw: fn(*a, **kw))
+            self._jitted = jax.jit(wrapper, **self._jit_kwargs)
 
 
 def recover_from_device_failure(exc: BaseException, *retraceables,
@@ -158,8 +161,14 @@ def recover_from_device_failure(exc: BaseException, *retraceables,
         f"demoting fused NC tier '{tier}' and re-tracing the eval programs "
         "— the run continues on the next tier", kind="device",
     )
-    for r in retraceables:
-        r.retrace()
+    from ncnet_tpu.observability.tracing import span
+
+    # the demotion span bounds the recovery's host-side cost (N retraces);
+    # the retry's recompile lands inside the next dispatch, where the trace
+    # shows it as that span's inflated wall
+    with span("tier_recovery", tier=tier, error=type(exc).__name__):
+        for r in retraceables:
+            r.retrace()
     return tier
 
 

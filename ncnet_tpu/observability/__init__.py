@@ -43,6 +43,18 @@ from ncnet_tpu.observability.device import (  # noqa: F401
     Heartbeat,
     device_snapshot,
 )
+from ncnet_tpu.observability.tracing import (  # noqa: F401
+    current_span_id,
+    span,
+    traced,
+)
+from ncnet_tpu.observability.perfstore import (  # noqa: F401
+    PerfStore,
+    check_regressions,
+    maybe_record,
+    metric_direction,
+    resolve_store_path,
+)
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -70,4 +82,12 @@ __all__ = [
     "DeviceMonitor",
     "Heartbeat",
     "device_snapshot",
+    "current_span_id",
+    "span",
+    "traced",
+    "PerfStore",
+    "check_regressions",
+    "maybe_record",
+    "metric_direction",
+    "resolve_store_path",
 ]

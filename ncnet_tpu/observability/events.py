@@ -78,6 +78,20 @@ def run_envelope(run_id: Optional[str] = None) -> Dict[str, Any]:
     return env
 
 
+def local_device_kind() -> Optional[str]:
+    """The local accelerator kind (``devices[0].device_kind``), or None when
+    no backend is reachable — the ONE fail-open probe every cross-run
+    consumer (envelope, perf store, tier cache) keys device entries by, so
+    they can never silently key under different kinds."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        return str(devices[0].device_kind) if devices else None
+    except Exception:  # noqa: BLE001 — no backend = no kind, never a crash
+        return None
+
+
 def git_revision(repo_dir: Optional[str] = None) -> Optional[str]:
     """Short git revision of ``repo_dir`` (default: this package's repo),
     or None outside a work tree — bench stamps it into its envelope so a

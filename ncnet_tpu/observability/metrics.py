@@ -25,8 +25,10 @@ disagree on the denominator.
 
 from __future__ import annotations
 
+import statistics
 import threading
 import time
+from collections import deque
 from typing import Dict, Optional, Sequence
 
 from ncnet_tpu.observability import events as _events
@@ -101,7 +103,15 @@ class Gauge:
 class Timer:
     """Accumulates wall intervals; use as a context manager or feed measured
     seconds via :meth:`observe` (the eval loops already hold their own
-    ``perf_counter`` deltas)."""
+    ``perf_counter`` deltas).
+
+    Keeps a bounded window of recent observations so :meth:`snapshot` can
+    report ``p50_s``: for step walls the MEAN is dominated by the first
+    step's compile (seconds vs milliseconds), which makes runs of different
+    lengths incomparable — the median is what cross-run consumers (the perf
+    store gate) should ingest."""
+
+    _WINDOW = 1024  # recent observations kept for the median
 
     def __init__(self):
         self.count = 0
@@ -109,6 +119,7 @@ class Timer:
         self.last_s: Optional[float] = None
         self.min_s: Optional[float] = None
         self.max_s: Optional[float] = None
+        self._recent: deque = deque(maxlen=self._WINDOW)
 
     def observe(self, seconds: float) -> None:
         s = float(seconds)
@@ -117,6 +128,7 @@ class Timer:
         self.last_s = s
         self.min_s = s if self.min_s is None else min(self.min_s, s)
         self.max_s = s if self.max_s is None else max(self.max_s, s)
+        self._recent.append(s)
 
     def __enter__(self) -> "Timer":
         self._t0 = time.perf_counter()
@@ -133,6 +145,8 @@ class Timer:
                 out[k] = round(v, 6)
         if self.count:
             out["mean_s"] = round(self.total_s / self.count, 6)
+        if self._recent:
+            out["p50_s"] = round(statistics.median(self._recent), 6)
         return out
 
 

@@ -701,9 +701,15 @@ def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
     The training backward tier ``"resident_vjp"`` must be named explicitly
     (see ``_TIER_ORDER`` note above).
     """
+    from ncnet_tpu.ops import tier_cache
+
     if tier is None:
+        # walk past persistently-demoted tiers too: "demoting" a tier a
+        # previous process already disabled would burn the recovery cycle
+        # without changing the program
+        dead = _runtime_demoted | tier_cache.persistent_demotions()
         for t in _TIER_ORDER:
-            if t not in _runtime_demoted:
+            if t not in dead:
                 tier = t
                 break
         else:
@@ -711,6 +717,9 @@ def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
     elif tier not in _ALL_TIERS or tier in _runtime_demoted:
         return None
     _runtime_demoted.add(tier)
+    # negative cache entry: a tier that crashed mid-run stays demoted
+    # across restarts (and its cached positive decisions are dropped)
+    tier_cache.record_demotion(tier)
     from ncnet_tpu.observability import events as _obs_events
 
     _obs_events.emit("tier_demoted", tier=tier,
@@ -724,9 +733,17 @@ def demoted_fused_tiers() -> frozenset:
 
 
 def reset_fused_tier_demotions() -> None:
-    """Re-arm all runtime-demoted tiers (tests; or a deliberate re-probe)."""
+    """Re-arm all runtime-demoted tiers (tests; or a deliberate re-probe).
+
+    A deliberate re-probe must mean what it says: the persistent tier cache
+    (``ops/tier_cache.py``) is cleared too, or a stale cached decision —
+    including the negative entry the demotion just wrote — would answer the
+    very probe this reset requests."""
     _runtime_demoted.clear()
     _emitted_choices.clear()
+    from ncnet_tpu.ops import tier_cache
+
+    tier_cache.clear()
 
 
 # last-emitted tier selection per shape signature: the telemetry event
@@ -736,7 +753,7 @@ def reset_fused_tier_demotions() -> None:
 _emitted_choices: dict = {}
 
 
-def _emit_tier_selected(stage: str, sig, tier) -> None:
+def _emit_tier_selected(stage: str, sig, tier, cached: bool = False) -> None:
     if _emitted_choices.get((stage, sig)) == tier:
         return
     _emitted_choices[(stage, sig)] = tier
@@ -746,7 +763,7 @@ def _emit_tier_selected(stage: str, sig, tier) -> None:
     _obs_events.emit(
         "tier_selected", stage=stage, tier=tier or "xla",
         shape=[ha, wa, hb, wb], kernels=list(kernels),
-        channels=list(channels),
+        channels=list(channels), cached=bool(cached),
     )
 
 
@@ -758,28 +775,79 @@ def choose_fused_stack(ha, wa, hb, wb, kernels, channels):
     that failed MID-RUN (``demote_fused_tier``) is skipped even where its
     compile probe stays green, because the failure mode (OOM under
     eval-loop memory pressure, Mosaic runtime faults) is invisible to the
-    probe."""
-    tier = _choose_fused_stack(ha, wa, hb, wb, kernels, channels)
-    _emit_tier_selected(
-        "forward", (ha, wa, hb, wb, tuple(kernels), tuple(channels)), tier)
+    probe.
+
+    Round 9: the persistent tier cache (``ops/tier_cache.py``) is consulted
+    before the compile probes — a warm process replays a previous process's
+    probed decision (the cheap feasibility gates still run) and skips the
+    Mosaic compile entirely; demotions persisted there apply like runtime
+    ones.  A miss probes as before and records the outcome."""
+    sig = (ha, wa, hb, wb, tuple(kernels), tuple(channels))
+    tier, cached = _choose_fused_stack(*sig)
+    _emit_tier_selected("forward", sig, tier, cached=cached)
     return tier
 
 
+def _forward_tier_usable(tier, ha, wa, hb, wb, kernels, channels) -> bool:
+    """Whether a CACHED forward decision is still admissible without a
+    probe: the tier is not demoted and passes its (cheap, arithmetic)
+    feasibility gate — so a cache written under different VMEM budget
+    constants degrades to a re-probe, not a doomed dispatch.  A cached
+    XLA decision (None) is never trusted: the probe failure that produces
+    one may be transient (device busy, tunnel hiccup), and replaying it
+    would pin the shape to the slow tier forever — XLA outcomes re-probe
+    every process instead (the pre-cache behavior)."""
+    if tier is None:
+        return False
+    from ncnet_tpu.ops import tier_cache
+
+    if tier in _runtime_demoted or tier in tier_cache.persistent_demotions():
+        return False
+    if tier == "resident":
+        return fused_resident_feasible(ha, wa, hb, wb, kernels, channels)
+    if tier == "perlayer":
+        return (channels[-1] == 1
+                and fused_lane_feasible(ha, wa, hb, wb, kernels, channels))
+    return False
+
+
 def _choose_fused_stack(ha, wa, hb, wb, kernels, channels):
+    """Returns ``(tier, from_cache)``."""
     from ncnet_tpu.ops.conv4d import _pallas_available
 
     if not _pallas_available():
-        return None
-    if "resident" not in _runtime_demoted \
-            and fused_resident_feasible(ha, wa, hb, wb, kernels, channels) \
-            and fused_resident_compiles(ha, wa, hb, wb, kernels, channels):
-        return "resident"
-    if "perlayer" not in _runtime_demoted \
+        return None, False
+    from ncnet_tpu.ops import tier_cache
+
+    sig = (ha, wa, hb, wb, kernels, channels)
+    hit = tier_cache.lookup("forward", sig)
+    if hit is not None and _forward_tier_usable(hit[0], *sig):
+        return hit[0], True
+    demoted = _runtime_demoted | tier_cache.persistent_demotions()
+    # a failed compile probe may be TRANSIENT (device busy, tunnel
+    # hiccup), so any decision downstream of one is not cacheable: caching
+    # it would pin the shape below its fast tier across every future
+    # process.  Only a decision reached without skipping past a failed
+    # probe is persisted; the rest re-probe next process (the pre-cache
+    # behavior).
+    probe_failed = False
+    tier = None
+    if "resident" not in demoted \
+            and fused_resident_feasible(ha, wa, hb, wb, kernels, channels):
+        if fused_resident_compiles(ha, wa, hb, wb, kernels, channels):
+            tier = "resident"
+        else:
+            probe_failed = True
+    if tier is None and "perlayer" not in demoted \
             and channels[-1] == 1 \
-            and fused_lane_feasible(ha, wa, hb, wb, kernels, channels) \
-            and fused_lane_compiles(ha, wa, hb, wb, kernels, channels):
-        return "perlayer"
-    return None
+            and fused_lane_feasible(ha, wa, hb, wb, kernels, channels):
+        if fused_lane_compiles(ha, wa, hb, wb, kernels, channels):
+            tier = "perlayer"
+        else:
+            probe_failed = True
+    if tier is not None and not probe_failed:
+        tier_cache.record("forward", sig, tier)
+    return tier, False
 
 
 def _fused_stack_impl(nc_params, x):

@@ -430,17 +430,23 @@ def choose_fused_vjp(ha, wa, hb, wb, kernels, channels) -> Optional[str]:
     force), or ``None`` (XLA-replay backward).  Mirrors
     ``choose_fused_stack``'s discipline — real TPU backend, green compile
     probe, no runtime demotion (``demote_fused_tier('resident_vjp')`` after
-    a mid-run device failure sends every later trace back to XLA)."""
+    a mid-run device failure sends every later trace back to XLA) — plus
+    the round-9 persistent tier cache: a warm process replays a previous
+    process's probed decision (the cheap VMEM/shape gate still runs) and
+    skips the whole-chain compile probe; ``NCNET_FUSED_VJP_FORCE`` paths
+    bypass the cache in both directions (a forced decision is not a probe
+    result and must not poison real runs)."""
     from ncnet_tpu.ops.nc_fused_lane import _emit_tier_selected
 
     kernels, channels = tuple(kernels), tuple(channels)
-    tier = _choose_fused_vjp(ha, wa, hb, wb, kernels, channels)
+    tier, cached = _choose_fused_vjp(ha, wa, hb, wb, kernels, channels)
     _emit_tier_selected(
-        "backward", (ha, wa, hb, wb, kernels, channels), tier)
+        "backward", (ha, wa, hb, wb, kernels, channels), tier, cached=cached)
     return tier
 
 
-def _choose_fused_vjp(ha, wa, hb, wb, kernels, channels) -> Optional[str]:
+def _choose_fused_vjp(ha, wa, hb, wb, kernels, channels):
+    """Returns ``(tier, from_cache)``."""
     force = _os.environ.get("NCNET_FUSED_VJP_FORCE", "")
     if force == "interpret":
         # still honor the shape/VMEM gate: the knob forces the BACKEND
@@ -448,18 +454,33 @@ def _choose_fused_vjp(ha, wa, hb, wb, kernels, channels) -> Optional[str]:
         # must keep degrading to the XLA-replay backward, not trip the
         # kernel's trace-time asserts
         if fused_vjp_feasible(ha, wa, hb, wb, kernels, channels):
-            return "interpret"
-        return None
+            return "interpret", False
+        return None, False
     if force == "off":
-        return None
+        return None, False
     from ncnet_tpu.ops.conv4d import _pallas_available
 
-    if not _pallas_available() or "resident_vjp" in demoted_fused_tiers():
-        return None
+    if not _pallas_available():
+        return None, False
+    from ncnet_tpu.ops import tier_cache
+
+    demoted = demoted_fused_tiers() | tier_cache.persistent_demotions()
+    if "resident_vjp" in demoted:
+        return None, False
+    sig = (ha, wa, hb, wb, kernels, channels)
+    hit = tier_cache.lookup("backward", sig)
+    # a cached None (XLA) is a miss, not a hit: the probe failure behind it
+    # may have been transient and must not pin the shape to XLA forever
+    if hit is not None and hit[0] == "resident_vjp" \
+            and fused_vjp_feasible(ha, wa, hb, wb, kernels, channels):
+        return hit[0], True
     if fused_vjp_feasible(ha, wa, hb, wb, kernels, channels) \
             and fused_vjp_compiles(ha, wa, hb, wb, kernels, channels):
-        return "resident_vjp"
-    return None
+        tier = "resident_vjp"
+        tier_cache.record("backward", sig, tier)
+    else:
+        tier = None
+    return tier, False
 
 
 def _vjp_stage(l, nc_params, xp, gamma, *, ha, wa, hb, wb, interpret):

@@ -1,0 +1,233 @@
+"""Persistent tier autotune cache: tier decisions that survive the process.
+
+``choose_fused_stack`` / ``choose_fused_vjp`` decide per shape class from
+real-compile probes — the right authority, but one that costs a Mosaic
+compile (seconds on a tunneled device) PER SHAPE PER PROCESS, re-paid on
+every restart even though the PR 5 ``tier_selected`` / ``tier_demoted``
+events already encode the answer.  This module is the consume side of that
+telemetry (the first concrete slice of ROADMAP item 4's kernel registry):
+
+  * every PROBE-BACKED tier decision is persisted per ``(device_kind,
+    stage, shape-class)``; the choosers consult the cache first and skip
+    the compile probe on a hit — a warm process reaches identical
+    decisions with zero probes.  A decision reached by skipping past a
+    FAILED compile probe — an XLA outcome, or a lower tier after a
+    higher-ranked candidate's probe failed — is deliberately never cached:
+    the failure may have been transient (device busy, tunnel hiccup), and
+    replaying the decision would pin the shape below its fast tier across
+    every future process;
+  * runtime demotions (``ops.demote_fused_tier`` — a tier that CRASHED
+    mid-run) are recorded as negative entries per device kind, so a
+    crashed tier stays demoted across restarts instead of greeting every
+    new process with the same mid-run failure;
+  * invalidation is by construction: entries are keyed under the device
+    kind (a different accelerator simply misses) and the file carries a
+    schema version (a reader that does not understand the file ignores it
+    wholesale and overwrites on the next record).  The cheap arithmetic
+    feasibility gates still run on every hit — a cached tier that no
+    longer passes them (changed VMEM budgets after a code update) is
+    treated as a miss and re-probed.
+
+Knob: ``NCNET_TPU_TIER_CACHE`` — a file path, or ``0``/``off`` to disable
+(every process probes from scratch, the pre-round-9 behavior).  Default:
+``~/.cache/ncnet_tpu/tier_cache.json`` (honors ``XDG_CACHE_HOME``).
+
+All paths are fail-open: a cache that cannot be read or written degrades to
+probing, never to an error — the cache is an accelerator, not an authority.
+The probe remains the authority on a miss; the cache only replays what a
+probe once proved.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, FrozenSet, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+CACHE_ENV = "NCNET_TPU_TIER_CACHE"
+
+_lock = threading.Lock()
+# in-process mirror of the on-disk doc: {"path": resolved path or None,
+# "doc": parsed doc} — loaded once, refreshed only by _reset_state (tests)
+_state: Dict[str, object] = {"loaded": False, "path": None, "doc": None}
+
+
+def cache_path() -> Optional[str]:
+    """Resolved cache file path, or None when disabled via the env knob."""
+    raw = os.environ.get(CACHE_ENV)
+    if raw is not None:
+        raw = raw.strip()
+        if raw.lower() in ("", "0", "off", "none"):
+            return None
+        return raw
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "ncnet_tpu", "tier_cache.json")
+
+
+def device_kind() -> str:
+    """The local device kind the cache keys under ('unknown' when no
+    backend is reachable — such entries never collide with real ones).
+    Shares the perf store's probe so the two cross-run consumers can never
+    key the same machine under different kinds."""
+    from ncnet_tpu.observability.events import local_device_kind
+
+    return local_device_kind() or "unknown"
+
+
+def signature_key(stage: str,
+                  sig: Tuple[int, int, int, int,
+                             Sequence[int], Sequence[int]]) -> str:
+    """Stable string key for one (stage, shape-class): the same tuple the
+    choosers and ``tier_selected`` events use."""
+    ha, wa, hb, wb, kernels, channels = sig
+    return (f"{stage}|{ha}x{wa}x{hb}x{wb}"
+            f"|k={','.join(str(k) for k in kernels)}"
+            f"|c={','.join(str(c) for c in channels)}")
+
+
+def _empty_doc() -> dict:
+    return {"kind": "ncnet_tpu_tier_cache", "schema": SCHEMA_VERSION,
+            "devices": {}}
+
+
+def _load_locked() -> dict:
+    """The parsed on-disk doc (cached in-process).  A missing, corrupt,
+    foreign or newer-schema file reads as empty — and is overwritten
+    wholesale on the next record (the invalidation rule)."""
+    if _state["loaded"]:
+        path = cache_path()
+        if path == _state["path"]:
+            return _state["doc"]  # type: ignore[return-value]
+    path = cache_path()
+    doc = _empty_doc()
+    if path is not None:
+        try:
+            with open(path) as f:
+                cand = json.load(f)
+            if (isinstance(cand, dict)
+                    and cand.get("kind") == "ncnet_tpu_tier_cache"
+                    and cand.get("schema") == SCHEMA_VERSION
+                    and isinstance(cand.get("devices"), dict)):
+                doc = cand
+        except (OSError, ValueError):
+            pass
+    _state.update(loaded=True, path=path, doc=doc)
+    return doc
+
+
+def _save_locked(doc: dict) -> None:
+    path = cache_path()
+    if path is None:
+        return
+    try:
+        from ncnet_tpu.utils.io import atomic_write_json
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        atomic_write_json(path, doc)
+    except (OSError, ValueError):
+        pass  # fail-open: an unwritable cache just means probing next time
+
+
+def _device_entry(doc: dict, kind: str) -> dict:
+    entry = doc["devices"].setdefault(kind, {})
+    entry.setdefault("decisions", {})
+    entry.setdefault("demoted", [])
+    return entry
+
+
+def lookup(stage: str, sig) -> Optional[Tuple[Optional[str]]]:
+    """Cached decision for (device kind, stage, shape class): a 1-tuple
+    ``(tier,)`` — ``(None,)`` is a cached "use XLA" — or None on a miss."""
+    if cache_path() is None:
+        return None
+    with _lock:
+        doc = _load_locked()
+        entry = doc["devices"].get(device_kind())
+        if not entry:
+            return None
+        key = signature_key(stage, sig)
+        decisions = entry.get("decisions", {})
+        if key not in decisions:
+            return None
+        tier = decisions[key]
+        return (tier if isinstance(tier, str) else None,)
+
+
+def record(stage: str, sig, tier: Optional[str]) -> None:
+    """Persist one fresh probe decision (no-op when disabled/unwritable)."""
+    if cache_path() is None:
+        return
+    with _lock:
+        doc = _load_locked()
+        entry = _device_entry(doc, device_kind())
+        key = signature_key(stage, sig)
+        if entry["decisions"].get(key, "\0miss") == tier:
+            return
+        entry["decisions"][key] = tier
+        _save_locked(doc)
+    from ncnet_tpu.observability import events as _events
+
+    _events.emit("tier_cache", op="store", stage=stage,
+                 key=signature_key(stage, sig), tier=tier or "xla")
+
+
+def record_demotion(tier: str) -> None:
+    """Persist a runtime demotion as a negative entry, and drop any cached
+    decisions that named the demoted tier (they are now known-bad: a warm
+    restart must re-probe those shapes on the surviving ladder)."""
+    if cache_path() is None:
+        return
+    with _lock:
+        doc = _load_locked()
+        entry = _device_entry(doc, device_kind())
+        changed = False
+        if tier not in entry["demoted"]:
+            entry["demoted"].append(tier)
+            changed = True
+        for key, cached in list(entry["decisions"].items()):
+            if cached == tier:
+                del entry["decisions"][key]
+                changed = True
+        if changed:
+            _save_locked(doc)
+    from ncnet_tpu.observability import events as _events
+
+    _events.emit("tier_cache", op="demote", tier=tier)
+
+
+def persistent_demotions() -> FrozenSet[str]:
+    """Tiers demoted in a PREVIOUS process of this device kind (negative
+    entries) — unioned with the runtime registry by the choosers."""
+    if cache_path() is None:
+        return frozenset()
+    with _lock:
+        doc = _load_locked()
+        entry = doc["devices"].get(device_kind())
+        if not entry:
+            return frozenset()
+        return frozenset(t for t in entry.get("demoted", [])
+                         if isinstance(t, str))
+
+
+def clear() -> None:
+    """Drop the cache file and the in-process mirror (a deliberate
+    re-probe; the runtime demotion registry is separate — see
+    ``ops.reset_fused_tier_demotions``)."""
+    path = cache_path()
+    with _lock:
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        _state.update(loaded=False, path=None, doc=None)
+
+
+def _reset_state() -> None:
+    """Tests: forget the in-process mirror so the next access re-reads the
+    file — the in-process analog of starting a fresh process."""
+    with _lock:
+        _state.update(loaded=False, path=None, doc=None)

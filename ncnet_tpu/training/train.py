@@ -106,6 +106,7 @@ from ncnet_tpu.observability.metrics import (
     device_peak_tflops,
     train_step_flops,
 )
+from ncnet_tpu.observability.tracing import span
 from ncnet_tpu.training.loss import (
     auto_accum_chunks,
     weak_loss,
@@ -372,10 +373,11 @@ def process_epoch(
         if mode == "train":
             batch = faults.corrupt_batch_hook(batch, step_base + off + 1)
         t0 = time.perf_counter()
-        staged_batch = {
-            "source_image": put_batch(batch["source_image"]),
-            "target_image": put_batch(batch["target_image"]),
-        }
+        with span("stage", mode=mode, step=step_base + off + 1):
+            staged_batch = {
+                "source_image": put_batch(batch["source_image"]),
+                "target_image": put_batch(batch["target_image"]),
+            }
         stage_walls[0] = time.perf_counter() - t0
         return staged_batch
 
@@ -393,64 +395,75 @@ def process_epoch(
             tracer.at_step(gstep)
         t_step = time.perf_counter()
         grad_norm = None
-        with annotate(f"{mode}_step"):
-            if mode == "train":
-                out = step_fn(state, images)
-                if len(out) == 3:
-                    state, loss, grad_norm = out
+        # the per-step parent span: dispatch / stage(N+1) / loss-sync — and
+        # any checkpoint commit inside on_step — nest under it, so the trace
+        # (and run_report --spans) can split step wall into its phases
+        with span(f"{mode}_step", step=gstep, batch=batch_idx):
+            with annotate(f"{mode}_step"), \
+                    span("dispatch", mode=mode, step=gstep):
+                if mode == "train":
+                    out = step_fn(state, images)
+                    if len(out) == 3:
+                        state, loss, grad_norm = out
+                    else:
+                        state, loss = out
                 else:
-                    state, loss = out
-            else:
-                loss = step_fn(state.params, images)
-        # stage batch N+1 while step N runs on device (the loader's own
-        # prefetch thread has usually decoded it already; this overlaps the
-        # host→device leg too), then sync the loss for logging/guards
-        nxt = next(it, None)
-        if nxt is not None:
-            staged = stage(*nxt)
-        losses.append(loss)
-        if batch_idx % log_interval == 0:
-            log.info(
-                f"{mode.capitalize()} Epoch: {epoch} [{batch_idx}/{n} "
-                f"({100.0 * batch_idx / n:.0f}%)]\t\tLoss: {float(loss):.6f}"
-            )
-        if mode == "train" and obs_events.get_global_sink() is not None:
-            # the loss sync above (or float() here) bounds the step wall;
-            # without the nan_guard's eager fetch this wall includes async
-            # dispatch only — still the honest host-side step cadence
-            loss_f = float(loss)
-            wall = time.perf_counter() - t_step
-            # .shape is the GLOBAL batch shape even for sharded/multi-host
-            # arrays — never materialize the batch on host just to count it
-            pairs = int(images["source_image"].shape[0]) \
-                if hasattr(images["source_image"], "shape") else 0
-            fields: Dict[str, Any] = {
-                "mode": mode, "epoch": epoch, "batch": batch_idx,
-                "step": gstep, "loss": loss_f,
-                "wall_s": round(wall, 6),
-                "stage_wall_s": round(stage_wall, 6),
-            }
-            if pairs and wall > 0:
-                fields["pairs_per_s"] = round(pairs / wall, 3)
-            if grad_norm is not None:
-                fields["grad_norm"] = float(grad_norm)
-            flops = ctx.get("flops_per_pair")
-            peak = ctx.get("peak_tflops")
-            if flops and peak and pairs and wall > 0:
-                fields["mfu_pct"] = round(
-                    100.0 * (flops * pairs / wall / 1e12) / peak, 2)
-            obs_events.emit("step", **fields)
-            if registry is not None:
-                registry.timer("step_wall").observe(wall)
-                registry.timer("stage_wall").observe(stage_wall)
-                registry.gauge("loss").set(loss_f)
-                if "pairs_per_s" in fields:
-                    registry.gauge("pairs_per_s").set(fields["pairs_per_s"])
-                if "mfu_pct" in fields:
-                    registry.gauge("mfu_pct").set(fields["mfu_pct"])
+                    loss = step_fn(state.params, images)
+            # stage batch N+1 while step N runs on device (the loader's own
+            # prefetch thread has usually decoded it already; this overlaps
+            # the host→device leg too), then sync the loss for logging/guards
+            nxt = next(it, None)
+            if nxt is not None:
+                staged = stage(*nxt)
+            losses.append(loss)
+            if batch_idx % log_interval == 0:
+                log.info(
+                    f"{mode.capitalize()} Epoch: {epoch} [{batch_idx}/{n} "
+                    f"({100.0 * batch_idx / n:.0f}%)]\t\tLoss: "
+                    f"{float(loss):.6f}"
+                )
+            if mode == "train" and obs_events.get_global_sink() is not None:
+                # the loss sync above (or float() here) bounds the step wall;
+                # without the nan_guard's eager fetch this wall includes
+                # async dispatch only — still the honest host-side cadence
+                with span("loss_sync", step=gstep):
+                    loss_f = float(loss)
+                wall = time.perf_counter() - t_step
+                # .shape is the GLOBAL batch shape even for sharded/
+                # multi-host arrays — never materialize the batch on host
+                # just to count it
+                pairs = int(images["source_image"].shape[0]) \
+                    if hasattr(images["source_image"], "shape") else 0
+                fields: Dict[str, Any] = {
+                    "mode": mode, "epoch": epoch, "batch": batch_idx,
+                    "step": gstep, "loss": loss_f,
+                    "wall_s": round(wall, 6),
+                    "stage_wall_s": round(stage_wall, 6),
+                }
+                if pairs and wall > 0:
+                    fields["pairs_per_s"] = round(pairs / wall, 3)
                 if grad_norm is not None:
-                    registry.gauge("grad_norm").set(float(grad_norm))
-        if on_step is not None and on_step(batch_idx, state, loss):
+                    fields["grad_norm"] = float(grad_norm)
+                flops = ctx.get("flops_per_pair")
+                peak = ctx.get("peak_tflops")
+                if flops and peak and pairs and wall > 0:
+                    fields["mfu_pct"] = round(
+                        100.0 * (flops * pairs / wall / 1e12) / peak, 2)
+                obs_events.emit("step", **fields)
+                if registry is not None:
+                    registry.timer("step_wall").observe(wall)
+                    registry.timer("stage_wall").observe(stage_wall)
+                    registry.gauge("loss").set(loss_f)
+                    if "pairs_per_s" in fields:
+                        registry.gauge("pairs_per_s").set(
+                            fields["pairs_per_s"])
+                    if "mfu_pct" in fields:
+                        registry.gauge("mfu_pct").set(fields["mfu_pct"])
+                    if grad_norm is not None:
+                        registry.gauge("grad_norm").set(float(grad_norm))
+            stop_now = (on_step is not None
+                        and on_step(batch_idx, state, loss))
+        if stop_now:
             break
     if not losses:
         # a resume position at the very end of an epoch: nothing left to do
@@ -607,7 +620,7 @@ def save_train_checkpoint(
     # could publish a version that is still being written)
     _sync_processes(f"ncnet_ckpt_commit_{n}")
     if primary:
-        with annotate("checkpoint_commit"):
+        with annotate("checkpoint_commit"), span("checkpoint_commit", step=n):
             if os.path.isdir(final):
                 # re-save at the same step (an epoch-end save landing on a
                 # periodic-save step): replace the old version, still
@@ -1132,6 +1145,30 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
             if telemetry is not None:
                 if train_registry is not None:
                     train_registry.flush(final=True)
+                    # cross-run perf history: the run's step-wall/throughput
+                    # summary lands in the persistent store so
+                    # tools/perf_regress.py can gate the NEXT run against it
+                    # (fail-open: an unwritable store never blocks the exit)
+                    from ncnet_tpu.observability import perfstore
+
+                    snap = train_registry.snapshot()
+                    summary: Dict[str, float] = {}
+                    for name, key in (("step_wall", "train_step_wall_s"),
+                                      ("stage_wall", "train_stage_wall_s")):
+                        st = snap.get(name)
+                        if isinstance(st, dict) and st.get("count"):
+                            # median, not mean: the first step's compile
+                            # dominates a short run's mean and would make
+                            # runs of different lengths incomparable in the
+                            # gated cross-run history
+                            summary[key] = st.get("p50_s", st["mean_s"])
+                    for name, key in (("pairs_per_s", "train_pairs_per_s"),
+                                      ("mfu_pct", "train_mfu_pct")):
+                        v = snap.get(name)
+                        if isinstance(v, (int, float)):
+                            summary[key] = float(v)
+                    perfstore.maybe_record(
+                        summary, source="fit", run_id=telemetry.run_id)
                 # global emit, not telemetry.emit: a disk-full append in a
                 # finally block must not mask the real exit (or a clean
                 # return) with an OSError

@@ -1,0 +1,18 @@
+"""Version-compatibility shims for the jax API surface.
+
+The repo targets the jax version baked into the container; where a
+convenience alias moved between releases (``jax.tree.*`` grew over several
+minors), the shim resolves the available spelling once at import time so
+call sites stay on one name.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax.tree, "map_with_path"):  # jax >= 0.4.34-ish alias
+    tree_map_with_path = jax.tree.map_with_path
+else:
+    tree_map_with_path = jax.tree_util.tree_map_with_path
+
+__all__ = ["tree_map_with_path"]

@@ -9,6 +9,15 @@ platform through jax.config before the backend is first initialized.
 
 import os
 
+# Tests must not mutate the repo's committed perf history or the user-level
+# tier cache: both default on (that is the product behavior), so the suite
+# turns them off globally — a hard override, not setdefault, so a developer
+# with either knob exported in their shell cannot have the suite write into
+# (or clear) their real store/cache.  Tests that exercise these point the
+# env vars at tmp paths explicitly via monkeypatch.
+os.environ["NCNET_TPU_PERF_STORE"] = "off"
+os.environ["NCNET_TPU_TIER_CACHE"] = "off"
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

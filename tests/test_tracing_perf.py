@@ -1,0 +1,842 @@
+"""Tier-1 tests for the round-9 telemetry consumers.
+
+Three layers, each proven the way PR 5 proved the write side — by executing
+the failure mode, not describing it:
+
+  * span tracing: nesting/parenting round-trips through the event log,
+    threads keep separate parent stacks, a subprocess SIGKILLed mid-span
+    still yields a torn trace that ``tools/trace_export.py`` renders as
+    valid Chrome trace JSON (the unclosed spans ARE the postmortem);
+  * perf store + sentinel: ``tools/perf_regress.py`` flags an injected 2×
+    step-wall regression against seeded history, stays green on noise, and
+    runs clean against the repo's committed BENCH_r01–r05 seed at
+    ``perf/history.jsonl`` (the CI gate);
+  * tier autotune cache: a cache hit skips the compile probe (spy-counted),
+    a demotion persists across a REAL process restart, and invalidation
+    (device kind, schema, failed feasibility re-gate) degrades to probing.
+
+The acceptance scenario closes the loop end to end: two instrumented
+``fit`` runs produce an event log that exports to a valid trace, a span
+breakdown in ``run_report --spans``, a perf store the sentinel gates, and a
+heartbeat the stall watchdog judges.
+"""
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ncnet_tpu.config import ModelConfig, TrainConfig
+from ncnet_tpu.data.synthetic import write_pair_dataset
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability.device import Heartbeat
+from ncnet_tpu.observability.events import EventLog, replay_events
+from ncnet_tpu.observability.perfstore import (
+    PerfStore,
+    check_regressions,
+    ingest_bench_artifact,
+    metric_direction,
+    resolve_store_path,
+)
+from ncnet_tpu.observability.tracing import current_span_id, span, traced
+from ncnet_tpu.ops import tier_cache
+import ncnet_tpu.ops.nc_fused_lane as lane
+import ncnet_tpu.ops.nc_fused_lane_vjp as lane_vjp
+from ncnet_tpu import training
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import perf_regress  # noqa: E402
+import run_report  # noqa: E402
+import stall_watchdog  # noqa: E402
+import trace_export  # noqa: E402
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                   ncons_channels=(1,))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """No leaked global sink, runtime demotions, emitted-choice dedup state
+    or in-process tier-cache mirror across tests (conftest already points
+    the cache/store env knobs at 'off', so no on-disk state leaks either)."""
+    obs_events.set_global_sink(None)
+    lane._runtime_demoted.clear()
+    lane._emitted_choices.clear()
+    tier_cache._reset_state()
+    yield
+    obs_events.set_global_sink(None)
+    lane._runtime_demoted.clear()
+    lane._emitted_choices.clear()
+    tier_cache._reset_state()
+
+
+# ---------------------------------------------------------------------------
+# span tracing: API contract
+# ---------------------------------------------------------------------------
+
+
+def test_span_is_inert_without_sink():
+    with span("outer") as s:
+        assert s._id is None          # nothing allocated
+        assert current_span_id() is None  # no stack traffic either
+    # and the no-op exit did not raise
+
+
+def test_span_nesting_roundtrips_through_event_log(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(path)):
+        with span("step", step=7) as outer:
+            assert current_span_id() == outer._id
+            with span("dispatch") as inner:
+                assert current_span_id() == inner._id
+            with span("loss_sync"):
+                time.sleep(0.01)
+        assert current_span_id() is None
+    _, events = replay_events(path)
+    sp = [e for e in events if e["event"] == "span"]
+    begins = {e["span"]: e for e in sp if e["ph"] == "B"}
+    ends = {e["span"]: e for e in sp if e["ph"] == "E"}
+    assert set(begins) == set(ends) and len(begins) == 3
+    by_name = {e["name"]: e for e in begins.values()}
+    step_id = by_name["step"]["span"]
+    assert by_name["step"]["parent"] is None
+    assert by_name["step"]["step"] == 7            # fields ride on the B
+    assert by_name["step"]["tid"] == threading.get_ident()
+    assert by_name["dispatch"]["parent"] == step_id
+    assert by_name["loss_sync"]["parent"] == step_id
+    assert ends[by_name["loss_sync"]["span"]]["dur_s"] >= 0.01
+    # entry order: step opens before its children, E of children precede
+    # E of the parent in the log (append order == emit order)
+    kinds = [(e["ph"], e["name"]) for e in sp]
+    assert kinds[0] == ("B", "step") and kinds[-1] == ("E", "step")
+
+
+def test_span_parents_are_per_thread(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(path)):
+        with span("outer"):
+            seen = {}
+
+            def worker():
+                with span("in_thread") as s:
+                    seen["parent"] = s._parent
+                    seen["tid_current"] = current_span_id() == s._id
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+    # the worker's span must NOT adopt the main thread's open span
+    assert seen["parent"] is None and seen["tid_current"]
+    _, events = replay_events(path)
+    b = {e["name"]: e for e in events
+         if e["event"] == "span" and e["ph"] == "B"}
+    assert b["in_thread"]["parent"] is None
+    assert b["in_thread"]["tid"] != b["outer"]["tid"]
+
+
+def test_traced_decorator_and_error_annotation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+
+    @traced()
+    def quick():
+        return 42
+
+    @traced("boom", phase="test")
+    def explode():
+        raise ValueError("no")
+
+    with obs_events.bound(EventLog(path)):
+        assert quick() == 42
+        with pytest.raises(ValueError):
+            explode()
+    _, events = replay_events(path)
+    sp = [e for e in events if e["event"] == "span"]
+    names = {e["name"] for e in sp}
+    assert names == {"quick", "boom"}   # default name = __name__
+    (boom_e,) = [e for e in sp if e["ph"] == "E" and e["name"] == "boom"]
+    assert boom_e["error"] == "ValueError"  # the E records how it died
+    (boom_b,) = [e for e in sp if e["ph"] == "B" and e["name"] == "boom"]
+    assert boom_b["phase"] == "test"
+
+
+def test_span_out_of_order_exit_never_raises(tmp_path):
+    """Telemetry must never raise into the run: closing spans out of order
+    (a buggy caller holding both context managers manually) degrades to
+    identity removal, and the stack still ends empty."""
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(path)):
+        a, b = span("a"), span("b")
+        a.__enter__()
+        b.__enter__()
+        a.__exit__(None, None, None)   # out of order
+        b.__exit__(None, None, None)
+        assert current_span_id() is None
+    _, events = replay_events(path)
+    assert sum(1 for e in events
+               if e["event"] == "span" and e["ph"] == "E") == 2
+
+
+# ---------------------------------------------------------------------------
+# trace_export: Chrome trace rendering, torn traces
+# ---------------------------------------------------------------------------
+
+
+def test_trace_export_complete_spans_and_instant_markers(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(path)) as log:
+        log.emit("run_start")
+        with span("step", step=1):
+            with span("dispatch"):
+                pass
+        log.emit("checkpoint_commit", step=1)
+    trace = trace_export.build_trace([path])
+    # valid JSON end to end
+    doc = json.loads(json.dumps(trace))
+    evs = doc["traceEvents"]
+    slices = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in slices} == {"step", "dispatch"}
+    for e in slices:
+        assert e["dur"] >= 0 and e["ts"] > 0 and e["pid"] >= 1
+    (step_slice,) = [e for e in slices if e["name"] == "step"]
+    assert step_slice["args"]["step"] == 1   # B fields become args
+    instants = {e["name"] for e in evs if e["ph"] == "i"}
+    assert {"run_start", "checkpoint_commit"} <= instants
+    # metadata names the run's process
+    assert any(e["ph"] == "M" and e["name"] == "process_name" for e in evs)
+
+
+def test_sigkill_mid_span_still_renders_torn_trace(tmp_path):
+    """THE crash-visibility claim: a process SIGKILLed with two spans open
+    leaves their fsynced B events on disk, and the exporter renders them as
+    unclosed slices — even with a torn trailing line on the log."""
+    path = str(tmp_path / "events.jsonl")
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import os, signal, sys
+sys.path.insert(0, {_REPO!r})
+from ncnet_tpu.observability.events import EventLog, set_global_sink
+from ncnet_tpu.observability.tracing import span
+
+set_global_sink(EventLog({path!r}))
+with span("epoch", epoch=0):
+    with span("step", step=3):
+        os.kill(os.getpid(), signal.SIGKILL)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, str(worker)], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True, timeout=300)
+    assert proc.returncode == -9, proc.stdout[-2000:]
+    with open(path, "a") as f:
+        f.write('{"t": 1.0, "run": "x", "seq": 99, "event": "to')  # torn
+    trace = trace_export.build_trace([path])
+    doc = json.loads(json.dumps(trace))
+    unclosed = [e for e in doc["traceEvents"]
+                if e["ph"] == "B" and e.get("args", {}).get("unclosed")]
+    assert {e["name"] for e in unclosed} == {"epoch", "step"}
+    assert all(e["ts"] > 0 for e in unclosed)
+    # the CLI path writes a loadable file and exits 0 on the same torn log
+    out = str(tmp_path / "trace.json")
+    assert trace_export.main([path, "-o", out]) == 0
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# run_report --spans: critical-path accounting
+# ---------------------------------------------------------------------------
+
+
+def _emit_span(log, ph, name, sid, parent=None, dur=None, t=None):
+    fields = {"ph": ph, "name": name, "span": sid}
+    if ph == "B":
+        fields.update(parent=parent, tid=1)
+    if dur is not None:
+        fields["dur_s"] = dur
+    log.emit("span", **fields)
+
+
+def test_span_breakdown_self_vs_child_time(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as log:
+        _emit_span(log, "B", "train_step", 1)
+        _emit_span(log, "B", "dispatch", 2, parent=1)
+        _emit_span(log, "E", "dispatch", 2, dur=0.3)
+        _emit_span(log, "B", "loss_sync", 3, parent=1)
+        _emit_span(log, "E", "loss_sync", 3, dur=0.2)
+        _emit_span(log, "E", "train_step", 1, dur=1.0)
+        _emit_span(log, "B", "fetch", 9)   # unclosed: in flight at death
+    _, events = replay_events(path)
+    sp = run_report.build_span_breakdown(events)
+    groups = {(g["parent"], g["name"]): g for g in sp["groups"]}
+    # self time = total minus time inside children, the critical-path rank
+    assert groups[("-", "train_step")]["self_s"] == pytest.approx(0.5)
+    assert groups[("-", "train_step")]["total_s"] == pytest.approx(1.0)
+    assert groups[("train_step", "dispatch")]["total_s"] == pytest.approx(0.3)
+    assert groups[("train_step", "loss_sync")]["mean_s"] == pytest.approx(0.2)
+    assert sp["closed"] == 3 and sp["unclosed"] == 1
+    # the report wires it in, and the text render names parent > child
+    report = run_report.build_report([path])
+    assert report["spans"]["unclosed"] == 1
+    text = run_report.render_spans(report)
+    assert "train_step > dispatch" in text and "1 unclosed" in text
+
+
+# ---------------------------------------------------------------------------
+# perf store: records, direction inference, the sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_perfstore_roundtrip_tolerates_torn_and_foreign_lines(tmp_path):
+    store = PerfStore(str(tmp_path / "h.jsonl"))
+    store.append("train_step_ms", 100.0, device_kind="cpu", git_rev="abc")
+    store.append("train_step_ms", 102.0, device_kind="cpu")
+    with open(store.path, "a") as f:
+        f.write("not json at all\n")
+        f.write(json.dumps({"kind": "perf", "schema": 999,
+                            "metric": "x", "value": 1}) + "\n")  # newer
+        f.write('{"kind": "perf", "metric": "torn')              # torn tail
+    recs = store.records()
+    assert [r["value"] for r in recs] == [100.0, 102.0]
+    assert recs[0]["git_rev"] == "abc"
+    assert [r["value"] for r in store.history("train_step_ms", "cpu")] \
+        == [100.0, 102.0]
+    assert store.history("train_step_ms", "tpu") == []
+    # append_many drops NaN and non-numeric values silently
+    n = store.append_many({"a_ms": 1.0, "nan_ms": float("nan"),
+                           "flag": True, "note": "x"}, device_kind="cpu")
+    assert n == 1
+
+
+def test_metric_direction_follows_naming_conventions():
+    assert metric_direction("train_step_ms") == "lower"
+    assert metric_direction("pf_pascal_eval_s_fetch") == "lower"
+    assert metric_direction("pf_pascal_pck") == "higher"
+    assert metric_direction("train_pairs_per_sec") == "higher"
+    # derived ratios and constants are report-only: gating them teaches
+    # operators to ignore the sentinel
+    assert metric_direction("forward_bf16_mfu_executed_pct") is None
+    assert metric_direction("vs_baseline") is None
+    assert metric_direction("roofline_filter_ms") is None
+    assert metric_direction("forward_bf16_tflops") is None
+
+
+def test_sentinel_flags_2x_regression_and_stays_green_on_noise():
+    def recs(values):
+        return [{"kind": "perf", "metric": "train_step_ms", "value": v,
+                 "device_kind": "cpu"} for v in values]
+
+    baseline = [100.0, 103.0, 98.0, 101.0, 99.0]
+    # 2x the median is far outside MAD + the relative floor
+    (f,) = check_regressions(recs(baseline + [200.0]))
+    assert f["status"] == "regression" and f["direction"] == "lower"
+    assert f["baseline_median"] == pytest.approx(100.0)
+    # ordinary noise stays green
+    (f,) = check_regressions(recs(baseline + [104.0]))
+    assert f["status"] == "ok"
+    # improvement is never a regression
+    (f,) = check_regressions(recs(baseline + [55.0]))
+    assert f["status"] == "ok"
+    # a gate that guesses is worse than no gate: thin history is skipped
+    (f,) = check_regressions(recs([100.0, 200.0]))
+    assert f["status"] == "skipped"
+    # higher-is-better metrics flip the comparison
+    pck = [{"kind": "perf", "metric": "pf_pascal_pck", "value": v,
+            "device_kind": "cpu"} for v in (0.8, 0.81, 0.79, 0.4)]
+    (f,) = check_regressions(pck)
+    assert f["status"] == "regression" and f["direction"] == "higher"
+    # report-only metrics are not judged unless explicitly listed
+    mfu = [{"kind": "perf", "metric": "train_mfu_pct", "value": v,
+            "device_kind": "cpu"} for v in (40.0, 41.0, 20.0)]
+    assert check_regressions(mfu) == []
+    # force-gating infers higher-is-better for the derived ratios: the MFU
+    # halving is the regression, an improvement is never one
+    (f,) = check_regressions(mfu, metrics=["train_mfu_pct"])
+    assert f["status"] == "regression" and f["direction"] == "higher"
+    mfu_up = mfu[:-1] + [dict(mfu[-1], value=55.0)]
+    (f,) = check_regressions(mfu_up, metrics=["train_mfu_pct"])
+    assert f["status"] == "ok"
+    # force-gating a metric whose direction nothing can infer refuses to
+    # guess: skipped with a reason, not judged lower-is-better
+    odd = [{"kind": "perf", "metric": "mystery_quantity", "value": v,
+            "device_kind": "cpu"} for v in (1.0, 1.1, 9.0)]
+    (f,) = check_regressions(odd, metrics=["mystery_quantity"])
+    assert f["status"] == "skipped" and "direction" in f["reason"]
+
+
+def test_resolve_store_path_env_knob(monkeypatch):
+    monkeypatch.setenv("NCNET_TPU_PERF_STORE", "off")
+    assert resolve_store_path() is None          # ingestion disabled
+    assert resolve_store_path("/x/y.jsonl") == "/x/y.jsonl"  # explicit wins
+    monkeypatch.setenv("NCNET_TPU_PERF_STORE", "/env/h.jsonl")
+    assert resolve_store_path() == "/env/h.jsonl"
+
+
+def test_perf_regress_cli_gates_injected_regression(tmp_path, capsys):
+    store_path = str(tmp_path / "h.jsonl")
+    # seed from bench-shaped artifacts (the bare stdout-line format)
+    arts = []
+    for i, wall in enumerate([950.0, 1010.0, 980.0]):
+        p = tmp_path / f"BENCH_x{i}.json"
+        p.write_text(json.dumps({
+            "metric": "pf_pascal_forward_ms_per_pair", "value": 11.7 + i / 10,
+            "extra": {"train_step_ms": wall, "device_kind": "TPU v5 lite"},
+        }))
+        arts.append(str(p))
+    rc = perf_regress.main(["--seed", *arts, "--store", store_path])
+    assert rc == 0
+    capsys.readouterr()
+    # fresh value inside the noise band: green
+    store = PerfStore(store_path)
+    store.append("train_step_ms", 990.0, device_kind="TPU v5 lite")
+    assert perf_regress.main(["--check", "--store", store_path]) == 0
+    out = capsys.readouterr().out
+    assert "0 regression(s)" in out
+    # injected 2x step-wall regression: exit 1, named in the findings
+    store.append("train_step_ms", 1980.0, device_kind="TPU v5 lite")
+    assert perf_regress.main(["--check", "--store", store_path,
+                              "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    (bad,) = [f for f in doc["findings"] if f["status"] == "regression"]
+    assert bad["metric"] == "train_step_ms"
+
+
+def test_perf_regress_check_is_clean_on_committed_seed_history(capsys):
+    """The CI gate: the committed perf/history.jsonl — seeded from
+    BENCH_r01–r05 — must gate green, or every job fails out of the box."""
+    committed = os.path.join(_REPO, "perf", "history.jsonl")
+    assert os.path.exists(committed), "committed seed history is missing"
+    assert perf_regress.main(["--check", "--store", committed]) == 0
+    out = capsys.readouterr().out
+    assert "0 regression(s)" in out
+    # and it is a REAL gate over that file, not a vacuous pass
+    assert " ok," in out and "[ok]" in out
+
+
+def test_seeding_from_committed_bench_artifacts(tmp_path):
+    """Rebuilding a store from the repo's BENCH_r*.json reproduces the
+    committed history: both artifact shapes (harness wrapper with parsed
+    payload, wrapper with only a tail) ingest; the failed round contributes
+    nothing."""
+    store = PerfStore(str(tmp_path / "h.jsonl"))
+    counts = {}
+    for r in range(1, 6):
+        p = os.path.join(_REPO, f"BENCH_r0{r}.json")
+        counts[r] = ingest_bench_artifact(store, p)
+    assert counts[2] == 0            # the failed round has no metrics
+    assert sum(counts.values()) == len(store.records()) > 0
+    committed = PerfStore(os.path.join(_REPO, "perf", "history.jsonl"))
+    assert len(committed.records()) == len(store.records())
+
+
+# ---------------------------------------------------------------------------
+# tier autotune cache
+# ---------------------------------------------------------------------------
+
+ARGS = (25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+
+
+def _arm_forward_probes(monkeypatch, results=None):
+    """Green feasibility everywhere; compile probes spy-counted (the thing
+    a cache hit must skip)."""
+    results = results or {}
+    conv4d_mod = importlib.import_module("ncnet_tpu.ops.conv4d")
+    monkeypatch.setattr(conv4d_mod, "_pallas_available", lambda: True)
+    counts = {"resident": 0, "perlayer": 0}
+    monkeypatch.setattr(lane, "fused_resident_feasible", lambda *a: True)
+    monkeypatch.setattr(lane, "fused_lane_feasible", lambda *a: True)
+
+    def resident_probe(*a):
+        counts["resident"] += 1
+        return results.get("resident", True)
+
+    def perlayer_probe(*a):
+        counts["perlayer"] += 1
+        return results.get("perlayer", True)
+
+    monkeypatch.setattr(lane, "fused_resident_compiles", resident_probe)
+    monkeypatch.setattr(lane, "fused_lane_compiles", perlayer_probe)
+    return counts
+
+
+@pytest.fixture
+def tier_cache_file(tmp_path, monkeypatch):
+    path = str(tmp_path / "tier_cache.json")
+    monkeypatch.setenv(tier_cache.CACHE_ENV, path)
+    tier_cache._reset_state()
+    return path
+
+
+def test_tier_cache_hit_skips_compile_probe(tier_cache_file, monkeypatch,
+                                            tmp_path):
+    counts = _arm_forward_probes(monkeypatch)
+    events_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(events_path)):
+        assert lane.choose_fused_stack(*ARGS) == "resident"
+        assert counts["resident"] == 1      # cold: the probe ran
+        # "fresh process": forget the in-process mirror and dedup state,
+        # keep the file — _reset_state is the designed process-restart analog
+        tier_cache._reset_state()
+        lane._emitted_choices.clear()
+        counts["resident"] = counts["perlayer"] = 0
+        assert lane.choose_fused_stack(*ARGS) == "resident"
+        assert counts == {"resident": 0, "perlayer": 0}  # zero probes
+    _, events = replay_events(events_path)
+    selected = [e for e in events if e["event"] == "tier_selected"]
+    assert [e["cached"] for e in selected] == [False, True]
+    assert len({e["tier"] for e in selected}) == 1   # identical decision
+    # the store event recorded the cold decision
+    assert any(e["event"] == "tier_cache" and e["op"] == "store"
+               for e in events)
+
+
+def test_tier_cache_hit_skips_vjp_compile_probe(tier_cache_file, monkeypatch):
+    monkeypatch.delenv("NCNET_FUSED_VJP_FORCE", raising=False)
+    conv4d_mod = importlib.import_module("ncnet_tpu.ops.conv4d")
+    monkeypatch.setattr(conv4d_mod, "_pallas_available", lambda: True)
+    monkeypatch.setattr(lane_vjp, "fused_vjp_feasible", lambda *a: True)
+    counts = {"vjp": 0}
+
+    def vjp_probe(*a):
+        counts["vjp"] += 1
+        return True
+
+    monkeypatch.setattr(lane_vjp, "fused_vjp_compiles", vjp_probe)
+    assert lane_vjp.choose_fused_vjp(*ARGS) == "resident_vjp"
+    assert counts["vjp"] == 1
+    tier_cache._reset_state()
+    lane._emitted_choices.clear()
+    counts["vjp"] = 0
+    assert lane_vjp.choose_fused_vjp(*ARGS) == "resident_vjp"
+    assert counts["vjp"] == 0
+
+
+def test_xla_outcome_is_not_cached(tier_cache_file, monkeypatch):
+    """A failed compile probe may be transient (device busy, tunnel
+    hiccup): the resulting XLA decision must not persist, or the shape
+    would be locked out of its fast tier across every future process."""
+    counts = _arm_forward_probes(monkeypatch, results={"resident": False,
+                                                      "perlayer": False})
+    assert lane.choose_fused_stack(*ARGS) is None
+    assert counts["resident"] == 1
+    assert tier_cache.lookup("forward", ARGS) is None   # nothing persisted
+    # "next process": the probe recovers and the fast tier comes back
+    tier_cache._reset_state()
+    lane._emitted_choices.clear()
+    counts2 = _arm_forward_probes(monkeypatch)
+    assert lane.choose_fused_stack(*ARGS) == "resident"
+    assert counts2["resident"] == 1    # re-probed, not replayed
+
+
+def test_tier_downstream_of_failed_probe_is_not_cached(
+        tier_cache_file, monkeypatch):
+    """'perlayer' reached only because resident's probe failed is just as
+    poisoned as an XLA outcome: caching it would pin the shape below its
+    fast tier.  A clean-probe 'perlayer' (resident not a candidate) DOES
+    cache."""
+    counts = _arm_forward_probes(monkeypatch, results={"resident": False})
+    assert lane.choose_fused_stack(*ARGS) == "perlayer"
+    assert tier_cache.lookup("forward", ARGS) is None   # not persisted
+    # next process: resident recovers and wins again
+    tier_cache._reset_state()
+    lane._emitted_choices.clear()
+    counts = _arm_forward_probes(monkeypatch)
+    assert lane.choose_fused_stack(*ARGS) == "resident"
+    assert counts["resident"] == 1
+    # clean perlayer (resident infeasible, its probe never ran) is cached
+    tier_cache._reset_state()
+    lane._emitted_choices.clear()
+    os.remove(tier_cache_file)
+    counts = _arm_forward_probes(monkeypatch)
+    monkeypatch.setattr(lane, "fused_resident_feasible", lambda *a: False)
+    assert lane.choose_fused_stack(*ARGS) == "perlayer"
+    assert counts == {"resident": 0, "perlayer": 1}
+    assert tier_cache.lookup("forward", ARGS) == ("perlayer",)
+
+
+def test_vjp_force_knob_bypasses_the_cache(tier_cache_file, monkeypatch):
+    """A forced decision is not a probe result: it must neither read nor
+    poison the cache."""
+    monkeypatch.setenv("NCNET_FUSED_VJP_FORCE", "interpret")
+    monkeypatch.setattr(lane_vjp, "fused_vjp_feasible", lambda *a: True)
+    assert lane_vjp.choose_fused_vjp(*ARGS) == "interpret"
+    assert tier_cache.lookup("backward", ARGS) is None   # nothing written
+
+
+def test_tier_cache_demotion_survives_in_process_restart(
+        tier_cache_file, monkeypatch):
+    counts = _arm_forward_probes(monkeypatch)
+    assert lane.choose_fused_stack(*ARGS) == "resident"
+    assert lane.demote_fused_tier() == "resident"
+    # fresh-process analog: runtime registry and mirror both gone
+    lane._runtime_demoted.clear()
+    lane._emitted_choices.clear()
+    tier_cache._reset_state()
+    counts["resident"] = counts["perlayer"] = 0
+    assert tier_cache.persistent_demotions() == {"resident"}
+    # the crashed tier stays demoted: the chooser lands on the next tier
+    # WITHOUT re-probing resident (its positive entry was dropped too)
+    assert lane.choose_fused_stack(*ARGS) == "perlayer"
+    assert counts["resident"] == 0 and counts["perlayer"] == 1
+    # a deliberate re-probe re-arms everything, including the cache file
+    lane.reset_fused_tier_demotions()
+    assert not os.path.exists(tier_cache_file)
+    assert tier_cache.persistent_demotions() == frozenset()
+    counts["resident"] = 0
+    assert lane.choose_fused_stack(*ARGS) == "resident"
+    assert counts["resident"] == 1
+
+
+_TIER_WORKER = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import importlib
+import ncnet_tpu.ops.nc_fused_lane as lane
+
+conv4d_mod = importlib.import_module("ncnet_tpu.ops.conv4d")
+conv4d_mod._pallas_available = lambda: True
+lane.fused_resident_feasible = lambda *a: True
+lane.fused_lane_feasible = lambda *a: True
+counts = {{"resident": 0, "perlayer": 0}}
+
+def _resident(*a):
+    counts["resident"] += 1
+    return True
+
+def _perlayer(*a):
+    counts["perlayer"] += 1
+    return True
+
+lane.fused_resident_compiles = _resident
+lane.fused_lane_compiles = _perlayer
+
+args = (25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+tier = lane.choose_fused_stack(*args)
+if os.environ.get("TIER_WORKER_DEMOTE"):
+    lane.demote_fused_tier()
+print(json.dumps({{"tier": tier, "counts": counts}}))
+"""
+
+
+def test_tier_demotion_persists_across_real_processes(tmp_path):
+    """The restart claim, proven with actual processes: process 1 chooses
+    'resident' and crashes it (demotes); process 2, warm off the cache file
+    alone, lands on 'perlayer' without ever probing resident."""
+    cache = str(tmp_path / "tier_cache.json")
+    worker = tmp_path / "worker.py"
+    worker.write_text(_TIER_WORKER.format(repo=_REPO))
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", NCNET_TPU_TIER_CACHE=cache,
+               TIER_WORKER_DEMOTE="1")
+    p1 = subprocess.run([sys.executable, str(worker)], env=env, text=True,
+                        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                        timeout=300)
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    r1 = json.loads(p1.stdout)
+    assert r1["tier"] == "resident" and r1["counts"]["resident"] == 1
+
+    env.pop("TIER_WORKER_DEMOTE")
+    p2 = subprocess.run([sys.executable, str(worker)], env=env, text=True,
+                        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                        timeout=300)
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    r2 = json.loads(p2.stdout)
+    assert r2["tier"] == "perlayer"
+    assert r2["counts"]["resident"] == 0   # never re-probed the dead tier
+
+
+def test_tier_cache_misses_across_device_kinds(tier_cache_file, monkeypatch):
+    monkeypatch.setattr(tier_cache, "device_kind", lambda: "TPU v5 lite")
+    tier_cache.record("forward", ARGS, "resident")
+    assert tier_cache.lookup("forward", ARGS) == ("resident",)
+    # a different accelerator simply misses: nothing to invalidate
+    monkeypatch.setattr(tier_cache, "device_kind", lambda: "TPU v6")
+    assert tier_cache.lookup("forward", ARGS) is None
+
+
+def test_tier_cache_ignores_foreign_and_newer_schema(tier_cache_file):
+    tier_cache.record("forward", ARGS, "resident")
+    with open(tier_cache_file) as f:
+        doc = json.load(f)
+    doc["schema"] = tier_cache.SCHEMA_VERSION + 1
+    with open(tier_cache_file, "w") as f:
+        json.dump(doc, f)
+    tier_cache._reset_state()
+    assert tier_cache.lookup("forward", ARGS) is None  # unreadable = miss
+    # the next record overwrites the foreign file wholesale
+    tier_cache.record("forward", ARGS, "perlayer")
+    tier_cache._reset_state()
+    assert tier_cache.lookup("forward", ARGS) == ("perlayer",)
+
+
+def test_cached_tier_failing_feasibility_regate_reprobes(
+        tier_cache_file, monkeypatch):
+    """A cached decision written under different VMEM budget constants must
+    degrade to a re-probe, not a doomed dispatch: the cheap feasibility
+    gates still run on every hit."""
+    counts = _arm_forward_probes(monkeypatch)
+    assert lane.choose_fused_stack(*ARGS) == "resident"
+    tier_cache._reset_state()
+    lane._emitted_choices.clear()
+    counts["resident"] = counts["perlayer"] = 0
+    # the budget changed: resident no longer feasible
+    monkeypatch.setattr(lane, "fused_resident_feasible", lambda *a: False)
+    assert lane.choose_fused_stack(*ARGS) == "perlayer"
+    assert counts["perlayer"] == 1          # re-probed on the live ladder
+
+
+def test_tier_cache_disabled_is_inert(monkeypatch):
+    monkeypatch.setenv(tier_cache.CACHE_ENV, "off")
+    tier_cache._reset_state()
+    assert tier_cache.cache_path() is None
+    tier_cache.record("forward", ARGS, "resident")     # all no-ops
+    assert tier_cache.lookup("forward", ARGS) is None
+    tier_cache.record_demotion("resident")
+    assert tier_cache.persistent_demotions() == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_stall_watchdog_verdicts(tmp_path, capsys):
+    hb_path = str(tmp_path / "heartbeat.json")
+    events_path = str(tmp_path / "events.jsonl")
+
+    # no heartbeat: exit 2, distinct from stalled
+    assert stall_watchdog.main([hb_path]) == 2
+    capsys.readouterr()
+
+    Heartbeat(hb_path, run_id="r1").beat(step=5)
+    with EventLog(events_path) as log:
+        for i, wall in enumerate([0.05, 0.04, 0.06, 0.05], start=1):
+            log.emit("step", mode="train", step=i, wall_s=wall)
+
+    # fresh beat: alive (threshold = max(min_age, 10 x median 0.05))
+    verdict = stall_watchdog.judge(hb_path, factor=10.0, min_age=0.1)
+    assert verdict["status"] == "alive"
+    assert verdict["median_step_wall_s"] == pytest.approx(0.05)
+    assert verdict["threshold_s"] == pytest.approx(0.5)
+    assert verdict["last_beat"]["step"] == 5
+    assert stall_watchdog.main([hb_path, "--min-age", "60"]) == 0
+    capsys.readouterr()
+
+    # age the heartbeat past the cadence-derived threshold: stalled
+    old = time.time() - 30.0
+    os.utime(hb_path, (old, old))
+    verdict = stall_watchdog.judge(hb_path, factor=10.0, min_age=0.1)
+    assert verdict["status"] == "stalled" and verdict["age_s"] > 29
+    rc = stall_watchdog.main([hb_path, "--factor", "10", "--min-age", "0.1",
+                              "--json"])
+    assert rc == 3
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "stalled"
+
+    # without a readable step cadence the floor is the whole threshold
+    verdict = stall_watchdog.judge(hb_path, events_path=str(tmp_path / "no"),
+                                   min_age=3600.0)
+    assert verdict["status"] == "alive"
+    assert verdict["median_step_wall_s"] is None
+    assert verdict["threshold_s"] == 3600.0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the whole loop on a real instrumented fit
+# ---------------------------------------------------------------------------
+
+
+def test_acceptance_fit_trace_store_gate(tmp_path, monkeypatch):
+    """End-to-end: two instrumented fit runs -> the event log renders to
+    valid Chrome trace JSON with the step phases as spans; run_report
+    --spans ranks them; both runs' summaries ingest into the perf store;
+    the sentinel is green on the real pair and gates an injected 2x
+    step-wall regression; the stall watchdog judges the artifact."""
+    store_path = str(tmp_path / "history.jsonl")
+    monkeypatch.setenv("NCNET_TPU_PERF_STORE", store_path)
+    root = str(tmp_path / "data")
+    write_pair_dataset(root, n_pairs=4, image_hw=(48, 48),
+                       shift=(16, 16), seed=1)
+
+    def run(out):
+        cfg = TrainConfig(
+            model=TINY, image_size=48,
+            dataset_image_path=root,
+            dataset_csv_path=root + "/image_pairs",
+            num_epochs=1, batch_size=2, lr=1e-3,
+            result_model_dir=str(tmp_path / out), log_interval=10,
+            data_parallel=False,
+        )
+        return training.fit(cfg, progress=False)
+
+    r1, r2 = run("out1"), run("out2")
+    events_path = os.path.join(r2["checkpoint"], "telemetry",
+                               "events.jsonl")
+
+    # 1. the train-step phases are spans in the log
+    _, events = replay_events(events_path)
+    names = {e["name"] for e in events
+             if e["event"] == "span" and e["ph"] == "B"}
+    assert {"train_step", "dispatch", "stage", "loss_sync",
+            "checkpoint_commit"} <= names
+
+    # 2. trace export: valid Chrome trace JSON, phases nested under steps
+    out = str(tmp_path / "trace.json")
+    assert trace_export.main([events_path, "-o", out]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {"train_step", "dispatch", "loss_sync"} <= \
+        {e["name"] for e in slices}
+    steps = [e for e in slices if e["name"] == "train_step"]
+    assert len(steps) == 2 and all(e["dur"] > 0 for e in steps)
+
+    # 3. run_report --spans: the critical-path breakdown nests correctly
+    report = run_report.build_report([events_path])
+    labels = {(g["parent"], g["name"]) for g in report["spans"]["groups"]}
+    assert ("train_step", "dispatch") in labels
+    assert ("train_step", "loss_sync") in labels
+    text = run_report.render_spans(report)
+    assert "train_step > dispatch" in text
+
+    # 4. both runs ingested into the perf store
+    store = PerfStore(store_path)
+    hist = store.history("train_step_wall_s")
+    assert len(hist) == 2 and all(r["source"] == "fit" for r in hist)
+    assert {r["run_id"] for r in hist} and hist[0]["device_kind"]
+
+    # 5. the sentinel: green on the real pair, exit 1 after an injected
+    # regression.  The two baseline points are REAL fit walls (cold vs warm
+    # process: legitimately far apart), so the injection must clear the
+    # MAD slack they imply for any spread: 10x the worst observed wall is
+    # > median + max(mad_k*1.4826*mad, min_rel*median) whatever the pair
+    # (the controlled-values 2x case is test_perf_regress_cli_gates_*)
+    check = ["--check", "--store", store_path, "--metrics",
+             "train_step_wall_s", "--min-history", "1", "--min-rel", "0.5"]
+    assert perf_regress.main(check) == 0
+    store.append("train_step_wall_s",
+                 10.0 * max(r["value"] for r in hist),
+                 device_kind=hist[-1]["device_kind"])
+    assert perf_regress.main(check) == 1
+
+    # 6. the watchdog judges the run's own artifact off its own cadence
+    hb = os.path.join(r2["checkpoint"], "telemetry", "heartbeat.json")
+    verdict = stall_watchdog.judge(hb, events_path=events_path,
+                                   min_age=3600.0)
+    assert verdict["status"] == "alive"
+    assert verdict["median_step_wall_s"] > 0
+    old = time.time() - 7200.0
+    os.utime(hb, (old, old))
+    assert stall_watchdog.judge(
+        hb, events_path=events_path,
+        min_age=1.0)["status"] == "stalled"

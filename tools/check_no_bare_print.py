@@ -15,8 +15,13 @@ Exemptions:
     in prose never trips it;
   * ``sys.stdout.write`` in the logger itself (that is the sink).
 
-Usage: ``python tools/check_no_bare_print.py [package_dir]`` — prints one
-``path:line`` per violation and exits 1 if any were found.
+Usage: ``python tools/check_no_bare_print.py [package_dir ...]`` — prints
+one ``path:line`` per violation and exits 1 if any were found.  Several
+targets may be given (each walked independently; a ``.py`` file is checked
+directly), so the tier-1 test pins the round-9 additions —
+``observability/tracing.py``, ``observability/perfstore.py``,
+``ops/tier_cache.py``, ``utils/compat.py`` — explicitly alongside the
+whole-package walk.
 """
 
 from __future__ import annotations
@@ -29,47 +34,56 @@ from typing import List, Tuple
 EXEMPT_DIRS = ("cli",)
 
 
-def find_bare_prints(package_dir: str) -> List[Tuple[str, int]]:
+def _check_file(path: str, hits: List[Tuple[str, int]]) -> None:
+    with open(path, "r") as f:
+        try:
+            tree = ast.parse(f.read(), path)
+        except SyntaxError as e:  # a broken module is its own bug
+            hits.append((path, e.lineno or 0))
+            return
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            hits.append((path, node.lineno))
+
+
+def find_bare_prints(target: str) -> List[Tuple[str, int]]:
     """``(path, lineno)`` for every ``print(...)`` call in a non-exempt
-    module under ``package_dir``.  AST-based: docstrings, comments and
-    attribute calls like ``pprint.print`` do not count."""
+    module under directory ``target`` (or in the single file ``target``).
+    AST-based: docstrings, comments and attribute calls like
+    ``pprint.print`` do not count."""
     hits: List[Tuple[str, int]] = []
-    for root, dirs, files in os.walk(package_dir):
-        rel = os.path.relpath(root, package_dir)
+    if os.path.isfile(target):
+        _check_file(target, hits)
+        return hits
+    for root, dirs, files in os.walk(target):
+        rel = os.path.relpath(root, target)
         parts = [] if rel == "." else rel.split(os.sep)
         if any(p in EXEMPT_DIRS for p in parts):
             continue
         for fname in sorted(files):
             if not fname.endswith(".py"):
                 continue
-            path = os.path.join(root, fname)
-            with open(path, "r") as f:
-                try:
-                    tree = ast.parse(f.read(), path)
-                except SyntaxError as e:  # a broken module is its own bug
-                    hits.append((path, e.lineno or 0))
-                    continue
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Name)
-                        and node.func.id == "print"):
-                    hits.append((path, node.lineno))
+            _check_file(os.path.join(root, fname), hits)
     return hits
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    package_dir = args[0] if args else os.path.join(
+    targets = args or [os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "ncnet_tpu",
-    )
-    hits = find_bare_prints(package_dir)
+    )]
+    hits: List[Tuple[str, int]] = []
+    for target in targets:
+        hits.extend(find_bare_prints(target))
     for path, lineno in hits:
         print(f"{path}:{lineno}: bare print() in a library module "
               "(use ncnet_tpu.observability.get_logger)")
     if hits:
-        print(f"{len(hits)} bare print call(s) found under {package_dir} "
-              f"(exempt: {', '.join(EXEMPT_DIRS)}/)")
+        print(f"{len(hits)} bare print call(s) found under "
+              f"{', '.join(targets)} (exempt: {', '.join(EXEMPT_DIRS)}/)")
         return 1
     return 0
 

@@ -56,6 +56,62 @@ def _percentiles(xs: List[float], qs=(50, 90, 99)) -> Dict[str, float]:
     return out
 
 
+def build_span_breakdown(events: List[dict]) -> Dict[str, Any]:
+    """Critical-path accounting over the span events: per span NAME (keyed
+    under its parent's name, so `train_step > dispatch` and a root-level
+    `dispatch` stay distinct), the count, total wall, and SELF time — total
+    minus the time spent inside child spans — which is what actually ranks
+    phases on the critical path: a `train_step` span's total wall double-
+    counts every phase nested in it, its self time is the unattributed
+    remainder.  Unclosed spans (SIGKILL mid-span) are counted, not timed."""
+    # pair B/E by (run, span id); resolve each span's parent NAME via the
+    # parent id stamped on the B event
+    opens: Dict[tuple, dict] = {}
+    closed: List[dict] = []
+    unclosed = 0
+    names: Dict[tuple, str] = {}
+    child_time: Dict[tuple, float] = {}
+    for e in events:
+        if e.get("event") != "span":
+            continue
+        key = (e.get("run"), e.get("span"))
+        if e.get("ph") == "B":
+            opens[key] = e
+            names[key] = str(e.get("name"))
+        elif e.get("ph") == "E":
+            b = opens.pop(key, None)
+            if b is None:
+                continue
+            dur = e.get("dur_s")
+            if not isinstance(dur, (int, float)):
+                continue
+            parent_key = (e.get("run"), b.get("parent"))
+            child_time[parent_key] = child_time.get(parent_key, 0.0) + dur
+            closed.append({"key": key, "name": names[key],
+                           "parent": b.get("parent"), "dur": float(dur),
+                           "error": e.get("error")})
+    unclosed = len(opens)
+    groups: Dict[tuple, Dict[str, Any]] = {}
+    for s in closed:
+        parent_name = (names.get((s["key"][0], s["parent"]), "-")
+                       if s["parent"] is not None else "-")
+        g = groups.setdefault((parent_name, s["name"]), {
+            "parent": parent_name, "name": s["name"], "n": 0,
+            "total_s": 0.0, "self_s": 0.0, "errors": 0,
+        })
+        g["n"] += 1
+        g["total_s"] += s["dur"]
+        g["self_s"] += s["dur"] - child_time.get(s["key"], 0.0)
+        if s["error"]:
+            g["errors"] += 1
+    out = sorted(groups.values(), key=lambda g: -g["self_s"])
+    for g in out:
+        g["total_s"] = round(g["total_s"], 6)
+        g["self_s"] = round(g["self_s"], 6)
+        g["mean_s"] = round(g["total_s"] / g["n"], 6)
+    return {"groups": out, "closed": len(closed), "unclosed": unclosed}
+
+
 def build_report(paths: List[str]) -> Dict[str, Any]:
     """Aggregate one report dict over every given event log."""
     runs: List[Dict[str, Any]] = []
@@ -171,6 +227,8 @@ def build_report(paths: List[str]) -> Dict[str, Any]:
         "checkpoints": checkpoints,
         "divergence_postmortem": postmortem,
     }
+    if any(e.get("event") == "span" for e in events):
+        report["spans"] = build_span_breakdown(events)
     if eval_batches or eval_queries or eval_summaries:
         pcks = [e["pck"] for e in eval_batches
                 if isinstance(e.get("pck"), (int, float))]
@@ -195,6 +253,24 @@ def _fmt_stats(stats: Dict[str, float], unit: str = "s") -> str:
     parts.append(f"mean={stats['mean']:.4f}{unit}")
     parts.append(f"n={stats['n']}")
     return "  ".join(parts)
+
+
+def render_spans(report: Dict[str, Any]) -> str:
+    sp = report.get("spans")
+    if not sp or not sp["groups"]:
+        return "(no span events in the log)"
+    lines = ["span breakdown (self-time ranked; parent > name):"]
+    width = max(len(f"{g['parent']} > {g['name']}") for g in sp["groups"])
+    for g in sp["groups"]:
+        label = f"{g['parent']} > {g['name']}"
+        err = f"  errors={g['errors']}" if g["errors"] else ""
+        lines.append(
+            f"  {label:<{width}}  n={g['n']:<6} self={g['self_s']:<10.4f} "
+            f"total={g['total_s']:<10.4f} mean={g['mean_s']:.4f}s{err}")
+    if sp["unclosed"]:
+        lines.append(f"  ({sp['unclosed']} unclosed span(s) — in flight at "
+                     "process death)")
+    return "\n".join(lines)
 
 
 def render_text(report: Dict[str, Any]) -> str:
@@ -281,12 +357,18 @@ def main(argv=None) -> int:
     ap.add_argument("logs", nargs="+", help="events.jsonl file(s)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as one JSON document")
+    ap.add_argument("--spans", action="store_true",
+                    help="append the span critical-path breakdown "
+                         "(self-time vs child-time per phase)")
     args = ap.parse_args(argv)
     report = build_report(args.logs)
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
         print(render_text(report))
+        if args.spans:
+            print()
+            print(render_spans(report))
     return 0
 
 

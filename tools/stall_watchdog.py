@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Reference implementation of the heartbeat watchdog contract.
+
+The training loop bumps ``<telemetry_dir>/heartbeat.json`` atomically every
+step (``ncnet_tpu/observability/device.py::Heartbeat``); the documented
+contract for external watchdogs is *mtime age > a few step walls ⇒ the
+process is stalled or dead*.  This tool is that watchdog: one invocation
+judges liveness NOW (cron / a supervisor loop / a CI babysitter runs it
+periodically), with the stall threshold derived from the run's own cadence
+rather than a guessed constant:
+
+  * the recent median step wall comes from the sibling event log's last
+    ``step`` events (default: ``events.jsonl`` beside the heartbeat file) —
+    a run stepping at 30 s/step gets a proportionally longer leash than one
+    at 0.3 s/step;
+  * stalled ⇔ heartbeat mtime age > ``N × median`` (default N=10), floored
+    at ``--min-age`` seconds (default 60) so startup jitter, checkpoint
+    pauses, or a watchdog racing the very first beat cannot false-positive;
+  * no event log / no step events ⇒ the threshold degrades to ``--min-age``
+    alone, and the tool says so.
+
+Exit codes: 0 = alive, 3 = STALLED, 2 = no heartbeat file (not started, or
+already cleaned up — distinct so supervisors can treat it differently).
+
+Usage::
+
+    python tools/stall_watchdog.py <telemetry_dir>/heartbeat.json
+        [--events <events.jsonl>] [--factor 10] [--min-age 60] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ncnet_tpu.observability.device import Heartbeat  # noqa: E402
+from ncnet_tpu.observability.events import replay_events  # noqa: E402
+
+
+def recent_median_step_wall(events_path: str,
+                            tail: int = 32) -> Optional[float]:
+    """Median ``wall_s`` of the last ``tail`` step events, or None when the
+    log is missing/unreadable/step-less (the caller falls back to the
+    static floor).  Torn tails are tolerated by ``replay_events``."""
+    try:
+        _, events = replay_events(events_path)
+    except (OSError, ValueError):
+        return None
+    walls: List[float] = [
+        e["wall_s"] for e in events
+        if e.get("event") == "step"
+        and isinstance(e.get("wall_s"), (int, float)) and e["wall_s"] > 0
+    ][-tail:]
+    if not walls:
+        return None
+    return float(statistics.median(walls))
+
+
+def judge(heartbeat_path: str, events_path: Optional[str] = None,
+          factor: float = 10.0, min_age: float = 60.0) -> Dict[str, Any]:
+    """One liveness verdict: ``{"status": "alive"|"stalled"|"missing", ...}``
+    with the evidence (age, threshold, median step wall, last payload)."""
+    age = Heartbeat.age_s(heartbeat_path)
+    if age is None:
+        return {"status": "missing", "heartbeat": heartbeat_path}
+    if events_path is None:
+        events_path = os.path.join(
+            os.path.dirname(os.path.abspath(heartbeat_path)), "events.jsonl")
+    median = recent_median_step_wall(events_path)
+    threshold = max(min_age, factor * median) if median else min_age
+    verdict: Dict[str, Any] = {
+        "status": "stalled" if age > threshold else "alive",
+        "heartbeat": heartbeat_path,
+        "age_s": round(age, 3),
+        "threshold_s": round(threshold, 3),
+        "median_step_wall_s": round(median, 6) if median else None,
+        "factor": factor,
+        "min_age_s": min_age,
+        "events": events_path if median else None,
+    }
+    payload = Heartbeat.read(heartbeat_path)
+    if payload:
+        verdict["last_beat"] = payload
+    return verdict
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Judge a training run's liveness from its heartbeat "
+                    "file + event log")
+    ap.add_argument("heartbeat", help="path to heartbeat.json")
+    ap.add_argument("--events", default=None,
+                    help="event log for the step-wall cadence (default: "
+                         "events.jsonl beside the heartbeat file)")
+    ap.add_argument("--factor", type=float, default=10.0,
+                    help="stall threshold = factor x median step wall "
+                         "(default 10)")
+    ap.add_argument("--min-age", type=float, default=60.0,
+                    help="threshold floor in seconds (default 60; also the "
+                         "whole threshold when no step cadence is readable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as one JSON document")
+    args = ap.parse_args(argv)
+
+    verdict = judge(args.heartbeat, events_path=args.events,
+                    factor=args.factor, min_age=args.min_age)
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    elif verdict["status"] == "missing":
+        print(f"no heartbeat at {verdict['heartbeat']} (run not started, "
+              "telemetry off, or already cleaned up)")
+    else:
+        cadence = (f"median step wall {verdict['median_step_wall_s']}s "
+                   f"x {verdict['factor']}"
+                   if verdict["median_step_wall_s"]
+                   else f"no step cadence; floor {verdict['min_age_s']}s")
+        beat = verdict.get("last_beat") or {}
+        print(f"{verdict['status'].upper()}: heartbeat age "
+              f"{verdict['age_s']}s vs threshold {verdict['threshold_s']}s "
+              f"({cadence}); last beat: step {beat.get('step')}, "
+              f"pid {beat.get('pid')}, run {beat.get('run')}")
+    return {"alive": 0, "missing": 2, "stalled": 3}[verdict["status"]]
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

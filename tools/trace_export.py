@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Export ncnet_tpu event logs as Chrome trace-event JSON (Perfetto-viewable).
+
+The span events (``ncnet_tpu/observability/tracing.py``) give the event log
+hierarchical structure — ``span``/``ph="B"`` at entry, ``ph="E"`` with a
+monotonic ``dur_s`` at exit, ``parent``/``tid`` stamped on the ``B``.  This
+tool renders any such log (or several: resumed runs append to one file,
+sharded runs write many) into the Chrome trace-event format that
+https://ui.perfetto.dev and chrome://tracing load directly:
+
+  * every CLOSED span becomes one complete ("X") slice, timed by the entry
+    event's wall clock and the exit event's monotonic duration;
+  * an UNCLOSED span (the process was SIGKILLed mid-span, or the sink died)
+    is emitted as a bare "B" — Perfetto renders it as a slice that never
+    ends, which is exactly the postmortem signal: *this* is what was in
+    flight when the process died;
+  * non-span events (step, checkpoint_commit, tier_selected, retry,
+    quarantine, …) become instant ("i") markers on a dedicated track, so
+    the trace shows the run's milestones against its time structure;
+  * each run id in the lineage gets its own trace process, each recorded
+    thread its own track, with "M" metadata records naming them.
+
+Replay is torn-tail tolerant (``replay_events``): a log whose writer was
+SIGKILLed mid-append still exports minus at most the torn trailing line.
+
+Usage::
+
+    python tools/trace_export.py <events.jsonl> [more.jsonl ...] [-o trace.json]
+
+``-o -`` writes the trace JSON to stdout.  Default output:
+``<first input>.trace.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ncnet_tpu.observability.events import replay_events  # noqa: E402
+
+# span "B" bookkeeping fields that should not be duplicated into args
+_B_META = ("t", "run", "seq", "event", "ph", "name", "span", "parent", "tid")
+# instant-event fields that are envelope, not payload
+_I_META = ("t", "run", "seq", "event")
+
+
+def _us(t: float) -> float:
+    return t * 1e6
+
+
+def build_trace(paths: List[str]) -> Dict[str, Any]:
+    """One Chrome trace document over every given event log."""
+    trace_events: List[Dict[str, Any]] = []
+    headers: List[Dict[str, Any]] = []
+    pid_of_run: Dict[str, int] = {}
+    tid_of: Dict[Tuple[int, Any], int] = {}  # (pid, raw tid) -> track id
+
+    def pid_for(run: Any, header: Dict[str, Any]) -> int:
+        key = str(run)
+        if key not in pid_of_run:
+            pid_of_run[key] = len(pid_of_run) + 1
+            trace_events.append({
+                "ph": "M", "name": "process_name", "pid": pid_of_run[key],
+                "tid": 0, "args": {"name": (
+                    f"run {key} @ {header.get('host', '?')}"
+                    f" [{header.get('device_kind') or 'no-device'}]")},
+            })
+            trace_events.append({
+                "ph": "M", "name": "thread_name", "pid": pid_of_run[key],
+                "tid": 0, "args": {"name": "events"},
+            })
+        return pid_of_run[key]
+
+    def tid_for(pid: int, raw) -> int:
+        key = (pid, raw)
+        if key not in tid_of:
+            # track 0 is the instant-marker track; spans start at 1
+            tid_of[key] = 1 + sum(1 for k in tid_of if k[0] == pid)
+            trace_events.append({
+                "ph": "M", "name": "thread_name", "pid": pid,
+                "tid": tid_of[key], "args": {"name": f"thread {raw}"},
+            })
+        return tid_of[key]
+
+    for path in paths:
+        header, events = replay_events(path)
+        head = header.get("header", {})
+        headers.append({"path": path, **head})
+        # pair span B/E by (run, span id) — ids are process-unique ints, so
+        # the run id disambiguates resume lineages appending to one file
+        open_spans: Dict[Tuple[Any, Any], Dict[str, Any]] = {}
+        for e in events:
+            run = e.get("run", "?")
+            pid = pid_for(run, head)
+            if e.get("event") != "span":
+                args = {k: v for k, v in e.items() if k not in _I_META}
+                trace_events.append({
+                    "ph": "i", "name": str(e.get("event")), "pid": pid,
+                    "tid": 0, "ts": _us(float(e.get("t", 0.0))), "s": "t",
+                    "cat": "event", "args": args,
+                })
+                continue
+            if e.get("ph") == "B":
+                open_spans[(run, e.get("span"))] = e
+                continue
+            b = open_spans.pop((run, e.get("span")), None)
+            if b is None:
+                continue  # E without B (sink bound mid-span): undisplayable
+            args = {k: v for k, v in b.items() if k not in _B_META}
+            if e.get("error"):
+                args["error"] = e["error"]
+            trace_events.append({
+                "ph": "X", "name": str(b.get("name")), "pid": pid,
+                "tid": tid_for(pid, b.get("tid")),
+                "ts": _us(float(b.get("t", 0.0))),
+                "dur": _us(float(e.get("dur_s") or 0.0)),
+                "cat": "span", "args": args,
+            })
+        # unclosed spans: what was in flight at SIGKILL.  A bare "B" is
+        # valid trace JSON; Perfetto draws it as a never-ending slice.
+        for (run, _), b in sorted(open_spans.items(),
+                                  key=lambda kv: kv[1].get("t", 0.0)):
+            pid = pid_of_run[str(run)]
+            args = {k: v for k, v in b.items() if k not in _B_META}
+            args["unclosed"] = True
+            trace_events.append({
+                "ph": "B", "name": str(b.get("name")), "pid": pid,
+                "tid": tid_for(pid, b.get("tid")),
+                "ts": _us(float(b.get("t", 0.0))),
+                "cat": "span", "args": args,
+            })
+
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"logs": headers, "exporter": "ncnet_tpu trace_export"},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Export ncnet_tpu event logs as Chrome trace JSON")
+    ap.add_argument("logs", nargs="+", help="events.jsonl file(s)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output path ('-' for stdout; default: "
+                         "<first input>.trace.json)")
+    args = ap.parse_args(argv)
+    trace = build_trace(args.logs)
+    out = args.output or (args.logs[0] + ".trace.json")
+    text = json.dumps(trace)
+    if out == "-":
+        sys.stdout.write(text + "\n")
+    else:
+        with open(out, "w") as f:
+            f.write(text)
+        n_spans = sum(1 for e in trace["traceEvents"] if e["ph"] in "XB")
+        sys.stderr.write(
+            f"wrote {out}: {n_spans} spans, "
+            f"{len(trace['traceEvents'])} trace events — open in "
+            "https://ui.perfetto.dev\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
