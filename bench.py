@@ -232,6 +232,46 @@ def bench_jax(res=None):
         except Exception:
             pass
 
+    # match-quality signals of the synthetic bench pair, per precision tier
+    # (observability/quality.py): every bench artifact records the tier
+    # ladder's ACCURACY cost beside its walls — a kernel-tier PR that buys
+    # speed by flattening the match distribution shows up here (and in the
+    # perf store, where the quality_* series gate with direction inference)
+    def _quality_for(model_cfg, suffix):
+        def measure():
+            from ncnet_tpu.observability.quality import (
+                QUALITY_SIGNALS,
+                active_tier,
+                quality_table,
+            )
+
+            k1, k2 = jax.random.split(jax.random.key(7))
+            src = jax.random.uniform(
+                k1, (1, IMAGE, IMAGE, 3), jnp.float32, -1, 1)
+            tgt = jax.random.uniform(
+                k2, (1, IMAGE, IMAGE, 3), jnp.float32, -1, 1)
+            table = np.asarray(jax.jit(
+                lambda s, t: quality_table(
+                    models.ncnet_forward(model_cfg, params, s, t).corr)
+            )(src, tgt))
+            vals = {f"quality_{name}_{suffix}": float(table[0, i])
+                    for i, name in enumerate(QUALITY_SIGNALS)}
+            # the tier the chooser actually picked for THIS forward — the
+            # fp32 run never consults the chooser (it is xla by
+            # construction) and must not inherit the bf16 timing runs'
+            # process-global decision
+            vals[f"quality_tier_{suffix}"] = active_tier(
+                model_cfg.half_precision)
+            return vals
+
+        if res.get(f"quality_score_{suffix}") is None:
+            out = _with_retries(measure, label=f"quality_{suffix}")
+            if out:
+                res.update(out)
+
+    _quality_for(cfg, "fp32")
+    _quality_for(cfg16, "bf16")
+
     # per-stage decomposition of the fused NC stack (ISSUE r6): time the
     # layout conversion and the layer prefixes of the SAME kernels the
     # production filter runs, so the residual roofline gap is attributed

@@ -185,7 +185,8 @@ def extract_match_table(
 
 def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
                       both_directions: bool, flip_direction: bool,
-                      mesh=None, preprocess_image_size: Optional[int] = None):
+                      mesh=None, preprocess_image_size: Optional[int] = None,
+                      quality_cb=None):
     """Returns ``matcher(src, tgt) -> (xA, yA, xB, yB, score)`` numpy arrays.
 
     One jitted program per (src_shape, tgt_shape) bucket — jit's native
@@ -206,6 +207,14 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
     ``mesh`` (with a >1 'spatial' axis) switches the forward to the
     hB-sharded path (parallel/spatial.py); pairs whose pooled hB does not
     divide over the shards fall back to the single-device forward.
+
+    ``quality_cb``: when given, every fetched pair's label-free quality
+    signals (``observability/quality.py``, computed IN the jitted pair
+    program over the same filtered volume the matches come from and pulled
+    as one extra row of the match table — no second device round trip)
+    are passed to it as ``{signal: float}``.  ``run_inloc_eval`` wires this
+    into tier-tagged ``quality`` events + the run's histogram digests; the
+    default None costs nothing.
     """
     k = max(config.relocalization_k_size, 1)
 
@@ -257,10 +266,19 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
             out = ncnet_forward_from_features(config, p, src, tgt)
         else:
             out = forward(p, src, tgt, sharded)
-        return extract_match_table(
+        table = extract_match_table(
             out, k_size=k, do_softmax=do_softmax,
             both_directions=both_directions, flip_direction=flip_direction,
         )
+        if quality_cb is None:
+            return table
+        # quality signals ride as one extra row of the (5, N) match table
+        # (the append_quality_row wire protocol, defined in
+        # observability/quality.py beside the signal list): the pair's
+        # single device→host pull stays single
+        from ncnet_tpu.observability.quality import append_quality_row
+
+        return append_quality_row(table, out.corr)
 
     # the device-error injection hook lives on the pair program only (one
     # hook per dispatched PAIR keeps injected-call ordinals predictable);
@@ -332,8 +350,16 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
             return jitted(params, src, tgt, sharded=sharded)
 
     def fetch(handle):
-        """Block on a dispatch handle and unpack to five numpy vectors."""
-        table = np.asarray(handle, dtype=np.float32)
+        """Block on a dispatch handle and unpack to five numpy vectors.
+        A 6-row table carries the pair's quality-signal row (see ``run``):
+        it is routed to ``quality_cb`` and stripped — callers always see
+        the plain 5-vector match tuple."""
+        from ncnet_tpu.observability.quality import split_quality_row
+
+        table, quality = split_quality_row(
+            np.asarray(handle, dtype=np.float32))
+        if quality is not None and quality_cb is not None:
+            quality_cb(quality)
         return tuple(table[i] for i in range(5))
 
     def matcher(src, tgt):
@@ -545,6 +571,24 @@ def run_inloc_eval(
     out_dir = os.path.join(config.output_root, output_folder_name(config))
     os.makedirs(out_dir, exist_ok=True)
 
+    # per-pair match-quality signals (README "Quality observability"):
+    # computed in the pair program, fetched with the match table, streamed
+    # as tier-tagged `quality` events and digested per run — the label-free
+    # accuracy monitor this eval otherwise lacks entirely (InLoc has no
+    # in-loop metric; a silent tier regression here only surfaces after the
+    # downstream PnP stage, hours later)
+    from ncnet_tpu.observability.metrics import MetricsRegistry
+    from ncnet_tpu.observability.quality import emit_quality
+
+    from ncnet_tpu.observability.quality import active_tier
+
+    quality_registry = MetricsRegistry(scope="inloc_eval")
+
+    def on_pair_quality(signals):
+        emit_quality("inloc_eval", signals,
+                     tier=active_tier(model_config.half_precision),
+                     registry=quality_registry)
+
     matcher = make_pair_matcher(
         model_config, params,
         do_softmax=config.softmax,
@@ -554,6 +598,7 @@ def run_inloc_eval(
         # raw uint8 in, normalize+resize on device: the upload is the
         # dominant per-pair cost and raw bytes are 4-15x smaller
         preprocess_image_size=config.image_size,
+        quality_cb=on_pair_quality,
     )
     n_cap = match_capacity(
         config.image_size, config.k_size, config.matching_both_directions
@@ -802,11 +847,12 @@ def run_inloc_eval(
             log.warning("quarantined queries (see manifest.json): "
                         + ", ".join(manifest.quarantined_ids),
                         kind="quarantine")
-        obs_events.emit(
-            "eval_summary", eval="inloc", completed=n_done,
-            quarantined=(list(manifest.quarantined_ids)
-                         if manifest is not None else []),
-        )
+        # flush the per-run quality digests beside the completion summary
+        # (one `metrics` event; the drift tool and run_report read both)
+        quality_registry.flush(event="eval_summary", eval="inloc",
+                               completed=n_done,
+                               quarantined=(list(manifest.quarantined_ids)
+                                            if manifest is not None else []))
     finally:
         if own_sink is not None:
             obs_events.set_global_sink(prev_sink)

@@ -59,6 +59,15 @@ from ncnet_tpu.models import NCNet
 from ncnet_tpu.observability import events as obs_events
 from ncnet_tpu.observability import get_logger
 from ncnet_tpu.observability.metrics import MetricsRegistry
+from ncnet_tpu.observability.quality import (
+    DIGEST_BINS,
+    QUALITY_SIGNALS,
+    SIGNAL_RANGE,
+    active_tier,
+    emit_quality,
+    quality_table,
+    spearman,
+)
 from ncnet_tpu.observability.tracing import span
 from ncnet_tpu.ops import corr_to_matches
 from ncnet_tpu.ops.image import normalize_imagenet, quantize_u8
@@ -66,9 +75,17 @@ from ncnet_tpu.utils.profiling import annotate
 
 log = get_logger("eval.pf_pascal")
 
+# per-batch result columns: per-pair PCK, then the quality signals — ONE
+# fetched table per batch carries labels and label-free signals together
+# (quality.py's zero-per-pair-postprocessing contract)
+RESULT_COLUMNS = ("pck",) + QUALITY_SIGNALS
+
 
 def make_eval_step(net: NCNet, alpha: float, device_normalize: bool = False):
-    """Jitted (params, images..., points...) → per-sample PCK.
+    """Jitted (params, images..., points...) → per-sample ``(B, 6)`` table:
+    PCK in column 0, the :data:`~ncnet_tpu.observability.quality.QUALITY_SIGNALS`
+    in the rest (computed in-graph over the same filtered volume the match
+    extraction reads — the fetch carries both at no extra round trip).
 
     ``device_normalize``: the batch's images arrive as raw resized uint8 and
     the ImageNet normalization runs on device (the uint8-upload fast path);
@@ -89,7 +106,11 @@ def make_eval_step(net: NCNet, alpha: float, device_normalize: bool = False):
                 tgt = tgt.astype(jnp.bfloat16)
         out = net.forward_fn(params, src, tgt)
         matches = corr_to_matches(out.corr, do_softmax=True)
-        return pck_metric(batch, matches, alpha)
+        scores = pck_metric(batch, matches, alpha)
+        return jnp.concatenate(
+            [scores.astype(jnp.float32)[:, None], quality_table(out.corr)],
+            axis=1,
+        )
 
     jitted = ResilientJit(step, label="pf_pascal_step")
 
@@ -223,6 +244,11 @@ def _run_eval_impl(
             "batch_size": batch_size,
             "device_normalize": bool(device_normalize),
             "n_pairs": len(dataset),
+            # journaled records are now the full per-pair result table
+            # (PCK + quality signals); a pre-quality journal must not be
+            # misread as PCK-only rows, so the layout is part of the header
+            # fingerprint and a mismatch starts fresh
+            "columns": list(RESULT_COLUMNS),
         }
         journal = EvalJournal(
             os.path.join(config.journal_dir, "pck_journal.jsonl"), header)
@@ -249,6 +275,8 @@ def _run_eval_impl(
         pipeline_depth, high_cap=0.7 * scale, low_cap=0.45 * scale
     )
     in_flight: list = []
+
+    n_cols = len(RESULT_COLUMNS)
 
     def nan_decode_quarantined(bi, arr) -> np.ndarray:
         """Score this batch's pairs NaN where THEIR OWN decode failed: the
@@ -312,7 +340,7 @@ def _run_eval_impl(
         breaker.note(not ok)
         if not ok:
             quarantined_batches.append(bi)
-            return np.full((n0,), np.nan, dtype=np.float32)
+            return np.full((n0, n_cols), np.nan, dtype=np.float32)
         return arr
 
     def drain_one(sample: bool = True):
@@ -326,15 +354,28 @@ def _run_eval_impl(
         registry.timer("fetch_wall").observe(fetch_wall)
         registry.counter("batches").inc()
         registry.gauge("pipeline_depth").set(depth_ctl.depth)
+        pck_col = arr[:, 0]
         if obs_events.get_global_sink() is not None:
-            good = arr[~np.isnan(arr)]
+            good = pck_col[~np.isnan(pck_col)]
             obs_events.emit(
-                "eval_batch", batch=bi, n=int(arr.size),
+                "eval_batch", batch=bi, n=int(pck_col.size),
                 valid=int(good.size),
                 pck=float(np.mean(good)) if good.size else None,
                 fetch_wall_s=round(fetch_wall, 6),
                 pipeline_depth=depth_ctl.depth,
             )
+        # per-pair quality signals, tier-tagged, next to the per-pair PCK
+        # (the event), and into the registry's fixed-bin digests (the
+        # per-run percentile aggregation the drift gate consumes).  Tier
+        # eligibility = this net's precision: an fp32 eval never consults
+        # the Pallas chooser and must not inherit a stale bf16 decision
+        # from elsewhere in the process.
+        emit_quality(
+            "pf_pascal_eval",
+            {name: arr[:, i + 1] for i, name in enumerate(QUALITY_SIGNALS)},
+            tier=active_tier(net.config.half_precision),
+            pck=pck_col, registry=registry, batch=bi, n=int(pck_col.size),
+        )
         if sample:
             depth_ctl.note_drain()
         else:
@@ -360,7 +401,18 @@ def _run_eval_impl(
             # reuse the stored (bitwise-exact) values without dispatching.
             while in_flight:
                 drain_one(sample=False)
-            results.append(journal.entries[i])
+            replayed = journal.entries[i].reshape(-1, n_cols)
+            results.append(replayed)
+            # replayed pairs feed the quality digests (the per-run
+            # aggregate must cover EVERY pair, so merged digests after a
+            # SIGKILL-resume equal an uninterrupted run's) but re-emit no
+            # quality event: the killed run's events for this batch are
+            # already in the shared lineage log
+            for k, name in enumerate(QUALITY_SIGNALS):
+                lo, hi = SIGNAL_RANGE[name]
+                vals = replayed[:, k + 1]
+                registry.histogram(f"q_{name}", lo, hi, DIGEST_BINS).add(
+                    vals[np.isfinite(vals)])
             replayed_batches += 1
             if manifest is not None:
                 manifest.complete(f"batch_{i}", journaled=True)
@@ -436,17 +488,34 @@ def _run_eval_impl(
     if journal is not None:
         journal.close()
 
-    results = np.concatenate(results)
-    # NaN = zero valid keypoints, a quarantined batch, or a pair with an
+    results = np.concatenate(results)  # (N, 1 + len(QUALITY_SIGNALS))
+    per_pair = results[:, 0]
+    # NaN PCK = zero valid keypoints, a quarantined batch, or a pair with an
     # undecodable image (nan_decode_quarantined above; the reference also
     # had a -1 sentinel in its preallocated stats array — pck() here never
     # produces one)
-    good = np.flatnonzero(~np.isnan(results))
+    good = np.flatnonzero(~np.isnan(per_pair))
+    quality = {name: results[:, i + 1]
+               for i, name in enumerate(QUALITY_SIGNALS)}
+    # signal-vs-PCK rank correlation: labels exist here, so the label-free
+    # signals are validated against them (positive rho = the signal ranks
+    # pairs the way PCK does — a usable unlabeled PCK proxy)
+    quality_pck_spearman = {
+        name: spearman(vals, per_pair) for name, vals in quality.items()
+    }
     stats = {
-        "pck": float(np.mean(results[good])) if good.size else float("nan"),
-        "total": int(results.size),
+        "pck": float(np.mean(per_pair[good])) if good.size else float("nan"),
+        "total": int(per_pair.size),
         "valid": int(good.size),
-        "per_pair": results,
+        "per_pair": per_pair,
+        "quality": quality,
+        "quality_digests": {
+            name: registry.histogram(
+                f"q_{name}", *SIGNAL_RANGE[name], DIGEST_BINS).snapshot()
+            for name in QUALITY_SIGNALS
+        },
+        "quality_pck_spearman": quality_pck_spearman,
+        "quality_tier": active_tier(net.config.half_precision),
         "timing": timing,
         "quarantined_batches": quarantined_batches,
         "decode_quarantined": sorted(loader.quarantined),
@@ -458,7 +527,10 @@ def _run_eval_impl(
         len(stats["decode_quarantined"]))
     registry.gauge("pck").set(stats["pck"])
     registry.flush(event="eval_summary", total=stats["total"],
-                   valid=stats["valid"])
+                   valid=stats["valid"], tier=stats["quality_tier"],
+                   quality_pck_spearman={
+                       k: (None if v != v else round(v, 4))
+                       for k, v in quality_pck_spearman.items()})
     # cross-run perf history: PCK + the wall split land in the persistent
     # store so tools/perf_regress.py can gate the next eval against them
     # (fail-open; NaN PCK from an all-quarantined run is filtered there).
@@ -470,6 +542,13 @@ def _run_eval_impl(
     from ncnet_tpu.observability import perfstore
 
     history = {"pf_pascal_pck": stats["pck"]}
+    # quality-signal means join PCK in the gated accuracy trajectory —
+    # direction is inferred from the signal name (margin/agreement/score/
+    # coherence higher-is-better, entropy lower; perfstore.metric_direction)
+    for name, vals in quality.items():
+        finite = vals[np.isfinite(vals)]
+        if finite.size:
+            history[f"pf_pascal_quality_{name}"] = float(np.mean(finite))
     if fresh_pairs and not replayed_batches:
         for k in ("decode", "dispatch", "fetch"):
             history[f"pf_pascal_{k}_s_per_pair"] = (

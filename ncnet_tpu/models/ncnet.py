@@ -644,6 +644,13 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
 
     params = jax.device_put(params)  # pre-staged once, reused every pair
 
+    from ncnet_tpu.observability.quality import (
+        active_tier,
+        append_quality_row,
+        emit_quality,
+        split_quality_row,
+    )
+
     def run(p, src, tgt):
         src = normalize_imagenet(src.astype(jnp.float32))
         tgt = normalize_imagenet(tgt.astype(jnp.float32))
@@ -655,8 +662,12 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
             k_size=max(config.relocalization_k_size, 1),
             do_softmax=do_softmax, scale=scale,
         )
-        # one stacked result: a single device→host pull instead of five
-        return jnp.stack([v.astype(jnp.float32) for v in m])
+        # one stacked result: a single device→host pull instead of five.
+        # An extra row carries the pair's quality signals (the
+        # append_quality_row wire protocol) — the serving path's per-query
+        # accuracy monitor, computed in-graph at no extra round trip.
+        table = jnp.stack([v.astype(jnp.float32) for v in m])
+        return append_quality_row(table, out.corr)
 
     jitted = ResilientJit(run, label="point_matcher")
 
@@ -665,7 +676,16 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
         return jitted(params, jnp.asarray(src), jnp.asarray(tgt))
 
     def fetch(handle) -> "Matches":
-        table = np.asarray(handle, dtype=np.float32)
+        table, quality = split_quality_row(
+            np.asarray(handle, dtype=np.float32))
+        if quality is not None:
+            # per-query quality: kept on the matcher (the serving layer's
+            # admission/monitoring hook) and streamed as a tier-tagged
+            # `quality` event when a telemetry sink is bound (no-op
+            # otherwise)
+            matcher.last_quality = quality
+            emit_quality("serving", quality,
+                         tier=active_tier(config.half_precision))
         return Matches(*(table[i] for i in range(5)))
 
     def matcher(src, tgt) -> "Matches":
@@ -673,6 +693,7 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
 
     matcher.dispatch = dispatch
     matcher.fetch = fetch
+    matcher.last_quality = None
     # tier-degradation seam: recover_from_device_failure(exc, matcher)
     matcher.retrace = jitted.retrace
     return matcher

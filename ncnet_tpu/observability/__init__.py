@@ -32,6 +32,7 @@ from ncnet_tpu.observability.metrics import (  # noqa: F401
     PEAK_HBM_GBPS,
     Counter,
     Gauge,
+    Histogram,
     MetricsRegistry,
     Timer,
     device_peak_tflops,
@@ -47,6 +48,13 @@ from ncnet_tpu.observability.tracing import (  # noqa: F401
     current_span_id,
     span,
     traced,
+)
+from ncnet_tpu.observability.quality import (  # noqa: F401
+    QUALITY_SIGNALS,
+    active_tier,
+    emit_quality,
+    quality_signals,
+    quality_table,
 )
 from ncnet_tpu.observability.perfstore import (  # noqa: F401
     PerfStore,
@@ -74,6 +82,7 @@ __all__ = [
     "PEAK_HBM_GBPS",
     "Counter",
     "Gauge",
+    "Histogram",
     "MetricsRegistry",
     "Timer",
     "device_peak_tflops",
@@ -85,6 +94,11 @@ __all__ = [
     "current_span_id",
     "span",
     "traced",
+    "QUALITY_SIGNALS",
+    "active_tier",
+    "emit_quality",
+    "quality_signals",
+    "quality_table",
     "PerfStore",
     "check_regressions",
     "maybe_record",

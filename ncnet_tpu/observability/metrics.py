@@ -150,6 +150,111 @@ class Timer:
         return out
 
 
+class Histogram:
+    """Fixed-bin histogram digest: bounded-memory distribution tracking.
+
+    The quality-observability layer (``observability/quality.py``) streams
+    per-pair match-quality signals through these so an eval-scale run can
+    report percentiles and feed the drift sentinel WITHOUT per-pair
+    storage: ``bins`` counters over ``[lo, hi]`` (values clamped to the
+    edge bins, so outliers are counted, not lost) plus exact count/sum/
+    min/max.  Two digests with identical binning merge by adding counts —
+    the property the SIGKILL-resume proof relies on (journal-replayed
+    batches re-feed the same values, so merged digests equal an
+    uninterrupted run's).  Percentiles interpolate linearly inside a bin:
+    exact to ±bin_width, which is all a drift gate needs.
+    """
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0, bins: int = 32):
+        if not (hi > lo and bins > 0):
+            raise ValueError(f"bad histogram binning [{lo}, {hi}] x {bins}")
+        self.lo, self.hi, self.bins = float(lo), float(hi), int(bins)
+        self.counts = [0] * int(bins)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def add(self, values) -> None:
+        """Accumulate value(s); NaN/inf are dropped (a failed pair must not
+        shift the distribution it failed to measure)."""
+        import math
+
+        try:
+            values = list(values)
+        except TypeError:
+            values = [values]
+        w = (self.hi - self.lo) / self.bins
+        for v in values:
+            v = float(v)
+            if not math.isfinite(v):
+                continue
+            i = min(self.bins - 1, max(0, int((v - self.lo) / w)))
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+    def merge(self, other: "Histogram") -> None:
+        if (other.lo, other.hi, other.bins) != (self.lo, self.hi, self.bins):
+            raise ValueError("cannot merge histograms with different binning")
+        for i, n in enumerate(other.counts):
+            self.counts[i] += n
+        self.count += other.count
+        self.sum += other.sum
+        for name, pick in (("min", min), ("max", max)):
+            ov = getattr(other, name)
+            if ov is not None:
+                mine = getattr(self, name)
+                setattr(self, name, ov if mine is None else pick(mine, ov))
+
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Approximate q-th percentile (0-100), linear within the bin."""
+        if not self.count:
+            return None
+        target = q / 100.0 * self.count
+        w = (self.hi - self.lo) / self.bins
+        seen = 0
+        for i, n in enumerate(self.counts):
+            if seen + n >= target and n:
+                frac = (target - seen) / n
+                return self.lo + (i + frac) * w
+            seen += n
+        return self.hi
+
+    def snapshot(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "count": self.count, "lo": self.lo, "hi": self.hi,
+            "counts": list(self.counts),
+        }
+        if self.count:
+            out["mean"] = round(self.sum / self.count, 6)
+            out["min"] = round(self.min, 6)
+            out["max"] = round(self.max, 6)
+            for q in (50, 90):
+                out[f"p{q}"] = round(self.percentile(q), 6)
+        return out
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict[str, object]) -> "Histogram":
+        """Rebuild a digest from its snapshot dict (the wire format the
+        drift sentinel's reference file stores).  min/max/sum degrade to
+        bin-resolution estimates when absent."""
+        counts = list(snap["counts"])
+        h = cls(float(snap["lo"]), float(snap["hi"]), len(counts))
+        h.counts = [int(n) for n in counts]
+        h.count = int(snap.get("count", sum(h.counts)))
+        if h.count:
+            h.sum = float(snap.get("mean", 0.0)) * h.count
+            h.min = float(snap.get("min", h.lo))
+            h.max = float(snap.get("max", h.hi))
+        return h
+
+
 class MetricsRegistry:
     """Named counters/gauges/timers for one run scope.
 
@@ -183,6 +288,27 @@ class MetricsRegistry:
     def timer(self, name: str) -> Timer:
         return self._get(name, Timer)
 
+    def histogram(self, name: str, lo: float = 0.0, hi: float = 1.0,
+                  bins: int = 32) -> Histogram:
+        """Fixed-bin digest; binning is set at first creation (later calls
+        return the existing digest — mismatched binning raises rather than
+        silently rebinning a live distribution)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = Histogram(lo, hi, bins)
+            elif not isinstance(m, Histogram):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not Histogram"
+                )
+            elif (m.lo, m.hi, m.bins) != (float(lo), float(hi), int(bins)):
+                raise ValueError(
+                    f"histogram {name!r} already registered with binning "
+                    f"[{m.lo}, {m.hi}] x {m.bins}"
+                )
+            return m
+
     def snapshot(self) -> Dict[str, object]:
         """Plain-data view: counters/gauges to their value, timers to their
         stat dict.  Unset gauges are omitted (a null metric is noise)."""
@@ -196,6 +322,9 @@ class MetricsRegistry:
                 if m.value is not None:
                     out[name] = m.value
             elif isinstance(m, Timer):
+                if m.count:
+                    out[name] = m.snapshot()
+            elif isinstance(m, Histogram):
                 if m.count:
                     out[name] = m.snapshot()
         return out

@@ -261,9 +261,16 @@ _UNGATED_TOKENS = ("mfu", "tflops", "vs_baseline", "gflops")
 # regression and wave a real drop through)
 _FORCED_HIGHER_TOKENS = _UNGATED_TOKENS
 _HIGHER_TOKENS = ("pck", "pairs_per_s", "pairs_per_sec", "qps",
-                  "localization_rate")
+                  "localization_rate",
+                  # match-quality signals (observability/quality.py): the
+                  # accuracy trajectory gates alongside the walls
+                  "margin", "mnn_agreement", "coherence", "score_gap",
+                  "quality_score")
 _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
-                 "_step_s", "_wall_s")
+                 "_step_s", "_wall_s",
+                 # diffuse match distributions are worse: entropy gates
+                 # lower-is-better
+                 "entropy")
 
 
 def metric_direction(name: str) -> Optional[str]:

@@ -1,0 +1,491 @@
+"""Match-quality observability: label-free quality signals over the 4D volume.
+
+PRs 5-6 made the system's *speed* observable; its *accuracy* was still
+invisible between labeled evals — a bf16 tier promotion, a future CP/FFT
+conv4d tier, or a quarantine-degraded run can silently shift match quality
+and nothing fires until someone re-runs PF-Pascal.  NCNet's own construction
+gives label-free confidence measures for free: the softmax match scores and
+the mutual-NN structure (``ops/matching.py``) are exactly the correspondence-
+confidence signals *Dual-Resolution Correspondence Networks* (PAPERS.md)
+ranks matches by.  This module extracts them IN-GRAPH, so every consumer
+(both eval loops, the warm serving matcher, training) fetches them with the
+match table at zero extra host round trips and zero per-pair Python
+postprocessing.
+
+Signals (:data:`QUALITY_SIGNALS`; all per pair, all in their stated range):
+
+  * ``score``          — mean over B cells of the max softmax match
+    probability (the B→A direction :func:`corr_to_matches` scores by);
+    [0, 1], higher = more confident.
+  * ``entropy``        — mean normalized entropy of the per-B-cell softmax
+    distribution over A cells (normalized by ``log(hA·wA)``); [0, 1],
+    1.0 = uniform (uninformative volume), lower = peakier.
+  * ``margin``         — mean top1−top2 softmax gap per B cell; [0, 1],
+    ~1.0 for a delta-peaked volume, ~0 for a flat one.
+  * ``mnn_agreement``  — hard mutual-argmax agreement ratio
+    (:func:`ncnet_tpu.ops.matching.mutual_argmax_agreement`); [0, 1].
+  * ``coherence``      — displacement-field smoothness: the fraction of
+    adjacent B-grid cell pairs whose matched A cells advance within 0.9 of
+    the expected grid step (the implied flow is locally smooth); [0, 1],
+    1.0 for an identity/rigid-shift volume, low both for
+    spatially-incoherent argmax noise and for a volume collapsed to a
+    constant argmax (the band sits strictly below one step, so the
+    degenerate constant field cannot masquerade as a perfect flow).
+
+Training additionally reports ``score_gap`` = score(positive) −
+score(negative) per step (the negation of the weak loss, [-1, 1]) — the
+per-step health signal of the weak supervision itself.
+
+Consumption path: signals stream into the PR 5 event log as ``quality``
+events **tagged with the active fused tier** (:func:`active_tier`, fed by
+``ops/nc_fused_lane.last_selected_tier``), aggregate through fixed-bin
+:class:`~ncnet_tpu.observability.metrics.Histogram` digests in the metrics
+registry (percentiles without per-pair storage), and gate against committed
+reference distributions (``perf/quality_ref.jsonl``) with a PSI-style
+divergence score — ``tools/quality_drift.py`` exits nonzero on drift, which
+is the standing accuracy gate every future kernel-tier PR runs under.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ncnet_tpu.observability.metrics import Histogram
+
+# signal order IS the wire order: the stacked quality table fetched beside
+# the match table lays columns out in this sequence
+QUALITY_SIGNALS = ("score", "entropy", "margin", "mnn_agreement", "coherence")
+
+# per-signal digest range; everything the volume extractor emits is [0, 1]
+# by construction, the training score gap is a difference of [0, 1] means
+SIGNAL_RANGE: Dict[str, Tuple[float, float]] = {
+    **{name: (0.0, 1.0) for name in QUALITY_SIGNALS},
+    "score_gap": (-1.0, 1.0),
+}
+DIGEST_BINS = 32
+
+REF_KIND = "ncnet_tpu_quality_ref"
+REF_SCHEMA = 1
+
+
+# ---------------------------------------------------------------------------
+# in-graph extraction (pure jnp — fuses into the eval/serving programs)
+# ---------------------------------------------------------------------------
+
+
+def quality_signals(corr: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Per-pair quality signals of a filtered volume; ``{name: (B,)}``.
+
+    Everything is reductions/gathers/top-k over the ``(B, hA, wA, hB, wB)``
+    volume — jittable, shardable, and cheap next to the NC filter that
+    produced the volume (one softmax the match extraction computes anyway,
+    one top-2, two argmax).  The B→A matching direction is used throughout,
+    matching :func:`~ncnet_tpu.ops.matching.corr_to_matches`'s default.
+    """
+    from ncnet_tpu.ops.matching import mutual_argmax_agreement
+
+    b, ha, wa, hb, wb = corr.shape
+    n_a, n_b = ha * wa, hb * wb
+    flat = corr.astype(jnp.float32).reshape(b, n_a, n_b)
+    # distribution over A cells per B cell (B→A, corr_to_matches default)
+    p = jax.nn.softmax(flat, axis=1)
+
+    # top-2 over the A axis: top1 is the softmax match score, the gap to
+    # top2 is the match's decision margin
+    top2 = jax.lax.top_k(jnp.swapaxes(p, 1, 2), 2)[0]  # (B, n_b, 2)
+    score = jnp.mean(top2[..., 0], axis=1)
+    margin = jnp.mean(top2[..., 0] - top2[..., 1], axis=1)
+
+    ent = -jnp.sum(p * jnp.log(p + 1e-12), axis=1)  # (B, n_b)
+    entropy = jnp.mean(ent, axis=1) / jnp.log(float(n_a))
+
+    agreement = mutual_argmax_agreement(corr)
+
+    # displacement-field coherence: matched A coordinates as a field over
+    # the B grid; adjacent B cells of a coherent flow map to A cells one
+    # expected-grid-step apart.  The tolerance band is 0.9 of a step (L∞,
+    # per axis), DELIBERATELY below one full step: a volume collapsed to a
+    # constant argmax (the tie behavior of a flattened/broken tier — every
+    # B cell matching A cell 0) advances 0 per step, exactly one step off,
+    # and an inclusive ±1-step band would score that pathology 1.0 like a
+    # perfect identity flow.  The cost is that genuine plateaus (two
+    # adjacent B cells sharing an A cell) also count incoherent — stricter,
+    # but rigid/identity flows still score exactly 1.0 and the gate only
+    # consumes the signal's DRIFT, not its absolute value.
+    idx_a = jnp.argmax(flat, axis=1)  # (B, n_b) flattened A index per B cell
+    ia = (idx_a // wa).reshape(b, hb, wb).astype(jnp.float32)
+    ja = (idx_a % wa).reshape(b, hb, wb).astype(jnp.float32)
+    # expected A-cells-per-B-cell step (1.0 on the square volumes)
+    step_i = (ha - 1) / max(hb - 1, 1)
+    step_j = (wa - 1) / max(wb - 1, 1)
+    tol_i = 0.9 * max(step_i, 1.0)
+    tol_j = 0.9 * max(step_j, 1.0)
+    ok_terms: List[jnp.ndarray] = []
+    if hb > 1:
+        di = ia[:, 1:, :] - ia[:, :-1, :] - step_i
+        dj = ja[:, 1:, :] - ja[:, :-1, :]
+        ok_terms.append(((jnp.abs(di) <= tol_i) & (jnp.abs(dj) <= tol_j))
+                        .astype(jnp.float32).reshape(b, -1))
+    if wb > 1:
+        di = ia[:, :, 1:] - ia[:, :, :-1]
+        dj = ja[:, :, 1:] - ja[:, :, :-1] - step_j
+        ok_terms.append(((jnp.abs(di) <= tol_i) & (jnp.abs(dj) <= tol_j))
+                        .astype(jnp.float32).reshape(b, -1))
+    if ok_terms:
+        coherence = jnp.mean(jnp.concatenate(ok_terms, axis=1), axis=1)
+    else:  # degenerate 1x1 B grid: no adjacency to judge
+        coherence = jnp.ones((b,), jnp.float32)
+
+    return {"score": score, "entropy": entropy, "margin": margin,
+            "mnn_agreement": agreement, "coherence": coherence}
+
+
+def quality_table(corr: jnp.ndarray) -> jnp.ndarray:
+    """``(B, len(QUALITY_SIGNALS))`` float32 signal table — the stacked form
+    the eval steps concatenate beside their per-pair results so ONE fetch
+    carries both (the zero-per-pair-postprocessing contract)."""
+    sigs = quality_signals(corr)
+    return jnp.stack([sigs[name].astype(jnp.float32)
+                      for name in QUALITY_SIGNALS], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# host side: tier tagging, events, digests
+# ---------------------------------------------------------------------------
+
+
+def append_quality_row(table: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
+    """Attach a single pair's quality signals to its ``(5, N)`` match table
+    as one extra zero-padded row (values in the first
+    ``len(QUALITY_SIGNALS)`` slots) — the wire protocol both serving-shaped
+    matchers (``make_point_matcher``, InLoc's ``make_pair_matcher``) use so
+    the pair's single device→host pull stays single.  Defined HERE, beside
+    :data:`QUALITY_SIGNALS`, so the two producers and
+    :func:`split_quality_row` can never disagree on the layout.  A table
+    too narrow to hold the signals (degenerate tiny grid) is returned
+    unchanged; the consumer detects the row by shape."""
+    q = quality_table(corr)[0]
+    if table.shape[1] < q.shape[0]:
+        return table
+    row = jnp.zeros((table.shape[1],), jnp.float32).at[: q.shape[0]].set(q)
+    return jnp.concatenate([table, row[None]], axis=0)
+
+
+def split_quality_row(table: np.ndarray):
+    """Invert :func:`append_quality_row` on the fetched numpy table:
+    ``(match_rows (5, N), {signal: float} | None)`` — None when no quality
+    row was attached."""
+    if table.shape[0] != 6:
+        return table, None
+    signals = dict(zip(
+        QUALITY_SIGNALS,
+        (float(v) for v in table[5, : len(QUALITY_SIGNALS)]),
+    ))
+    return table[:5], signals
+
+
+def active_tier(eligible: bool = True, stage: str = "forward") -> str:
+    """The fused-tier label for quality events.
+
+    ``eligible``: whether the program that produced the signals could have
+    routed through the fused Pallas stack AT ALL — callers pass their
+    config's ``half_precision`` (the chooser is only consulted for bf16
+    volumes).  An ineligible program is ``"xla"`` by construction; asking
+    the process-global ``last_selected_tier`` would return whatever a bf16
+    program elsewhere in the process last decided (e.g. bench times the
+    bf16 forward before measuring fp32 quality) and mis-file the digests
+    under the wrong tier series.  For eligible programs the label is the
+    stage chooser's most recent decision — per STAGE, not per shape, so a
+    mixed-shape eligible run is tagged with its latest decision (shapes are
+    constant within one eval/training run, where this is exact)."""
+    if not eligible:
+        return "xla"
+    from ncnet_tpu.ops import last_selected_tier
+
+    return last_selected_tier(stage) or "xla"
+
+
+def emit_quality(scope: str, signals: Dict[str, Any], *,
+                 tier: Optional[str] = None,
+                 pck: Optional[Iterable[float]] = None,
+                 registry=None, **ids) -> None:
+    """Stream one unit's per-pair signals: a ``quality`` event into the
+    bound sink (no-op when unbound), tagged with the active fused tier, and
+    — when a registry is given — into its per-signal histogram digests
+    (NaNs dropped there; they mark quarantined pairs).  ``pck`` rides along
+    when labels exist so consumers can rank-correlate signal vs PCK."""
+    from ncnet_tpu.observability import events as _events
+
+    tier = tier or active_tier()
+    sig_lists = {}
+    for name, vals in signals.items():
+        arr = np.atleast_1d(np.asarray(vals, dtype=np.float64))
+        sig_lists[name] = [round(float(v), 6) for v in arr]
+        if registry is not None:
+            lo, hi = SIGNAL_RANGE.get(name, (0.0, 1.0))
+            registry.histogram(f"q_{name}", lo, hi, DIGEST_BINS).add(
+                arr[np.isfinite(arr)])
+    if _events.get_global_sink() is not None:
+        fields = dict(ids)
+        if pck is not None:
+            fields["pck"] = [round(float(v), 6)
+                             for v in np.atleast_1d(np.asarray(pck))]
+        _events.emit("quality", scope=scope, tier=tier,
+                     signals=sig_lists, **fields)
+
+
+def digests_from_events(events: Iterable[dict],
+                        bins_like: Optional[dict] = None
+                        ) -> Dict[Tuple[str, str], Histogram]:
+    """Aggregate ``quality`` events into digests keyed ``(tier, signal)``.
+
+    ``bins_like`` optionally maps signal name → snapshot dict whose binning
+    must be matched (the drift check bins the current run exactly like the
+    reference it is judged against)."""
+    out: Dict[Tuple[str, str], Histogram] = {}
+    for e in events:
+        if e.get("event") != "quality":
+            continue
+        tier = str(e.get("tier") or "xla")
+        for name, vals in (e.get("signals") or {}).items():
+            key = (tier, name)
+            h = out.get(key)
+            if h is None:
+                if bins_like is not None and name in bins_like:
+                    ref = bins_like[name]
+                    h = Histogram(float(ref["lo"]), float(ref["hi"]),
+                                  len(ref["counts"]))
+                else:
+                    lo, hi = SIGNAL_RANGE.get(name, (0.0, 1.0))
+                    h = Histogram(lo, hi, DIGEST_BINS)
+                out[key] = h
+            arr = np.atleast_1d(np.asarray(vals, dtype=np.float64))
+            h.add(arr[np.isfinite(arr)])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# drift: PSI divergence against a committed reference distribution
+# ---------------------------------------------------------------------------
+
+
+def psi(ref: Histogram, cur: Histogram, eps: float = 1e-3) -> float:
+    """Population Stability Index between two same-binned digests.
+
+    ``sum((q_i - p_i) * ln(q_i / p_i))`` over bins with ``eps`` flooring
+    (empty bins must not produce infinities).  Standard reading: < 0.1 no
+    shift, 0.1-0.25 moderate, > 0.25 major — the drift gate defaults to
+    0.25.  Symmetric and 0 for identical distributions.
+    """
+    if (ref.lo, ref.hi, ref.bins) != (cur.lo, cur.hi, cur.bins):
+        raise ValueError("PSI requires identically-binned digests")
+    if not ref.count or not cur.count:
+        raise ValueError("PSI over an empty digest")
+    p = np.maximum(np.asarray(ref.counts, np.float64) / ref.count, eps)
+    q = np.maximum(np.asarray(cur.counts, np.float64) / cur.count, eps)
+    p, q = p / p.sum(), q / q.sum()
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+DEFAULT_PSI_THRESHOLD = 0.25
+
+
+def default_reference_path() -> str:
+    """``<repo>/perf/quality_ref.jsonl`` — beside the perf history it is the
+    accuracy twin of."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "perf", "quality_ref.jsonl")
+
+
+def write_reference(path: str,
+                    digests: Dict[Tuple[str, str], Histogram], *,
+                    device_kind: Optional[str],
+                    meta: Optional[dict] = None) -> int:
+    """Write (replace) a reference-distribution file: one self-describing
+    JSONL record per (device_kind, tier, signal) series.  Returns the record
+    count.  The file is the drift gate's committed baseline — re-seed it
+    only from a CLEAN eval of the committed weights (README "Quality
+    observability" documents the policy)."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    n = 0
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for (tier, signal), h in sorted(digests.items()):
+            if not h.count:
+                continue
+            rec = {"kind": REF_KIND, "schema": REF_SCHEMA,
+                   "device_kind": device_kind or "unknown",
+                   "tier": tier, "signal": signal,
+                   "digest": h.snapshot()}
+            if meta:
+                rec["meta"] = meta
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            n += 1
+    os.replace(tmp, path)
+    return n
+
+
+def load_reference(path: str) -> Dict[Tuple[str, str, str], Histogram]:
+    """Reference digests keyed ``(device_kind, tier, signal)``.  Foreign or
+    newer-schema lines are skipped (the perf-store tolerance discipline);
+    a missing file is an empty reference, not an error — the drift tool
+    reports the series it could not judge."""
+    out: Dict[Tuple[str, str, str], Histogram] = {}
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return out
+    for line in raw.split(b"\n"):
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if (not isinstance(rec, dict) or rec.get("kind") != REF_KIND
+                or rec.get("schema", 0) > REF_SCHEMA):
+            continue
+        try:
+            h = Histogram.from_snapshot(rec["digest"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        out[(str(rec.get("device_kind", "unknown")),
+             str(rec.get("tier", "xla")),
+             str(rec.get("signal", "")))] = h
+    return out
+
+
+def reference_binning(
+        reference: Dict[Tuple[str, str, str], Histogram]) -> Dict[str, dict]:
+    """Per-signal binning spec (``bins_like`` for
+    :func:`digests_from_events`) from :func:`load_reference` output — THE
+    rule both the standalone drift gate and ``run_report --quality`` bin
+    current runs by, so their verdicts can never diverge.  First entry
+    wins when one signal is binned differently across device kinds."""
+    out: Dict[str, dict] = {}
+    for (_dk, _tier, signal), h in reference.items():
+        out.setdefault(signal, {"lo": h.lo, "hi": h.hi,
+                                "counts": [0] * h.bins})
+    return out
+
+
+def check_drift(reference: Dict[Tuple[str, str, str], Histogram],
+                current: Dict[Tuple[str, str], Histogram], *,
+                device_kind: Optional[str],
+                threshold: float = DEFAULT_PSI_THRESHOLD,
+                min_count: int = 4) -> List[Dict[str, Any]]:
+    """Judge every current (tier, signal) digest against the reference.
+
+    Returns one finding per series — ``{"tier", "signal", "status":
+    "ok"|"drift"|"skipped", "psi", ...}``, drifts first.  Series absent from
+    the reference, binned differently, or with fewer than ``min_count``
+    samples are ``skipped`` with a reason (a gate that guesses is worse
+    than no gate) — and so are reference series this device kind SHOULD
+    have produced but the run did not: a tier that silently stopped
+    emitting must surface in the findings, not vanish from them.
+    ``device_kind`` keys the reference lookup: digests are only comparable
+    within one backend (the very shifts the gate hunts — bf16 tiers,
+    kernel rewrites — are device-kind-shaped).
+    """
+    dk = device_kind or "unknown"
+    findings: List[Dict[str, Any]] = []
+    for (rdk, tier, signal) in sorted(reference):
+        if rdk == dk and (tier, signal) not in current:
+            findings.append({
+                "tier": tier, "signal": signal, "device_kind": dk,
+                "count": 0, "mean": None, "status": "skipped",
+                "reason": "series present in the reference but absent "
+                          "from this run (emitter broken, or the tier "
+                          "never executed here)",
+            })
+    for (tier, signal), cur in sorted(current.items()):
+        finding: Dict[str, Any] = {
+            "tier": tier, "signal": signal, "device_kind": dk,
+            "count": cur.count, "mean": cur.mean(),
+        }
+        ref = reference.get((dk, tier, signal))
+        if ref is None:
+            finding.update(status="skipped",
+                           reason="no reference series for "
+                                  f"({dk}, {tier}, {signal})")
+        elif cur.count < min_count:
+            finding.update(status="skipped",
+                           reason=f"only {cur.count} sample(s) "
+                                  f"(< min_count={min_count})")
+        elif (ref.lo, ref.hi, ref.bins) != (cur.lo, cur.hi, cur.bins):
+            finding.update(status="skipped",
+                           reason="binning mismatch vs reference")
+        else:
+            d = psi(ref, cur)
+            finding.update(
+                psi=round(d, 6), threshold=threshold,
+                ref_mean=ref.mean(), ref_count=ref.count,
+                status="drift" if d > threshold else "ok",
+            )
+        findings.append(finding)
+    findings.sort(key=lambda f: (f["status"] != "drift",
+                                 f["status"] == "skipped",
+                                 f["tier"], f["signal"]))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# signal-vs-PCK validation (labels exist → the signals must track them)
+# ---------------------------------------------------------------------------
+
+
+def spearman(a, b) -> float:
+    """Spearman rank correlation with average ranks for ties; NaN pairs are
+    dropped, degenerate inputs (under 3 pairs, or a constant side) return
+    NaN rather than a fake verdict."""
+    a = np.asarray(a, dtype=np.float64).ravel()
+    b = np.asarray(b, dtype=np.float64).ravel()
+    m = np.isfinite(a) & np.isfinite(b)
+    a, b = a[m], b[m]
+    if a.size < 3:
+        return float("nan")
+
+    def rank(x):
+        order = np.argsort(x, kind="stable")
+        r = np.empty(x.size, dtype=np.float64)
+        r[order] = np.arange(1, x.size + 1)
+        # average ranks over tie groups
+        for v in np.unique(x):
+            tie = x == v
+            if np.sum(tie) > 1:
+                r[tie] = np.mean(r[tie])
+        return r
+
+    ra, rb = rank(a), rank(b)
+    sa, sb = np.std(ra), np.std(rb)
+    if sa == 0 or sb == 0:
+        return float("nan")
+    return float(np.mean((ra - np.mean(ra)) * (rb - np.mean(rb))) / (sa * sb))
+
+
+def signal_pck_correlation(events: Iterable[dict]) -> Dict[str, float]:
+    """Per-signal Spearman rank correlation between quality signals and
+    per-pair PCK, over every ``quality`` event that carries both (the
+    PF-Pascal eval emits them side by side).  The check that validates the
+    signals as label-free PCK proxies."""
+    pairs: Dict[str, List[Tuple[float, float]]] = {}
+    for e in events:
+        if e.get("event") != "quality" or not e.get("pck"):
+            continue
+        pck = e["pck"]
+        for name, vals in (e.get("signals") or {}).items():
+            if isinstance(vals, list) and len(vals) == len(pck):
+                pairs.setdefault(name, []).extend(zip(vals, pck))
+    return {
+        name: spearman([p[0] for p in ps], [p[1] for p in ps])
+        for name, ps in sorted(pairs.items())
+    }

@@ -23,6 +23,7 @@ from ncnet_tpu.ops.conv4d import (
 from ncnet_tpu.ops.nc_fused_lane import (  # noqa: F401
     choose_fused_stack,
     demote_fused_tier,
+    last_selected_tier,
     demoted_fused_tiers,
     fused_resident_feasible,
     nc_stack_resident,
@@ -39,6 +40,7 @@ from ncnet_tpu.ops.nc_fused_lane_vjp import (  # noqa: F401
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
 from ncnet_tpu.ops.matching import (
     Matches,
+    mutual_argmax_agreement,
     mutual_matching,
     corr_to_matches,
     nearest_neighbor_point_tnf,
@@ -71,6 +73,7 @@ __all__ = [
     "choose_fused_stack",
     "choose_fused_vjp",
     "demote_fused_tier",
+    "last_selected_tier",
     "demoted_fused_tiers",
     "fused_lane_feasible",
     "fused_resident_feasible",
@@ -81,6 +84,7 @@ __all__ = [
     "nc_stack_resident",
     "reset_fused_tier_demotions",
     "maxpool4d_with_argmax",
+    "mutual_argmax_agreement",
     "mutual_matching",
     "corr_to_matches",
     "nearest_neighbor_point_tnf",

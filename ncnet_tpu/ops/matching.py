@@ -34,6 +34,30 @@ def mutual_matching(corr: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     return corr * (ratio_a * ratio_b)
 
 
+def mutual_argmax_agreement(corr: jnp.ndarray) -> jnp.ndarray:
+    """Hard mutual-nearest-neighbour agreement ratio per pair.
+
+    The HARD twin of :func:`mutual_matching`'s soft gating: for each B cell
+    take its argmax A cell, then ask whether that A cell's own argmax points
+    back.  The returned ``(B,)`` fraction of B cells in a mutual-argmax
+    cycle is a label-free match-confidence signal — 1.0 for a volume whose
+    matches form a bijection (e.g. a delta-peaked/identity volume), near
+    ``1/(hB·wB)`` for an uninformative one (ties all collapse onto argmax's
+    first index).  Pure reductions/gathers: jits and shards freely, so the
+    quality-observability layer fuses it into the eval fetch.
+
+    Args:
+      corr: ``(B, hA, wA, hB, wB)``.
+    """
+    b, ha, wa, hb, wb = corr.shape
+    flat = corr.reshape(b, ha * wa, hb * wb)
+    best_a = jnp.argmax(flat, axis=1)   # (B, n_b): best A cell per B cell
+    best_b = jnp.argmax(flat, axis=2)   # (B, n_a): best B cell per A cell
+    back = jnp.take_along_axis(best_b, best_a, axis=1)  # (B, n_b)
+    agree = back == jnp.arange(hb * wb)[None, :]
+    return jnp.mean(agree.astype(jnp.float32), axis=1)
+
+
 def normalize_axis(x, length):
     """Pixel coord (1-indexed convention) → [-1, 1] (point_tnf.py:6-7)."""
     return (x - 1 - (length - 1) / 2) * 2 / (length - 1)

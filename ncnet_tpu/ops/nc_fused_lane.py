@@ -741,6 +741,7 @@ def reset_fused_tier_demotions() -> None:
     very probe this reset requests."""
     _runtime_demoted.clear()
     _emitted_choices.clear()
+    _last_selected.clear()
     from ncnet_tpu.ops import tier_cache
 
     tier_cache.clear()
@@ -752,8 +753,24 @@ def reset_fused_tier_demotions() -> None:
 # retrace of an unchanged decision
 _emitted_choices: dict = {}
 
+# most recent decision per STAGE ("forward" / "backward"), regardless of
+# shape — the "active fused tier" label the quality-observability layer
+# stamps on its signals (observability/quality.py::active_tier).  Updated on
+# EVERY chooser consult (not just decision changes), so a post-demotion
+# retrace relabels subsequent quality events immediately.
+_last_selected: dict = {}
+
+
+def last_selected_tier(stage: str = "forward"):
+    """The tier name the stage's chooser most recently decided on for ANY
+    shape ('resident' / 'perlayer' / 'resident_vjp' / 'xla'), or None when
+    the chooser has not run this process (a pure-XLA path that never
+    consulted it — fp32/CPU volumes)."""
+    return _last_selected.get(stage)
+
 
 def _emit_tier_selected(stage: str, sig, tier, cached: bool = False) -> None:
+    _last_selected[stage] = tier or "xla"
     if _emitted_choices.get((stage, sig)) == tier:
         return
     _emitted_choices[(stage, sig)] = tier

@@ -257,10 +257,22 @@ def weak_loss_and_grads(
         return jnp.sum(match_score_per_pair(nc, normalization) * wc)
 
     c = n2 // accum_chunks
-    chunked = lambda x: x.reshape(accum_chunks, c, *x.shape[1:])  # noqa: E731
 
-    def body(acc, xs):
-        fac, fbc, wc = xs
+    # the scan walks CHUNK INDICES and dynamic-slices the 2B-volume operands
+    # inside the body, NOT a pre-chunked (chunks, c, ...) reshape of them.
+    # The two are the same program in principle, but under a data-parallel
+    # pair-axis sharding this container's CPU XLA MISCOMPILES the reshaped
+    # form: reshaping the sharded-concatenated feature batch to
+    # (chunks, c, ...) and consuming a scanned slice through the symmetric
+    # batch-fold (concat([x, xT]) → conv → y[:b] + y[b:]) returns wrong
+    # VALUES (≈2× off at chunk parity, worse elsewhere — reproduced outside
+    # this module with the fold alone; the two-pass form is unaffected).
+    # Slicing the operands in the body sidesteps the bad partition and is
+    # bitwise-identical on a single device.
+    def body(acc, i):
+        fac = lax.dynamic_slice_in_dim(fa2, i * c, c, axis=0)
+        fbc = lax.dynamic_slice_in_dim(fb2, i * c, c, axis=0)
+        wc = lax.dynamic_slice_in_dim(w2, i * c, c, axis=0)
         val, g_nc = jax.value_and_grad(chunk_loss)(params["nc"], fac, fbc, wc)
         return (
             acc[0] + val,
@@ -268,9 +280,7 @@ def weak_loss_and_grads(
         ), None
 
     zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params["nc"]))
-    (loss, g_nc), _ = lax.scan(
-        body, zero, (chunked(fa2), chunked(fb2), chunked(w2))
-    )
+    (loss, g_nc), _ = lax.scan(body, zero, jnp.arange(accum_chunks))
     # zero gradients for the (detached) trunk — the optax frozen partition
     # expects the full param tree structure
     grads = {

@@ -450,6 +450,29 @@ def process_epoch(
                     fields["mfu_pct"] = round(
                         100.0 * (flops * pairs / wall / 1e12) / peak, 2)
                 obs_events.emit("step", **fields)
+                # per-step weak-loss health signal: the pos/neg score gap
+                # (score(pos) − score(neg) = −loss, since the weak loss is
+                # score(neg) − score(pos)).  A healthy run's gap GROWS; a
+                # low-precision tier regression or poisoned data shrinks it
+                # long before a labeled eval would notice.  Emitted as a
+                # `quality` event tagged with the active fused tier and
+                # digested in the registry, exactly like the eval signals.
+                if math.isfinite(loss_f):
+                    from ncnet_tpu.observability.quality import (
+                        active_tier,
+                        emit_quality,
+                    )
+
+                    emit_quality(
+                        "train", {"score_gap": [-loss_f]},
+                        # training's tier is the BACKWARD chooser's (the
+                        # step runs the fused stack only where the Pallas
+                        # VJP engages); eligibility rides in from fit's
+                        # model config — an fp32 step is xla by definition
+                        tier=active_tier(ctx.get("nc_bf16", False),
+                                         stage="backward"),
+                        registry=registry, step=gstep, epoch=epoch,
+                    )
                 if registry is not None:
                     registry.timer("step_wall").observe(wall)
                     registry.timer("stage_wall").observe(stage_wall)
@@ -1063,6 +1086,11 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
         telemetry_ctx.update(
             registry=train_registry,
             peak_tflops=device_peak_tflops(),
+            # quality-event tier eligibility: the step can only have routed
+            # through a fused Pallas tier when the NC stack ran bf16 with
+            # the Pallas VJP permitted
+            nc_bf16=bool(model_config.half_precision
+                         and config.nc_pallas_vjp),
         )
         try:
             from ncnet_tpu.models.ncnet import extract_features
@@ -1167,6 +1195,16 @@ def fit(config: TrainConfig, progress: bool = True) -> Dict[str, Any]:
                         v = snap.get(name)
                         if isinstance(v, (int, float)):
                             summary[key] = float(v)
+                    # accuracy trajectory: the run's mean pos/neg score gap
+                    # (higher-is-better by name inference) gates the NEXT
+                    # run's weak-supervision health like the walls.  MEAN,
+                    # not the digest p50: the histogram's [-1,1]/32-bin
+                    # median quantizes at ~0.06 — coarser than a typical
+                    # early-training gap — while count/sum are exact
+                    gap = snap.get("q_score_gap")
+                    if isinstance(gap, dict) and gap.get("count") \
+                            and isinstance(gap.get("mean"), (int, float)):
+                        summary["train_quality_score_gap"] = gap["mean"]
                     perfstore.maybe_record(
                         summary, source="fit", run_id=telemetry.run_id)
                 # global emit, not telemetry.emit: a disk-full append in a

@@ -1,0 +1,474 @@
+"""Match-quality observability (ISSUE 7): signal correctness on synthetic
+volumes, digest accuracy, the drift sentinel, resume-merged digests, and THE
+acceptance path — a synthetic PF-Pascal eval emitting tier-tagged per-pair
+quality events whose rank correlation against PCK is positive and whose
+distributions gate against the committed reference
+(``perf/quality_ref.jsonl``) via ``tools/quality_drift.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ncnet_tpu.observability.metrics import Histogram, MetricsRegistry
+from ncnet_tpu.observability.quality import (
+    DIGEST_BINS,
+    QUALITY_SIGNALS,
+    SIGNAL_RANGE,
+    check_drift,
+    digests_from_events,
+    load_reference,
+    psi,
+    quality_signals,
+    quality_table,
+    signal_pck_correlation,
+    spearman,
+    write_reference,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import quality_drift  # noqa: E402  (tools/quality_drift.py)
+
+
+# ---------------------------------------------------------------------------
+# signal correctness on synthetic volumes
+# ---------------------------------------------------------------------------
+
+
+def _identity_volume(side=5, peak=30.0):
+    corr = np.zeros((1, side, side, side, side), np.float32)
+    for i in range(side):
+        for j in range(side):
+            corr[0, i, j, i, j] = peak
+    return jnp.asarray(corr)
+
+
+def test_delta_peaked_volume_scores_confident():
+    """A delta-peaked (identity) volume is maximally confident: ~1.0
+    margin/agreement/score, ~0 entropy, perfectly coherent flow."""
+    s = {k: float(v[0]) for k, v in quality_signals(_identity_volume()).items()}
+    assert s["margin"] > 0.95
+    assert s["mnn_agreement"] == 1.0
+    assert s["score"] > 0.95
+    assert s["entropy"] < 0.05
+    assert s["coherence"] == 1.0
+
+
+def test_uniform_volume_scores_max_entropy():
+    """A constant (uninformative) volume scores maximum normalized entropy
+    and zero margin — softmax over A cells is exactly uniform."""
+    s = {k: float(v[0])
+         for k, v in quality_signals(jnp.zeros((1, 5, 5, 5, 5))).items()}
+    assert s["entropy"] == pytest.approx(1.0, abs=1e-5)
+    assert s["margin"] == pytest.approx(0.0, abs=1e-6)
+    assert s["score"] == pytest.approx(1.0 / 25.0, abs=1e-6)
+    # the collapsed constant-argmax field must NOT read as a perfect flow:
+    # the coherence band sits strictly below one grid step by design
+    assert s["coherence"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_shifted_volume_is_coherent_random_is_not():
+    """A rigid one-cell shift keeps a smooth displacement field (only the
+    clamped border row breaks the step pattern); spatially-incoherent
+    argmax noise does not."""
+    side = 6
+    shifted = np.zeros((1, side, side, side, side), np.float32)
+    for i in range(side):
+        for j in range(side):
+            shifted[0, min(i + 1, side - 1), j, i, j] = 20.0
+    s = quality_signals(jnp.asarray(shifted))
+    # 60 adjacent pairs, 6 broken by the border clamp (the last row's
+    # plateau counts incoherent under the strict sub-one-step band)
+    assert float(s["coherence"][0]) == pytest.approx(54 / 60, abs=1e-6)
+
+    rng = np.random.default_rng(3)
+    noise = rng.normal(0, 5, (1, side, side, side, side)).astype(np.float32)
+    r = quality_signals(jnp.asarray(noise))
+    assert float(r["coherence"][0]) < 0.5
+
+
+def test_quality_table_order_and_batch_independence():
+    """The stacked table lays columns out in QUALITY_SIGNALS order, and a
+    pair's signals do not depend on its batch neighbours."""
+    rng = np.random.default_rng(0)
+    v1 = rng.normal(0, 3, (1, 4, 4, 4, 4)).astype(np.float32)
+    v2 = rng.normal(0, 3, (1, 4, 4, 4, 4)).astype(np.float32)
+    both = quality_table(jnp.asarray(np.concatenate([v1, v2])))
+    one = quality_table(jnp.asarray(v1))
+    sigs = quality_signals(jnp.asarray(v1))
+    np.testing.assert_allclose(np.asarray(both)[0], np.asarray(one)[0],
+                               rtol=1e-6)
+    for i, name in enumerate(QUALITY_SIGNALS):
+        assert float(one[0, i]) == pytest.approx(float(sigs[name][0]),
+                                                 abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# digest accuracy + merge
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_digest_tracks_exact_percentiles():
+    rng = np.random.default_rng(1)
+    vals = np.clip(rng.normal(0.55, 0.15, 5000), 0, 1)
+    h = Histogram(0.0, 1.0, DIGEST_BINS)
+    h.add(vals)
+    bin_w = 1.0 / DIGEST_BINS
+    assert h.count == 5000
+    assert h.mean() == pytest.approx(float(np.mean(vals)), abs=1e-6)
+    for q in (50, 90):
+        assert h.percentile(q) == pytest.approx(
+            float(np.percentile(vals, q)), abs=bin_w)
+    # NaN is dropped, not binned
+    h2 = Histogram(0.0, 1.0, DIGEST_BINS)
+    h2.add([0.5, float("nan"), 0.5])
+    assert h2.count == 2
+
+
+def test_histogram_merge_equals_single_pass_and_roundtrips():
+    rng = np.random.default_rng(2)
+    vals = np.clip(rng.normal(0.4, 0.2, 1000), 0, 1)
+    whole = Histogram(0.0, 1.0, DIGEST_BINS)
+    whole.add(vals)
+    a, b = Histogram(0.0, 1.0, DIGEST_BINS), Histogram(0.0, 1.0, DIGEST_BINS)
+    a.add(vals[:300])
+    b.add(vals[300:])
+    a.merge(b)
+    assert a.counts == whole.counts and a.count == whole.count
+    assert a.sum == pytest.approx(whole.sum)
+    # snapshot → from_snapshot preserves the distribution (PSI exactly 0)
+    back = Histogram.from_snapshot(whole.snapshot())
+    assert psi(whole, back) == 0.0
+    with pytest.raises(ValueError):
+        a.merge(Histogram(0.0, 1.0, DIGEST_BINS + 1))
+
+
+def test_registry_histogram_binning_is_pinned():
+    reg = MetricsRegistry(scope="t")
+    h = reg.histogram("q_margin", 0.0, 1.0, DIGEST_BINS)
+    assert reg.histogram("q_margin", 0.0, 1.0, DIGEST_BINS) is h
+    with pytest.raises(ValueError):
+        reg.histogram("q_margin", 0.0, 2.0, DIGEST_BINS)
+    h.add([0.5])
+    assert reg.snapshot()["q_margin"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# drift sentinel: flags an injected shift, stays green on noise
+# ---------------------------------------------------------------------------
+
+
+def _digest_of(rng, mu, n=400, sigma=0.08):
+    h = Histogram(0.0, 1.0, DIGEST_BINS)
+    h.add(np.clip(rng.normal(mu, sigma, n), 0, 1))
+    return h
+
+
+def test_drift_sentinel_flags_shift_stays_green_on_noise(tmp_path):
+    rng = np.random.default_rng(5)
+    ref = {("resident", "score"): _digest_of(rng, 0.62)}
+    ref_path = str(tmp_path / "ref.jsonl")
+    write_reference(ref_path, ref, device_kind="TPU v5 lite")
+    reference = load_reference(ref_path)
+    assert ("TPU v5 lite", "resident", "score") in reference
+
+    # same distribution, fresh sampling noise → green
+    noisy = {("resident", "score"): _digest_of(rng, 0.62)}
+    findings = check_drift(reference, noisy, device_kind="TPU v5 lite")
+    assert [f["status"] for f in findings] == ["ok"]
+
+    # a bf16-style score shift (distribution moved down) → flagged
+    shifted = {("resident", "score"): _digest_of(rng, 0.45)}
+    findings = check_drift(reference, shifted, device_kind="TPU v5 lite")
+    assert [f["status"] for f in findings] == ["drift"]
+    assert findings[0]["psi"] > findings[0]["threshold"]
+
+    # series the reference cannot vouch for are skipped, never guessed:
+    # unknown signal, and a matching signal on a DIFFERENT device kind.
+    # Symmetrically, a reference series the run failed to produce at all
+    # (broken emitter / tier never executed) must SURFACE as skipped, not
+    # silently vanish from the findings
+    extra = {("resident", "margin"): _digest_of(rng, 0.5)}
+    findings = check_drift(reference, extra, device_kind="TPU v5 lite")
+    assert sorted(f["signal"] for f in findings) == ["margin", "score"]
+    assert all(f["status"] == "skipped" for f in findings)
+    missing = next(f for f in findings if f["signal"] == "score")
+    assert "absent from this run" in missing["reason"]
+    findings = check_drift(reference, noisy, device_kind="cpu")
+    assert [f["status"] for f in findings] == ["skipped"]
+
+
+def test_drift_tool_refuses_to_judge_zero_evidence(tmp_path):
+    """An accuracy gate must never report green on zero evidence: a log
+    with NO quality events is an input error (exit 2), not a clean run."""
+    from ncnet_tpu.observability.events import EventLog
+
+    p = str(tmp_path / "events.jsonl")
+    log = EventLog(p)
+    log.emit("run_start")
+    log.close()
+    committed = os.path.join(_REPO, "perf", "quality_ref.jsonl")
+    assert os.path.exists(committed)
+    assert quality_drift.main(["--check", p]) == 2
+
+
+def test_render_quality_survives_all_nan_series():
+    """A (tier, signal) series whose every sample was NaN (all pairs
+    quarantined under that tier) renders as n/a, not a TypeError."""
+    import run_report
+
+    events = [{"event": "quality", "tier": "resident",
+               "signals": {"score": [float("nan")]}}]
+    section = run_report.build_quality_section(events, "cpu")
+    assert section["table"][0]["n"] == 0
+    report = {"quality": section}
+    text = run_report.render_quality(report)
+    assert "n/a" in text
+
+
+def test_perf_store_direction_inference_for_quality_metrics():
+    """Satellite: quality_* series gate with the stated directions."""
+    from ncnet_tpu.observability.perfstore import metric_direction
+
+    assert metric_direction("pf_pascal_pck") == "higher"
+    assert metric_direction("pf_pascal_quality_margin") == "higher"
+    assert metric_direction("pf_pascal_quality_mnn_agreement") == "higher"
+    assert metric_direction("pf_pascal_quality_coherence") == "higher"
+    assert metric_direction("pf_pascal_quality_score") == "higher"
+    assert metric_direction("pf_pascal_quality_entropy") == "lower"
+    assert metric_direction("train_quality_score_gap") == "higher"
+
+
+def test_spearman_rank_correlation():
+    assert spearman([1, 2, 3, 4], [10, 20, 30, 40]) == pytest.approx(1.0)
+    assert spearman([1, 2, 3, 4], [4, 3, 2, 1]) == pytest.approx(-1.0)
+    assert np.isnan(spearman([1, 1, 1], [1, 2, 3]))   # constant side
+    assert np.isnan(spearman([1, 2], [2, 1]))         # too few pairs
+    # NaN pairs are dropped, ties get average ranks
+    r = spearman([1, 2, 2, 3, np.nan], [1, 2, 2, 3, 99])
+    assert r == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance path: synthetic eval → tier-tagged events → run_report
+# correlation → drift gate green vs committed ref, red on perturbation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    work = str(tmp_path_factory.mktemp("quality_clean"))
+    stats, events_path = quality_drift.synthetic_reference_run(work)
+    return stats, events_path
+
+
+def test_eval_emits_tier_tagged_per_pair_quality_events(clean_run):
+    """Every PF-Pascal eval batch emits one `quality` event carrying
+    per-pair signals AND per-pair PCK, tagged with the active fused tier —
+    with zero per-pair Python postprocessing on the hot path (the signals
+    arrive in the same fetched table as the PCK column)."""
+    from ncnet_tpu.observability.events import replay_events
+
+    stats, events_path = clean_run
+    _, events = replay_events(events_path)
+    qevents = [e for e in events if e.get("event") == "quality"]
+    n_batches = quality_drift.SYNTH_PAIRS // quality_drift.SYNTH_BATCH
+    assert len(qevents) == n_batches
+    for e in qevents:
+        assert e["scope"] == "pf_pascal_eval"
+        assert e["tier"] == "xla"  # CPU backend: no Pallas chooser ran
+        assert set(e["signals"]) == set(QUALITY_SIGNALS)
+        for vals in e["signals"].values():
+            assert len(vals) == quality_drift.SYNTH_BATCH
+        assert len(e["pck"]) == quality_drift.SYNTH_BATCH
+    # the eval summary carries the per-signal digests (metrics registry)
+    summaries = [e for e in events if e.get("event") == "eval_summary"
+                 and isinstance(e.get("metrics"), dict)]
+    assert summaries
+    snap = summaries[-1]["metrics"]
+    for name in QUALITY_SIGNALS:
+        assert snap[f"q_{name}"]["count"] == quality_drift.SYNTH_PAIRS
+    # and the stats dict exposes the same aggregation
+    assert stats["quality_tier"] == "xla"
+    for name in QUALITY_SIGNALS:
+        assert stats["quality_digests"][name]["count"] == \
+            quality_drift.SYNTH_PAIRS
+        assert len(stats["quality"][name]) == quality_drift.SYNTH_PAIRS
+
+
+def test_signals_rank_correlate_with_pck(clean_run):
+    """The confident/scrambled pair mix must produce a POSITIVE Spearman
+    rho between each confidence signal and PCK (entropy: negative) — the
+    signals are validated as label-free PCK proxies, both in the eval's own
+    stats and through run_report --quality."""
+    stats, events_path = clean_run
+    rho = stats["quality_pck_spearman"]
+    for name in ("score", "margin", "mnn_agreement", "coherence"):
+        assert rho[name] > 0.3, f"{name}: rho={rho[name]}"
+    assert rho["entropy"] < -0.3
+
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import run_report
+
+    report = run_report.build_report([events_path])
+    q = report["quality"]
+    assert q["pck_spearman"]["margin"] > 0.3
+    assert q["pck_spearman"]["entropy"] < -0.3
+    rows = {(r["tier"], r["signal"]): r for r in q["table"]}
+    assert rows[("xla", "margin")]["n"] == quality_drift.SYNTH_PAIRS
+    text = run_report.render_quality(report)
+    assert "signal-vs-PCK rank correlation" in text
+
+    # event-level correlation helper agrees with the stats-level one
+    from ncnet_tpu.observability.events import replay_events
+
+    _, events = replay_events(events_path)
+    rho_ev = signal_pck_correlation(events)
+    assert rho_ev["margin"] == pytest.approx(rho["margin"], abs=1e-6)
+
+
+def test_drift_gate_green_on_committed_ref_red_on_perturbation(
+        clean_run, tmp_path):
+    """quality_drift --check exits 0 against the COMMITTED reference for a
+    clean run of the pinned fixture, and nonzero when the volume is
+    perturbed to simulate a low-precision tier regression."""
+    _, events_path = clean_run
+    committed = os.path.join(_REPO, "perf", "quality_ref.jsonl")
+    assert os.path.exists(committed), "committed quality_ref.jsonl missing"
+    assert quality_drift.main(["--check", events_path]) == 0
+
+    work = str(tmp_path / "perturbed")
+    os.makedirs(work)
+    _, bad_events = quality_drift.synthetic_reference_run(work, perturb=True)
+    assert quality_drift.main(["--check", bad_events]) == 1
+
+    # run_report --quality shows the same verdicts inline
+    import run_report
+
+    report = run_report.build_report([bad_events], quality_ref=committed)
+    drift = {(f["tier"], f["signal"]): f["status"]
+             for f in report["quality"]["drift"]}
+    assert "drift" in drift.values()
+
+
+def test_quality_counters_in_trace_export(clean_run, tmp_path):
+    """quality + metrics events render as Perfetto counter ('C') tracks on
+    the same timeline as the spans."""
+    import trace_export
+
+    _, events_path = clean_run
+    trace = trace_export.build_trace([events_path])
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    names = {e["name"] for e in counters}
+    assert any(n.startswith("quality/pf_pascal_eval/xla") for n in names)
+    assert any(n.startswith("metrics/") for n in names)
+    qc = next(e for e in counters
+              if e["name"].startswith("quality/pf_pascal_eval"))
+    assert set(QUALITY_SIGNALS) <= set(qc["args"])
+    assert all(isinstance(v, float) for v in qc["args"].values())
+    # a quality/metrics event never also renders as an instant marker
+    instants = {e["name"] for e in trace["traceEvents"] if e["ph"] == "i"}
+    assert "quality" not in instants and "metrics" not in instants
+    # still a loadable Chrome trace document
+    json.dumps(trace)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL-mid-eval resume: merged digests match an uninterrupted run
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_resume_merged_digests_match_uninterrupted(tmp_path):
+    """SIGKILL mid-journal-append; the resumed run replays journaled
+    batches into the quality digests (no re-dispatch), and the merged
+    digests — replayed + fresh — are identical to an uninterrupted run's."""
+    from ncnet_tpu.data.synthetic import write_pf_pascal_like
+    from ncnet_tpu import models
+    from ncnet_tpu.config import EvalPFPascalConfig, ModelConfig
+    from ncnet_tpu.evaluation import run_eval
+
+    root = str(tmp_path / "data")
+    write_pf_pascal_like(root, n_pairs=3, image_hw=(96, 96), shift=(16, 16),
+                         seed=7)
+    journal_dir = str(tmp_path / "j")
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {_REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from ncnet_tpu import models
+from ncnet_tpu.config import EvalPFPascalConfig, ModelConfig
+from ncnet_tpu.evaluation import run_eval
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                   ncons_channels=(1,))
+config = EvalPFPascalConfig(image_size=96, eval_dataset_path={root!r},
+                            journal_dir={journal_dir!r})
+run_eval(config, net=models.NCNet(TINY, seed=0), batch_size=1,
+         num_workers=0, progress=False)
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NCNET_TPU_FAULTS"] = json.dumps({"kill_at_journal_append": 2})
+    proc = subprocess.run(
+        [sys.executable, str(worker)], env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=600,
+    )
+    assert proc.returncode == -9, f"expected SIGKILL:\n{proc.stdout[-3000:]}"
+
+    tiny = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                       ncons_channels=(1,))
+
+    def run(journal=""):
+        cfg = EvalPFPascalConfig(image_size=96, eval_dataset_path=root,
+                                 journal_dir=journal)
+        return run_eval(cfg, net=models.NCNet(tiny, seed=0), batch_size=1,
+                        num_workers=0, progress=False)
+
+    resumed = run(journal=journal_dir)
+    full = run()
+    np.testing.assert_array_equal(resumed["per_pair"], full["per_pair"])
+    for name in QUALITY_SIGNALS:
+        np.testing.assert_array_equal(resumed["quality"][name],
+                                      full["quality"][name])
+        assert resumed["quality_digests"][name]["counts"] == \
+            full["quality_digests"][name]["counts"]
+        assert resumed["quality_digests"][name]["count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# digests_from_events binning follows the reference
+# ---------------------------------------------------------------------------
+
+
+def test_digests_from_events_respects_reference_binning():
+    events = [
+        {"event": "quality", "tier": "resident",
+         "signals": {"score": [0.2, 0.4], "margin": [0.1]}},
+        {"event": "quality", "tier": "resident",
+         "signals": {"score": [0.6, float("nan")]}},
+        {"event": "other"},
+    ]
+    digs = digests_from_events(events)
+    assert digs[("resident", "score")].count == 3  # NaN dropped
+    assert digs[("resident", "margin")].count == 1
+    # reference-provided binning overrides the default
+    digs = digests_from_events(
+        events, bins_like={"score": {"lo": 0.0, "hi": 2.0,
+                                     "counts": [0] * 8}})
+    h = digs[("resident", "score")]
+    assert (h.lo, h.hi, h.bins) == (0.0, 2.0, 8)
+    # default binning comes from SIGNAL_RANGE
+    lo, hi = SIGNAL_RANGE["margin"]
+    hm = digs[("resident", "margin")]
+    assert (hm.lo, hm.hi, hm.bins) == (lo, hi, DIGEST_BINS)

@@ -206,7 +206,22 @@ def test_train_cli_smoke(tmp_path, capsys):
 
 def test_data_parallel_matches_single_device(tmp_path):
     """fit() on the 8-virtual-device CPU mesh (data-parallel path) must match
-    the single-device run batch for batch."""
+    the single-device run batch for batch.
+
+    History (round 10): this failed on the clean seed in this container —
+    the FIRST step's loss already differed by ~2e-3 (far beyond f32
+    reassociation noise), i.e. the sharded program computed wrong VALUES.
+    Root cause: this jaxlib's CPU GSPMD partitioner miscompiles
+    ``weak_loss_and_grads``'s chunked scan when the scanned operands are a
+    ``reshape(chunks, c, ...)`` of the sharded-concatenated feature batch
+    and the body runs the symmetric batch-fold
+    (``conv4d(concat([x, xT])) → y[:b] + y[b:]``): the folded halves
+    resolve to wrong slices (reproduced standalone at exactly 4× the true
+    sum with the conv replaced by identity; the two-pass form and the
+    no-scan form are both correct).  Fixed at the root in
+    ``training/loss.py``: the scan walks chunk INDICES and
+    ``dynamic_slice``s the operands inside the body — bitwise-identical on
+    one device, correct under sharding."""
     root = str(tmp_path / "data")
     write_pair_dataset(root, n_pairs=8, image_hw=(48, 48), shift=(16, 16), seed=3)
 

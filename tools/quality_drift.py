@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Match-quality drift sentinel: gate a run's quality-signal distributions
+against the committed reference (``perf/quality_ref.jsonl``).
+
+The quality layer (``ncnet_tpu/observability/quality.py``) streams per-pair
+label-free signals — softmax score, match entropy, top1-top2 margin, hard
+mutual-NN agreement, displacement coherence — into the event log as
+``quality`` events tagged with the active fused tier.  This tool is the gate
+on top, the accuracy twin of ``tools/perf_regress.py``:
+
+  * ``--check``: rebuild per-``(tier, signal)`` histogram digests from one
+    or more event logs (binned exactly like the reference), score each
+    against the committed reference distribution for the log's device kind
+    with a PSI divergence (< 0.1 no shift, 0.1-0.25 moderate, > 0.25
+    major — the default threshold), and **exit 1 on drift**.  A bf16 tier
+    promotion, a CP/FFT conv4d prototype, or a quarantine-degraded run that
+    shifts match quality fails the job between labeled evals — this is the
+    standing accuracy gate new kernel tiers run under (ROADMAP items 2-4).
+  * ``--seed-ref``: (re)write the reference file from event logs of a CLEAN
+    eval of the committed weights, or — with ``--synthetic`` — from the
+    pinned deterministic synthetic PF-Pascal CPU eval this repo's tier-1
+    tests replay (the committed ``perf/quality_ref.jsonl`` is produced this
+    way; README "Quality observability" documents the re-seed policy).
+
+Usage::
+
+    python tools/quality_drift.py --check events.jsonl [more.jsonl ...]
+        [--ref perf/quality_ref.jsonl] [--threshold 0.25] [--json]
+    python tools/quality_drift.py --seed-ref events.jsonl [--ref ...]
+    python tools/quality_drift.py --seed-ref --synthetic [--ref ...]
+
+Exit codes: 0 = no drift (or seed OK; unjudgeable series are reported as
+skipped, never guessed), 1 = drift detected, 2 = usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ncnet_tpu.observability.events import replay_events  # noqa: E402
+from ncnet_tpu.observability.quality import (  # noqa: E402
+    DEFAULT_PSI_THRESHOLD,
+    check_drift,
+    default_reference_path,
+    digests_from_events,
+    load_reference,
+    reference_binning,
+    write_reference,
+)
+
+_out = sys.stdout.write
+_err = sys.stderr.write
+
+# the pinned synthetic fixture: what the committed reference was seeded from
+# and what the tier-1 drift test replays.  Changing ANY of these re-defines
+# the reference distribution — re-seed perf/quality_ref.jsonl in the same
+# commit.
+SYNTH_SEED = 11
+SYNTH_PAIRS = 12
+SYNTH_SCRAMBLED = 4          # trailing pairs whose target is unrelated
+SYNTH_IMAGE_HW = (96, 96)
+SYNTH_SHIFT = (16, 16)
+SYNTH_BATCH = 2
+
+
+def synthetic_reference_run(workdir: str, perturb: bool = False):
+    """Run the pinned deterministic synthetic PF-Pascal eval on this
+    backend; returns ``(stats, events_path)``.
+
+    The fixture mixes confident pairs (exact feature-cell shifts the
+    identity NC stack recovers, PCK ~1) with scrambled pairs (unrelated
+    target textures: diffuse match distributions, PCK ~0), so the
+    signal-vs-PCK rank correlation is measurable and the reference
+    distribution spans both regimes.  Everything is seed-pinned — dataset,
+    trunk init, loader order — so two runs on one backend produce
+    bit-identical signals, which is what lets the committed reference gate
+    at PSI ≈ 0.
+
+    ``perturb=True`` coarsely quantizes the filtered volume before match
+    extraction — the injected stand-in for a low-precision kernel-tier
+    regression the drift gate must flag.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    from PIL import Image
+
+    from ncnet_tpu import models
+    from ncnet_tpu.config import EvalPFPascalConfig, ModelConfig
+    from ncnet_tpu.data.synthetic import _textured_image, write_pf_pascal_like
+    from ncnet_tpu.evaluation.pf_pascal import run_eval
+
+    data = os.path.join(workdir, "data")
+    write_pf_pascal_like(data, n_pairs=SYNTH_PAIRS, image_hw=SYNTH_IMAGE_HW,
+                         shift=SYNTH_SHIFT, seed=SYNTH_SEED)
+    # scramble the trailing pairs' targets: unrelated texture, keypoints
+    # kept — low PCK AND diffuse (low-confidence) match distributions
+    rng = np.random.default_rng(SYNTH_SEED + 1)
+    h, w = SYNTH_IMAGE_HW
+    for i in range(SYNTH_PAIRS - SYNTH_SCRAMBLED, SYNTH_PAIRS):
+        Image.fromarray(_textured_image(rng, h, w)).save(
+            os.path.join(data, "images", f"test_{i}_b.jpg"), quality=95)
+
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,))
+    net = models.NCNet(cfg, seed=0)
+    iw = np.zeros((3, 3, 3, 3, 1, 1), np.float32)
+    iw[1, 1, 1, 1, 0, 0] = 1.0
+    net.params["nc"] = [{"w": jnp.asarray(iw), "b": jnp.zeros((1,))}]
+    if perturb:
+        orig = net.forward_fn
+
+        def forward_fn(params, src, tgt):
+            out = orig(params, src, tgt)
+            # coarse value quantization of the filtered volume: the shape
+            # of a numeric-precision regression (scores flatten, margins
+            # shrink) without modeling any one kernel's exact rounding
+            step = 0.05 * jnp.max(jnp.abs(out.corr))
+            return out._replace(
+                corr=jnp.round(out.corr / step) * step)
+
+        net.forward_fn = forward_fn
+
+    tdir = os.path.join(workdir, "telemetry")
+    ecfg = EvalPFPascalConfig(eval_dataset_path=data, image_size=96,
+                              telemetry_dir=tdir)
+    # a fixture eval is NOT a perf datapoint: its walls/PCK must never be
+    # ingested into the committed cross-run history the regression sentinel
+    # gates on (the env knob is restored after the run)
+    from ncnet_tpu.observability.perfstore import STORE_ENV
+
+    prev = os.environ.get(STORE_ENV)
+    os.environ[STORE_ENV] = "off"
+    try:
+        stats = run_eval(ecfg, net=net, batch_size=SYNTH_BATCH,
+                         num_workers=0, progress=False)
+    finally:
+        if prev is None:
+            os.environ.pop(STORE_ENV, None)
+        else:
+            os.environ[STORE_ENV] = prev
+    return stats, os.path.join(tdir, "events.jsonl")
+
+
+def _load_logs(paths: List[str]):
+    """Replay logs → (device_kind, events).  The device kind comes from the
+    first header that names one — digests are only comparable within one
+    backend, so it keys the reference lookup."""
+    events: List[dict] = []
+    device_kind: Optional[str] = None
+    for path in paths:
+        header, recs = replay_events(path)
+        events.extend(recs)
+        if device_kind is None:
+            device_kind = (header.get("header") or {}).get("device_kind")
+    return device_kind, events
+
+
+def _render(findings: List[dict]) -> str:
+    n_drift = sum(1 for f in findings if f["status"] == "drift")
+    n_ok = sum(1 for f in findings if f["status"] == "ok")
+    n_skip = sum(1 for f in findings if f["status"] == "skipped")
+    lines = [f"=== quality_drift: {n_drift} drift(s), {n_ok} ok, "
+             f"{n_skip} skipped ==="]
+    for f in findings:
+        tag = {"drift": "DRIFT", "ok": "ok", "skipped": "skipped"}[f["status"]]
+        line = (f"[{tag}] {f['tier']}/{f['signal']} "
+                f"({f['device_kind']}): n={f['count']}")
+        if f.get("mean") is not None:
+            line += f" mean={f['mean']:.4f}"
+        if f["status"] == "skipped":
+            line += f"  ({f['reason']})"
+        else:
+            line += (f"  psi={f['psi']:.4f} (threshold {f['threshold']}) "
+                     f"ref: n={f['ref_count']} mean={f['ref_mean']:.4f}")
+        lines.append(line)
+    if not findings:
+        lines.append("(no quality events in the given logs)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate quality-signal distributions against the "
+                    "committed reference")
+    ap.add_argument("logs", nargs="*", help="events.jsonl file(s)")
+    ap.add_argument("--ref", default=None,
+                    help="reference file (default: perf/quality_ref.jsonl)")
+    ap.add_argument("--check", action="store_true",
+                    help="judge the logs' digests against the reference; "
+                         "exit 1 on drift")
+    ap.add_argument("--seed-ref", action="store_true",
+                    help="(re)write the reference from the logs (or "
+                         "--synthetic)")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="with --seed-ref: run the pinned synthetic CPU "
+                         "eval and seed from it")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_PSI_THRESHOLD,
+                    help=f"PSI drift threshold (default "
+                         f"{DEFAULT_PSI_THRESHOLD})")
+    ap.add_argument("--min-count", type=int, default=4,
+                    help="samples required before judging a series "
+                         "(default 4)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as one JSON document")
+    args = ap.parse_args(argv)
+
+    ref_path = args.ref or default_reference_path()
+    if not args.check and not args.seed_ref:
+        _err("quality_drift: nothing to do (pass --check and/or "
+             "--seed-ref)\n")
+        return 2
+
+    logs = list(args.logs)
+    if args.seed_ref and args.synthetic:
+        import tempfile
+
+        work = tempfile.mkdtemp(prefix="quality_ref_")
+        _err(f"running the pinned synthetic reference eval under {work}\n")
+        _, events_path = synthetic_reference_run(work)
+        logs = [events_path] + logs
+
+    if not logs:
+        _err("quality_drift: no event logs given\n")
+        return 2
+    try:
+        device_kind, events = _load_logs(logs)
+    except (OSError, ValueError) as e:
+        _err(f"quality_drift: cannot replay logs: {e}\n")
+        return 2
+
+    if args.seed_ref:
+        digests = digests_from_events(events)
+        n = write_reference(
+            ref_path, digests, device_kind=device_kind,
+            meta={"logs": [os.path.basename(p) for p in logs]},
+        )
+        _err(f"seeded {n} reference series into {ref_path}\n")
+        if not args.check:
+            return 0
+
+    reference = load_reference(ref_path)
+    if not reference:
+        _err(f"quality_drift: reference {ref_path} is missing or empty\n")
+        return 2
+    # bin the current run exactly like the reference per signal (the ref
+    # self-describes its binning)
+    current = digests_from_events(
+        events, bins_like=reference_binning(reference))
+    if not current:
+        # an accuracy gate must never report green on zero evidence: a log
+        # with no quality events means the emitter is broken or the wrong
+        # file was passed — an input error, not a clean run
+        _err("quality_drift: no quality events in the given logs — "
+             "nothing to judge (broken emitter, or wrong events file?)\n")
+        return 2
+    findings = check_drift(reference, current, device_kind=device_kind,
+                           threshold=args.threshold,
+                           min_count=args.min_count)
+    if args.json:
+        _out(json.dumps({"ref": ref_path, "findings": findings},
+                        indent=2, sort_keys=True) + "\n")
+    else:
+        _out(_render(findings))
+    return 1 if any(f["status"] == "drift" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

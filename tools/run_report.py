@@ -112,7 +112,58 @@ def build_span_breakdown(events: List[dict]) -> Dict[str, Any]:
     return {"groups": out, "closed": len(closed), "unclosed": unclosed}
 
 
-def build_report(paths: List[str]) -> Dict[str, Any]:
+def build_quality_section(events: List[dict],
+                          device_kind: Optional[str],
+                          ref_path: Optional[str] = None) -> Dict[str, Any]:
+    """Aggregate the ``quality`` events (observability/quality.py): a
+    per-(tier, signal) stats table, the signal-vs-PCK rank correlation where
+    labels rode along (the PF-Pascal eval emits them side by side — a
+    positive rho validates the signal as a label-free PCK proxy), and —
+    when a reference file is given/committed — the PSI drift verdicts the
+    standalone ``tools/quality_drift.py`` gate would report."""
+    from ncnet_tpu.observability.quality import (
+        check_drift,
+        digests_from_events,
+        load_reference,
+        reference_binning,
+        signal_pck_correlation,
+    )
+
+    # ONE aggregation pass serves both the stats table and the drift
+    # verdicts: when a reference exists its binning is applied up front
+    # (count/mean are binning-independent; table percentiles are ±bin
+    # width either way)
+    reference = {}
+    bins_like = None
+    if ref_path and os.path.exists(ref_path):
+        reference = load_reference(ref_path)
+        if reference:
+            bins_like = reference_binning(reference)
+    digests = digests_from_events(events, bins_like=bins_like)
+    table = []
+    for (tier, signal), h in sorted(digests.items()):
+        table.append({
+            "tier": tier, "signal": signal, "n": h.count,
+            "mean": round(h.mean(), 6) if h.count else None,
+            "p50": round(h.percentile(50), 6) if h.count else None,
+            "p90": round(h.percentile(90), 6) if h.count else None,
+        })
+    section: Dict[str, Any] = {
+        "table": table,
+        "pck_spearman": {
+            k: (None if v != v else round(v, 4))
+            for k, v in signal_pck_correlation(events).items()
+        },
+    }
+    if reference:
+        section["drift"] = check_drift(reference, digests,
+                                       device_kind=device_kind)
+        section["drift_ref"] = ref_path
+    return section
+
+
+def build_report(paths: List[str],
+                 quality_ref: Optional[str] = None) -> Dict[str, Any]:
     """Aggregate one report dict over every given event log."""
     runs: List[Dict[str, Any]] = []
     events: List[dict] = []
@@ -229,6 +280,12 @@ def build_report(paths: List[str]) -> Dict[str, Any]:
     }
     if any(e.get("event") == "span" for e in events):
         report["spans"] = build_span_breakdown(events)
+    if any(e.get("event") == "quality" for e in events):
+        device_kind = next(
+            (r["header"].get("device_kind") for r in runs
+             if r["header"].get("device_kind")), None)
+        report["quality"] = build_quality_section(
+            events, device_kind, ref_path=quality_ref)
     if eval_batches or eval_queries or eval_summaries:
         pcks = [e["pck"] for e in eval_batches
                 if isinstance(e.get("pck"), (int, float))]
@@ -270,6 +327,39 @@ def render_spans(report: Dict[str, Any]) -> str:
     if sp["unclosed"]:
         lines.append(f"  ({sp['unclosed']} unclosed span(s) — in flight at "
                      "process death)")
+    return "\n".join(lines)
+
+
+def render_quality(report: Dict[str, Any]) -> str:
+    q = report.get("quality")
+    if not q or not q["table"]:
+        return "(no quality events in the log)"
+    lines = ["quality signals (per tier):"]
+    # a (tier, signal) whose every sample was NaN (all pairs quarantined
+    # under that tier) has count 0 and None stats — render, don't crash
+    fmt = lambda v: "n/a" if v is None else v  # noqa: E731
+    for row in q["table"]:
+        lines.append(
+            f"  {row['tier']:<12} {row['signal']:<14} n={row['n']:<6} "
+            f"mean={fmt(row['mean']):<8} p50={fmt(row['p50']):<8} "
+            f"p90={fmt(row['p90'])}")
+    rho = q.get("pck_spearman")
+    if rho:
+        lines.append("signal-vs-PCK rank correlation (Spearman):")
+        for name, v in sorted(rho.items()):
+            lines.append(f"  {name:<14} rho={'n/a' if v is None else v}")
+    drift = q.get("drift")
+    if drift is not None:
+        lines.append(f"drift vs {q.get('drift_ref')}:")
+        for f in drift:
+            if f["status"] == "skipped":
+                lines.append(f"  [skipped] {f['tier']}/{f['signal']}  "
+                             f"({f['reason']})")
+            else:
+                tag = "DRIFT" if f["status"] == "drift" else "ok"
+                lines.append(
+                    f"  [{tag}] {f['tier']}/{f['signal']}  "
+                    f"psi={f['psi']:.4f} (threshold {f['threshold']})")
     return "\n".join(lines)
 
 
@@ -360,8 +450,20 @@ def main(argv=None) -> int:
     ap.add_argument("--spans", action="store_true",
                     help="append the span critical-path breakdown "
                          "(self-time vs child-time per phase)")
+    ap.add_argument("--quality", action="store_true",
+                    help="append the match-quality section: per-tier "
+                         "signal table, drift verdicts vs the committed "
+                         "reference, signal-vs-PCK rank correlation")
+    ap.add_argument("--quality-ref", default=None,
+                    help="reference distributions for the drift verdicts "
+                         "(default: perf/quality_ref.jsonl)")
     args = ap.parse_args(argv)
-    report = build_report(args.logs)
+    quality_ref = None
+    if args.quality or args.quality_ref:
+        from ncnet_tpu.observability.quality import default_reference_path
+
+        quality_ref = args.quality_ref or default_reference_path()
+    report = build_report(args.logs, quality_ref=quality_ref)
     if args.json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
@@ -369,6 +471,9 @@ def main(argv=None) -> int:
         if args.spans:
             print()
             print(render_spans(report))
+        if args.quality:
+            print()
+            print(render_quality(report))
     return 0
 
 

@@ -17,6 +17,13 @@ https://ui.perfetto.dev and chrome://tracing load directly:
   * non-span events (step, checkpoint_commit, tier_selected, retry,
     quarantine, …) become instant ("i") markers on a dedicated track, so
     the trace shows the run's milestones against its time structure;
+  * ``quality`` and ``metrics`` events become counter ("C") tracks —
+    Perfetto renders them as stacked value-over-time plots, so a
+    match-quality drift (observability/quality.py) is visible on the SAME
+    timeline as the spans that caused it (a tier demotion's quality cost
+    lines up under its ``tier_recovery`` span).  Per-pair signal lists
+    collapse to their mean per event; metrics snapshots contribute their
+    scalars (and timers their ``last_s``);
   * each run id in the lineage gets its own trace process, each recorded
     thread its own track, with "M" metadata records naming them.
 
@@ -53,6 +60,44 @@ _I_META = ("t", "run", "seq", "event")
 
 def _us(t: float) -> float:
     return t * 1e6
+
+
+def _finite_mean(vals) -> "float | None":
+    xs = [float(v) for v in vals
+          if isinstance(v, (int, float)) and not isinstance(v, bool)
+          and float(v) == float(v)]
+    return sum(xs) / len(xs) if xs else None
+
+
+def counter_events(e: dict) -> List[Dict[str, Any]]:
+    """Render one ``quality`` or ``metrics`` event as Chrome counter ("C")
+    args — numbers only (a counter track cannot plot strings or NaN).
+    Returns [] when nothing numeric survives."""
+    args: Dict[str, float] = {}
+    if e.get("event") == "quality":
+        name = f"quality/{e.get('scope', '?')}/{e.get('tier') or '?'}"
+        for sig, vals in (e.get("signals") or {}).items():
+            m = _finite_mean(vals if isinstance(vals, list) else [vals])
+            if m is not None:
+                args[sig] = m
+        pck = e.get("pck")
+        if isinstance(pck, list):
+            m = _finite_mean(pck)
+            if m is not None:
+                args["pck"] = m
+    else:  # metrics
+        name = f"metrics/{e.get('scope', '?')}"
+        for k, v in (e.get("metrics") or {}).items():
+            if isinstance(v, dict):
+                # timer/histogram snapshot: the most recent wall (timers)
+                # or the running mean (histograms) is the plottable scalar
+                v = v.get("last_s", v.get("mean"))
+            m = _finite_mean([v])
+            if m is not None:
+                args[k] = m
+    if not args:
+        return []
+    return [{"name": name, "args": args}]
 
 
 def build_trace(paths: List[str]) -> Dict[str, Any]:
@@ -99,6 +144,26 @@ def build_trace(paths: List[str]) -> Dict[str, Any]:
         for e in events:
             run = e.get("run", "?")
             pid = pid_for(run, head)
+            if e.get("event") == "quality" or \
+                    isinstance(e.get("metrics"), dict):
+                # value-over-time payloads render as counter tracks —
+                # Perfetto plots them beside the spans, which is exactly
+                # how a quality drift is seen against its cause.  Registry
+                # flushes carry their snapshot under `metrics` whatever the
+                # event name (fit flushes as `metrics`, the eval loops as
+                # `eval_summary`): the snapshot becomes counter samples
+                # either way, and an event that is MORE than a flush
+                # (eval_summary's completed/quarantined fields) also keeps
+                # its instant marker below, minus the plotted snapshot.
+                for c in counter_events(e):
+                    trace_events.append({
+                        "ph": "C", "name": c["name"], "pid": pid, "tid": 0,
+                        "ts": _us(float(e.get("t", 0.0))),
+                        "cat": "counter", "args": c["args"],
+                    })
+                if e.get("event") in ("quality", "metrics"):
+                    continue
+                e = {k: v for k, v in e.items() if k != "metrics"}
             if e.get("event") != "span":
                 args = {k: v for k, v in e.items() if k not in _I_META}
                 trace_events.append({
