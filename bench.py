@@ -669,6 +669,95 @@ def bench_jax(res=None):
 
         put("inloc_matcher_s_per_pair", inloc_with_percentiles,
             label="inloc_matcher")
+
+    # resident match SERVICE under offered load (ISSUE r8): open-loop sweep
+    # against ncnet_tpu/serving at the bench arch — capacity (closed loop),
+    # steady-state latency percentiles at 70% of capacity (open loop, so
+    # queueing delay is measured, not hidden by client backpressure), and
+    # the shed fraction under a pinned ~3x-capacity burst.  The serve_*
+    # series land in the perf store with inferred directions (qps higher,
+    # *_ms lower, shed_pct lower), so perf_regress --check gates serving
+    # latency like every other metric.  TPU-gated like the InLoc metric;
+    # NCNET_BENCH_SERVE=1 forces it elsewhere.
+    flag = os.environ.get("NCNET_BENCH_SERVE")
+    on_tpu = "TPU" in jax.devices()[0].device_kind
+    if (flag not in ("0", "") if flag is not None else on_tpu) \
+            and res.get("serve_qps") is None:
+
+        def _serving_metrics():
+            import itertools
+
+            from ncnet_tpu.serving import MatchService, ServingConfig
+            from ncnet_tpu.utils.faults import paced_burst
+
+            scfg = ServingConfig(
+                max_queue=128, max_batch=8,
+                # the closed-loop capacity phase deliberately saturates
+                # from ONE client; the per-client fairness cap must sit
+                # above the queue bound or it would shed the probe itself
+                max_in_flight_per_client=256,
+                buckets=((IMAGE, IMAGE),), max_buckets=2,
+                warm_buckets=((IMAGE, IMAGE),),
+            )
+            service = MatchService(cfg16, params, scfg).start()
+            try:
+                rng_l = np.random.default_rng(11)
+                pairs = [
+                    (rng_l.integers(0, 255, (IMAGE, IMAGE, 3), dtype=np.uint8),
+                     rng_l.integers(0, 255, (IMAGE, IMAGE, 3), dtype=np.uint8))
+                    for _ in range(8)
+                ]
+                # closed-loop capacity: saturate the pipeline, measure drain
+                t0 = time.perf_counter()
+                futs = [service.submit(*pairs[i % 8]) for i in range(32)]
+                for f in futs:
+                    f.result(timeout=300)
+                cap_qps = 32 / (time.perf_counter() - t0)
+                # open loop at 70% of capacity: offered rate is pinned, so
+                # the latencies include real queueing delay
+                counter = itertools.count()
+                submit = lambda: service.submit(  # noqa: E731
+                    *pairs[next(counter) % 8])
+                rate = max(cap_qps * 0.7, 1.0)
+                n_offered = max(int(rate * 4), 16)
+                t0 = time.perf_counter()
+                futs, _ = paced_burst(submit, rate, n_offered)
+                lat = []
+                for f in futs:
+                    try:
+                        lat.append(f.result(timeout=300).wall_s * 1e3)
+                    except Exception:  # noqa: BLE001 — count successes only
+                        pass
+                span = time.perf_counter() - t0
+                if not lat:
+                    raise RuntimeError("no serving results at 70% load")
+                out = {
+                    "serve_capacity_qps": round(cap_qps, 2),
+                    "serve_qps": round(len(lat) / span, 2),
+                    "serve_p50_ms": round(float(np.percentile(lat, 50)), 2),
+                    "serve_p95_ms": round(float(np.percentile(lat, 95)), 2),
+                    "serve_p99_ms": round(float(np.percentile(lat, 99)), 2),
+                }
+                # overload: ~2 s PACED at 3x capacity — paced_burst's
+                # docstring explains why pacing makes shed_pct pin to the
+                # overload factor (gate-sound lower-is-better) instead of
+                # scaling with absolute capacity
+                burst_rate = cap_qps * 3
+                n_burst = max(int(burst_rate * 2), 64)
+                futs_b, sheds_b = paced_burst(submit, burst_rate, n_burst)
+                for f in futs_b:
+                    try:
+                        f.result(timeout=300)
+                    except Exception:  # noqa: BLE001 — outcome only
+                        pass
+                out["serve_shed_pct"] = round(
+                    100.0 * len(sheds_b) / n_burst, 2)
+                return out
+            finally:
+                service.stop()
+
+        out = _with_retries(_serving_metrics, label="serving") or {}
+        res.update(out)
     for k in [k for k, v in res.items() if v is None]:  # prune in place so a
         del res[k]  # shared res dict keeps already-captured metrics on retry
 

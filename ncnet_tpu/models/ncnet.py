@@ -666,7 +666,12 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
         # An extra row carries the pair's quality signals (the
         # append_quality_row wire protocol) — the serving path's per-query
         # accuracy monitor, computed in-graph at no extra round trip.
-        table = jnp.stack([v.astype(jnp.float32) for v in m])
+        # ravel() flattens the batch-1 fields to the (5, N) wire shape the
+        # protocol expects (round-10 stacked them as (5, 1, N), which
+        # silently failed append_quality_row's width guard — the quality
+        # row never actually rode along; fetch restores the (1, N) field
+        # shape on host)
+        table = jnp.stack([v.astype(jnp.float32).ravel() for v in m])
         return append_quality_row(table, out.corr)
 
     jitted = ResilientJit(run, label="point_matcher")
@@ -675,24 +680,40 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
         """Enqueue upload + forward + match extraction without blocking."""
         return jitted(params, jnp.asarray(src), jnp.asarray(tgt))
 
-    def fetch(handle) -> "Matches":
+    def fetch_with_quality(handle):
+        """``(Matches, {signal: float} | None)`` for one fetched handle —
+        the quality travels WITH the result it describes, so concurrent
+        callers (the serving layer pipelines several pairs) can never read
+        another request's signals.  The per-call return is the fix for the
+        round-10 attribute-on-closure pattern: ``matcher.last_quality`` is
+        kept as a demo/notebook convenience but is last-write-wins across
+        callers by construction — anything concurrent must use this."""
         table, quality = split_quality_row(
             np.asarray(handle, dtype=np.float32))
         if quality is not None:
-            # per-query quality: kept on the matcher (the serving layer's
-            # admission/monitoring hook) and streamed as a tier-tagged
-            # `quality` event when a telemetry sink is bound (no-op
-            # otherwise)
+            # streamed as a tier-tagged `quality` event when a telemetry
+            # sink is bound (no-op otherwise)
             matcher.last_quality = quality
             emit_quality("serving", quality,
                          tier=active_tier(config.half_precision))
-        return Matches(*(table[i] for i in range(5)))
+        return Matches(*(table[i][None] for i in range(5))), quality
+
+    def fetch(handle) -> "Matches":
+        return fetch_with_quality(handle)[0]
+
+    def match_with_quality(src, tgt):
+        """One blocking call returning ``(Matches, quality | None)``."""
+        return fetch_with_quality(dispatch(src, tgt))
 
     def matcher(src, tgt) -> "Matches":
         return fetch(dispatch(src, tgt))
 
     matcher.dispatch = dispatch
     matcher.fetch = fetch
+    matcher.fetch_with_quality = fetch_with_quality
+    matcher.match_with_quality = match_with_quality
+    # single-caller convenience only (see fetch_with_quality): the signals
+    # of the most recent fetch ANY caller made
     matcher.last_quality = None
     # tier-degradation seam: recover_from_device_failure(exc, matcher)
     matcher.retrace = jitted.retrace

@@ -270,7 +270,10 @@ _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  "_step_s", "_wall_s",
                  # diffuse match distributions are worse: entropy gates
                  # lower-is-better
-                 "entropy")
+                 "entropy",
+                 # serving: shed fraction at a FIXED offered load (the bench
+                 # scenario pins the load, so more shedding = less capacity)
+                 "shed_pct")
 
 
 def metric_direction(name: str) -> Optional[str]:

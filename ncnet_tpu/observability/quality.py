@@ -160,21 +160,45 @@ def quality_table(corr: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def append_quality_row(table: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
-    """Attach a single pair's quality signals to its ``(5, N)`` match table
-    as one extra zero-padded row (values in the first
-    ``len(QUALITY_SIGNALS)`` slots) — the wire protocol both serving-shaped
-    matchers (``make_point_matcher``, InLoc's ``make_pair_matcher``) use so
-    the pair's single device→host pull stays single.  Defined HERE, beside
-    :data:`QUALITY_SIGNALS`, so the two producers and
-    :func:`split_quality_row` can never disagree on the layout.  A table
-    too narrow to hold the signals (degenerate tiny grid) is returned
-    unchanged; the consumer detects the row by shape."""
-    q = quality_table(corr)[0]
-    if table.shape[1] < q.shape[0]:
+def append_quality_rows(table: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
+    """Attach per-pair quality signals to a BATCHED ``(B, 5, N)`` match
+    table as one extra zero-padded row per pair (values in the first
+    ``len(QUALITY_SIGNALS)`` slots of row 5) → ``(B, 6, N)``.  THE wire
+    layout — defined here, beside :data:`QUALITY_SIGNALS`, so every
+    producer (``make_point_matcher``, InLoc's ``make_pair_matcher``, the
+    serving ``BatchMatchEngine``) and both splitters can never disagree.
+    A table too narrow to hold the signals (degenerate tiny grid) is
+    returned unchanged; consumers detect the row by shape."""
+    q = quality_table(corr)  # (B, S)
+    if table.shape[2] < q.shape[1]:
         return table
-    row = jnp.zeros((table.shape[1],), jnp.float32).at[: q.shape[0]].set(q)
-    return jnp.concatenate([table, row[None]], axis=0)
+    row = jnp.zeros((table.shape[0], 1, table.shape[2]), jnp.float32)
+    row = row.at[:, 0, : q.shape[1]].set(q)
+    return jnp.concatenate([table, row], axis=1)
+
+
+def split_quality_rows(table: np.ndarray):
+    """Invert :func:`append_quality_rows` on a fetched numpy batch table:
+    ``(match_tables (B, 5, N), [per-pair {signal: float}] | None)`` — None
+    when no quality rows were attached.  Anything that is not a batch
+    table is a caller error (the single-pair splitter stays lenient for
+    its legacy callers; a batch producer controls its own shape)."""
+    if table.ndim != 3 or table.shape[1] not in (5, 6):
+        raise ValueError(f"not a batched match table: {table.shape}")
+    if table.shape[1] == 5:
+        return table, None
+    quality = [
+        dict(zip(QUALITY_SIGNALS,
+                 (float(v) for v in table[b, 5, : len(QUALITY_SIGNALS)])))
+        for b in range(table.shape[0])
+    ]
+    return table[:, :5], quality
+
+
+def append_quality_row(table: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
+    """Single-pair form of :func:`append_quality_rows` for the ``(5, N)``
+    tables the batch-1 matchers pull (``corr`` must be batch 1)."""
+    return append_quality_rows(table[None], corr)[0]
 
 
 def split_quality_row(table: np.ndarray):

@@ -1,0 +1,59 @@
+"""Resident match serving: the fault-tolerant service around the warm matcher.
+
+ROADMAP item 1, built on the PR 1-7 layers: continuous batching into padded
+shape buckets (bounded jit cache), admission control with classified
+``Overloaded`` shedding + retry-after hints, per-request deadlines checked
+at admission/dequeue/fetch, demote-retrace survival of device failures with
+zero lost requests, SIGTERM drain, a STARTING/READY/DEGRADED/DRAINING/
+STOPPED health machine for probes, and full event/metric/quality telemetry.
+See README "Serving" for the API, overload semantics and chaos knobs;
+tests/test_serving.py is the fault-injected proof of the invariants.
+"""
+
+from ncnet_tpu.serving.admission import AdmissionController  # noqa: F401
+from ncnet_tpu.serving.buckets import ShapeBucketer, pad_to_bucket  # noqa: F401
+from ncnet_tpu.serving.engine import BatchMatchEngine  # noqa: F401
+from ncnet_tpu.serving.health import (  # noqa: F401
+    ADMITTING,
+    DEGRADED,
+    DRAINING,
+    READY,
+    STARTING,
+    STOPPED,
+    HealthMachine,
+)
+from ncnet_tpu.serving.request import (  # noqa: F401
+    TERMINAL_OUTCOMES,
+    DeadlineExceeded,
+    MatchFuture,
+    MatchRequest,
+    MatchResult,
+    Overloaded,
+    RequestQuarantined,
+    bucket_label,
+)
+from ncnet_tpu.serving.service import MatchService, ServingConfig  # noqa: F401
+
+__all__ = [
+    "ADMITTING",
+    "AdmissionController",
+    "BatchMatchEngine",
+    "DEGRADED",
+    "DRAINING",
+    "DeadlineExceeded",
+    "HealthMachine",
+    "MatchFuture",
+    "MatchRequest",
+    "MatchResult",
+    "MatchService",
+    "Overloaded",
+    "READY",
+    "RequestQuarantined",
+    "STARTING",
+    "STOPPED",
+    "ServingConfig",
+    "ShapeBucketer",
+    "TERMINAL_OUTCOMES",
+    "bucket_label",
+    "pad_to_bucket",
+]

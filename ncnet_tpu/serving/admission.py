@@ -1,0 +1,106 @@
+"""Admission control + backpressure for the match service.
+
+Load shedding at the door is the difference between a service that degrades
+(rejects the overflow with a classified error and a retry hint, keeps its
+admitted work inside deadline) and one that collapses (admits everything,
+queues grow without bound, EVERY request deadline-blows).  The controller
+enforces three independent bounds, checked in one place under the service
+lock:
+
+  * **queue depth** — total queued requests across buckets may not exceed
+    ``max_queue``; the overflow sheds with ``reason="queue_full"`` and a
+    ``retry_after_s`` hint derived from actual throughput (queue depth ×
+    recent batch wall / batch size), so well-behaved clients back off
+    proportionally to real load.
+  * **per-client in-flight cap** — one misbehaving client (a runaway retry
+    loop, a fan-out bug) may not occupy the whole queue; beyond
+    ``max_in_flight_per_client`` outstanding (queued or dispatched)
+    requests, that client's submissions shed with ``reason="client_cap"``
+    while other clients keep being admitted.
+  * **lifecycle** — a draining or stopped service admits nothing
+    (``reason="draining"`` / ``"stopped"``), so SIGTERM can complete the
+    admitted work without the queue refilling behind it.
+
+The controller holds no lock of its own: the service serializes every call
+under its condition lock, and the throughput EWMA is a single float write.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ncnet_tpu.serving.request import Overloaded
+
+
+class AdmissionController:
+    """Bounds + the retry-after estimator (see module docstring)."""
+
+    _ALPHA = 0.3  # batch-wall EWMA: ~6-sample memory, enough to track load
+
+    def __init__(self, max_queue: int = 64,
+                 max_in_flight_per_client: int = 16,
+                 max_batch: int = 8):
+        if max_queue < 1 or max_in_flight_per_client < 1 or max_batch < 1:
+            raise ValueError(
+                f"bad admission knobs: max_queue={max_queue} "
+                f"per_client={max_in_flight_per_client} max_batch={max_batch}"
+            )
+        self.max_queue = int(max_queue)
+        self.max_in_flight_per_client = int(max_in_flight_per_client)
+        self.max_batch = int(max_batch)
+        self._per_client: Dict[str, int] = {}
+        self._batch_wall_ewma: Optional[float] = None
+
+    # -- accounting (service-lock serialized) -------------------------------
+
+    def note_admit(self, client: str) -> None:
+        self._per_client[client] = self._per_client.get(client, 0) + 1
+
+    def note_done(self, client: str) -> None:
+        """Called on EVERY terminal outcome of an admitted request — the
+        cap tracks outstanding work, so a leak here would slowly choke the
+        client out (the chaos suite pins the pairing)."""
+        n = self._per_client.get(client, 0) - 1
+        if n <= 0:
+            self._per_client.pop(client, None)
+        else:
+            self._per_client[client] = n
+
+    def note_batch_wall(self, seconds: float) -> None:
+        s = float(seconds)
+        self._batch_wall_ewma = s if self._batch_wall_ewma is None else (
+            self._ALPHA * s + (1.0 - self._ALPHA) * self._batch_wall_ewma
+        )
+
+    def outstanding(self, client: str) -> int:
+        return self._per_client.get(client, 0)
+
+    # -- the decision -------------------------------------------------------
+
+    def retry_after_s(self, queue_depth: int) -> float:
+        """When a shed client should retry: the time to drain the current
+        queue at the recent batch cadence, floored at 50 ms (an empty
+        estimate must not invite an instant hammer-retry)."""
+        wall = self._batch_wall_ewma if self._batch_wall_ewma else 0.1
+        batches_ahead = max(1.0, queue_depth / self.max_batch)
+        return max(0.05, round(batches_ahead * wall, 3))
+
+    def admit(self, client: str, queue_depth: int) -> None:
+        """Raise :class:`Overloaded` when the request must shed; returns
+        None when admissible.  The caller (service.submit, under its lock)
+        then enqueues and calls :meth:`note_admit` — check and commit are
+        one critical section."""
+        if queue_depth >= self.max_queue:
+            raise Overloaded(
+                f"queue full ({queue_depth}/{self.max_queue})",
+                reason="queue_full",
+                retry_after_s=self.retry_after_s(queue_depth),
+            )
+        if self.outstanding(client) >= self.max_in_flight_per_client:
+            raise Overloaded(
+                f"client {client!r} has "
+                f"{self.outstanding(client)} requests in flight "
+                f"(cap {self.max_in_flight_per_client})",
+                reason="client_cap",
+                retry_after_s=self.retry_after_s(queue_depth),
+            )

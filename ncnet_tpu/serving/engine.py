@@ -1,0 +1,104 @@
+"""Batched warm matcher: the device-facing half of the match service.
+
+``make_point_matcher`` (models/ncnet.py) is the batch-1 serving program; the
+service needs the same program shape at batch B so continuous batching can
+amortize the dispatch/tunnel cost the r05 bench measured (5.5 ms device vs
+~681 ms serial wall at bs1).  One jitted program per shape bucket (jit's
+per-shape cache does the bucketing; ``serving/buckets.py`` bounds it):
+raw uint8 pairs in, ImageNet-normalized on device, full forward, compact
+per-pair match tables out, with the per-pair quality signals
+(observability/quality.py) appended as one extra table row so the batch's
+single device→host pull carries accuracy telemetry too.
+
+The engine exposes the same ``dispatch``/``fetch``/``retrace`` seam as the
+eval matchers: ``dispatch`` enqueues without blocking (jax async dispatch),
+``fetch`` blocks on the device result, and ``retrace`` drops the compiled
+programs so :func:`~ncnet_tpu.models.ncnet.recover_from_device_failure` can
+demote a poisoned Pallas tier and rebuild on the survivor — the service's
+degraded-mode path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ncnet_tpu.config import ModelConfig
+
+
+class BatchMatchEngine:
+    """Resident batched matcher over pre-staged weights.
+
+    ``dispatch(src_u8, tgt_u8)`` takes ``(B, H, W, 3)`` uint8 batches
+    (already padded to one bucket) and returns an on-device handle;
+    ``fetch`` pulls the ``(B, 6, N)`` float32 table — rows 0-4 are the
+    match table (xA, yA, xB, yB, score), row 5 carries the pair's quality
+    signals in its first ``len(QUALITY_SIGNALS)`` slots (``(B, 5, N)``
+    when the grid is too narrow for the row; :meth:`split` detects which).
+    """
+
+    def __init__(self, config: ModelConfig, params, *,
+                 do_softmax: bool = True, scale: str = "centered"):
+        import jax
+        import jax.numpy as jnp
+
+        from ncnet_tpu.models.ncnet import ResilientJit, ncnet_forward
+        from ncnet_tpu.observability.quality import append_quality_rows
+        from ncnet_tpu.ops import corr_to_matches
+        from ncnet_tpu.ops.image import normalize_imagenet
+
+        self.config = config
+        self._params = jax.device_put(params)  # staged once, every batch
+        k = max(config.relocalization_k_size, 1)
+
+        def run(p, src, tgt):
+            src = normalize_imagenet(src.astype(jnp.float32))
+            tgt = normalize_imagenet(tgt.astype(jnp.float32))
+            out = ncnet_forward(config, p, src, tgt)
+            m = corr_to_matches(
+                out.corr, delta4d=out.delta4d, k_size=k,
+                do_softmax=do_softmax, scale=scale,
+            )
+            table = jnp.stack(
+                [v.astype(jnp.float32) for v in m], axis=1)  # (B, 5, N)
+            # the quality-row wire layout has ONE home (quality.py): the
+            # pair's signals ride as row 5 → (B, 6, N), narrow grids skip
+            return append_quality_rows(table, out.corr)
+
+        self._jitted = ResilientJit(run, label="serve_batch")
+
+    def dispatch(self, src_u8: np.ndarray, tgt_u8: np.ndarray):
+        """Enqueue upload + forward + match extraction; returns the
+        on-device handle without blocking.  The fault-injection seam
+        (``faults.device_fail_calls``) lives on the ResilientJit dispatch,
+        exactly like the eval pair programs."""
+        import jax.numpy as jnp
+
+        return self._jitted(self._params, jnp.asarray(src_u8),
+                            jnp.asarray(tgt_u8))
+
+    def fetch(self, handle) -> np.ndarray:
+        """Block on the device result; one pull per batch."""
+        return np.asarray(handle, dtype=np.float32)
+
+    def retrace(self) -> None:
+        """Drop every cached executable (all shape buckets): the next
+        dispatch re-traces through the tier chooser — the demote-retrace
+        recovery seam."""
+        self._jitted.retrace()
+
+    @property
+    def half_precision(self) -> bool:
+        return bool(self.config.half_precision)
+
+    @staticmethod
+    def split(table: np.ndarray
+              ) -> Tuple[np.ndarray, Optional[List[Dict[str, float]]]]:
+        """``(B, 5|6, N)`` fetched table → ``(match_tables (B, 5, N),
+        per-pair quality dicts | None)`` — delegates to the wire layout's
+        one home, :func:`~ncnet_tpu.observability.quality.
+        split_quality_rows`."""
+        from ncnet_tpu.observability.quality import split_quality_rows
+
+        return split_quality_rows(table)

@@ -1,0 +1,91 @@
+"""Serving health state machine — the probe surface external supervisors see.
+
+States and their meaning for a load balancer / readiness probe:
+
+  * ``STARTING``  — the worker is up but warming (compiling warm buckets).
+    Admission IS open (requests queue behind the warmup) but probes should
+    not route fresh traffic yet.
+  * ``READY``     — serving normally on the preferred tier ladder.
+  * ``DEGRADED``  — a runtime device failure demoted a Pallas tier
+    (``recover_from_device_failure``); the service is still serving — with
+    zero lost requests, on a slower tier — but an operator should look.
+    Sticky until the tier registry is reset (a demotion outlives the batch
+    that triggered it by design, see ops/nc_fused_lane demotion registry).
+  * ``DRAINING``  — SIGTERM (or ``stop()``): admission is closed, admitted
+    work is completing.  Probes must stop routing here.
+  * ``STOPPED``   — terminal; the worker has exited.
+
+Transitions are monotone along STARTING → READY → DEGRADED and any
+non-terminal state may enter DRAINING → STOPPED; anything else is a service
+bug and raises.  Every transition is emitted as a ``serve_health`` event so
+``tools/run_report.py --serving`` can reconstruct the health timeline of a
+dead service from its event log alone.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ncnet_tpu.observability import events as obs_events
+
+STARTING = "STARTING"
+READY = "READY"
+DEGRADED = "DEGRADED"
+DRAINING = "DRAINING"
+STOPPED = "STOPPED"
+
+_ALLOWED = {
+    STARTING: (READY, DEGRADED, DRAINING, STOPPED),
+    READY: (DEGRADED, DRAINING, STOPPED),
+    DEGRADED: (DRAINING, STOPPED),
+    DRAINING: (STOPPED,),
+    STOPPED: (),
+}
+
+# states whose admission door is open
+ADMITTING = (STARTING, READY, DEGRADED)
+
+
+class HealthMachine:
+    """The service's state cell; mutated only under the service lock."""
+
+    def __init__(self):
+        self.state = STARTING
+        self.since = time.time()
+        self.reason: Optional[str] = None
+        self.history: List[Dict[str, Any]] = [
+            {"state": STARTING, "t": self.since, "reason": "init"}
+        ]
+
+    def to(self, state: str, reason: str = "") -> bool:
+        """Transition (emitting ``serve_health``); returns False when the
+        machine is already there (idempotent re-entry is not an error —
+        DEGRADED may be requested per failed batch)."""
+        if state == self.state:
+            return False
+        if state not in _ALLOWED[self.state]:
+            raise RuntimeError(
+                f"illegal health transition {self.state} -> {state}"
+            )
+        self.state = state
+        self.since = time.time()
+        self.reason = reason or None
+        self.history.append(
+            {"state": state, "t": self.since, "reason": reason or None})
+        obs_events.emit("serve_health", state=state, reason=reason or None)
+        return True
+
+    @property
+    def admitting(self) -> bool:
+        return self.state in ADMITTING
+
+    def probe(self) -> Dict[str, Any]:
+        """The health-endpoint payload: current state + how long it has
+        held + why (the serving twin of the heartbeat's last payload)."""
+        return {
+            "state": self.state,
+            "since": self.since,
+            "age_s": round(max(0.0, time.time() - self.since), 3),
+            "reason": self.reason,
+        }

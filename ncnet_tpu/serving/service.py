@@ -1,0 +1,825 @@
+"""The resident match service: continuous batching + fault-tolerant serving.
+
+This is the serving twin of PR 1 (fault-tolerant training) and PR 3
+(resilient batch eval): a resident process around the warm matcher that
+keeps answering — correctly, within deadlines, at a degraded tier if it
+must — while devices fail, queues overflow, and clients misbehave.  The r05
+bench motivates the shape: bs1 bf16 device time is 5.5 ms but a serial
+caller waits ~681 ms of wall; the win is structural (queueing, batching,
+pipelining), not a kernel.
+
+Pieces, and where each discipline comes from:
+
+  * **Continuous batching** — an async request queue coalesces
+    variable-resolution queries into padded shape buckets
+    (``serving/buckets.py``, bounded jit cache) and dispatches the next
+    batch while the previous batch's fetch is still in flight; the
+    in-flight depth follows the PR 2 ``PipelineDepthController`` (the drain
+    unit is one batch, exactly the PF-Pascal regime).
+  * **Admission control + backpressure** — ``serving/admission.py``:
+    bounded queue depth, per-client in-flight caps, classified
+    ``Overloaded`` rejections with throughput-derived retry-after hints.
+  * **Per-request deadlines** — the budget is checked at admission (an
+    already-expired request is refused), at dequeue (expired requests are
+    EVICTED from the batch before dispatch — they never waste device time),
+    and at fetch (a result that lands after its caller's budget resolves
+    deadline-exceeded, not as a zombie success).  The fetch itself rides
+    ``pipeline.call_with_watchdog`` so a hung tunnel surfaces as a
+    retryable timeout, not an eternal stall.
+  * **Degraded-mode survival** — a runtime device failure mid-stream runs
+    the PR 3 ``recover_from_device_failure`` demote-retrace path and
+    REQUEUES the failed batch at the front (zero lost requests, retried
+    off-budget because the program changed); repeated failures quarantine
+    individual requests into a journaled ``RunManifest``; SIGTERM (PR 1's
+    ``PreemptionHandler`` pattern) stops admission and drains admitted work
+    to completion; the STARTING/READY/DEGRADED/DRAINING/STOPPED health
+    machine (``serving/health.py``) is exported for probes.
+  * **Telemetry** — every lifecycle edge is an event (``serve_admit`` /
+    ``serve_shed`` / ``serve_batch`` / ``serve_result`` / ``serve_deadline``
+    / ``serve_quarantine`` / ``serve_health`` / ``serve_drain``), latency
+    aggregates through per-bucket ``Histogram`` digests, per-pair quality
+    signals stream tier-tagged through ``emit_quality``, and the PR 5
+    ``Heartbeat`` is bumped per dispatched batch (the
+    ``tools/stall_watchdog.py`` liveness contract).
+
+The outcome-total contract (serving/request.py): every admitted request
+terminates in exactly one of {result, deadline, overloaded, quarantined} —
+proven by event-log accounting in ``tools/run_report.py --serving`` and
+executed under fault injection by tests/test_serving.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ncnet_tpu.observability import MetricsRegistry, events as obs_events
+from ncnet_tpu.observability import get_logger
+from ncnet_tpu.serving.admission import AdmissionController
+from ncnet_tpu.serving.buckets import ShapeBucketer, pad_to_bucket
+from ncnet_tpu.serving.health import (
+    DEGRADED,
+    DRAINING,
+    READY,
+    STARTING,
+    STOPPED,
+    HealthMachine,
+)
+from ncnet_tpu.serving.request import (
+    Bucket,
+    DeadlineExceeded,
+    MatchFuture,
+    MatchRequest,
+    MatchResult,
+    Overloaded,
+    RequestQuarantined,
+    as_pair_image,
+    bucket_label,
+)
+
+log = get_logger("serving")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs of the resident match service (README "Serving")."""
+
+    # admission / backpressure
+    max_queue: int = 64                 # total queued requests before shedding
+    max_in_flight_per_client: int = 16  # outstanding per client id
+    # batching
+    max_batch: int = 8                  # requests coalesced per dispatch
+    pipeline_depth: int = 0             # 0 = adaptive (2-4); >0 pins it
+    # deadlines / hangs
+    default_deadline_s: Optional[float] = None  # None = no implicit deadline
+    fetch_timeout_s: float = 0.0        # >0: watchdog per batch fetch
+    # failure policy
+    retries: int = 1                    # budgeted retries per request
+    quarantine_dir: Optional[str] = None  # RunManifest home (None = events only)
+    # shape buckets (bounded jit cache)
+    bucket_multiple: int = 64
+    max_image_side: int = 1024
+    max_buckets: int = 4
+    buckets: Optional[Tuple[Tuple[int, int], ...]] = None  # fixed ladder
+    warm_buckets: Tuple[Tuple[int, int], ...] = ()  # square pairs compiled at start
+    # liveness / telemetry
+    heartbeat_path: Optional[str] = None
+    latency_hist_ms: float = 2000.0     # per-bucket latency digest range
+    install_sigterm: bool = False       # SIGTERM -> drain (PreemptionHandler style)
+    # match extraction
+    do_softmax: bool = True
+    scale: str = "centered"
+
+
+@dataclasses.dataclass
+class _InFlight:
+    handle: Any
+    batch: List[MatchRequest]
+    bucket: Bucket
+    t0: float
+
+
+class MatchService:
+    """Resident, fault-tolerant match service around the warm matcher.
+
+    Usage::
+
+        service = MatchService(config, params, ServingConfig(...))
+        service.start()
+        fut = service.submit(src_u8, tgt_u8, deadline_s=0.5, client="cam0")
+        result = fut.result(timeout=5.0)   # MatchResult, or a classified error
+        ...
+        service.stop()                      # drains admitted work, then stops
+
+    ``engine`` may be injected (anything with ``dispatch``/``fetch``/
+    ``retrace``) — the chaos suite drives the full lifecycle against a fake
+    device without paying jit compiles.
+    """
+
+    def __init__(self, model_config=None, params=None,
+                 serving: ServingConfig = ServingConfig(), *,
+                 engine=None, registry: Optional[MetricsRegistry] = None):
+        if engine is None:
+            from ncnet_tpu.serving.engine import BatchMatchEngine
+
+            engine = BatchMatchEngine(
+                model_config, params, do_softmax=serving.do_softmax,
+                scale=serving.scale,
+            )
+        self.cfg = serving
+        self._engine = engine
+        self._registry = registry or MetricsRegistry(scope="serving")
+        self._bucketer = ShapeBucketer(
+            multiple=serving.bucket_multiple,
+            max_side=serving.max_image_side,
+            max_buckets=serving.max_buckets,
+            fixed=serving.buckets,
+        )
+        self._admission = AdmissionController(
+            max_queue=serving.max_queue,
+            max_in_flight_per_client=serving.max_in_flight_per_client,
+            max_batch=serving.max_batch,
+        )
+        from ncnet_tpu.evaluation.pipeline import PipelineDepthController
+
+        self._controller = PipelineDepthController(fixed=serving.pipeline_depth)
+        self._health = HealthMachine()
+        self._heartbeat = None
+        if serving.heartbeat_path:
+            from ncnet_tpu.observability import Heartbeat
+
+            self._heartbeat = Heartbeat(serving.heartbeat_path)
+        self._manifest = None
+        if serving.quarantine_dir:
+            from ncnet_tpu.evaluation.resilience import RunManifest
+
+            os.makedirs(serving.quarantine_dir, exist_ok=True)
+            self._manifest = RunManifest(
+                os.path.join(serving.quarantine_dir, "manifest.json"),
+                meta={"scope": "serving"},
+            )
+
+        self._cond = threading.Condition()
+        self._queues: Dict[Bucket, Deque[MatchRequest]] = {}
+        self._inflight: Deque[_InFlight] = deque()
+        self._worker: Optional[threading.Thread] = None
+        self._draining = False
+        self._drain_requested = False   # set from the signal handler: no lock
+        self._stop_now = False
+        self._finishing = False         # _finish has begun: admission closed
+        self._processing: Optional[List[MatchRequest]] = None
+        self._last_idle_beat = 0.0
+        self._drain_resolved = 0
+        self._req_seq = 0
+        self._batch_seq = 0
+        self._old_sigterm = None
+        # terminal-outcome accounting (the event log is the durable copy;
+        # these back the health probe and the drain summary)
+        self._n = {"admitted": 0, "results": 0, "deadline": 0,
+                   "quarantined": 0, "shed": 0}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "MatchService":
+        if self._worker is not None:
+            raise RuntimeError("service already started")
+        obs_events.emit(
+            "serve_start",
+            max_queue=self.cfg.max_queue, max_batch=self.cfg.max_batch,
+            retries=self.cfg.retries,
+            default_deadline_s=self.cfg.default_deadline_s,
+            fetch_timeout_s=self.cfg.fetch_timeout_s,
+        )
+        if self.cfg.install_sigterm and \
+                threading.current_thread() is threading.main_thread():
+            self._old_sigterm = signal.signal(signal.SIGTERM, self._on_sigterm)
+        self._worker = threading.Thread(
+            target=self._run, name="match-serve", daemon=True)
+        self._worker.start()
+        # safety net for a process that exits without stop() (an unhandled
+        # exception in the caller): settle the outstanding futures and join
+        # the worker before interpreter teardown — a daemon thread killed
+        # mid-XLA-dispatch can otherwise segfault the exit
+        import atexit
+
+        atexit.register(self._atexit_stop)
+        return self
+
+    def _atexit_stop(self) -> None:
+        w = self._worker
+        if w is not None and w.is_alive():
+            self.stop(drain=False, timeout=10.0)
+
+    def _on_sigterm(self, signum, frame):
+        # handler discipline (PR 1 PreemptionHandler): flip a flag, write
+        # via os.write (print from a handler can deadlock on the stream
+        # lock), let the worker act at its next loop edge.  No lock here —
+        # the main thread may hold self._cond inside submit() when the
+        # signal lands.
+        self._drain_requested = True
+        os.write(2, b"[serving] received SIGTERM; draining in-flight "
+                    b"requests, admission closed\n")
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        """Stop the service.  ``drain=True`` (default) completes every
+        admitted request first; ``drain=False`` aborts — queued and
+        in-flight requests settle ``Overloaded(reason="shutdown")`` (still a
+        classified terminal outcome, never a silent drop).  One caveat on
+        the abort: a batch whose blocking device fetch has ALREADY begun
+        completes normally first (a blocking fetch cannot be interrupted;
+        configure ``fetch_timeout_s`` to bound that wait)."""
+        with self._cond:
+            if drain:
+                self._begin_drain_locked("stop")
+            else:
+                # NOT _draining: an abort force-settles admitted work, and
+                # the serve_drain event's `drained` flag must be able to
+                # tell the two apart; admission closes via _stop_now
+                self._stop_now = True
+            self._cond.notify_all()
+        w = self._worker
+        if w is not None and w is not threading.current_thread():
+            w.join(timeout)
+        if self._old_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._old_sigterm)
+            self._old_sigterm = None
+        import atexit
+
+        try:
+            # the safety net registered by start() would otherwise hold a
+            # strong reference (service + engine jit cache + staged params)
+            # for the life of the process
+            atexit.unregister(self._atexit_stop)
+        except Exception:  # noqa: BLE001 — interpreter teardown ordering
+            pass
+
+    def request_drain(self, reason: str = "drain") -> None:
+        """Close admission and finish admitted work (the SIGTERM path,
+        callable programmatically); returns immediately — join via
+        :meth:`stop` or poll :meth:`health`."""
+        with self._cond:
+            self._begin_drain_locked(reason)
+            self._cond.notify_all()
+
+    def _begin_drain_locked(self, reason: str) -> None:
+        if self._draining:
+            return
+        self._draining = True
+        if self._health.state != STOPPED:
+            self._health.to(DRAINING, reason)
+
+    def __enter__(self) -> "MatchService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def submit(self, src, tgt, *, deadline_s: Optional[float] = None,
+               client: str = "default") -> MatchFuture:
+        """Admit one match query (raw uint8 pair).  Returns a
+        :class:`MatchFuture`; raises :class:`Overloaded` (shed) or
+        :class:`DeadlineExceeded` (budget already gone) synchronously —
+        rejections are classified at the door, not discovered by timeout.
+        """
+        src = as_pair_image(src, "src")
+        tgt = as_pair_image(tgt, "tgt")
+        now = time.monotonic()
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        shed: Optional[Overloaded] = None
+        expired = False
+        req: Optional[MatchRequest] = None
+        with self._cond:
+            if self._worker is None or self._finishing or self._stop_now \
+                    or self._health.state == STOPPED:
+                # _finishing closes the submit/_finish race: once _finish
+                # has collected the leftover queues, a late submit must
+                # shed rather than enqueue work nobody will ever settle
+                shed = Overloaded("service is not running", reason="stopped")
+            elif self._draining or self._drain_requested:
+                shed = Overloaded("service is draining", reason="draining")
+            elif deadline_s is not None and deadline_s <= 0:
+                expired = True
+            else:
+                depth = self._queued_locked()
+                try:
+                    # peek first, COMMIT only after admission passes: a
+                    # shed request must not burn a compiled-program slot
+                    bucket = self._bucketer.peek(
+                        src.shape[:2], tgt.shape[:2])
+                    self._admission.admit(client, depth)
+                    self._bucketer.commit(bucket)
+                except Overloaded as e:
+                    shed = e
+                else:
+                    # RESERVE only — the request is not visible to the
+                    # worker until phase 2 enqueues it, so its serve_admit
+                    # event always reaches the log before any terminal
+                    # event (negative unresolved counts would otherwise be
+                    # possible after a crash in the emit window)
+                    self._req_seq += 1
+                    req = MatchRequest(
+                        id=f"r{self._req_seq}", client=client, src=src,
+                        tgt=tgt, bucket=bucket,
+                        future=MatchFuture(f"r{self._req_seq}"),
+                        submitted_t=now,
+                        deadline_t=(now + deadline_s) if deadline_s
+                        else None,
+                    )
+                    self._admission.note_admit(client)
+                    self._n["admitted"] += 1
+                    self._registry.counter("admitted").inc()
+                    self._registry.gauge("queue_depth").set(depth + 1)
+            if shed is not None:
+                self._n["shed"] += 1
+                self._registry.counter("shed").inc()
+        # event emission OUTSIDE the lock: EventLog appends flush+fsync,
+        # and an fsync held under the service lock would serialize every
+        # client's admission (and the worker's queue operations) behind
+        # the disk
+        if expired:
+            obs_events.emit("serve_deadline", request=None, client=client,
+                            where="admission", admitted=False)
+            raise DeadlineExceeded(
+                f"deadline budget {deadline_s}s already expired at "
+                "admission", where="admission")
+        if shed is not None:
+            obs_events.emit(
+                "serve_shed", client=client, reason=shed.reason,
+                retry_after_s=shed.retry_after_s, admitted=False,
+            )
+            raise shed
+        obs_events.emit(
+            "serve_admit", request=req.id, client=client,
+            bucket=bucket_label(req.bucket),
+            deadline_s=round(deadline_s, 6) if deadline_s else None,
+        )
+        # phase 2: make the admitted request visible to the worker.  If
+        # the service died between the phases, the admitted request still
+        # gets its terminal outcome here (nobody else can see it).
+        with self._cond:
+            dead = self._finishing or self._stop_now \
+                or self._health.state == STOPPED
+            if not dead:
+                self._queues.setdefault(req.bucket, deque()).append(req)
+                self._cond.notify_all()
+        if dead:
+            exc = Overloaded(
+                f"service stopped before request {req.id} was queued",
+                reason="stopped")
+            req.future._settle("overloaded", error=exc)
+            with self._cond:
+                self._n["shed"] += 1
+                self._registry.counter("shed").inc()
+            obs_events.emit("serve_shed", request=req.id, client=client,
+                            reason="stopped", admitted=True)
+            self._terminal(req)
+            raise exc
+        return req.future
+
+    # ------------------------------------------------------------------
+    # probes
+    # ------------------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """The probe payload: health state + queue/in-flight depth +
+        outcome counters + active buckets."""
+        with self._cond:
+            return {
+                **self._health.probe(),
+                "queue_depth": self._queued_locked(),
+                "inflight_batches": len(self._inflight),
+                "buckets": [bucket_label(b) for b in self._bucketer.buckets],
+                "counters": dict(self._n),
+                "pipeline_depth": self._controller.depth,
+            }
+
+    @property
+    def state(self) -> str:
+        return self._health.state
+
+    def metrics(self) -> Dict[str, Any]:
+        return self._registry.snapshot()
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+
+    def _queued_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _run(self) -> None:
+        crashed: Optional[BaseException] = None
+        try:
+            self._warmup()
+            with self._cond:
+                if self._health.state == STARTING:
+                    self._health.to(READY, "warm")
+            while True:
+                if self._drain_requested:
+                    self.request_drain("sigterm")
+                self._fill_pipeline()
+                inf = None
+                with self._cond:
+                    if self._stop_now:
+                        # an ABORT does not drain in-flight fetches: the
+                        # deque's batches settle Overloaded("shutdown") in
+                        # _finish, as stop(drain=False) documents
+                        break
+                    if self._inflight:
+                        inf = self._inflight.popleft()
+                        # crash accounting: a batch popped from the
+                        # in-flight deque is otherwise invisible to
+                        # _finish — track it until its outcome lands
+                        self._processing = inf.batch
+                    else:
+                        if self._stop_now or (
+                                self._draining and not self._queued_locked()):
+                            break
+                        if not self._queued_locked():
+                            self._controller.note_gap()
+                            self._idle_beat()
+                            self._cond.wait(0.05)
+                if inf is not None:
+                    # no finally: if _drain_batch raises (a worker crash),
+                    # _processing stays set so _finish settles the batch
+                    self._drain_batch(inf)
+                    with self._cond:
+                        self._processing = None
+        except BaseException as e:  # the worker must never die silently
+            crashed = e
+            log.error(f"serving worker crashed: {type(e).__name__}: {e}",
+                      kind="device")
+        finally:
+            self._finish(crashed)
+
+    def _idle_beat(self) -> None:
+        """Keep the heartbeat fresh while IDLE (rate-limited to ~1/s): a
+        quiet service must stay distinguishable from a wedged one — a
+        genuinely wedged fetch blocks the worker loop itself, so these
+        beats stop exactly when the stall watchdog should fire."""
+        if self._heartbeat is None:
+            return
+        now = time.monotonic()
+        if now - self._last_idle_beat >= 1.0:
+            self._last_idle_beat = now
+            self._heartbeat.beat(step=self._batch_seq,
+                                 state=self._health.state, idle=True)
+
+    def _batch_ladder(self) -> List[int]:
+        """The padded batch sizes _dispatch can produce: powers of two up
+        to (and always including) max_batch."""
+        sizes, b = [], 1
+        while b < self.cfg.max_batch:
+            sizes.append(b)
+            b *= 2
+        sizes.append(self.cfg.max_batch)
+        return sizes
+
+    def _warmup(self) -> None:
+        """Compile the configured warm buckets (square pairs) at EVERY
+        ladder batch size before admitting traffic counts them as latency
+        — _dispatch pads batches onto the power-of-two ladder, so a
+        bucket warmed only at B=1 would still stall the live stream the
+        first time a coalesced batch arrives.  Fail-open: a failed warm
+        compile logs and moves on — the first real request in that shape
+        pays the compile instead."""
+        for hw in self.cfg.warm_buckets:
+            try:
+                bucket = self._bucketer.register(tuple(hw), tuple(hw))
+                for b in self._batch_ladder():
+                    zeros = np.zeros((b, *bucket[0], 3), np.uint8)
+                    zt = np.zeros((b, *bucket[1], 3), np.uint8)
+                    self._engine.fetch(self._engine.dispatch(zeros, zt))
+                obs_events.emit("serve_warm", bucket=bucket_label(bucket),
+                                batch_sizes=self._batch_ladder())
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                log.warning(f"warmup of bucket {hw} failed "
+                            f"({type(e).__name__}: {e}); first request "
+                            "pays the compile", kind="device")
+
+    def _fill_pipeline(self) -> None:
+        """Dispatch batches until the pipeline is full or the queue is
+        empty — dispatching the NEXT batch while the previous fetch is in
+        flight is the continuous-batching overlap itself."""
+        while True:
+            expired: List[MatchRequest] = []
+            batch: List[MatchRequest] = []
+            bucket: Optional[Bucket] = None
+            with self._cond:
+                if self._stop_now:
+                    return
+                if len(self._inflight) >= self._controller.depth:
+                    return
+                bucket = self._pick_bucket_locked()
+                if bucket is not None:
+                    q = self._queues[bucket]
+                    now = time.monotonic()
+                    while q and len(batch) < self.cfg.max_batch:
+                        req = q.popleft()
+                        # deadline check at DEQUEUE: an expired request is
+                        # evicted before it can waste a device slot
+                        (expired if req.expired(now) else batch).append(req)
+                    if not q:
+                        del self._queues[bucket]
+            for req in expired:
+                self._resolve_deadline(req, "dequeue")
+            if not batch:
+                if expired:
+                    continue  # the queue may hold more work behind evictions
+                return
+            with self._cond:
+                self._processing = batch  # crash accounting (see _run)
+            self._dispatch(batch, bucket)
+            with self._cond:
+                self._processing = None
+
+    def _pick_bucket_locked(self) -> Optional[Bucket]:
+        """Oldest-head-first across buckets: global FIFO fairness at batch
+        granularity (a hot bucket cannot starve a cold one)."""
+        best = None
+        for bucket, q in self._queues.items():
+            if q and (best is None
+                      or q[0].submitted_t < self._queues[best][0].submitted_t):
+                best = bucket
+        return best
+
+    def _dispatch(self, batch: List[MatchRequest], bucket: Bucket) -> None:
+        # the BATCH dimension is bucketed too (next power of two, capped at
+        # max_batch): without it every distinct coalesced size 1..max_batch
+        # compiles its own program per shape bucket, and the first
+        # occurrence of each size stalls the whole stream for a compile —
+        # the very spike the bounded-jit-cache design exists to prevent.
+        # Rows beyond len(batch) are zero padding; _drain_batch indexes
+        # results by request position and never reads them.
+        b = 1
+        while b < len(batch):
+            b *= 2
+        b = min(b, self.cfg.max_batch)
+        pad = [None] * (b - len(batch))
+        src = pad_to_bucket(
+            [r.src for r in batch] + pad, bucket[0])
+        tgt = pad_to_bucket(
+            [r.tgt for r in batch] + pad, bucket[1])
+        try:
+            handle = self._engine.dispatch(src, tgt)
+        except Exception as e:
+            self._on_batch_failure(batch, e, phase="dispatch")
+            return
+        self._batch_seq += 1
+        if self._heartbeat is not None:
+            # the liveness contract (tools/stall_watchdog.py): one beat per
+            # dispatched batch — a wedged fetch stops the beats
+            self._heartbeat.beat(step=self._batch_seq,
+                                 state=self._health.state)
+        with self._cond:
+            self._inflight.append(
+                _InFlight(handle, batch, bucket, time.monotonic()))
+            self._registry.gauge("queue_depth").set(self._queued_locked())
+
+    def _drain_batch(self, inf: _InFlight) -> None:
+        from ncnet_tpu.evaluation.pipeline import call_with_watchdog
+
+        try:
+            table = call_with_watchdog(
+                self._engine.fetch, (inf.handle,),
+                timeout=self.cfg.fetch_timeout_s, label="serve_fetch",
+            )
+        except Exception as e:
+            self._on_batch_failure(inf.batch, e, phase="fetch")
+            return
+        now = time.monotonic()
+        wall = now - inf.t0
+        self._controller.note_drain()
+        self._admission.note_batch_wall(wall)
+        self._registry.counter("batches").inc()
+        self._registry.timer("batch_wall_s").observe(wall)
+        with self._cond:
+            qd = self._queued_locked()
+        obs_events.emit(
+            "serve_batch", bucket=bucket_label(inf.bucket),
+            size=len(inf.batch), wall_s=round(wall, 6), queue_depth=qd,
+            inflight=len(self._inflight), seq=self._batch_seq,
+        )
+        tables, quality = self._engine.split(np.asarray(table))
+        tier = self._active_tier()
+        for i, req in enumerate(inf.batch):
+            if req.expired(now):
+                # deadline check at FETCH: the caller's budget is gone —
+                # the computed result is discarded, the outcome classified
+                self._resolve_deadline(req, "fetch")
+                continue
+            req_wall = now - req.submitted_t
+            result = MatchResult(
+                request_id=req.id, table=np.array(tables[i]),
+                quality=quality[i] if quality else None,
+                bucket=inf.bucket, wall_s=req_wall,
+            )
+            req.future._settle("result", result=result)
+            self._n["results"] += 1
+            self._registry.counter("results").inc()
+            self._registry.histogram(
+                f"serve_wall_ms_{bucket_label(inf.bucket)}",
+                0.0, self.cfg.latency_hist_ms,
+            ).add(req_wall * 1e3)
+            obs_events.emit(
+                "serve_result", request=req.id, client=req.client,
+                bucket=bucket_label(inf.bucket),
+                wall_ms=round(req_wall * 1e3, 3), batch_size=len(inf.batch),
+            )
+            if quality:
+                from ncnet_tpu.observability.quality import emit_quality
+
+                emit_quality("serving", quality[i], tier=tier,
+                             registry=self._registry, request=req.id)
+            self._terminal(req)
+
+    def _active_tier(self) -> str:
+        from ncnet_tpu.observability.quality import active_tier
+
+        return active_tier(getattr(self._engine, "half_precision", False))
+
+    # ------------------------------------------------------------------
+    # failure handling
+    # ------------------------------------------------------------------
+
+    def _on_batch_failure(self, batch: List[MatchRequest],
+                          exc: Exception, phase: str) -> None:
+        """One failed batch (dispatch raised, fetch raised, or the fetch
+        watchdog fired).  Recovery order mirrors ``run_isolated``: a
+        program-changing recovery (tier demotion + retrace) grants a FREE
+        retry of the whole batch; otherwise each request's bounded budget
+        is charged and exhausted requests quarantine.  Requeued requests go
+        to the FRONT of their bucket queue — queued work behind a failure
+        is delayed, never lost or reordered past the failure."""
+        from ncnet_tpu.evaluation.resilience import classify_failure
+        from ncnet_tpu.models.ncnet import recover_from_device_failure
+
+        self._controller.note_failure()
+        kind = classify_failure(exc)
+        try:
+            tier = recover_from_device_failure(exc, self._engine)
+        except Exception as rec_exc:  # noqa: BLE001 — recovery must not
+            # take the worker (and every queued request) down with it;
+            # a failed recovery just means the plain retry budget applies
+            log.error(f"tier recovery itself failed "
+                      f"({type(rec_exc).__name__}: {rec_exc}); falling "
+                      "back to the plain retry budget", kind="device")
+            tier = None
+        requeue: List[MatchRequest] = []
+        quarantine: List[MatchRequest] = []
+        if tier is not None:
+            with self._cond:
+                # a demotion during DRAINING/STOPPED must not fight the
+                # lifecycle states — the drain keeps completing admitted
+                # work on the demoted tier either way
+                if self._health.state in (STARTING, READY):
+                    self._health.to(DEGRADED, f"tier_demoted:{tier}")
+            log.warning(
+                f"serving batch {phase} failed ({kind}); demoted tier "
+                f"'{tier}' and re-tracing — {len(batch)} request(s) "
+                "requeued off-budget", kind=kind)
+            for req in batch:
+                obs_events.emit("retry", unit=req.id, kind=kind,
+                                recovered=tier, on_budget=False,
+                                scope="serving")
+                requeue.append(req)
+        else:
+            for req in batch:
+                req.attempts += 1
+                if req.attempts <= self.cfg.retries:
+                    obs_events.emit("retry", unit=req.id, kind=kind,
+                                    attempt=req.attempts, on_budget=True,
+                                    scope="serving")
+                    requeue.append(req)
+                else:
+                    quarantine.append(req)
+            if requeue:
+                log.warning(
+                    f"serving batch {phase} failed ({kind}: "
+                    f"{type(exc).__name__}: {exc}); {len(requeue)} "
+                    "request(s) requeued on-budget", kind=kind)
+        if requeue:
+            with self._cond:
+                q = self._queues.setdefault(requeue[0].bucket, deque())
+                q.extendleft(reversed(requeue))
+                self._cond.notify_all()
+        for req in quarantine:
+            self._quarantine(req, kind, exc)
+
+    def _quarantine(self, req: MatchRequest, kind: str,
+                    exc: Exception) -> None:
+        msg = (f"request {req.id} gave up after {req.attempts} attempt(s): "
+               f"{type(exc).__name__}: {exc}")
+        log.warning(f"{msg} — quarantined; the stream continues",
+                    kind="quarantine")
+        req.future._settle("quarantined", error=RequestQuarantined(
+            msg, kind=kind, attempts=req.attempts))
+        self._n["quarantined"] += 1
+        self._registry.counter("quarantined").inc()
+        obs_events.emit("serve_quarantine", request=req.id,
+                        client=req.client, kind=kind,
+                        attempts=req.attempts, error=str(exc)[:300])
+        if self._manifest is not None:
+            self._manifest.quarantine(req.id, kind, str(exc), req.attempts)
+        self._terminal(req)
+
+    def _resolve_deadline(self, req: MatchRequest, where: str) -> None:
+        req.future._settle("deadline", error=DeadlineExceeded(
+            f"request {req.id} deadline expired at {where}", where=where))
+        self._n["deadline"] += 1
+        self._registry.counter("deadline_exceeded").inc()
+        obs_events.emit("serve_deadline", request=req.id, client=req.client,
+                        where=where, admitted=True)
+        self._terminal(req)
+
+    def _terminal(self, req: MatchRequest) -> None:
+        """Close one admitted request's accounting (every settle path ends
+        here — the exactly-one-outcome bar)."""
+        with self._cond:
+            self._admission.note_done(req.client)
+        if self._draining:
+            self._drain_resolved += 1
+            from ncnet_tpu.utils import faults
+
+            # chaos seam: SIGKILL after the Nth terminal outcome of the
+            # drain phase (tests prove the event log still accounts for
+            # everything that had no outcome yet)
+            faults.serve_drain_kill_hook(self._drain_resolved)
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+
+    def _finish(self, crashed: Optional[BaseException]) -> None:
+        with self._cond:
+            self._finishing = True  # admission closed before collection
+            leftovers: List[MatchRequest] = []
+            for q in self._queues.values():
+                leftovers.extend(q)
+            self._queues.clear()
+            for inf in self._inflight:
+                leftovers.extend(inf.batch)
+            self._inflight.clear()
+            if self._processing:
+                # the batch the worker held when it crashed — in no queue
+                # and no longer in the in-flight deque
+                leftovers.extend(self._processing)
+                self._processing = None
+        reason = "crashed" if crashed is not None else "shutdown"
+        for req in leftovers:
+            if req.future.done():
+                continue  # settled before the crash interrupted its batch
+            # an aborted shutdown (or a worker crash) still settles every
+            # admitted request with a classified outcome
+            req.future._settle("overloaded", error=Overloaded(
+                f"service stopped before request {req.id} completed",
+                reason=reason))
+            self._n["shed"] += 1
+            obs_events.emit("serve_shed", request=req.id, client=req.client,
+                            reason=reason, admitted=True)
+            self._terminal(req)
+        obs_events.emit(
+            "serve_drain", drained=self._draining and crashed is None,
+            leftover=len(leftovers), **{f"n_{k}": v
+                                        for k, v in self._n.items()},
+        )
+        self._registry.flush(scope="serving")
+        with self._cond:
+            if self._health.state != STOPPED:
+                self._health.to(
+                    STOPPED, "crashed" if crashed is not None else "clean")
+            self._cond.notify_all()

@@ -52,6 +52,22 @@ claims about its event log, so it gets the same proof obligation:
     mid-append of the Nth event record (per process), flushing a torn
     prefix first — replay and re-open must tolerate the partial tail.
 
+The resident match service (ncnet_tpu/serving/) rides the existing serving-
+shaped hooks — ``device_error_hook`` fires on its batch dispatches (the
+engine's ResilientJit carries label ``serve_batch``) and
+``hang_fetch_hook`` on its watchdogged batch fetches — and adds:
+
+  * ``serve_drain_kill_hook(n)``  — serving/service.MatchService: SIGKILLs
+    the process after the Nth request reaches a terminal outcome DURING a
+    drain — the kill-mid-drain crash window.  The replayed event log must
+    still account for every admitted request (terminal or provably
+    in-flight at death), which ``tools/run_report.py --serving`` checks.
+  * ``queue_overflow_burst(...)`` — not a hook but the chaos traffic
+    generator: fires N back-to-back submissions at a service and returns
+    the admitted futures + classified sheds, the deterministic
+    queue-overflow shape the chaos suite and ``tools/serve_probe.py``
+    share.
+
 Arming: programmatic via :func:`install`/:func:`clear` (or the
 :func:`injected` context manager) in-process, or the ``NCNET_TPU_FAULTS``
 environment variable (a JSON object of :class:`FaultPlan` fields) for
@@ -127,6 +143,11 @@ class FaultPlan:
     # SIGKILL self mid-append of the Nth observability EventLog record
     # (1-based, per EventLog instance), flushing a torn prefix first
     kill_at_event_append: int = -1
+    # --- serving faults (ncnet_tpu/serving/ layer) ---
+    # SIGKILL self after the Nth terminal request outcome of a service
+    # DRAIN (1-based) — the kill-mid-drain window: some admitted requests
+    # die without an outcome and the event log must prove exactly which
+    kill_at_drain_result: int = -1
 
 
 _plan: Optional[FaultPlan] = None
@@ -310,6 +331,57 @@ def journal_kill_hook(n_append: int, write_partial: Callable[[], None]) -> None:
         return
     write_partial()
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def serve_drain_kill_hook(n_resolved: int) -> None:
+    """SIGKILL self after the ``n_resolved``-th terminal request outcome of
+    a serving drain (if armed) — the kill-mid-drain crash window.  The
+    event log's fsynced appends mean every outcome emitted before the kill
+    survives; run_report --serving must account the rest as lost-in-drain,
+    not silently."""
+    p = _active()
+    if p is None or p.kill_at_drain_result < 0 \
+            or n_resolved != p.kill_at_drain_result:
+        return
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def queue_overflow_burst(submit: Callable[[], object], n: int):
+    """Drive ``n`` back-to-back submissions (the queue-overflow chaos
+    traffic shape): returns ``(futures, sheds)`` where ``futures`` are the
+    admitted :class:`~ncnet_tpu.serving.request.MatchFuture`s and ``sheds``
+    the classified :class:`~ncnet_tpu.serving.request.Overloaded`
+    rejections, in submission order.  Any other exception propagates — a
+    burst that crashes the service is a finding, not a shed."""
+    return paced_burst(submit, rate_qps=0.0, n=n)
+
+
+def paced_burst(submit: Callable[[], object], rate_qps: float, n: int):
+    """Open-loop paced traffic: one submission every ``1/rate_qps``
+    seconds regardless of completions (``rate_qps <= 0`` = back to back).
+    Returns ``(futures, sheds)`` like :func:`queue_overflow_burst`.
+
+    The pacing is load-bearing for the bench's ``serve_shed_pct`` gate
+    direction: at a PINNED overload factor the steady state admits
+    ~capacity and sheds the rest, so the shed fraction reads as the
+    overload fraction and gates lower-is-better soundly — a back-to-back
+    burst instead sheds MORE the faster the service is (queue/offered),
+    which would invert the gate.  One implementation here so bench.py and
+    tools/serve_probe.py can never drift apart on that subtlety."""
+    from ncnet_tpu.serving.request import Overloaded
+
+    futures, sheds = [], []
+    t0 = time.perf_counter()
+    for i in range(int(n)):
+        if rate_qps > 0:
+            dt = t0 + i / rate_qps - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+        try:
+            futures.append(submit())
+        except Overloaded as e:
+            sheds.append(e)
+    return futures, sheds
 
 
 def event_kill_hook(n_append: int, write_partial: Callable[[], None]) -> None:

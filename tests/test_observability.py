@@ -660,12 +660,15 @@ def test_library_modules_have_no_bare_print(tmp_path):
         os.path.join(_REPO, "ncnet_tpu"))
     assert hits == [], f"bare print() in library modules: {hits}"
 
-    # round-10 additions pinned explicitly (the quality layer and its gate
-    # write structured events / sys.stdout — a bare print() would reopen
-    # the side channel): the whole-package walk covers quality.py, but the
-    # TOOLS are outside it and only this pin keeps them honest
+    # round-10/11 additions pinned explicitly (the quality layer, the
+    # serving subsystem, and their tools write structured events /
+    # sys.stdout — a bare print() would reopen the side channel): the
+    # whole-package walk covers the ncnet_tpu/ paths, but the TOOLS are
+    # outside it and only this pin keeps them honest
     for target in ("ncnet_tpu/observability/quality.py",
-                   "tools/quality_drift.py"):
+                   "ncnet_tpu/serving",
+                   "tools/quality_drift.py",
+                   "tools/serve_probe.py"):
         hits = check_no_bare_print.find_bare_prints(
             os.path.join(_REPO, target))
         assert hits == [], f"bare print() in {target}: {hits}"

@@ -1,0 +1,802 @@
+"""Chaos suite for the resident match service (ncnet_tpu/serving/).
+
+The ISSUE 8 acceptance bars, executed deterministically through the
+utils/faults.py harness:
+
+  (a) sustained synthetic query stream → injected device failure mid-stream
+      → the service demotes a tier and KEEPS SERVING with zero lost
+      requests (every admitted request reaches exactly one terminal
+      outcome, proven by event-log accounting in run_report --serving);
+  (b) SIGTERM → in-flight requests complete, the drain event is emitted,
+      admission stays closed, clean exit;
+  (c) an overload burst sheds with classified ``Overloaded`` (and never
+      deadline-blows admitted work), and deadline-expired requests are
+      evicted before dispatch;
+  (d) kill-mid-drain (SIGKILL) → the replayed event log still accounts for
+      every admitted request, naming the ones that died without an outcome.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+import jax
+
+from ncnet_tpu import models, ops
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.observability import EventLog
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.serving import (
+    DEGRADED,
+    DRAINING,
+    READY,
+    STARTING,
+    STOPPED,
+    AdmissionController,
+    BatchMatchEngine,
+    DeadlineExceeded,
+    HealthMachine,
+    MatchService,
+    Overloaded,
+    RequestQuarantined,
+    ServingConfig,
+    ShapeBucketer,
+)
+from ncnet_tpu.utils import faults
+from ncnet_tpu.utils.faults import FaultPlan, queue_overflow_burst
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import run_report  # noqa: E402
+import stall_watchdog  # noqa: E402
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                   ncons_channels=(1,))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No armed faults, no demoted tiers, no leaked event sink."""
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return models.init_ncnet(TINY, jax.random.key(0))
+
+
+def u8(side=32, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+class FakeEngine:
+    """Device stand-in for lifecycle tests: deterministic tables, a
+    configurable fetch latency, and the same fault-injection seams as the
+    real engine (``device_error_hook`` at dispatch; the hang hook fires
+    inside ``call_with_watchdog`` when a fetch timeout is configured)."""
+
+    split = staticmethod(BatchMatchEngine.split)
+    half_precision = False
+
+    def __init__(self, latency_s: float = 0.0):
+        self.latency_s = latency_s
+        self.retraces = 0
+        self.dispatches = 0
+        self.batch_sizes = []  # PADDED sizes, as a jit cache would key them
+
+    def dispatch(self, src, tgt):
+        faults.device_error_hook("fake_serve")
+        self.dispatches += 1
+        self.batch_sizes.append(src.shape[0])
+        return (src.shape[0], time.monotonic())
+
+    def fetch(self, handle):
+        b, t0 = handle
+        # poll the latency knob so a test can release a simulated wedge
+        # mid-fetch (lowering latency_s frees the blocked worker at once)
+        while time.monotonic() - t0 < self.latency_s:
+            time.sleep(0.01)
+        table = np.zeros((b, 6, 16), np.float32)
+        table[:, 4, :] = 1.0
+        table[:, 5, :5] = [0.5, 0.1, 0.4, 0.9, 0.8]
+        return table
+
+    def retrace(self):
+        self.retraces += 1
+
+
+def fake_service(tmp_path=None, latency_s=0.0, **over):
+    cfg = dict(bucket_multiple=32, max_image_side=128, max_batch=8)
+    cfg.update(over)
+    engine = FakeEngine(latency_s=latency_s)
+    return MatchService(engine=engine, serving=ServingConfig(**cfg)), engine
+
+
+# ---------------------------------------------------------------------------
+# units: bucketer, admission, health
+# ---------------------------------------------------------------------------
+
+
+def test_bucketer_rounds_caps_and_bounds():
+    b = ShapeBucketer(multiple=32, max_side=64, max_buckets=2)
+    assert b.bucket_for((30, 33), (10, 10)) == ((32, 64), (32, 32))
+    assert b.bucket_for((32, 64), (32, 32)) == ((32, 64), (32, 32))
+    # too large for any bucket: unservable, retry can never help
+    with pytest.raises(Overloaded) as e:
+        b.bucket_for((100, 10), (10, 10))
+    assert e.value.reason == "unservable_shape"
+    # a THIRD distinct pair bucket exceeds the compiled-program budget
+    b.bucket_for((64, 64), (64, 64))
+    with pytest.raises(Overloaded) as e:
+        b.bucket_for((10, 10), (10, 10))
+    assert e.value.reason == "bucket_capacity"
+
+    fixed = ShapeBucketer(fixed=[(48, 48), (96, 96)], max_buckets=4)
+    assert fixed.bucket_for((40, 40), (50, 50)) == ((48, 48), (96, 96))
+    with pytest.raises(Overloaded):
+        fixed.bucket_for((97, 10), (10, 10))
+
+
+def test_shed_request_does_not_consume_bucket_budget():
+    """peek is budget-free; only an ADMITTED request commits a compiled-
+    program slot — a burst of shed requests with novel shapes must not
+    permanently burn the bucket budget."""
+    b = ShapeBucketer(multiple=32, max_side=64, max_buckets=1)
+    assert b.peek((10, 10), (10, 10)) == ((32, 32), (32, 32))
+    assert b.buckets == []
+    b.commit(((32, 32), (32, 32)))
+    with pytest.raises(Overloaded):
+        b.peek((64, 64), (64, 64))
+
+    svc, eng = fake_service(latency_s=0.3, max_queue=1, max_batch=1,
+                            pipeline_depth=1, max_buckets=2)
+    svc.start()
+    try:
+        f1 = svc.submit(u8(32, 1), u8(32, 1))  # bucket A, goes in flight
+        time.sleep(0.05)
+        f2 = svc.submit(u8(32, 2), u8(32, 2))  # fills the 1-deep queue
+        with pytest.raises(Overloaded) as e:
+            svc.submit(u8(40, 3), u8(40, 3))   # NEW shape, queue full
+        assert e.value.reason == "queue_full"
+        assert svc.health()["buckets"] == ["32x32-32x32"]  # no leaked slot
+        f1.result(timeout=30)
+        f2.result(timeout=30)
+        # the previously-shed shape is admissible once there is room
+        assert svc.submit(u8(40, 4), u8(40, 4)).result(timeout=30)
+    finally:
+        svc.stop()
+
+
+def test_admission_controller_bounds_and_retry_after():
+    a = AdmissionController(max_queue=2, max_in_flight_per_client=2,
+                            max_batch=2)
+    a.admit("c1", 0)
+    a.note_admit("c1")
+    a.admit("c1", 1)
+    a.note_admit("c1")
+    with pytest.raises(Overloaded) as e:
+        a.admit("c2", 2)  # queue full
+    assert e.value.reason == "queue_full" and e.value.retry_after_s > 0
+    with pytest.raises(Overloaded) as e:
+        a.admit("c1", 1)  # per-client cap
+    assert e.value.reason == "client_cap"
+    a.note_done("c1")
+    a.admit("c1", 1)  # back under the cap
+    # retry-after tracks measured throughput
+    a.note_batch_wall(0.2)
+    assert a.retry_after_s(8) == pytest.approx(4 * 0.2, rel=0.3)
+
+
+def test_health_machine_transitions(tmp_path):
+    with obs_events.bound(EventLog(str(tmp_path / "e.jsonl"))):
+        h = HealthMachine()
+        assert h.state == STARTING and h.admitting
+        assert h.to(READY, "warm")
+        assert not h.to(READY)  # idempotent re-entry is not an error
+        assert h.to(DEGRADED, "tier_demoted:resident")
+        assert h.admitting
+        assert h.to(DRAINING, "sigterm") and not h.admitting
+        with pytest.raises(RuntimeError):
+            h.to(READY)
+        assert h.to(STOPPED)
+    _, events = obs_events.replay_events(str(tmp_path / "e.jsonl"))
+    states = [e["state"] for e in events if e["event"] == "serve_health"]
+    assert states == [READY, DEGRADED, DRAINING, STOPPED]
+    assert h.probe()["state"] == STOPPED
+
+
+def test_engine_split_protocol():
+    t6 = np.zeros((2, 6, 8), np.float32)
+    t6[:, 5, :5] = [0.5, 0.1, 0.4, 0.9, 0.8]
+    tables, quality = BatchMatchEngine.split(t6)
+    assert tables.shape == (2, 5, 8)
+    assert quality[1]["score"] == pytest.approx(0.5)
+    assert quality[0]["coherence"] == pytest.approx(0.8)
+    t5 = np.zeros((2, 5, 8), np.float32)
+    tables, quality = BatchMatchEngine.split(t5)
+    assert tables.shape == (2, 5, 8) and quality is None
+    with pytest.raises(ValueError):
+        BatchMatchEngine.split(np.zeros((2, 7, 8), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# serving correctness: the real tiny engine
+# ---------------------------------------------------------------------------
+
+
+def test_service_serves_real_matches_with_quality(tiny_params):
+    """The served table equals the warm point matcher's output for the same
+    pair (no pad: 32-aligned input), and the per-request quality dict is
+    the same signal set the matcher emits."""
+    # 64 px: the 4x4 feature grid gives N=16 match cells, wide enough for
+    # the 5-signal quality row (a 2x2 grid would drop it by design)
+    src, tgt = u8(64, 1), u8(64, 2)
+    svc = MatchService(TINY, tiny_params, ServingConfig(
+        bucket_multiple=32, max_image_side=64)).start()
+    try:
+        res = svc.submit(src, tgt).result(timeout=120)
+    finally:
+        svc.stop()
+    matcher = models.make_point_matcher(TINY, tiny_params, do_softmax=True)
+    want, want_q = matcher.match_with_quality(src[None], tgt[None])
+    for got_row, want_row in zip(res.table, want):
+        np.testing.assert_allclose(
+            got_row, np.asarray(want_row, np.float32)[0], atol=1e-5)
+    assert set(res.quality) == set(want_q)
+    for name, v in want_q.items():
+        assert res.quality[name] == pytest.approx(v, abs=1e-5)
+    assert svc.health()["counters"]["results"] == 1
+
+
+def test_point_matcher_quality_is_per_call(tiny_params):
+    """Satellite fix: quality travels WITH each fetched result — two
+    in-flight pairs cannot read each other's signals (the old
+    ``last_quality`` closure attribute was last-write-wins)."""
+    matcher = models.make_point_matcher(TINY, tiny_params, do_softmax=True)
+    a1, a2 = u8(64, 3)[None], u8(64, 4)[None]
+    h1 = matcher.dispatch(a1, a1)
+    h2 = matcher.dispatch(a2, a2)
+    m1, q1 = matcher.fetch_with_quality(h1)
+    m2, q2 = matcher.fetch_with_quality(h2)
+    assert q1 is not None and q2 is not None and q1 != q2
+    # the legacy attribute still tracks the LAST fetch (demo convenience)
+    assert matcher.last_quality == q2
+    # plain fetch keeps its old signature
+    assert len(matcher.fetch(matcher.dispatch(a1, a1))) == 5
+    # and the one-shot with-quality call matches its parts
+    m, q = matcher.match_with_quality(a1, a1)
+    assert q == pytest.approx(q1, abs=1e-6)
+
+
+def test_two_resolutions_two_buckets(tiny_params):
+    """Variable-resolution queries coalesce into distinct padded buckets,
+    both served; the bucket rides on the result."""
+    svc = MatchService(TINY, tiny_params, ServingConfig(
+        bucket_multiple=32, max_image_side=64, max_buckets=4)).start()
+    try:
+        f1 = svc.submit(u8(32, 1), u8(32, 2))
+        f2 = svc.submit(u8(40, 3), u8(40, 4))  # pads to 64
+        r1, r2 = f1.result(timeout=120), f2.result(timeout=120)
+    finally:
+        svc.stop()
+    assert r1.bucket == ((32, 32), (32, 32))
+    assert r2.bucket == ((64, 64), (64, 64))
+    assert sorted(svc.health()["buckets"]) == ["32x32-32x32", "64x64-64x64"]
+
+
+# ---------------------------------------------------------------------------
+# batching, admission, deadlines (fake device)
+# ---------------------------------------------------------------------------
+
+
+def test_continuous_batching_coalesces(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.05)
+        svc.start()
+        img = u8()
+        futs = [svc.submit(img, img) for _ in range(12)]
+        for f in futs:
+            f.result(timeout=30)
+        svc.stop()
+    _, events = obs_events.replay_events(log_path)
+    sizes = [e["size"] for e in events if e["event"] == "serve_batch"]
+    assert sum(sizes) == 12
+    # the queue builds while a batch is in flight; later dispatches coalesce
+    assert max(sizes) >= 2
+    assert len(sizes) < 12
+    # the batch dim the DEVICE sees is bucketed to a power-of-two ladder —
+    # otherwise every coalesced size 1..max_batch compiles its own program
+    assert set(eng.batch_sizes) <= {1, 2, 4, 8}
+
+
+def test_overload_burst_sheds_never_deadline_blows_admitted(tmp_path):
+    """Acceptance (c): a burst beyond the queue bound sheds with classified
+    Overloaded + retry-after, and every ADMITTED request still resolves as
+    a result (the bound is what protects admitted work's latency)."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.03, max_queue=4, max_batch=2,
+                                default_deadline_s=20.0)
+        svc.start()
+        img = u8()
+        futs, sheds = queue_overflow_burst(
+            lambda: svc.submit(img, img), 30)
+        outcomes = []
+        for f in futs:
+            f.result(timeout=30)
+            outcomes.append(f.outcome)
+        svc.stop()
+    assert sheds, "a 30-deep burst against a 4-deep queue must shed"
+    assert all(s.reason == "queue_full" for s in sheds)
+    assert all(s.retry_after_s and s.retry_after_s > 0 for s in sheds)
+    assert all(o == "result" for o in outcomes)
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["deadline_exceeded"] == 0
+    assert sec["outcomes"]["unresolved"] == 0
+    assert sec["shed_reasons"]["queue_full"] == len(sheds)
+
+
+def test_per_client_cap_isolates_misbehaving_client():
+    svc, eng = fake_service(latency_s=0.1, max_queue=32,
+                            max_in_flight_per_client=2)
+    svc.start()
+    try:
+        img = u8()
+        futs, sheds = [], []
+        for _ in range(6):
+            try:
+                futs.append(svc.submit(img, img, client="noisy"))
+            except Overloaded as e:
+                sheds.append(e)
+        assert sheds and all(s.reason == "client_cap" for s in sheds)
+        # the polite client is unaffected by the noisy one's cap
+        ok = svc.submit(img, img, client="polite")
+        assert ok.result(timeout=30).request_id
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        svc.stop()
+
+
+def test_deadline_checked_at_admission_dequeue_and_fetch(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.25, pipeline_depth=1,
+                                max_batch=1)
+        svc.start()
+        img = u8()
+        # admission: an already-spent budget is refused synchronously
+        with pytest.raises(DeadlineExceeded) as e:
+            svc.submit(img, img, deadline_s=0)
+        assert e.value.where == "admission"
+        # dequeue: r2 expires while r1's batch occupies the (depth-1)
+        # pipeline — evicted before dispatch, never wasting a device slot
+        f1 = svc.submit(img, img)
+        time.sleep(0.02)  # let the worker take r1 in flight first
+        f2 = svc.submit(img, img, deadline_s=0.05)
+        with pytest.raises(DeadlineExceeded) as e:
+            f2.result(timeout=30)
+        assert e.value.where == "dequeue"
+        assert f1.result(timeout=30)
+        eng2_dispatches = eng.dispatches
+        # fetch: the result lands after the caller's budget — classified,
+        # not returned as a zombie success.  The idle worker dispatches in
+        # ms, far under the 0.2 s budget; the 0.5 s fetch blows it.
+        eng.latency_s = 0.5
+        f3 = svc.submit(img, img, deadline_s=0.2)
+        with pytest.raises(DeadlineExceeded) as e:
+            f3.result(timeout=30)
+        assert e.value.where == "fetch"
+        assert eng.dispatches == eng2_dispatches + 1
+        svc.stop()
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_serving_section(events)
+    assert sec["deadline_where"] == {"admission": 1, "dequeue": 1,
+                                     "fetch": 1}
+    # admission-refused budgets were never admitted; accounting stays total
+    assert sec["outcomes"]["admitted"] == 3
+    assert sec["outcomes"]["unresolved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# failure handling: demotion, quarantine, hung fetch
+# ---------------------------------------------------------------------------
+
+
+def test_device_failure_demotes_and_keeps_serving_zero_lost(
+        tmp_path, tiny_params):
+    """Acceptance (a): sustained stream → injected device failure
+    mid-stream → tier demoted, service DEGRADED but serving, every admitted
+    request reaches exactly one terminal outcome (event-log accounting),
+    zero lost."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc = MatchService(TINY, tiny_params, ServingConfig(
+            bucket_multiple=32, max_image_side=64, max_batch=2,
+            quarantine_dir=str(tmp_path / "q"))).start()
+        # ordinal 2: the SECOND dispatched batch fails mid-stream
+        faults.install(FaultPlan(device_fail_calls=(2,)))
+        try:
+            futs = [svc.submit(u8(32, i), u8(32, i + 100))
+                    for i in range(8)]
+            outcomes = []
+            for f in futs:
+                f.result(timeout=180)
+                outcomes.append(f.outcome)
+            health = svc.health()
+        finally:
+            faults.clear()
+            svc.stop()
+    assert all(o == "result" for o in outcomes)
+    assert health["state"] == DEGRADED
+    assert ops.demoted_fused_tiers()  # the ladder actually moved
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["admitted"] == 8
+    assert sec["outcomes"]["results"] == 8
+    assert sec["outcomes"]["unresolved"] == 0 and not sec["lost_requests"]
+    # the off-budget recovery retry is in the log, attributed to serving
+    retries = [e for e in events if e.get("event") == "retry"
+               and e.get("scope") == "serving"]
+    assert retries and all(e["on_budget"] is False for e in retries)
+    assert any(e.get("event") == "serve_health"
+               and e.get("state") == DEGRADED for e in events)
+    # nothing quarantined: the manifest stays empty
+    from ncnet_tpu.evaluation.resilience import manifest_has_quarantined
+
+    assert not manifest_has_quarantined(
+        str(tmp_path / "q" / "manifest.json"))
+
+
+def test_exhausted_failures_quarantine_and_stream_continues(
+        tmp_path, tiny_params):
+    """With every tier already demoted (nothing left to recover with) and
+    the retry budget at zero, a persistently failing request quarantines —
+    into the manifest AND as a classified future error — while the next
+    request serves normally."""
+    while ops.demote_fused_tier() is not None:
+        pass
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc = MatchService(TINY, tiny_params, ServingConfig(
+            bucket_multiple=32, max_image_side=64, retries=0,
+            quarantine_dir=str(tmp_path / "q"))).start()
+        faults.install(FaultPlan(device_fail_calls=tuple(range(1, 10))))
+        try:
+            f = svc.submit(u8(32, 1), u8(32, 2))
+            with pytest.raises(RequestQuarantined) as e:
+                f.result(timeout=120)
+            assert e.value.kind == "device" and f.outcome == "quarantined"
+            faults.clear()
+            ok = svc.submit(u8(32, 3), u8(32, 4))
+            assert ok.result(timeout=120).table.shape[0] == 5
+        finally:
+            faults.clear()
+            svc.stop()
+    from ncnet_tpu.evaluation.resilience import RunManifest
+
+    m = RunManifest(str(tmp_path / "q" / "manifest.json"),
+                    meta={"scope": "serving"})
+    assert m.data["quarantined"] and \
+        list(m.data["quarantined"].values())[0]["kind"] == "device"
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["quarantined"] == 1
+    assert sec["outcomes"]["results"] == 1
+    assert sec["outcomes"]["unresolved"] == 0
+
+
+def test_recovery_crash_falls_back_to_retry_budget(monkeypatch):
+    """If the tier-recovery path ITSELF raises, the worker must not die
+    (taking every queued request with it): the failure falls back to the
+    plain retry budget and the request still completes."""
+    import ncnet_tpu.models.ncnet as ncnet_mod
+
+    def boom(exc, *retraceables, **kw):
+        raise RuntimeError("recovery exploded")
+
+    monkeypatch.setattr(ncnet_mod, "recover_from_device_failure", boom)
+    svc, eng = fake_service(max_batch=1, retries=1)
+    svc.start()
+    faults.install(FaultPlan(device_fail_calls=(1,)))
+    try:
+        f = svc.submit(u8(), u8())
+        assert f.result(timeout=30).request_id
+        assert f.outcome == "result"
+        assert svc.state == READY  # no crash, no spurious DEGRADED
+    finally:
+        faults.clear()
+        svc.stop()
+
+
+def test_hung_fetch_surfaces_as_timeout_and_retries(tmp_path):
+    """A hung tunnel fetch (injected) overruns the fetch watchdog, is
+    classified 'timeout', charged to the budget, and the retried batch
+    completes — the stream never wedges."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.0, fetch_timeout_s=0.3,
+                                retries=1)
+        svc.start()
+        faults.install(FaultPlan(hang_fetch_calls=(1,),
+                                 hang_fetch_seconds=1.5))
+        try:
+            t0 = time.monotonic()
+            f = svc.submit(u8(), u8())
+            res = f.result(timeout=30)
+            assert res.request_id and f.outcome == "result"
+            assert time.monotonic() - t0 < 10
+        finally:
+            faults.clear()
+            svc.stop()
+    _, events = obs_events.replay_events(log_path)
+    assert any(e.get("event") == "watchdog_timeout" for e in events)
+    retries = [e for e in events if e.get("event") == "retry"
+               and e.get("scope") == "serving"]
+    assert retries and retries[0]["kind"] == "timeout" \
+        and retries[0]["on_budget"] is True
+
+
+# ---------------------------------------------------------------------------
+# drain: SIGTERM, kill-mid-drain
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_drains_in_flight_then_stops(tmp_path):
+    """Acceptance (b): SIGTERM → admission closes, every admitted request
+    completes, the drain event lands, the exit is clean."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.04, max_batch=2,
+                                install_sigterm=True)
+        svc.start()
+        img = u8()
+        futs = [svc.submit(img, img) for _ in range(6)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        for f in futs:
+            f.result(timeout=30)
+        # the worker notices the flag, drains, and stops on its own
+        deadline = time.monotonic() + 10
+        while svc.state != STOPPED and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.state == STOPPED
+        with pytest.raises(Overloaded) as e:
+            svc.submit(img, img)
+        assert e.value.reason in ("draining", "stopped")
+        svc.stop()  # restores the old handler; worker already gone
+    assert all(f.outcome == "result" for f in futs)
+    _, events = obs_events.replay_events(log_path)
+    drains = [e for e in events if e.get("event") == "serve_drain"]
+    assert len(drains) == 1 and drains[0]["drained"] is True \
+        and drains[0]["leftover"] == 0
+    states = [e["state"] for e in events if e.get("event") == "serve_health"]
+    assert states[-2:] == [DRAINING, STOPPED]
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["unresolved"] == 0
+
+
+def test_device_failure_during_drain_still_completes(tmp_path):
+    """A device failure while DRAINING must not fight the lifecycle state
+    machine (DRAINING -> DEGRADED is illegal): the tier still demotes, the
+    batch still requeues off-budget, and the drain guarantee — every
+    admitted request completes — holds."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.03, max_batch=1,
+                                pipeline_depth=1)
+        svc.start()
+        img = u8()
+        faults.install(FaultPlan(device_fail_calls=(3,)))
+        try:
+            futs = [svc.submit(img, img) for _ in range(6)]
+            svc.request_drain()
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            faults.clear()
+            svc.stop(timeout=30)
+    assert all(f.outcome == "result" for f in futs)
+    assert eng.retraces == 1  # the recovery really ran, mid-drain
+    assert ops.demoted_fused_tiers()
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["unresolved"] == 0
+    states = [e["state"] for e in events if e.get("event") == "serve_health"]
+    assert DEGRADED not in states  # no illegal DRAINING -> DEGRADED edge
+    assert states[-2:] == [DRAINING, STOPPED]
+
+
+def test_abort_stop_settles_queued_work_classified(tmp_path):
+    """stop(drain=False) is still outcome-total: queued work settles
+    Overloaded(reason='shutdown'), never a hang or a silent drop — and the
+    serve_drain event says drained=False (an abort that rejected admitted
+    work must stay distinguishable from a clean drain)."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.2, max_batch=1,
+                                pipeline_depth=1)
+        svc.start()
+        img = u8()
+        futs = [svc.submit(img, img) for _ in range(5)]
+        svc.stop(drain=False, timeout=30)
+    outcomes = set()
+    for f in futs:
+        try:
+            f.result(timeout=10)
+            outcomes.add("result")
+        except Overloaded as e:
+            assert e.reason == "shutdown"
+            outcomes.add("overloaded")
+    assert "overloaded" in outcomes  # the tail was aborted, classified
+    assert all(f.outcome is not None for f in futs)
+    _, events = obs_events.replay_events(log_path)
+    drains = [e for e in events if e.get("event") == "serve_drain"]
+    assert len(drains) == 1 and drains[0]["drained"] is False \
+        and drains[0]["leftover"] > 0
+
+
+_KILL_MID_DRAIN_CHILD = """
+import os, sys, time
+import numpy as np
+
+sys.path.insert(0, {repo!r})
+from ncnet_tpu.observability import EventLog
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.serving import MatchService, ServingConfig
+from ncnet_tpu.serving.engine import BatchMatchEngine
+
+
+class FakeEngine:
+    split = staticmethod(BatchMatchEngine.split)
+    half_precision = False
+
+    def dispatch(self, s, t):
+        return s.shape[0]
+
+    def fetch(self, b):
+        time.sleep(0.03)
+        tab = np.zeros((b, 6, 16), np.float32)
+        tab[:, 5, :5] = 0.5
+        return tab
+
+    def retrace(self):
+        pass
+
+
+obs_events.set_global_sink(EventLog(sys.argv[1]))
+svc = MatchService(engine=FakeEngine(), serving=ServingConfig(
+    bucket_multiple=32, max_image_side=64, max_batch=1,
+    pipeline_depth=1)).start()
+img = np.zeros((32, 32, 3), np.uint8)
+futs = [svc.submit(img, img) for _ in range(8)]
+svc.request_drain()
+svc.stop(timeout=60)
+sys.stdout.write("CLEAN\\n")
+"""
+
+
+def test_kill_mid_drain_event_log_accounts_for_losses(tmp_path):
+    """Acceptance (d): SIGKILL after the 3rd terminal outcome of the drain.
+    The fsynced event log survives; replayed accounting identifies exactly
+    the admitted requests that died without an outcome — they are named,
+    not silently lost."""
+    log_path = str(tmp_path / "events.jsonl")
+    child = tmp_path / "child.py"
+    child.write_text(_KILL_MID_DRAIN_CHILD.format(repo=_REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off",
+               NCNET_TPU_FAULTS=json.dumps({"kill_at_drain_result": 3}))
+    proc = subprocess.run(
+        [sys.executable, str(child), log_path],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "CLEAN" not in proc.stdout
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["admitted"] == 8
+    # >= 3 terminals made it to disk before the kill; the rest are NAMED
+    assert sec["outcomes"]["terminals"] >= 3
+    assert sec["outcomes"]["unresolved"] == len(sec["lost_requests"]) > 0
+    # the tool renders the degraded log end to end
+    assert run_report.main([log_path, "--serving", "--json"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# liveness: heartbeat + stall watchdog (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_stall_watchdog_flags_wedged_service_green_under_load(tmp_path):
+    """The service beats the heartbeat once per dispatched batch; the
+    stall watchdog derives its threshold from the serve_batch cadence in
+    the sibling event log — green under load, STALLED while a hung fetch
+    wedges the pipeline, green again after recovery."""
+    hb = str(tmp_path / "heartbeat.json")
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.02, max_batch=1,
+                                pipeline_depth=1, heartbeat_path=hb)
+        svc.start()
+        img = u8()
+        for f in [svc.submit(img, img) for _ in range(10)]:
+            f.result(timeout=30)
+        # under load: fresh beats, cadence-derived threshold, alive
+        v = stall_watchdog.judge(hb, events_path=log_path, factor=5,
+                                 min_age=0.4)
+        assert v["status"] == "alive"
+        assert v["median_step_wall_s"] is not None  # serve_batch cadence
+        # wedge the device: the next fetch hangs; beats stop.  The wait
+        # must clear factor x median even when suite load inflates the
+        # recorded batch walls — 2 s vs 5 x ~0.02-0.1 s leaves margin
+        eng.latency_s = 30.0
+        svc.submit(img, img)
+        time.sleep(2.0)
+        v = stall_watchdog.judge(hb, events_path=log_path, factor=5,
+                                 min_age=0.4)
+        assert v["status"] == "stalled"
+        # release the wedge: the blocked fetch returns, beats resume with
+        # the next dispatched batches and the verdict recovers
+        eng.latency_s = 0.0
+        for f in [svc.submit(img, img) for _ in range(3)]:
+            f.result(timeout=30)
+        assert stall_watchdog.judge(
+            hb, events_path=log_path, factor=5,
+            min_age=0.4)["status"] == "alive"
+        svc.stop(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# tools: probe smoke, report rendering
+# ---------------------------------------------------------------------------
+
+
+def test_serve_probe_tiny_smoke(tmp_path, capsys):
+    import serve_probe
+
+    out_path = str(tmp_path / "probe.json")
+    rc = serve_probe.main(["--tiny", "--sides", "32", "--pairs", "4",
+                           "--no-demote", "--burst-factor", "1.0",
+                           "--json", out_path])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    with open(out_path) as f:
+        assert json.load(f) == doc
+    assert "32x32" in doc["buckets"]
+    assert doc["buckets"]["32x32"]["latency_ms"]["n"] == 4
+    assert doc["burst"]["offered"] >= 32
+    assert doc["health"]["counters"]["results"] >= 4
+
+
+def test_run_report_serving_text_render(tmp_path, capsys):
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, eng = fake_service(latency_s=0.01)
+        svc.start()
+        img = u8()
+        for f in [svc.submit(img, img) for _ in range(3)]:
+            f.result(timeout=30)
+        svc.stop()
+    assert run_report.main([log_path, "--serving"]) == 0
+    out = capsys.readouterr().out
+    assert "serving:" in out
+    assert "exactly one terminal outcome" in out
+    assert "admitted=3" in out and "results=3" in out

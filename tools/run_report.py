@@ -162,6 +162,104 @@ def build_quality_section(events: List[dict],
     return section
 
 
+def build_serving_section(events: List[dict]) -> Dict[str, Any]:
+    """The serving postmortem: request-outcome accounting (the outcome-total
+    invariant ``admitted == results + deadlines + quarantines +
+    admitted_sheds``; a nonzero ``unresolved`` means requests died without
+    an outcome — the kill-mid-drain signature), per-bucket latency
+    percentiles, the queue-depth trajectory (from ``serve_batch`` events),
+    and the health-state timeline."""
+    admits = [e for e in events if e.get("event") == "serve_admit"]
+    results = [e for e in events if e.get("event") == "serve_result"]
+    deadlines = [e for e in events if e.get("event") == "serve_deadline"
+                 and e.get("admitted") is not False]
+    quarantines = [e for e in events
+                   if e.get("event") == "serve_quarantine"]
+    sheds = [e for e in events if e.get("event") == "serve_shed"]
+    sheds_admitted = [e for e in sheds if e.get("admitted") is True]
+    terminals = (len(results) + len(deadlines) + len(quarantines)
+                 + len(sheds_admitted))
+    # which admitted requests never reached an outcome (lost mid-drain /
+    # in flight at process death) — keyed (run, request): request ids
+    # restart at r1 per service process, and a restarted run appending to
+    # the same log (the resume-lineage design) must not mask a dead run's
+    # losses with its own same-named requests
+    def _key(e: dict):
+        return (e.get("run"), e.get("request"))
+
+    settled_ids = {_key(e) for e in results + quarantines}
+    settled_ids |= {_key(e) for e in deadlines}
+    settled_ids |= {_key(e) for e in sheds_admitted}
+    lost = [f"{e.get('request')} (run {e.get('run')})" for e in admits
+            if _key(e) not in settled_ids]
+
+    lat_all = [e["wall_ms"] for e in results
+               if isinstance(e.get("wall_ms"), (int, float))]
+    per_bucket: Dict[str, List[float]] = {}
+    for e in results:
+        if isinstance(e.get("wall_ms"), (int, float)):
+            per_bucket.setdefault(str(e.get("bucket")), []).append(
+                e["wall_ms"])
+    shed_reasons: Dict[str, int] = {}
+    for e in sheds:
+        r = str(e.get("reason", "unknown"))
+        shed_reasons[r] = shed_reasons.get(r, 0) + 1
+    deadline_where: Dict[str, int] = {}
+    for e in [e for e in events if e.get("event") == "serve_deadline"]:
+        w = str(e.get("where", "unknown"))
+        deadline_where[w] = deadline_where.get(w, 0) + 1
+
+    batches = [e for e in events if e.get("event") == "serve_batch"]
+    # the queue-depth trajectory, downsampled to <= 64 points so a long
+    # run's report stays readable
+    traj = [{"t": e.get("t"), "queue_depth": e.get("queue_depth"),
+             "size": e.get("size")} for e in batches]
+    if len(traj) > 64:
+        step = len(traj) / 64.0
+        traj = [traj[int(i * step)] for i in range(64)]
+
+    return {
+        "outcomes": {
+            "admitted": len(admits),
+            "results": len(results),
+            "deadline_exceeded": len(deadlines),
+            "quarantined": len(quarantines),
+            "shed_admitted": len(sheds_admitted),
+            "shed_at_admission": len(sheds) - len(sheds_admitted),
+            "terminals": terminals,
+            # clamped: a crash in the admit-emit window can lose an admit
+            # record for a settled request, and a negative count must not
+            # render as "-1 requests died"
+            "unresolved": max(0, len(admits) - terminals),
+        },
+        "lost_requests": lost,
+        "latency_ms": _percentiles(lat_all),
+        "latency_ms_by_bucket": {
+            b: _percentiles(v) for b, v in sorted(per_bucket.items())},
+        "batches": {
+            "n": len(batches),
+            "wall_s": _percentiles(
+                [e["wall_s"] for e in batches
+                 if isinstance(e.get("wall_s"), (int, float))]),
+            "mean_size": (sum(e.get("size", 0) for e in batches)
+                          / len(batches)) if batches else None,
+        },
+        "queue_depth_trajectory": traj,
+        "shed_reasons": shed_reasons,
+        "deadline_where": deadline_where,
+        "health_timeline": [
+            {"t": e.get("t"), "state": e.get("state"),
+             "reason": e.get("reason")}
+            for e in events if e.get("event") == "serve_health"
+        ],
+        "drains": [
+            {k: e.get(k) for k in e
+             if k.startswith("n_") or k in ("t", "drained", "leftover")}
+            for e in events if e.get("event") == "serve_drain"
+        ],
+    }
+
+
 def build_report(paths: List[str],
                  quality_ref: Optional[str] = None) -> Dict[str, Any]:
     """Aggregate one report dict over every given event log."""
@@ -280,6 +378,8 @@ def build_report(paths: List[str],
     }
     if any(e.get("event") == "span" for e in events):
         report["spans"] = build_span_breakdown(events)
+    if any(str(e.get("event", "")).startswith("serve_") for e in events):
+        report["serving"] = build_serving_section(events)
     if any(e.get("event") == "quality" for e in events):
         device_kind = next(
             (r["header"].get("device_kind") for r in runs
@@ -360,6 +460,58 @@ def render_quality(report: Dict[str, Any]) -> str:
                 lines.append(
                     f"  [{tag}] {f['tier']}/{f['signal']}  "
                     f"psi={f['psi']:.4f} (threshold {f['threshold']})")
+    return "\n".join(lines)
+
+
+def render_serving(report: Dict[str, Any]) -> str:
+    sv = report.get("serving")
+    if not sv:
+        return "(no serving events in the log)"
+    lines = ["serving:"]
+    o = sv["outcomes"]
+    lines.append(
+        f"  outcomes: admitted={o['admitted']}  results={o['results']}  "
+        f"deadline={o['deadline_exceeded']}  quarantined={o['quarantined']}  "
+        f"shed_admitted={o['shed_admitted']}  "
+        f"shed_at_admission={o['shed_at_admission']}")
+    if o["unresolved"]:
+        lines.append(
+            f"  UNRESOLVED: {o['unresolved']} admitted request(s) died "
+            f"without an outcome (lost mid-drain/crash): "
+            f"{', '.join(str(r) for r in sv['lost_requests'][:16])}")
+    else:
+        lines.append("  outcome-total: every admitted request reached "
+                     "exactly one terminal outcome")
+    if sv["latency_ms"]:
+        lines.append(f"  latency:  {_fmt_stats(sv['latency_ms'], 'ms')}")
+    for b, stats in sv["latency_ms_by_bucket"].items():
+        lines.append(f"    {b}: {_fmt_stats(stats, 'ms')}")
+    bt = sv["batches"]
+    if bt["n"]:
+        lines.append(
+            f"  batches: n={bt['n']}  mean_size={bt['mean_size']:.2f}  "
+            f"wall {_fmt_stats(bt['wall_s'])}")
+    if sv["shed_reasons"]:
+        lines.append("  shed by reason: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(sv["shed_reasons"].items())))
+    if sv["deadline_where"]:
+        lines.append("  deadlines by checkpoint: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(sv["deadline_where"].items())))
+    if sv["health_timeline"]:
+        lines.append("  health timeline:")
+        for h in sv["health_timeline"]:
+            lines.append(f"    -> {h['state']}"
+                         + (f"  ({h['reason']})" if h.get("reason") else ""))
+    if sv["queue_depth_trajectory"]:
+        depths = [p["queue_depth"] for p in sv["queue_depth_trajectory"]
+                  if isinstance(p.get("queue_depth"), (int, float))]
+        if depths:
+            lines.append(f"  queue depth: first={depths[0]} "
+                         f"max={max(depths)} last={depths[-1]} "
+                         f"({len(depths)} samples)")
+    for d in sv["drains"]:
+        lines.append(f"  drain: drained={d.get('drained')} "
+                     f"leftover={d.get('leftover')}")
     return "\n".join(lines)
 
 
@@ -457,6 +609,11 @@ def main(argv=None) -> int:
     ap.add_argument("--quality-ref", default=None,
                     help="reference distributions for the drift verdicts "
                          "(default: perf/quality_ref.jsonl)")
+    ap.add_argument("--serving", action="store_true",
+                    help="append the serving section: request-outcome "
+                         "accounting (the outcome-total invariant), "
+                         "per-bucket latency, queue-depth trajectory, "
+                         "health-state timeline")
     args = ap.parse_args(argv)
     quality_ref = None
     if args.quality or args.quality_ref:
@@ -474,6 +631,9 @@ def main(argv=None) -> int:
         if args.quality:
             print()
             print(render_quality(report))
+        if args.serving:
+            print()
+            print(render_serving(report))
     return 0
 
 

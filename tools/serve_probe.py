@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Real-device probe of the resident match service (ncnet_tpu/serving/).
+
+For the next TPU-attached session — the serving twin of
+``nc_resident_probe`` / ``eval_faults_probe``.  Three measurements the CPU
+tier-1 suite cannot make honestly:
+
+  1. **Continuous-batching walls per shape bucket** — closed-loop streams
+     per configured bucket side: batch wall, per-request latency
+     percentiles, achieved qps, mean coalesced batch size.  The r05 bench
+     question made concrete: how much of the ~681 ms serial bs1 wall does
+     the queue+pipeline actually recover on a real tunnel?
+  2. **Demotion under load** — arms ``faults.device_fail_calls`` mid-stream
+     and measures the serving PAUSE (last success before the injected
+     failure → first success after the demote-retrace-recompile), plus the
+     outcome accounting proving zero lost requests across the recovery.
+  3. **Offered-load shed behavior** — an open-loop burst at a multiple of
+     measured capacity: shed fraction, admitted-work latency (the admitted
+     stream must NOT deadline-blow while the overflow sheds).
+
+Usage::
+
+    python tools/serve_probe.py [--sides 400,512] [--pairs 48] [--tiny]
+        [--no-demote] [--burst-factor 3.0] [--json out.json]
+
+``--tiny`` runs the CPU-sized smoke configuration (tiny backbone, 64 px) so
+the probe's own plumbing is testable without a TPU.  Output: one JSON
+document (stdout, plus ``--json`` path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _percentiles(xs: List[float]) -> Dict[str, float]:
+    import numpy as np
+
+    if not xs:
+        return {}
+    return {
+        "p50": round(float(np.percentile(xs, 50)), 3),
+        "p95": round(float(np.percentile(xs, 95)), 3),
+        "p99": round(float(np.percentile(xs, 99)), 3),
+        "mean": round(float(np.mean(xs)), 3),
+        "n": len(xs),
+    }
+
+
+def probe(sides: List[int], n_pairs: int, tiny: bool, demote: bool,
+          burst_factor: float) -> Dict[str, Any]:
+    import warnings
+
+    import jax
+    import numpy as np
+
+    from ncnet_tpu import models
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.serving import MatchService, ServingConfig
+    from ncnet_tpu.utils import faults
+    from ncnet_tpu.utils.faults import FaultPlan, paced_burst
+
+    if tiny:
+        cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                          ncons_channels=(1,), half_precision=False)
+        sides = [min(s, 64) for s in sides]
+    else:
+        cfg = ModelConfig(ncons_kernel_sizes=(5, 5, 5),
+                          ncons_channels=(16, 16, 1),
+                          half_precision=True, backbone_bf16=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # random-trunk warning: timing only
+        params = models.init_ncnet(cfg, jax.random.key(0))
+
+    buckets = tuple((s, s) for s in sorted(set(sides)))
+    scfg = ServingConfig(
+        max_queue=max(2 * n_pairs, 64), max_batch=8,
+        # the closed-loop phases saturate from one client; the fairness
+        # cap must exceed the stream depth or the probe sheds itself
+        max_in_flight_per_client=max(2 * n_pairs, 64),
+        buckets=buckets, max_buckets=len(buckets) ** 2,
+        warm_buckets=buckets,
+    )
+    out: Dict[str, Any] = {
+        "device_kind": str(jax.devices()[0].device_kind),
+        "tiny": tiny, "sides": sides, "n_pairs": n_pairs,
+    }
+    rng = np.random.default_rng(0)
+
+    def pair(side):
+        return (rng.integers(0, 255, (side, side, 3), dtype=np.uint8),
+                rng.integers(0, 255, (side, side, 3), dtype=np.uint8))
+
+    service = MatchService(cfg, params, scfg).start()
+    try:
+        # 1. per-bucket continuous-batching walls (closed loop)
+        per_bucket: Dict[str, Any] = {}
+        side_caps: Dict[int, float] = {}
+        for side in sides:
+            pairs = [pair(side) for _ in range(8)]
+            t0 = time.perf_counter()
+            futs = [service.submit(*pairs[i % 8]) for i in range(n_pairs)]
+            walls = [f.result(timeout=600).wall_s * 1e3 for f in futs]
+            span = time.perf_counter() - t0
+            snap = service.metrics()
+            batch = snap.get("batch_wall_s", {})
+            side_caps[side] = n_pairs / span
+            per_bucket[f"{side}x{side}"] = {
+                "qps": round(side_caps[side], 2),
+                "latency_ms": _percentiles(walls),
+                "batch_wall_p50_ms": round(
+                    1e3 * batch.get("p50_s", 0.0), 3) if batch else None,
+            }
+        out["buckets"] = per_bucket
+        # the demotion and burst phases both drive sides[0]-shaped pairs,
+        # so THAT bucket's capacity is the one their rates must key off
+        cap_qps = side_caps[sides[0]]
+        out["capacity_qps"] = round(cap_qps, 2)
+
+        # 2. demotion under load: inject a device failure mid-stream and
+        # time the serving pause around the demote-retrace-recompile
+        if demote:
+            side = sides[0]
+            pairs = [pair(side) for _ in range(8)]
+            # the ordinal counts process-global ResilientJit dispatches
+            # from install(); ordinal 2 = the SECOND dispatched batch —
+            # the first batch takes whatever is queued at dispatch time
+            # (usually one request) and the rest coalesce behind it, so
+            # ordinal 2 reliably exists even when batching folds the
+            # whole stream into two dispatches
+            faults.install(FaultPlan(device_fail_calls=(2,)))
+            try:
+                # more requests than one max_batch can swallow, so at
+                # least two batches dispatch and the armed ordinal exists
+                n_stream = max(n_pairs, 3 * scfg.max_batch)
+                t0 = time.perf_counter()
+                futs = [service.submit(*pairs[i % 8])
+                        for i in range(n_stream)]
+                ticks, outcomes = [], {"result": 0, "other": 0}
+                for f in futs:
+                    try:
+                        f.result(timeout=600)
+                        outcomes["result"] += 1
+                    except Exception:  # noqa: BLE001 — classified below
+                        outcomes["other"] += 1
+                    ticks.append(time.perf_counter())
+                gaps = np.diff(np.asarray([t0] + ticks))
+                from ncnet_tpu import ops as _ops
+
+                out["demotion"] = {
+                    "outcomes": outcomes,
+                    "lost": sum(1 for f in futs if f.outcome is None),
+                    "pause_ms": round(float(np.max(gaps)) * 1e3, 1),
+                    "median_gap_ms": round(
+                        float(np.median(gaps)) * 1e3, 1),
+                    "health": service.health()["state"],
+                    "demoted_tiers": list(_ops.demoted_fused_tiers()),
+                }
+            finally:
+                faults.clear()
+
+        # 3. overload PACED at burst_factor x capacity for ~2 s — see
+        # faults.paced_burst's docstring for why pacing (vs back-to-back)
+        # makes shed_pct read as the overload fraction rather than scale
+        # with absolute capacity
+        side = sides[0]
+        p0 = pair(side)
+        burst_rate = max(cap_qps * burst_factor, 1.0)
+        n_burst = max(int(burst_rate * 2), 32)
+        futs_b, sheds = paced_burst(
+            lambda: service.submit(*p0), burst_rate, n_burst)
+        lat = []
+        for f in futs_b:
+            try:
+                lat.append(f.result(timeout=600).wall_s * 1e3)
+            except Exception:  # noqa: BLE001 — shed accounting below
+                pass
+        out["burst"] = {
+            "offered": n_burst,
+            "rate_qps": round(burst_rate, 2),
+            "shed_pct": round(100.0 * len(sheds) / n_burst, 2),
+            "admitted_latency_ms": _percentiles(lat),
+            "retry_after_s": (round(sheds[0].retry_after_s, 3)
+                              if sheds and sheds[0].retry_after_s else None),
+        }
+        out["health"] = service.health()
+    finally:
+        service.stop()
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Probe the resident match service on the attached "
+                    "device (batching walls, demotion under load, shed "
+                    "behavior)")
+    ap.add_argument("--sides", default="400",
+                    help="comma-separated square bucket sides (default 400)")
+    ap.add_argument("--pairs", type=int, default=48,
+                    help="closed-loop pairs per bucket (default 48)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU-sized smoke config (tiny backbone, 64 px)")
+    ap.add_argument("--no-demote", action="store_true",
+                    help="skip the injected-failure demotion measurement")
+    ap.add_argument("--burst-factor", type=float, default=3.0,
+                    help="overload burst rate as a multiple of capacity")
+    ap.add_argument("--json", default=None, help="also write the JSON here")
+    args = ap.parse_args(argv)
+
+    # stdout is the probe's one JSON document; the injected-failure phase
+    # legitimately logs recovery warnings through the library console sink
+    # (also stdout), so quiet it to errors FOR THE PROBE RUN ONLY unless
+    # the operator overrode the level themselves — restored afterwards, so
+    # an in-process caller (the tier-1 smoke test) does not inherit a
+    # silenced logger
+    level_was_unset = "NCNET_TPU_LOG_LEVEL" not in os.environ
+    os.environ.setdefault("NCNET_TPU_LOG_LEVEL", "error")
+    try:
+        sides = [int(s) for s in args.sides.split(",") if s]
+        out = probe(sides, args.pairs, args.tiny, not args.no_demote,
+                    args.burst_factor)
+    finally:
+        if level_was_unset:
+            os.environ.pop("NCNET_TPU_LOG_LEVEL", None)
+    doc = json.dumps(out, indent=2, sort_keys=True)
+    sys.stdout.write(doc + "\n")
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(doc + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

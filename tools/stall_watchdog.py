@@ -47,16 +47,20 @@ from ncnet_tpu.observability.events import replay_events  # noqa: E402
 
 def recent_median_step_wall(events_path: str,
                             tail: int = 32) -> Optional[float]:
-    """Median ``wall_s`` of the last ``tail`` step events, or None when the
-    log is missing/unreadable/step-less (the caller falls back to the
-    static floor).  Torn tails are tolerated by ``replay_events``."""
+    """Median ``wall_s`` of the last ``tail`` cadence events, or None when
+    the log is missing/unreadable/cadence-less (the caller falls back to
+    the static floor).  Cadence events: training ``step``s, and serving
+    ``serve_batch``es (the match service beats its heartbeat once per
+    dispatched batch, so the batch wall IS its step wall — one watchdog
+    contract for both process shapes).  Torn tails are tolerated by
+    ``replay_events``."""
     try:
         _, events = replay_events(events_path)
     except (OSError, ValueError):
         return None
     walls: List[float] = [
         e["wall_s"] for e in events
-        if e.get("event") == "step"
+        if e.get("event") in ("step", "serve_batch")
         and isinstance(e.get("wall_s"), (int, float)) and e["wall_s"] > 0
     ][-tail:]
     if not walls:
