@@ -1,13 +1,17 @@
 """Resident match serving: the fault-tolerant service around the warm matcher.
 
 ROADMAP item 1, built on the PR 1-7 layers: continuous batching into padded
-shape buckets (bounded jit cache), admission control with classified
-``Overloaded`` shedding + retry-after hints, per-request deadlines checked
-at admission/dequeue/fetch, demote-retrace survival of device failures with
-zero lost requests, SIGTERM drain, a STARTING/READY/DEGRADED/DRAINING/
-STOPPED health machine for probes, and full event/metric/quality telemetry.
-See README "Serving" for the API, overload semantics and chaos knobs;
-tests/test_serving.py is the fault-injected proof of the invariants.
+shape buckets (bounded jit cache), a replica pool (one engine per visible
+device) with health-scored routing, replica failover/quarantine and
+resurrection probes, elastic admission control with classified
+``Overloaded`` shedding + aggregate-pool-cadence retry-after hints,
+per-request deadlines checked at admission/dequeue/fetch, demote-retrace
+survival of device failures with zero lost requests, SIGTERM drain, a
+STARTING/READY/DEGRADED/DRAINING/STOPPED health machine for probes, and
+full replica-tagged event/metric/quality telemetry.  See README "Serving" /
+"Replicated serving" for the API, overload semantics and chaos knobs;
+tests/test_serving.py and tests/test_serving_pool.py are the fault-injected
+proof of the invariants.
 """
 
 from ncnet_tpu.serving.admission import AdmissionController  # noqa: F401
@@ -21,6 +25,12 @@ from ncnet_tpu.serving.health import (  # noqa: F401
     STARTING,
     STOPPED,
     HealthMachine,
+)
+from ncnet_tpu.serving.replica import (  # noqa: F401
+    REPLICA_DEAD,
+    REPLICA_READY,
+    Replica,
+    ReplicaPool,
 )
 from ncnet_tpu.serving.request import (  # noqa: F401
     TERMINAL_OUTCOMES,
@@ -48,6 +58,10 @@ __all__ = [
     "MatchService",
     "Overloaded",
     "READY",
+    "REPLICA_DEAD",
+    "REPLICA_READY",
+    "Replica",
+    "ReplicaPool",
     "RequestQuarantined",
     "STARTING",
     "STOPPED",

@@ -39,7 +39,8 @@ class BatchMatchEngine:
     """
 
     def __init__(self, config: ModelConfig, params, *,
-                 do_softmax: bool = True, scale: str = "centered"):
+                 do_softmax: bool = True, scale: str = "centered",
+                 device=None):
         import jax
         import jax.numpy as jnp
 
@@ -49,7 +50,12 @@ class BatchMatchEngine:
         from ncnet_tpu.ops.image import normalize_imagenet
 
         self.config = config
-        self._params = jax.device_put(params)  # staged once, every batch
+        self.device = device
+        # staged once, every batch; committing the params to an explicit
+        # device pins every jit dispatch there — the replica-pool seam
+        # (serving/replica.py): one engine per visible device
+        self._params = (jax.device_put(params, device)
+                        if device is not None else jax.device_put(params))
         k = max(config.relocalization_k_size, 1)
 
         def run(p, src, tgt):

@@ -7,10 +7,13 @@ States and their meaning for a load balancer / readiness probe:
     not route fresh traffic yet.
   * ``READY``     — serving normally on the preferred tier ladder.
   * ``DEGRADED``  — a runtime device failure demoted a Pallas tier
-    (``recover_from_device_failure``); the service is still serving — with
-    zero lost requests, on a slower tier — but an operator should look.
-    Sticky until the tier registry is reset (a demotion outlives the batch
-    that triggered it by design, see ops/nc_fused_lane demotion registry).
+    (``recover_from_device_failure``), or the replica pool is below full
+    strength (a replica is DEAD awaiting resurrection — capacity degraded,
+    availability intact); the service is still serving — with zero lost
+    requests — but an operator should look.  A tier demotion is sticky
+    until the registry is reset; a pure capacity degradation recovers to
+    READY once every replica is resurrected (the one DEGRADED → READY
+    edge).
   * ``DRAINING``  — SIGTERM (or ``stop()``): admission is closed, admitted
     work is completing.  Probes must stop routing here.
   * ``STOPPED``   — terminal; the worker has exited.
@@ -38,7 +41,11 @@ STOPPED = "STOPPED"
 _ALLOWED = {
     STARTING: (READY, DEGRADED, DRAINING, STOPPED),
     READY: (DEGRADED, DRAINING, STOPPED),
-    DEGRADED: (DRAINING, STOPPED),
+    # DEGRADED -> READY is the replica-pool recovery edge ONLY: every dead
+    # replica resurrected AND no Pallas tier demoted (the service checks
+    # both before requesting it).  A tier-demotion DEGRADED stays sticky
+    # exactly as before — nothing requests READY while a demotion holds.
+    DEGRADED: (READY, DRAINING, STOPPED),
     DRAINING: (STOPPED,),
     STOPPED: (),
 }

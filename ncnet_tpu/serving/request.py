@@ -57,8 +57,9 @@ class Overloaded(ServeError):
     """Admission shed the request (or an aborted shutdown rejected it).
 
     ``reason`` is machine-readable: ``queue_full`` / ``client_cap`` /
-    ``bucket_capacity`` / ``unservable_shape`` / ``draining`` / ``stopped``
-    / ``shutdown`` / ``crashed``.  ``retry_after_s`` (when not None) is the
+    ``bucket_capacity`` / ``unservable_shape`` / ``no_capacity`` (every
+    pool replica is DEAD; retry after the resurrection-probe period) /
+    ``draining`` / ``stopped`` / ``shutdown`` / ``crashed``.  ``retry_after_s`` (when not None) is the
     service's estimate of when a retry could be admitted — derived from the
     current queue depth and the recent batch wall, so a well-behaved client
     backs off proportionally to actual load instead of hammering.
@@ -140,20 +141,35 @@ class MatchFuture:
         self.request_id = request_id
         self.outcome: Optional[str] = None
         self._event = threading.Event()
+        self._lock = threading.Lock()
         self._result: Optional[MatchResult] = None
         self._error: Optional[BaseException] = None
 
     def _settle(self, outcome: str, *, result: Optional[MatchResult] = None,
                 error: Optional[BaseException] = None) -> None:
-        if self.outcome is not None:
+        if not self._try_settle(outcome, result=result, error=error):
             raise RuntimeError(
                 f"request {self.request_id} settled twice "
                 f"({self.outcome} then {outcome})"
             )
+
+    def _try_settle(self, outcome: str, *,
+                    result: Optional[MatchResult] = None,
+                    error: Optional[BaseException] = None) -> bool:
+        """Atomically settle if still pending; False when another path won
+        the race.  The check-and-set is one critical section because the
+        pool's settle paths genuinely race: a bounded-join shutdown
+        force-settles a hung fetcher's batch while the late fetch may be
+        landing its results — exactly one side must win, and the loser must
+        skip its accounting rather than crash."""
         assert outcome in TERMINAL_OUTCOMES
-        self._result, self._error = result, error
-        self.outcome = outcome
+        with self._lock:
+            if self.outcome is not None:
+                return False
+            self._result, self._error = result, error
+            self.outcome = outcome
         self._event.set()
+        return True
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -186,6 +202,11 @@ class MatchRequest:
     submitted_t: float
     deadline_t: Optional[float] = None
     attempts: int = 0
+    # replica ids this request's batch has already failed on: the router
+    # prefers replicas NOT in this set, and a re-route to a fresh replica
+    # is off-budget (the failure was the replica's, not the request's);
+    # once no fresh READY replica remains, failures charge the budget
+    failed_on: set = dataclasses.field(default_factory=set)
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t

@@ -1,51 +1,71 @@
-"""The resident match service: continuous batching + fault-tolerant serving.
+"""The resident match service: continuous batching + replicated fault-
+tolerant serving.
 
 This is the serving twin of PR 1 (fault-tolerant training) and PR 3
 (resilient batch eval): a resident process around the warm matcher that
-keeps answering — correctly, within deadlines, at a degraded tier if it
-must — while devices fail, queues overflow, and clients misbehave.  The r05
-bench motivates the shape: bs1 bf16 device time is 5.5 ms but a serial
-caller waits ~681 ms of wall; the win is structural (queueing, batching,
-pipelining), not a kernel.
+keeps answering — correctly, within deadlines, at a degraded tier or on a
+surviving replica if it must — while devices fail, queues overflow, and
+clients misbehave.  The r05 bench motivates the shape: bs1 bf16 device time
+is 5.5 ms but a serial caller waits ~681 ms of wall; the win is structural
+(queueing, batching, pipelining, replication), not a kernel.
 
 Pieces, and where each discipline comes from:
 
   * **Continuous batching** — an async request queue coalesces
     variable-resolution queries into padded shape buckets
     (``serving/buckets.py``, bounded jit cache) and dispatches the next
-    batch while the previous batch's fetch is still in flight; the
-    in-flight depth follows the PR 2 ``PipelineDepthController`` (the drain
-    unit is one batch, exactly the PF-Pascal regime).
+    batch while previous batches' fetches are still in flight; the
+    per-replica in-flight depth follows the PR 2
+    ``PipelineDepthController`` (the drain unit is one batch, exactly the
+    PF-Pascal regime).
+  * **Replicated serving** — ``serving/replica.py``: a :class:`ReplicaPool`
+    of one ``BatchMatchEngine`` per visible device.  A dedicated fetcher
+    thread per replica blocks on that replica's fetches, so a wedged chip
+    stalls only its own lane; the dispatcher routes each coalesced batch to
+    the least-loaded READY replica by a health score fed by the measured
+    batch-wall EWMA, consecutive-failure streak, and tier-demotion state.
+  * **Replica failover** — a replica failing mid-batch requeues that batch
+    at the FRONT and re-routes it to a surviving replica OFF-budget (the
+    failure is the replica's fault, not the request's — zero lost
+    requests); ``replica_max_failures`` consecutive failures quarantine the
+    REPLICA into a DEAD state with periodic resurrection probes.  Pool
+    membership changes flow into admission control: the queue bound and
+    ``retry_after_s`` hints track live capacity elastically.
   * **Admission control + backpressure** — ``serving/admission.py``:
-    bounded queue depth, per-client in-flight caps, classified
-    ``Overloaded`` rejections with throughput-derived retry-after hints.
+    elastic queue depth, per-client in-flight caps, classified
+    ``Overloaded`` rejections with aggregate-pool-cadence retry-after
+    hints, ``no_capacity`` shedding when every replica is dead.
   * **Per-request deadlines** — the budget is checked at admission (an
     already-expired request is refused), at dequeue (expired requests are
     EVICTED from the batch before dispatch — they never waste device time),
     and at fetch (a result that lands after its caller's budget resolves
-    deadline-exceeded, not as a zombie success).  The fetch itself rides
+    deadline-exceeded, not as a zombie success).  Each fetch rides
     ``pipeline.call_with_watchdog`` so a hung tunnel surfaces as a
     retryable timeout, not an eternal stall.
-  * **Degraded-mode survival** — a runtime device failure mid-stream runs
-    the PR 3 ``recover_from_device_failure`` demote-retrace path and
-    REQUEUES the failed batch at the front (zero lost requests, retried
-    off-budget because the program changed); repeated failures quarantine
+  * **Degraded-mode survival** — when no surviving replica can take a
+    failed batch (a single-replica pool, or a request that failed
+    everywhere), the PR 3 ``recover_from_device_failure`` demote-retrace
+    path runs and grants a free retry; repeated failures quarantine
     individual requests into a journaled ``RunManifest``; SIGTERM (PR 1's
     ``PreemptionHandler`` pattern) stops admission and drains admitted work
     to completion; the STARTING/READY/DEGRADED/DRAINING/STOPPED health
-    machine (``serving/health.py``) is exported for probes.
+    machine (``serving/health.py``) is exported for probes, with the
+    replica-pool recovery owning the one DEGRADED → READY edge.
   * **Telemetry** — every lifecycle edge is an event (``serve_admit`` /
     ``serve_shed`` / ``serve_batch`` / ``serve_result`` / ``serve_deadline``
-    / ``serve_quarantine`` / ``serve_health`` / ``serve_drain``), latency
-    aggregates through per-bucket ``Histogram`` digests, per-pair quality
-    signals stream tier-tagged through ``emit_quality``, and the PR 5
-    ``Heartbeat`` is bumped per dispatched batch (the
-    ``tools/stall_watchdog.py`` liveness contract).
+    / ``serve_quarantine`` / ``serve_health`` / ``serve_drain``), with
+    ``serve_batch``/``serve_result``/``retry``/``quality`` and replica
+    deaths/resurrections tagged by replica id; latency aggregates through
+    per-bucket AND per-replica ``Histogram`` digests, and the PR 5
+    ``Heartbeat`` is bumped per dispatched batch pool-wide (the
+    ``tools/stall_watchdog.py`` liveness contract — one wedged replica
+    cannot stop the beats while survivors dispatch).
 
 The outcome-total contract (serving/request.py): every admitted request
 terminates in exactly one of {result, deadline, overloaded, quarantined} —
 proven by event-log accounting in ``tools/run_report.py --serving`` and
-executed under fault injection by tests/test_serving.py.
+executed under fault injection by tests/test_serving.py (single engine) and
+tests/test_serving_pool.py (the replica pool's chaos chain).
 """
 
 from __future__ import annotations
@@ -71,6 +91,11 @@ from ncnet_tpu.serving.health import (
     STARTING,
     STOPPED,
     HealthMachine,
+)
+from ncnet_tpu.serving.replica import (
+    REPLICA_READY,
+    Replica,
+    ReplicaPool,
 )
 from ncnet_tpu.serving.request import (
     Bucket,
@@ -103,6 +128,11 @@ class ServingConfig:
     # failure policy
     retries: int = 1                    # budgeted retries per request
     quarantine_dir: Optional[str] = None  # RunManifest home (None = events only)
+    # replication (serving/replica.py)
+    replicas: int = 1                   # engines in the pool; 0 = one per device
+    replica_max_failures: int = 3       # consecutive failures -> replica DEAD
+    resurrect_after_s: float = 5.0      # probe period for DEAD replicas
+    elastic_admission: bool = True      # queue bound tracks ready/total
     # shape buckets (bounded jit cache)
     bucket_multiple: int = 64
     max_image_side: int = 1024
@@ -123,7 +153,9 @@ class _InFlight:
     handle: Any
     batch: List[MatchRequest]
     bucket: Bucket
+    replica: Replica
     t0: float
+    seq: int  # stamped at dispatch: fetchers complete out of order
 
 
 class MatchService:
@@ -140,21 +172,30 @@ class MatchService:
 
     ``engine`` may be injected (anything with ``dispatch``/``fetch``/
     ``retrace``) — the chaos suite drives the full lifecycle against a fake
-    device without paying jit compiles.
+    device without paying jit compiles; a SEQUENCE of engines builds a
+    multi-replica pool over them (one replica per engine, ids ``rep0..``).
+    Without injection, ``serving.replicas`` controls the pool: 1 (default)
+    is the PR 8 single-engine service on the default device, N builds one
+    ``BatchMatchEngine`` per visible device (0 = all of them).
     """
 
     def __init__(self, model_config=None, params=None,
                  serving: ServingConfig = ServingConfig(), *,
                  engine=None, registry: Optional[MetricsRegistry] = None):
-        if engine is None:
-            from ncnet_tpu.serving.engine import BatchMatchEngine
-
-            engine = BatchMatchEngine(
-                model_config, params, do_softmax=serving.do_softmax,
-                scale=serving.scale,
-            )
         self.cfg = serving
-        self._engine = engine
+        if engine is not None:
+            engines = list(engine) if isinstance(engine, (list, tuple)) \
+                else [engine]
+            self._pool = ReplicaPool(
+                [Replica(f"rep{i}", e) for i, e in enumerate(engines)],
+                on_change=self._on_pool_change,
+            )
+        else:
+            self._pool = ReplicaPool.from_model(
+                model_config, params, serving.replicas,
+                on_change=self._on_pool_change,
+                do_softmax=serving.do_softmax, scale=serving.scale,
+            )
         self._registry = registry or MetricsRegistry(scope="serving")
         self._bucketer = ShapeBucketer(
             multiple=serving.bucket_multiple,
@@ -166,7 +207,11 @@ class MatchService:
             max_queue=serving.max_queue,
             max_in_flight_per_client=serving.max_in_flight_per_client,
             max_batch=serving.max_batch,
+            elastic=serving.elastic_admission,
+            dead_retry_after_s=serving.resurrect_after_s,
         )
+        self._admission.note_capacity(len(self._pool.ready()),
+                                      len(self._pool.replicas))
         from ncnet_tpu.evaluation.pipeline import PipelineDepthController
 
         self._controller = PipelineDepthController(fixed=serving.pipeline_depth)
@@ -188,8 +233,9 @@ class MatchService:
 
         self._cond = threading.Condition()
         self._queues: Dict[Bucket, Deque[MatchRequest]] = {}
-        self._inflight: Deque[_InFlight] = deque()
         self._worker: Optional[threading.Thread] = None
+        self._fetchers: List[threading.Thread] = []
+        self._fetchers_stop = False
         self._draining = False
         self._drain_requested = False   # set from the signal handler: no lock
         self._stop_now = False
@@ -200,6 +246,13 @@ class MatchService:
         self._req_seq = 0
         self._batch_seq = 0
         self._old_sigterm = None
+        # tier-recovery single-flight: concurrent fetcher failures must not
+        # each burn a ladder rung for ONE fault (generation bumps on every
+        # successful demotion; a failure observed before someone else's
+        # recovery rides that recovery instead of demoting again)
+        self._recovery_lock = threading.Lock()
+        self._recovery_gen = 0
+        self._last_recovery_tier: Optional[str] = None
         # terminal-outcome accounting (the event log is the durable copy;
         # these back the health probe and the drain summary)
         self._n = {"admitted": 0, "results": 0, "deadline": 0,
@@ -218,10 +271,16 @@ class MatchService:
             retries=self.cfg.retries,
             default_deadline_s=self.cfg.default_deadline_s,
             fetch_timeout_s=self.cfg.fetch_timeout_s,
+            replicas=[r.id for r in self._pool.replicas],
         )
         if self.cfg.install_sigterm and \
                 threading.current_thread() is threading.main_thread():
             self._old_sigterm = signal.signal(signal.SIGTERM, self._on_sigterm)
+        for rep in self._pool.replicas:
+            t = threading.Thread(target=self._fetch_loop, args=(rep,),
+                                 name=f"match-fetch-{rep.id}", daemon=True)
+            t.start()
+            self._fetchers.append(t)
         self._worker = threading.Thread(
             target=self._run, name="match-serve", daemon=True)
         self._worker.start()
@@ -416,15 +475,19 @@ class MatchService:
 
     def health(self) -> Dict[str, Any]:
         """The probe payload: health state + queue/in-flight depth +
-        outcome counters + active buckets."""
+        outcome counters + active buckets + the per-replica pool rows."""
         with self._cond:
             return {
                 **self._health.probe(),
                 "queue_depth": self._queued_locked(),
-                "inflight_batches": len(self._inflight),
+                "inflight_batches": self._pool.inflight_total(),
                 "buckets": [bucket_label(b) for b in self._bucketer.buckets],
                 "counters": dict(self._n),
                 "pipeline_depth": self._controller.depth,
+                "replicas": [r.probe() for r in self._pool.replicas],
+                "ready_replicas": len(self._pool.ready()),
+                "effective_max_queue":
+                    self._admission.effective_max_queue(),
             }
 
     @property
@@ -435,7 +498,31 @@ class MatchService:
         return self._registry.snapshot()
 
     # ------------------------------------------------------------------
-    # worker
+    # pool membership -> admission (the elastic-capacity seam)
+    # ------------------------------------------------------------------
+
+    def _on_pool_change(self, ready: int, total: int) -> None:
+        """ReplicaPool membership callback (service lock already held —
+        mark_dead/resurrect are only called under it).  Queue bounds and
+        retry hints re-derive from live capacity; the health machine
+        reflects pool strength: below full → DEGRADED, fully restored with
+        no standing tier demotion → back to READY (the pool owns that one
+        recovery edge)."""
+        self._admission.note_capacity(ready, total)
+        self._registry.gauge("ready_replicas").set(ready)
+        if self._health.state in (STARTING, READY) and ready < total:
+            self._health.to(
+                DEGRADED,
+                "no_ready_replicas" if ready == 0
+                else f"replicas_ready:{ready}/{total}")
+        elif self._health.state == DEGRADED and ready == total:
+            from ncnet_tpu import ops
+
+            if not ops.demoted_fused_tiers():
+                self._health.to(READY, "pool_restored")
+
+    # ------------------------------------------------------------------
+    # worker (dispatcher)
     # ------------------------------------------------------------------
 
     def _queued_locked(self) -> int:
@@ -451,34 +538,26 @@ class MatchService:
             while True:
                 if self._drain_requested:
                     self.request_drain("sigterm")
+                self._maybe_resurrect()
+                self._evict_expired()
                 self._fill_pipeline()
-                inf = None
                 with self._cond:
                     if self._stop_now:
                         # an ABORT does not drain in-flight fetches: the
-                        # deque's batches settle Overloaded("shutdown") in
+                        # replica backlogs settle Overloaded("shutdown") in
                         # _finish, as stop(drain=False) documents
                         break
-                    if self._inflight:
-                        inf = self._inflight.popleft()
-                        # crash accounting: a batch popped from the
-                        # in-flight deque is otherwise invisible to
-                        # _finish — track it until its outcome lands
-                        self._processing = inf.batch
-                    else:
-                        if self._stop_now or (
-                                self._draining and not self._queued_locked()):
-                            break
-                        if not self._queued_locked():
-                            self._controller.note_gap()
-                            self._idle_beat()
-                            self._cond.wait(0.05)
-                if inf is not None:
-                    # no finally: if _drain_batch raises (a worker crash),
-                    # _processing stays set so _finish settles the batch
-                    self._drain_batch(inf)
-                    with self._cond:
-                        self._processing = None
+                    busy = self._pool.inflight_total() > 0
+                    if self._draining and not self._queued_locked() \
+                            and not busy:
+                        break
+                    if not self._queued_locked() and not busy:
+                        self._controller.note_gap()
+                        self._idle_beat()
+                    # fetcher completions, submits, and stop/drain all
+                    # notify; the timeout bounds resurrection-probe and
+                    # deadline-eviction latency while idle
+                    self._cond.wait(0.05)
         except BaseException as e:  # the worker must never die silently
             crashed = e
             log.error(f"serving worker crashed: {type(e).__name__}: {e}",
@@ -488,9 +567,10 @@ class MatchService:
 
     def _idle_beat(self) -> None:
         """Keep the heartbeat fresh while IDLE (rate-limited to ~1/s): a
-        quiet service must stay distinguishable from a wedged one — a
-        genuinely wedged fetch blocks the worker loop itself, so these
-        beats stop exactly when the stall watchdog should fire."""
+        quiet service must stay distinguishable from a wedged one — these
+        beats fire only when no batch is queued or in flight anywhere in
+        the pool, so a wedged fetch (with nothing else dispatching) stops
+        the beats exactly when the stall watchdog should fire."""
         if self._heartbeat is None:
             return
         now = time.monotonic()
@@ -511,50 +591,96 @@ class MatchService:
 
     def _warmup(self) -> None:
         """Compile the configured warm buckets (square pairs) at EVERY
-        ladder batch size before admitting traffic counts them as latency
-        — _dispatch pads batches onto the power-of-two ladder, so a
-        bucket warmed only at B=1 would still stall the live stream the
-        first time a coalesced batch arrives.  Fail-open: a failed warm
-        compile logs and moves on — the first real request in that shape
-        pays the compile instead."""
+        ladder batch size on EVERY replica before admitting traffic counts
+        them as latency — each replica compiles its own programs on its own
+        device, so a bucket warmed only on rep0 would still stall the live
+        stream the first time the router sends that shape to rep1.
+        Fail-open: a failed warm compile logs and moves on — the first real
+        request in that shape pays the compile instead."""
         for hw in self.cfg.warm_buckets:
             try:
                 bucket = self._bucketer.register(tuple(hw), tuple(hw))
-                for b in self._batch_ladder():
-                    zeros = np.zeros((b, *bucket[0], 3), np.uint8)
-                    zt = np.zeros((b, *bucket[1], 3), np.uint8)
-                    self._engine.fetch(self._engine.dispatch(zeros, zt))
-                obs_events.emit("serve_warm", bucket=bucket_label(bucket),
-                                batch_sizes=self._batch_ladder())
             except Exception as e:  # noqa: BLE001 — warmup is best-effort
-                log.warning(f"warmup of bucket {hw} failed "
-                            f"({type(e).__name__}: {e}); first request "
-                            "pays the compile", kind="device")
+                log.warning(f"warm bucket {hw} not registrable "
+                            f"({type(e).__name__}: {e})", kind="device")
+                continue
+            warmed = []
+            for rep in self._pool.replicas:
+                try:
+                    for b in self._batch_ladder():
+                        zeros = np.zeros((b, *bucket[0], 3), np.uint8)
+                        zt = np.zeros((b, *bucket[1], 3), np.uint8)
+                        rep.fetch(rep.dispatch(zeros, zt))
+                    warmed.append(rep.id)
+                except Exception as e:  # noqa: BLE001 — one replica's
+                    # failed warm compile must not cold-start the others
+                    log.warning(f"warmup of bucket {hw} on {rep.id} failed "
+                                f"({type(e).__name__}: {e}); its first "
+                                "request pays the compile", kind="device")
+            obs_events.emit("serve_warm", bucket=bucket_label(bucket),
+                            batch_sizes=self._batch_ladder(),
+                            replicas=warmed)
+
+    def _evict_expired(self) -> None:
+        """Evict deadline-expired QUEUED requests even when no replica can
+        take a batch — _fill_pipeline's dequeue check never runs while the
+        pool is unroutable (all replicas dead or at depth), and a parked
+        request whose budget is gone must still settle the classified
+        ``DeadlineExceeded(where="dequeue")``, not hang until resurrection
+        or shutdown.  Cheap: a scan per worker tick, a rebuild only when
+        something actually expired."""
+        now = time.monotonic()
+        expired: List[MatchRequest] = []
+        with self._cond:
+            if not any(req.expired(now)
+                       for q in self._queues.values() for req in q):
+                return
+            for bucket in list(self._queues):
+                keep: Deque[MatchRequest] = deque()
+                for req in self._queues[bucket]:
+                    (expired if req.expired(now) else keep).append(req)
+                if keep:
+                    self._queues[bucket] = keep
+                else:
+                    del self._queues[bucket]
+        for req in expired:
+            self._resolve_deadline(req, "dequeue")
 
     def _fill_pipeline(self) -> None:
-        """Dispatch batches until the pipeline is full or the queue is
-        empty — dispatching the NEXT batch while the previous fetch is in
-        flight is the continuous-batching overlap itself."""
+        """Dispatch batches until every READY replica's pipeline is full or
+        the queue is empty — dispatching the NEXT batch while previous
+        fetches are in flight is the continuous-batching overlap itself,
+        and routing picks the least-loaded healthy replica per batch."""
         while True:
             expired: List[MatchRequest] = []
             batch: List[MatchRequest] = []
             bucket: Optional[Bucket] = None
+            replica: Optional[Replica] = None
             with self._cond:
                 if self._stop_now:
                     return
-                if len(self._inflight) >= self._controller.depth:
-                    return
                 bucket = self._pick_bucket_locked()
-                if bucket is not None:
-                    q = self._queues[bucket]
-                    now = time.monotonic()
-                    while q and len(batch) < self.cfg.max_batch:
-                        req = q.popleft()
-                        # deadline check at DEQUEUE: an expired request is
-                        # evicted before it can waste a device slot
-                        (expired if req.expired(now) else batch).append(req)
-                    if not q:
-                        del self._queues[bucket]
+                if bucket is None:
+                    return
+                q = self._queues[bucket]
+                # route BEFORE popping: an unroutable batch (every replica
+                # busy or dead) stays queued instead of bouncing.  The
+                # head request's failed-on set is the exclusion hint — a
+                # requeued failed batch sits contiguously at the front, so
+                # the head's history speaks for the batch.
+                replica = self._pool.route(
+                    max_load=self._controller.depth,
+                    exclude=frozenset(q[0].failed_on))
+                if replica is None:
+                    return
+                now = time.monotonic()
+                while q and len(batch) < self.cfg.max_batch:
+                    req = q.popleft()
+                    # deadline check at DEQUEUE: an expired request is
+                    # evicted before it can waste a device slot
+                    (expired if req.expired(now) else batch).append(req)
+                if not q:
+                    del self._queues[bucket]
             for req in expired:
                 self._resolve_deadline(req, "dequeue")
             if not batch:
@@ -562,8 +688,8 @@ class MatchService:
                     continue  # the queue may hold more work behind evictions
                 return
             with self._cond:
-                self._processing = batch  # crash accounting (see _run)
-            self._dispatch(batch, bucket)
+                self._processing = batch  # crash accounting (see _finish)
+            self._dispatch(batch, bucket, replica)
             with self._cond:
                 self._processing = None
 
@@ -577,7 +703,8 @@ class MatchService:
                 best = bucket
         return best
 
-    def _dispatch(self, batch: List[MatchRequest], bucket: Bucket) -> None:
+    def _dispatch(self, batch: List[MatchRequest], bucket: Bucket,
+                  replica: Replica) -> None:
         # the BATCH dimension is bucketed too (next power of two, capped at
         # max_batch): without it every distinct coalesced size 1..max_batch
         # compiles its own program per shape bucket, and the first
@@ -595,47 +722,88 @@ class MatchService:
         tgt = pad_to_bucket(
             [r.tgt for r in batch] + pad, bucket[1])
         try:
-            handle = self._engine.dispatch(src, tgt)
+            handle = replica.dispatch(src, tgt)
         except Exception as e:
-            self._on_batch_failure(batch, e, phase="dispatch")
+            self._on_batch_failure(batch, e, phase="dispatch",
+                                   replica=replica)
             return
         self._batch_seq += 1
         if self._heartbeat is not None:
             # the liveness contract (tools/stall_watchdog.py): one beat per
-            # dispatched batch — a wedged fetch stops the beats
+            # dispatched batch, POOL-wide — a wedged replica stops the
+            # beats only when no survivor is dispatching either
             self._heartbeat.beat(step=self._batch_seq,
                                  state=self._health.state)
         with self._cond:
-            self._inflight.append(
-                _InFlight(handle, batch, bucket, time.monotonic()))
+            replica.last_bucket = bucket
+            replica.pending.append(
+                _InFlight(handle, batch, bucket, replica, time.monotonic(),
+                          self._batch_seq))
             self._registry.gauge("queue_depth").set(self._queued_locked())
+            self._cond.notify_all()  # wake the replica's fetcher
+
+    # ------------------------------------------------------------------
+    # fetchers (one thread per replica)
+    # ------------------------------------------------------------------
+
+    def _fetch_loop(self, replica: Replica) -> None:
+        """One replica's fetch lane: blocks on that replica's oldest
+        in-flight batch, settles its requests, hands failures to the
+        shared failover path.  A wedged chip therefore stalls only its own
+        lane — survivors keep draining theirs."""
+        while True:
+            inf: Optional[_InFlight] = None
+            with self._cond:
+                while not replica.pending and not self._fetchers_stop:
+                    self._cond.wait(0.2)
+                if self._fetchers_stop:
+                    # batches still pending here are dispatched-but-never-
+                    # fetched: _finish settles them as classified sheds
+                    # (the stop(drain=False) contract)
+                    return
+                inf = replica.pending.popleft()
+                replica.processing = inf.batch
+            try:
+                self._drain_batch(inf)
+            finally:
+                with self._cond:
+                    replica.processing = None
+                    self._cond.notify_all()  # capacity freed: wake dispatcher
 
     def _drain_batch(self, inf: _InFlight) -> None:
         from ncnet_tpu.evaluation.pipeline import call_with_watchdog
 
         try:
             table = call_with_watchdog(
-                self._engine.fetch, (inf.handle,),
+                inf.replica.fetch, (inf.handle,),
                 timeout=self.cfg.fetch_timeout_s, label="serve_fetch",
             )
         except Exception as e:
-            self._on_batch_failure(inf.batch, e, phase="fetch")
+            self._on_batch_failure(inf.batch, e, phase="fetch",
+                                   replica=inf.replica)
             return
         now = time.monotonic()
         wall = now - inf.t0
-        self._controller.note_drain()
-        self._admission.note_batch_wall(wall)
-        self._registry.counter("batches").inc()
-        self._registry.timer("batch_wall_s").observe(wall)
+        rid = inf.replica.id
         with self._cond:
+            self._controller.note_drain()
+            self._admission.note_batch_wall(wall)
+            inf.replica.note_success(wall)
             qd = self._queued_locked()
+            inflight = self._pool.inflight_total()
+            self._registry.counter("batches").inc()
+            self._registry.counter(f"replica_batches_{rid}").inc()
+            self._registry.timer("batch_wall_s").observe(wall)
+            self._registry.histogram(
+                f"replica_wall_ms_{rid}", 0.0, self.cfg.latency_hist_ms,
+            ).add(wall * 1e3)
         obs_events.emit(
             "serve_batch", bucket=bucket_label(inf.bucket),
             size=len(inf.batch), wall_s=round(wall, 6), queue_depth=qd,
-            inflight=len(self._inflight), seq=self._batch_seq,
+            inflight=inflight, seq=inf.seq, replica=rid,
         )
-        tables, quality = self._engine.split(np.asarray(table))
-        tier = self._active_tier()
+        tables, quality = self._split_table(inf.replica, table)
+        tier = self._active_tier(inf.replica)
         for i, req in enumerate(inf.batch):
             if req.expired(now):
                 # deadline check at FETCH: the caller's budget is gone —
@@ -648,91 +816,137 @@ class MatchService:
                 quality=quality[i] if quality else None,
                 bucket=inf.bucket, wall_s=req_wall,
             )
-            req.future._settle("result", result=result)
-            self._n["results"] += 1
-            self._registry.counter("results").inc()
-            self._registry.histogram(
-                f"serve_wall_ms_{bucket_label(inf.bucket)}",
-                0.0, self.cfg.latency_hist_ms,
-            ).add(req_wall * 1e3)
+            if not req.future._try_settle("result", result=result):
+                continue  # settled elsewhere (abandoned-fetch abort path)
+            with self._cond:
+                self._n["results"] += 1
+                self._registry.counter("results").inc()
+                self._registry.histogram(
+                    f"serve_wall_ms_{bucket_label(inf.bucket)}",
+                    0.0, self.cfg.latency_hist_ms,
+                ).add(req_wall * 1e3)
             obs_events.emit(
                 "serve_result", request=req.id, client=req.client,
                 bucket=bucket_label(inf.bucket),
                 wall_ms=round(req_wall * 1e3, 3), batch_size=len(inf.batch),
+                replica=rid,
             )
             if quality:
                 from ncnet_tpu.observability.quality import emit_quality
 
                 emit_quality("serving", quality[i], tier=tier,
-                             registry=self._registry, request=req.id)
+                             registry=self._registry, request=req.id,
+                             replica=rid)
             self._terminal(req)
 
-    def _active_tier(self) -> str:
+    @staticmethod
+    def _split_table(replica: Replica, table) -> Tuple[Any, Any]:
+        split = getattr(replica.engine, "split", None)
+        if split is not None:
+            return split(np.asarray(table))
+        from ncnet_tpu.serving.engine import BatchMatchEngine
+
+        return BatchMatchEngine.split(np.asarray(table))
+
+    def _active_tier(self, replica: Replica) -> str:
         from ncnet_tpu.observability.quality import active_tier
 
-        return active_tier(getattr(self._engine, "half_precision", False))
+        return active_tier(getattr(replica.engine, "half_precision", False))
 
     # ------------------------------------------------------------------
-    # failure handling
+    # failure handling (failover ladder)
     # ------------------------------------------------------------------
 
     def _on_batch_failure(self, batch: List[MatchRequest],
-                          exc: Exception, phase: str) -> None:
-        """One failed batch (dispatch raised, fetch raised, or the fetch
-        watchdog fired).  Recovery order mirrors ``run_isolated``: a
-        program-changing recovery (tier demotion + retrace) grants a FREE
-        retry of the whole batch; otherwise each request's bounded budget
-        is charged and exhausted requests quarantine.  Requeued requests go
-        to the FRONT of their bucket queue — queued work behind a failure
-        is delayed, never lost or reordered past the failure."""
-        from ncnet_tpu.evaluation.resilience import classify_failure
-        from ncnet_tpu.models.ncnet import recover_from_device_failure
+                          exc: Exception, phase: str,
+                          replica: Replica) -> None:
+        """One failed batch on one replica (dispatch raised, fetch raised,
+        or the fetch watchdog fired).  The failover ladder, per request:
 
-        self._controller.note_failure()
+          1. a surviving READY replica this request has NOT failed on →
+             requeue at the FRONT, re-routed OFF-budget (the failure is the
+             replica's fault; zero lost requests);
+          2. no READY replica at all (the pool is dead) → requeue
+             off-budget and WAIT — resurrection probes are the recovery,
+             and new admissions shed ``no_capacity`` meanwhile;
+          3. otherwise (single-replica pool, or failed everywhere) the PR 8
+             ladder: a program-changing recovery (tier demotion + retrace
+             of every replica) grants a FREE retry; else the request's
+             bounded budget is charged and exhausted requests quarantine.
+
+        Repeated failures quarantine the REPLICA: ``replica_max_failures``
+        consecutive failures move it to DEAD (router stops sending traffic,
+        admission capacity shrinks, resurrection probes begin)."""
+        from ncnet_tpu.evaluation.resilience import classify_failure
+
         kind = classify_failure(exc)
-        try:
-            tier = recover_from_device_failure(exc, self._engine)
-        except Exception as rec_exc:  # noqa: BLE001 — recovery must not
-            # take the worker (and every queued request) down with it;
-            # a failed recovery just means the plain retry budget applies
-            log.error(f"tier recovery itself failed "
-                      f"({type(rec_exc).__name__}: {rec_exc}); falling "
-                      "back to the plain retry budget", kind="device")
-            tier = None
+        with self._cond:
+            self._controller.note_failure()
+            replica.note_failure()
+            self._registry.counter(f"replica_failures_{replica.id}").inc()
+            if replica.state == REPLICA_READY and \
+                    replica.consecutive_failures >= \
+                    self.cfg.replica_max_failures:
+                log.warning(
+                    f"replica {replica.id} hit "
+                    f"{replica.consecutive_failures} consecutive failures "
+                    f"({kind}); quarantined DEAD — resurrection probes "
+                    f"every {self.cfg.resurrect_after_s}s", kind=kind)
+                self._pool.mark_dead(replica, f"{kind}:{type(exc).__name__}")
+            pending = [r for r in batch if not r.future.done()]
+            for req in pending:
+                req.failed_on.add(replica.id)
+            survivors = [r for r in self._pool.ready() if r is not replica]
+            any_ready = bool(self._pool.ready())
+            recovery_gen = self._recovery_gen
         requeue: List[MatchRequest] = []
         quarantine: List[MatchRequest] = []
-        if tier is not None:
-            with self._cond:
-                # a demotion during DRAINING/STOPPED must not fight the
-                # lifecycle states — the drain keeps completing admitted
-                # work on the demoted tier either way
-                if self._health.state in (STARTING, READY):
-                    self._health.to(DEGRADED, f"tier_demoted:{tier}")
-            log.warning(
-                f"serving batch {phase} failed ({kind}); demoted tier "
-                f"'{tier}' and re-tracing — {len(batch)} request(s) "
-                "requeued off-budget", kind=kind)
-            for req in batch:
+        tier: Optional[str] = None
+        tier_attempted = False
+        for req in pending:
+            fresh = any(r.id not in req.failed_on for r in survivors)
+            if fresh:
+                obs_events.emit("retry", unit=req.id, kind=kind,
+                                on_budget=False, scope="serving",
+                                replica=replica.id, via="reroute")
+                requeue.append(req)
+                continue
+            if not any_ready:
+                # the whole pool is dead: park the work off-budget behind
+                # the resurrection probes — availability degraded, nothing
+                # lost
+                obs_events.emit("retry", unit=req.id, kind=kind,
+                                on_budget=False, scope="serving",
+                                replica=replica.id,
+                                via="awaiting_capacity")
+                requeue.append(req)
+                continue
+            if not tier_attempted:
+                tier_attempted = True
+                tier = self._try_tier_recovery(exc, replica, recovery_gen)
+            if tier is not None:
+                # a new program: every replica is fresh evidence again
+                req.failed_on.clear()
                 obs_events.emit("retry", unit=req.id, kind=kind,
                                 recovered=tier, on_budget=False,
-                                scope="serving")
+                                scope="serving", replica=replica.id)
                 requeue.append(req)
-        else:
-            for req in batch:
-                req.attempts += 1
-                if req.attempts <= self.cfg.retries:
-                    obs_events.emit("retry", unit=req.id, kind=kind,
-                                    attempt=req.attempts, on_budget=True,
-                                    scope="serving")
-                    requeue.append(req)
-                else:
-                    quarantine.append(req)
-            if requeue:
-                log.warning(
-                    f"serving batch {phase} failed ({kind}: "
-                    f"{type(exc).__name__}: {exc}); {len(requeue)} "
-                    "request(s) requeued on-budget", kind=kind)
+                continue
+            req.attempts += 1
+            if req.attempts <= self.cfg.retries:
+                obs_events.emit("retry", unit=req.id, kind=kind,
+                                attempt=req.attempts, on_budget=True,
+                                scope="serving", replica=replica.id)
+                requeue.append(req)
+            else:
+                quarantine.append(req)
         if requeue:
+            routes = {r.id for r in survivors} or {"(awaiting capacity)"}
+            log.warning(
+                f"serving batch {phase} failed on {replica.id} ({kind}: "
+                f"{type(exc).__name__}: {exc}); {len(requeue)} request(s) "
+                f"requeued at the front (candidates: {sorted(routes)})",
+                kind=kind)
             with self._cond:
                 q = self._queues.setdefault(requeue[0].bucket, deque())
                 q.extendleft(reversed(requeue))
@@ -740,16 +954,62 @@ class MatchService:
         for req in quarantine:
             self._quarantine(req, kind, exc)
 
+    def _try_tier_recovery(self, exc: Exception, replica: Replica,
+                           gen: int) -> Optional[str]:
+        """The PR 8 demote-retrace path (last resort once no surviving
+        replica can take the batch): demote the Pallas tier registry and
+        retrace EVERY replica's engine — the registry is process-global, so
+        a poisoned tier must be rebuilt out of all of them.  Single-flight
+        across fetcher threads: ``gen`` is the recovery generation observed
+        WHEN this failure was classified; if another thread's recovery
+        landed since, this failure rides that program change instead of
+        burning a second ladder rung for the same fault.  On success the
+        service degrades (unless already draining) and the failing
+        replica's demotion count feeds its routing penalty; a recovery that
+        itself crashes falls back to the plain retry budget rather than
+        taking the worker (and every queued request) down with it."""
+        from ncnet_tpu.models.ncnet import recover_from_device_failure
+
+        with self._recovery_lock:
+            if self._recovery_gen != gen:
+                return self._last_recovery_tier
+            try:
+                tier = recover_from_device_failure(
+                    exc, *[r.engine for r in self._pool.replicas])
+            except Exception as rec_exc:  # noqa: BLE001 — recovery must
+                # not take the stream down with it
+                log.error(f"tier recovery itself failed "
+                          f"({type(rec_exc).__name__}: {rec_exc}); falling "
+                          "back to the plain retry budget", kind="device")
+                return None
+            if tier is None:
+                return None
+            self._recovery_gen += 1
+            self._last_recovery_tier = tier
+        with self._cond:
+            replica.demotions += 1  # its failures forced this: route-penalized
+            # a demotion during DRAINING/STOPPED must not fight the
+            # lifecycle states — the drain keeps completing admitted
+            # work on the demoted tier either way
+            if self._health.state in (STARTING, READY):
+                self._health.to(DEGRADED, f"tier_demoted:{tier}")
+        log.warning(
+            f"demoted tier '{tier}' and re-traced every replica — "
+            "the failed batch retries off-budget", kind="device")
+        return tier
+
     def _quarantine(self, req: MatchRequest, kind: str,
                     exc: Exception) -> None:
         msg = (f"request {req.id} gave up after {req.attempts} attempt(s): "
                f"{type(exc).__name__}: {exc}")
+        if not req.future._try_settle("quarantined", error=RequestQuarantined(
+                msg, kind=kind, attempts=req.attempts)):
+            return
         log.warning(f"{msg} — quarantined; the stream continues",
                     kind="quarantine")
-        req.future._settle("quarantined", error=RequestQuarantined(
-            msg, kind=kind, attempts=req.attempts))
-        self._n["quarantined"] += 1
-        self._registry.counter("quarantined").inc()
+        with self._cond:
+            self._n["quarantined"] += 1
+            self._registry.counter("quarantined").inc()
         obs_events.emit("serve_quarantine", request=req.id,
                         client=req.client, kind=kind,
                         attempts=req.attempts, error=str(exc)[:300])
@@ -758,10 +1018,13 @@ class MatchService:
         self._terminal(req)
 
     def _resolve_deadline(self, req: MatchRequest, where: str) -> None:
-        req.future._settle("deadline", error=DeadlineExceeded(
-            f"request {req.id} deadline expired at {where}", where=where))
-        self._n["deadline"] += 1
-        self._registry.counter("deadline_exceeded").inc()
+        if not req.future._try_settle("deadline", error=DeadlineExceeded(
+                f"request {req.id} deadline expired at {where}",
+                where=where)):
+            return
+        with self._cond:
+            self._n["deadline"] += 1
+            self._registry.counter("deadline_exceeded").inc()
         obs_events.emit("serve_deadline", request=req.id, client=req.client,
                         where=where, admitted=True)
         self._terminal(req)
@@ -772,13 +1035,71 @@ class MatchService:
         with self._cond:
             self._admission.note_done(req.client)
         if self._draining:
-            self._drain_resolved += 1
+            with self._cond:
+                self._drain_resolved += 1
+                n = self._drain_resolved
             from ncnet_tpu.utils import faults
 
             # chaos seam: SIGKILL after the Nth terminal outcome of the
             # drain phase (tests prove the event log still accounts for
             # everything that had no outcome yet)
-            faults.serve_drain_kill_hook(self._drain_resolved)
+            faults.serve_drain_kill_hook(n)
+
+    # ------------------------------------------------------------------
+    # resurrection probes
+    # ------------------------------------------------------------------
+
+    def _maybe_resurrect(self) -> None:
+        """Schedule resurrection probes for DEAD replicas whose period has
+        elapsed.  Each probe (a tiny zero pair at the replica's last, or
+        smallest known, bucket) runs on its OWN daemon thread — a probe at
+        a replica that hangs instead of erroring must not stall the
+        dispatcher, which would wedge every healthy lane behind a dead
+        chip's silence.  Success returns the replica to READY and its
+        capacity to admission; failure leaves it DEAD until the next
+        period.  Probes run during DRAINING too — a drain stuck behind a
+        dead pool NEEDS the resurrection to finish its admitted work."""
+        if self._stop_now:
+            return
+        now = time.monotonic()
+        with self._cond:
+            due = self._pool.due_probes(now, self.cfg.resurrect_after_s)
+            buckets = self._bucketer.buckets
+        for rep in due:
+            bucket = rep.last_bucket or (buckets[0] if buckets else None)
+            if bucket is None:
+                m = self.cfg.bucket_multiple
+                bucket = ((m, m), (m, m))
+            threading.Thread(
+                target=self._probe_replica, args=(rep, bucket),
+                name=f"match-probe-{rep.id}", daemon=True,
+            ).start()
+
+    def _probe_replica(self, rep: Replica, bucket: Bucket) -> None:
+        ok, err = True, None
+        try:
+            from ncnet_tpu.evaluation.pipeline import call_with_watchdog
+
+            src = np.zeros((1, *bucket[0], 3), np.uint8)
+            tgt = np.zeros((1, *bucket[1], 3), np.uint8)
+            handle = rep.dispatch(src, tgt)
+            call_with_watchdog(rep.fetch, (handle,),
+                               timeout=self.cfg.fetch_timeout_s,
+                               label="resurrect_probe")
+        except Exception as e:  # noqa: BLE001 — a failed probe only
+            # means the replica stays dead until the next period
+            ok, err = False, f"{type(e).__name__}: {e}"
+        obs_events.emit("serve_replica_probe", replica=rep.id, ok=ok,
+                        error=err and err[:200],
+                        bucket=bucket_label(bucket))
+        with self._cond:
+            rep.probing = False
+            if ok:
+                self._pool.resurrect(rep)
+            self._cond.notify_all()
+        if ok:
+            log.info(f"replica {rep.id} resurrected (probe ok); "
+                     "rejoining the pool", kind="device")
 
     # ------------------------------------------------------------------
     # shutdown
@@ -787,27 +1108,43 @@ class MatchService:
     def _finish(self, crashed: Optional[BaseException]) -> None:
         with self._cond:
             self._finishing = True  # admission closed before collection
+            self._fetchers_stop = True
+            self._cond.notify_all()
+        for t in self._fetchers:
+            # a fetch that already began completes normally (a blocking
+            # fetch cannot be interrupted); the join is bounded so a hung
+            # fetch without a watchdog cannot wedge shutdown — its batch is
+            # then force-settled below and the late fetch result discarded
+            # (the done() guards in _drain_batch)
+            t.join(10.0)
+        with self._cond:
             leftovers: List[MatchRequest] = []
             for q in self._queues.values():
                 leftovers.extend(q)
             self._queues.clear()
-            for inf in self._inflight:
-                leftovers.extend(inf.batch)
-            self._inflight.clear()
+            for rep in self._pool.replicas:
+                for inf in rep.pending:
+                    leftovers.extend(inf.batch)
+                rep.pending.clear()
+                if rep.processing:
+                    # the batch a hung (or crashed) fetcher still holds
+                    leftovers.extend(rep.processing)
+                    rep.processing = None
             if self._processing:
                 # the batch the worker held when it crashed — in no queue
-                # and no longer in the in-flight deque
+                # and no replica's backlog
                 leftovers.extend(self._processing)
                 self._processing = None
         reason = "crashed" if crashed is not None else "shutdown"
         for req in leftovers:
-            if req.future.done():
-                continue  # settled before the crash interrupted its batch
             # an aborted shutdown (or a worker crash) still settles every
-            # admitted request with a classified outcome
-            req.future._settle("overloaded", error=Overloaded(
-                f"service stopped before request {req.id} completed",
-                reason=reason))
+            # admitted request with a classified outcome; _try_settle keeps
+            # this atomic against a hung fetcher that outlived the bounded
+            # join and is only now landing its results
+            if not req.future._try_settle("overloaded", error=Overloaded(
+                    f"service stopped before request {req.id} completed",
+                    reason=reason)):
+                continue  # settled before the crash interrupted its batch
             self._n["shed"] += 1
             obs_events.emit("serve_shed", request=req.id, client=req.client,
                             reason=reason, admitted=True)

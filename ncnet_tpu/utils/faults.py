@@ -67,6 +67,12 @@ engine's ResilientJit carries label ``serve_batch``) and
     the admitted futures + classified sheds, the deterministic
     queue-overflow shape the chaos suite and ``tools/serve_probe.py``
     share.
+  * ``replica_fault_hook(id, phase)`` — serving/replica.py dispatch/fetch:
+    kills (``dead_replica_ids``: InjectedDeviceError until cleared — the
+    chip-death shape whose batches must fail over to surviving replicas
+    with zero lost requests) or slows (``slow_replica_ids``: a per-fetch
+    sleep the health-scored router must de-prioritize) individual pool
+    replicas.
 
 Arming: programmatic via :func:`install`/:func:`clear` (or the
 :func:`injected` context manager) in-process, or the ``NCNET_TPU_FAULTS``
@@ -148,6 +154,19 @@ class FaultPlan:
     # DRAIN (1-based) — the kill-mid-drain window: some admitted requests
     # die without an outcome and the event log must prove exactly which
     kill_at_drain_result: int = -1
+    # --- replica-pool faults (ncnet_tpu/serving/replica.py layer) ---
+    # these replica ids fail every armed-phase call with
+    # InjectedDeviceError — the SIGKILL-style chip death: the replica stays
+    # dead until the plan is cleared (a resurrection probe then succeeds)
+    dead_replica_ids: Tuple[str, ...] = ()
+    # which calls die: "fetch" (default — the mid-batch window: the
+    # dispatch already succeeded, the in-flight batch must fail over),
+    # "dispatch", or "both"
+    dead_replica_phase: str = "fetch"
+    # these replica ids sleep slow_replica_seconds inside every fetch — the
+    # degraded-chip shape the health-scored router must de-prioritize
+    slow_replica_ids: Tuple[str, ...] = ()
+    slow_replica_seconds: float = 0.25
 
 
 _plan: Optional[FaultPlan] = None
@@ -344,6 +363,27 @@ def serve_drain_kill_hook(n_resolved: int) -> None:
             or n_resolved != p.kill_at_drain_result:
         return
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def replica_fault_hook(replica_id: str, phase: str) -> None:
+    """The replica-pool chaos seam (serving/replica.py dispatch/fetch).
+
+    ``slow_replica_ids`` sleep on fetch — the slow-chip injection whose
+    inflated batch walls the health-scored router must measurably
+    de-prioritize.  ``dead_replica_ids`` raise :class:`InjectedDeviceError`
+    on the armed phase(s) — a replica-local death: with survivors in the
+    pool the service must re-route the batch off-budget and quarantine the
+    REPLICA, never the request."""
+    p = _active()
+    if p is None:
+        return
+    if phase == "fetch" and replica_id in p.slow_replica_ids:
+        time.sleep(p.slow_replica_seconds)
+    if replica_id in p.dead_replica_ids and \
+            p.dead_replica_phase in (phase, "both"):
+        raise InjectedDeviceError(
+            f"injected replica death ({replica_id}, {phase})"
+        )
 
 
 def queue_overflow_burst(submit: Callable[[], object], n: int):

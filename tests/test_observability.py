@@ -665,6 +665,8 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # sys.stdout — a bare print() would reopen the side channel): the
     # whole-package walk covers the ncnet_tpu/ paths, but the TOOLS are
     # outside it and only this pin keeps them honest
+    # (the ncnet_tpu/serving directory walk recursively covers every
+    # serving module, incl. the PR 10 replica.py — no per-file entries)
     for target in ("ncnet_tpu/observability/quality.py",
                    "ncnet_tpu/serving",
                    "tools/quality_drift.py",

@@ -1,0 +1,630 @@
+"""Chaos suite for the replicated serving pool (ncnet_tpu/serving/replica.py).
+
+The ISSUE 10 acceptance bars, executed deterministically through the
+utils/faults.py replica hooks (``dead_replica_ids`` / ``slow_replica_ids``)
+against a 4-replica CPU pool:
+
+  (a) sustained stream → SIGKILL-style death of one replica mid-batch →
+      the service stays READY/DEGRADED with ZERO lost requests (the
+      outcome-total identity recomputed from the event log names every
+      request) → the replica resurrects via a probe and resumes taking
+      traffic → SIGTERM drains the whole pool cleanly;
+  (b) all-replicas-dead → DEGRADED with classified ``no_capacity``
+      shedding (retry hints at the resurrection-probe period), admitted
+      work PARKED off-budget, then full recovery on resurrection;
+  (c) a slow replica's inflated batch walls make the health-scored router
+      measurably de-prioritize it;
+  (d) pool membership changes flow into admission control: queue bounds
+      and retry-after hints track ready/total capacity elastically;
+  (e) a REAL multi-device pool (``--xla_force_host_platform_device_count``)
+      builds one engine per device and serves across all of them.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import ops
+from ncnet_tpu.observability import EventLog, Heartbeat
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.serving import (
+    DEGRADED,
+    READY,
+    REPLICA_DEAD,
+    REPLICA_READY,
+    STOPPED,
+    AdmissionController,
+    BatchMatchEngine,
+    MatchService,
+    Overloaded,
+    Replica,
+    ReplicaPool,
+    ServingConfig,
+)
+from ncnet_tpu.utils import faults
+from ncnet_tpu.utils.faults import FaultPlan
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import run_report  # noqa: E402
+import stall_watchdog  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No armed faults, no demoted tiers, no leaked event sink."""
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+
+
+def u8(side=32, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+class FakeEngine:
+    """Device stand-in (same protocol as tests/test_serving.py): the
+    replica-level chaos seams live in serving/replica.py's wrappers, so a
+    fake engine behind a real Replica exercises the REAL failover paths."""
+
+    split = staticmethod(BatchMatchEngine.split)
+    half_precision = False
+
+    def __init__(self, latency_s: float = 0.0):
+        self.latency_s = latency_s
+        self.retraces = 0
+        self.dispatches = 0
+
+    def dispatch(self, src, tgt):
+        faults.device_error_hook("fake_serve")
+        self.dispatches += 1
+        return (src.shape[0], time.monotonic())
+
+    def fetch(self, handle):
+        b, t0 = handle
+        while time.monotonic() - t0 < self.latency_s:
+            time.sleep(0.01)
+        table = np.zeros((b, 6, 16), np.float32)
+        table[:, 4, :] = 1.0
+        table[:, 5, :5] = [0.5, 0.1, 0.4, 0.9, 0.8]
+        return table
+
+    def retrace(self):
+        self.retraces += 1
+
+
+def pool_service(n=4, latency_s=0.02, **over):
+    cfg = dict(bucket_multiple=32, max_image_side=64, max_batch=2,
+               replica_max_failures=1, resurrect_after_s=0.2,
+               # the chaos streams saturate from ONE client; the fairness
+               # cap must exceed the stream depth or the tests shed
+               # themselves
+               max_queue=128, max_in_flight_per_client=128)
+    cfg.update(over)
+    engines = [FakeEngine(latency_s=latency_s) for _ in range(n)]
+    return MatchService(engine=engines,
+                        serving=ServingConfig(**cfg)), engines
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# units: pool routing, health scores, elastic admission
+# ---------------------------------------------------------------------------
+
+
+def test_replica_health_score_and_routing():
+    a, b, c = (Replica("a", object()), Replica("b", object()),
+               Replica("c", object()))
+    pool = ReplicaPool([a, b, c])
+    a.note_success(0.01)
+    b.note_success(0.2)
+    # measured-fastest wins; an unmeasured replica routes at the prior
+    assert pool.route(max_load=2).id == "a"
+    a.pending.extend([object(), object()])  # a at full depth
+    assert pool.route(max_load=2).id == "c"  # prior 0.05 beats b's 0.2
+    # a failure streak doubles the score per consecutive failure
+    c.note_failure()
+    c.note_failure()
+    assert c.health_score() == pytest.approx(0.05 * 4)
+    assert pool.route(max_load=2).id == "b"
+    c.note_success(0.01)  # a success clears the streak
+    assert c.consecutive_failures == 0
+    # exclusion prefers fresh replicas but falls back rather than strand
+    assert pool.route(max_load=2, exclude=frozenset({"c"})).id == "b"
+    assert pool.route(
+        max_load=2, exclude=frozenset({"b", "c"})).id in ("b", "c")
+    pool.mark_dead(b, "test")
+    pool.mark_dead(c, "test")
+    assert b.state == REPLICA_DEAD and b.deaths == 1
+    assert pool.route(max_load=2) is None  # a full, b/c dead
+    pool.resurrect(c)
+    assert c.state == REPLICA_READY and c.ewma_wall_s is None
+    assert pool.route(max_load=2).id == "c"
+
+
+def test_pool_due_probes_are_periodic_and_skip_loaded():
+    a, b = Replica("a", object()), Replica("b", object())
+    pool = ReplicaPool([a, b])
+    pool.mark_dead(a, "test")
+    pool.mark_dead(b, "test")
+    b.pending.append(object())  # still draining its backlog: not probeable
+    t0 = a.dead_since
+    assert pool.due_probes(t0 + 0.05, 0.2) == []
+    due = pool.due_probes(t0 + 0.25, 0.2)
+    assert due == [a]  # b skipped while loaded
+    # the probing flag was stamped: while the probe thread is out the
+    # replica is never double-scheduled, no matter how late it runs
+    assert a.probing is True
+    assert pool.due_probes(t0 + 10.0, 0.2) == []
+    a.probing = False  # the probe returned (and failed)
+    # last_probe_t was stamped too: not due again until another period
+    assert pool.due_probes(t0 + 0.3, 0.2) == []
+    assert pool.due_probes(t0 + 0.5, 0.2) == [a]
+
+
+def test_admission_tracks_pool_capacity_elastically():
+    """Satellite: retry_after_s derives from AGGREGATE pool cadence
+    (batch wall / ready replicas) and the queue bound scales with the live
+    ready fraction; an all-dead pool sheds classified no_capacity with the
+    resurrection period as the hint."""
+    a = AdmissionController(max_queue=64, max_in_flight_per_client=64,
+                            max_batch=8, dead_retry_after_s=2.5)
+    a.note_batch_wall(0.4)
+    a.note_capacity(4, 4)
+    assert a.retry_after_s(8) == pytest.approx(0.1, rel=0.01)
+    a.note_capacity(1, 4)  # three replicas died: hints stretch 4x
+    assert a.retry_after_s(8) == pytest.approx(0.4, rel=0.01)
+    assert a.effective_max_queue() == 16
+    a.note_capacity(3, 4)
+    assert a.effective_max_queue() == 48
+    a.note_capacity(0, 4)
+    with pytest.raises(Overloaded) as e:
+        a.admit("c", 0)
+    assert e.value.reason == "no_capacity"
+    assert e.value.retry_after_s == pytest.approx(2.5)
+    # the bound floors at one batch so a lone survivor still coalesces
+    b = AdmissionController(max_queue=16, max_in_flight_per_client=8,
+                            max_batch=8)
+    b.note_capacity(1, 8)
+    assert b.effective_max_queue() == 8
+    # elastic off: the static PR 8 bound regardless of membership
+    c = AdmissionController(max_queue=64, max_in_flight_per_client=8,
+                            max_batch=8, elastic=False)
+    c.note_capacity(1, 4)
+    assert c.effective_max_queue() == 64
+
+
+# ---------------------------------------------------------------------------
+# routing behavior under load
+# ---------------------------------------------------------------------------
+
+
+def test_pool_spreads_load_and_tags_events(tmp_path):
+    """Every replica takes traffic under a sustained stream, and the
+    serve_batch / serve_result / quality events are replica-tagged."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, engines = pool_service(n=3, latency_s=0.03, max_batch=1)
+        svc.start()
+        img = u8()
+        futs = [svc.submit(img, img) for _ in range(18)]
+        for f in futs:
+            f.result(timeout=60)
+        svc.stop()
+    _, events = obs_events.replay_events(log_path)
+    batch_reps = {e["replica"] for e in events
+                  if e.get("event") == "serve_batch"}
+    assert batch_reps == {"rep0", "rep1", "rep2"}
+    result_reps = {e.get("replica") for e in events
+                   if e.get("event") == "serve_result"}
+    assert result_reps <= batch_reps and result_reps
+    quality_reps = {e.get("replica") for e in events
+                    if e.get("event") == "quality"}
+    assert quality_reps and None not in quality_reps
+    sec = run_report.build_serving_section(events)
+    assert set(sec["replicas"]) == {"rep0", "rep1", "rep2"}
+    assert sum(r["batches"] for r in sec["replicas"].values()) == 18
+    assert sec["outcomes"]["unresolved"] == 0
+
+
+def test_slow_replica_is_deprioritized():
+    """Acceptance (c): an injected slow replica (its fetches sleep) ends up
+    with an inflated wall EWMA and a worse health score, and the router
+    sends it measurably less traffic than its healthy peer."""
+    svc, engines = pool_service(n=2, latency_s=0.01, max_batch=1,
+                                replica_max_failures=5)
+    faults.install(FaultPlan(slow_replica_ids=("rep1",),
+                             slow_replica_seconds=0.25))
+    try:
+        svc.start()
+        img = u8()
+        futs = [svc.submit(img, img) for _ in range(24)]
+        for f in futs:
+            f.result(timeout=60)
+        health = svc.health()
+    finally:
+        faults.clear()
+        svc.stop()
+    rep = {r["id"]: r for r in health["replicas"]}
+    assert rep["rep0"]["batches"] + rep["rep1"]["batches"] == 24
+    assert rep["rep1"]["batches"] >= 1  # it did serve — just rarely
+    assert rep["rep0"]["batches"] >= 3 * rep["rep1"]["batches"]
+    # the telemetry that fed the decision: slow EWMA, worse score
+    assert rep["rep1"]["ewma_wall_ms"] > rep["rep0"]["ewma_wall_ms"] * 5
+    assert rep["rep1"]["score"] > rep["rep0"]["score"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: replica death, failover, resurrection, all-dead
+# ---------------------------------------------------------------------------
+
+
+def test_acceptance_chain_kill_resurrect_drain(tmp_path):
+    """THE ISSUE 10 acceptance chain on a 4-replica pool: sustained stream
+    → rep2 dies mid-batch (dispatch succeeded, fetch raises — the
+    SIGKILL-style chip death) → zero lost requests, service DEGRADED but
+    serving → faults heal, the resurrection probe returns rep2 to READY
+    and the pool to full strength (service back to READY — no tier was
+    demoted, so the capacity DEGRADED recovers) → rep2 takes traffic again
+    → SIGTERM drains the whole pool cleanly."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, engines = pool_service(n=4, install_sigterm=True)
+        svc.start()
+        img = u8()
+        # phase 1: healthy sustained stream
+        for f in [svc.submit(img, img) for _ in range(8)]:
+            f.result(timeout=60)
+        assert svc.state == READY
+        # phase 2: rep2 dies mid-batch; every request still resolves
+        faults.install(FaultPlan(dead_replica_ids=("rep2",)))
+        futs = [svc.submit(img, img) for _ in range(16)]
+        for f in futs:
+            f.result(timeout=60)
+        assert all(f.outcome == "result" for f in futs)
+        assert wait_until(lambda: svc.health()["ready_replicas"] == 3)
+        h = svc.health()
+        assert h["state"] == DEGRADED
+        assert {r["id"]: r["state"] for r in h["replicas"]}["rep2"] \
+            == REPLICA_DEAD
+        # elastic admission: the advertised queue shrank with the pool
+        assert h["effective_max_queue"] < svc.cfg.max_queue
+        # probes fire while the fault is armed — and fail
+        assert wait_until(lambda: any(
+            r["probes"] for r in
+            run_report.build_serving_section(
+                obs_events.replay_events(log_path)[1])["replicas"].values()
+        ), timeout=5.0, interval=0.1)
+        # phase 3: heal the chip; the probe resurrects rep2
+        faults.clear()
+        assert wait_until(lambda: svc.health()["ready_replicas"] == 4)
+        assert svc.state == READY  # capacity DEGRADED recovered, no tier down
+        # phase 4: rep2 takes traffic again
+        futs = [svc.submit(img, img) for _ in range(16)]
+        for f in futs:
+            f.result(timeout=60)
+        # phase 5: SIGTERM drains the whole pool cleanly
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert wait_until(lambda: svc.state == STOPPED)
+        svc.stop()  # restores the handler; worker already gone
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_serving_section(events)
+    # the outcome-total identity over the whole chain: 40 admitted, 40
+    # results, nothing lost, nothing quarantined
+    assert sec["outcomes"]["admitted"] == 40
+    assert sec["outcomes"]["results"] == 40
+    assert sec["outcomes"]["unresolved"] == 0 and not sec["lost_requests"]
+    assert sec["outcomes"]["quarantined"] == 0
+    # the failover is in the log: off-budget reroutes away from rep2
+    reroutes = [e for e in events if e.get("event") == "retry"
+                and e.get("via") == "reroute"]
+    assert reroutes and all(e["replica"] == "rep2" for e in reroutes)
+    assert all(e["on_budget"] is False for e in reroutes)
+    # no tier demotion happened: failover, not program change
+    assert not ops.demoted_fused_tiers()
+    assert all(e.retraces == 0 for e in engines)
+    # replica lifecycle in the health timeline: rep2 DEAD then READY
+    rep2 = sec["replicas"]["rep2"]
+    assert rep2["deaths"] == 1 and rep2["resurrections"] == 1
+    assert rep2["batches"] >= 1  # it resumed taking traffic after probe_ok
+    states = [(e.get("replica"), e["state"]) for e in events
+              if e.get("event") == "serve_health"]
+    assert ("rep2", REPLICA_DEAD) in states
+    assert states.index(("rep2", REPLICA_READY)) \
+        > states.index(("rep2", REPLICA_DEAD))
+    # rep2 served real batches AFTER its resurrection
+    resurrect_seq = next(
+        i for i, e in enumerate(events) if e.get("event") == "serve_health"
+        and e.get("replica") == "rep2" and e["state"] == REPLICA_READY)
+    assert any(e.get("event") == "serve_batch" and e.get("replica") == "rep2"
+               for e in events[resurrect_seq:])
+    # service-level timeline: READY -> DEGRADED -> READY -> DRAINING -> STOPPED
+    svc_states = [e["state"] for e in events
+                  if e.get("event") == "serve_health"
+                  and e.get("replica") is None]
+    assert svc_states == [READY, DEGRADED, READY, "DRAINING", STOPPED]
+    drains = [e for e in events if e.get("event") == "serve_drain"]
+    assert len(drains) == 1 and drains[0]["drained"] is True \
+        and drains[0]["leftover"] == 0
+    # the tool renders the pool postmortem end to end
+    assert run_report.main([log_path, "--serving"]) == 0
+
+
+def test_all_replicas_dead_sheds_then_recovers(tmp_path):
+    """Acceptance (b): every replica dead → admitted work PARKS off-budget
+    (zero lost), new admissions shed classified ``no_capacity`` with the
+    resurrection period as the retry hint, service DEGRADED — then the
+    probes revive the pool, the parked work completes, and full membership
+    restores READY."""
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, engines = pool_service(n=2, max_batch=1, resurrect_after_s=0.25)
+        svc.start()
+        img = u8()
+        faults.install(FaultPlan(dead_replica_ids=("rep0", "rep1")))
+        f1 = svc.submit(img, img)
+        f_dl = svc.submit(img, img, deadline_s=0.3)
+        assert wait_until(lambda: svc.health()["ready_replicas"] == 0)
+        assert svc.state == DEGRADED
+        assert f1.outcome is None  # parked behind the probes, not lost
+        with pytest.raises(Overloaded) as e:
+            svc.submit(img, img)
+        assert e.value.reason == "no_capacity"
+        assert e.value.retry_after_s == pytest.approx(0.25)
+        # a parked request whose budget expires is still evicted with the
+        # classified deadline outcome — even with NOTHING routable
+        from ncnet_tpu.serving import DeadlineExceeded
+
+        with pytest.raises(DeadlineExceeded) as de:
+            f_dl.result(timeout=10)
+        assert de.value.where == "dequeue"
+        # heal: the next probe resurrects a replica and the stream resumes
+        faults.clear()
+        assert f1.result(timeout=60).request_id == f1.request_id
+        assert f1.outcome == "result"
+        assert wait_until(lambda: svc.health()["ready_replicas"] == 2)
+        assert svc.state == READY
+        svc.stop()
+    _, events = obs_events.replay_events(log_path)
+    parked = [e for e in events if e.get("event") == "retry"
+              and e.get("via") == "awaiting_capacity"]
+    assert parked and all(e["on_budget"] is False for e in parked)
+    sheds = [e for e in events if e.get("event") == "serve_shed"]
+    assert any(e.get("reason") == "no_capacity" for e in sheds)
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["unresolved"] == 0
+    assert sec["outcomes"]["results"] == 1
+    deaths = sum(r["deaths"] for r in sec["replicas"].values())
+    assert deaths == 2
+
+
+def test_single_replica_pool_keeps_pr8_tier_recovery(tmp_path):
+    """A pool of one has no survivor to fail over to: a device-shaped
+    failure must still walk the PR 8 demote-retrace ladder (free retry on a
+    program change) — the replica is NOT killed for a failure the tier
+    recovery absorbed."""
+    svc, engines = pool_service(n=1, max_batch=1, replica_max_failures=3)
+    svc.start()
+    faults.install(FaultPlan(device_fail_calls=(1,)))
+    try:
+        f = svc.submit(u8(), u8())
+        assert f.result(timeout=60).request_id
+        assert f.outcome == "result"
+    finally:
+        faults.clear()
+        svc.stop()
+    assert engines[0].retraces == 1  # the recovery really retraced
+    assert ops.demoted_fused_tiers()
+    rep = svc.health()["replicas"][0]
+    assert rep["deaths"] == 0
+    # the demotion its failure forced feeds the routing penalty + probe
+    assert rep["demotions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# liveness: one wedged replica must not flag a healthy pool (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_wedged_replica_does_not_stall_healthy_pool(tmp_path):
+    """One replica wedges (its fetch hangs); survivors keep dispatching, so
+    the pool-wide heartbeat stays fresh and the watchdog stays green —
+    while the wedged lane is visibly 'not recent' in the breakdown."""
+    hb = str(tmp_path / "heartbeat.json")
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, engines = pool_service(n=2, latency_s=0.02, max_batch=1,
+                                    heartbeat_path=hb,
+                                    replica_max_failures=100)
+        svc.start()
+        img = u8()
+        for f in [svc.submit(img, img) for _ in range(8)]:
+            f.result(timeout=60)
+        # wedge rep1's engine: its next fetch blocks ~forever.  Everything
+        # not already stranded behind the wedged lane (it holds at most
+        # pipeline-depth batches — a silent wedge is invisible at dispatch
+        # time; fetch_timeout_s is the knob that converts it into a
+        # failover) keeps resolving through rep0.
+        engines[1].latency_s = 60.0
+        futs = [svc.submit(img, img) for _ in range(12)]
+        assert wait_until(lambda: sum(
+            f.outcome is not None for f in futs) >= 8, timeout=20)
+        # a second wave under the standing wedge: still served (by rep0)
+        futs2 = [svc.submit(img, img) for _ in range(4)]
+        assert wait_until(lambda: all(
+            f.outcome is not None for f in futs2), timeout=20)
+        # judged immediately: the pool-wide heartbeat and rep0's cadence
+        # are fresh, so one wedged replica does NOT flag the pool
+        v = stall_watchdog.judge(hb, events_path=log_path, factor=5,
+                                 min_age=0.5)
+        assert v["status"] == "alive"
+        assert v["replicas"]["rep0"]["recent"] is True
+        # release the wedge so shutdown is clean
+        engines[1].latency_s = 0.0
+        for f in futs:
+            f.result(timeout=60)
+        svc.stop(timeout=60)
+
+
+def test_stall_watchdog_alive_via_replica_cadence(tmp_path):
+    """The event-log backstop: a stale heartbeat (file unwritable, clock
+    skew) must not flag a pool whose log shows a lane still draining — and
+    with every lane stale the verdict is honestly STALLED."""
+    hb = str(tmp_path / "heartbeat.json")
+    log_path = str(tmp_path / "events.jsonl")
+    now = time.time()
+    with obs_events.bound(EventLog(log_path)):
+        for _ in range(4):  # a long-wedged lane...
+            obs_events.emit("serve_batch", replica="rep0", wall_s=0.05,
+                            t=now - 60)
+        for _ in range(4):  # ...and a lane that drained moments ago
+            obs_events.emit("serve_batch", replica="rep1", wall_s=0.05,
+                            t=now - 0.5)
+    Heartbeat(hb).beat(step=1)
+    os.utime(hb, (now - 60, now - 60))  # heartbeat looks long dead
+    v = stall_watchdog.judge(hb, events_path=log_path, factor=5, min_age=2.0)
+    assert v["status"] == "alive"
+    assert v["alive_via"] == "replica_cadence:rep1"
+    assert v["replicas"]["rep0"]["recent"] is False
+    assert v["replicas"]["rep1"]["recent"] is True
+    # every lane stale: genuinely stalled, named per replica
+    log2 = str(tmp_path / "events2.jsonl")
+    with obs_events.bound(EventLog(log2)):
+        for rid in ("rep0", "rep1"):
+            obs_events.emit("serve_batch", replica=rid, wall_s=0.05,
+                            t=now - 60)
+    v = stall_watchdog.judge(hb, events_path=log2, factor=5, min_age=2.0)
+    assert v["status"] == "stalled"
+    assert not any(r["recent"] for r in v["replicas"].values())
+
+
+# ---------------------------------------------------------------------------
+# real engines: a multi-device pool end to end
+# ---------------------------------------------------------------------------
+
+
+_MULTIDEV_CHILD = """
+import json, sys, warnings
+import numpy as np
+
+sys.path.insert(0, {repo!r})
+import jax
+
+from ncnet_tpu import models
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.observability import EventLog
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.serving import MatchService, ServingConfig
+
+assert len(jax.devices()) == 4, jax.devices()
+cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                  ncons_channels=(1,))
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")
+    params = models.init_ncnet(cfg, jax.random.key(0))
+obs_events.set_global_sink(EventLog(sys.argv[1]))
+svc = MatchService(cfg, params, ServingConfig(
+    bucket_multiple=32, max_image_side=32, max_batch=1,
+    replicas=0)).start()  # 0 = one replica per visible device
+rng = np.random.default_rng(0)
+futs = [svc.submit(rng.integers(0, 255, (32, 32, 3), dtype=np.uint8),
+                   rng.integers(0, 255, (32, 32, 3), dtype=np.uint8))
+        for _ in range(8)]
+tables = [f.result(timeout=300).table for f in futs]
+health = svc.health()
+svc.stop()
+print(json.dumps({{
+    "n_results": len(tables),
+    "table_rows": int(tables[0].shape[0]),
+    "replicas": [r["id"] for r in health["replicas"]],
+    "devices": sorted({{r["device"] for r in health["replicas"]}}),
+}}))
+"""
+
+
+def test_real_pool_one_engine_per_forced_host_device(tmp_path):
+    """Acceptance (e): ``XLA_FLAGS=--xla_force_host_platform_device_count=4``
+    gives the child four CPU devices; ``replicas=0`` builds one real
+    BatchMatchEngine per device (params committed per device) and the
+    stream is served across them."""
+    log_path = str(tmp_path / "events.jsonl")
+    child = tmp_path / "child.py"
+    child.write_text(_MULTIDEV_CHILD.format(repo=_REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off")
+    proc = subprocess.run(
+        [sys.executable, str(child), log_path],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["n_results"] == 8 and doc["table_rows"] == 5
+    assert doc["replicas"] == ["rep0", "rep1", "rep2", "rep3"]
+    assert len(doc["devices"]) == 4  # four DISTINCT devices, one each
+    _, events = obs_events.replay_events(log_path)
+    batch_reps = {e["replica"] for e in events
+                  if e.get("event") == "serve_batch"}
+    # the router spread the stream across the pool (at minimum it used
+    # more than one device; typically all four)
+    assert len(batch_reps) >= 2
+    sec = run_report.build_serving_section(events)
+    assert sec["outcomes"]["admitted"] == 8
+    assert sec["outcomes"]["unresolved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# tools: probe sweep smoke, report rendering
+# ---------------------------------------------------------------------------
+
+
+def test_serve_probe_replica_sweep_tiny_smoke(tmp_path, capsys):
+    import serve_probe
+
+    rc = serve_probe.main(["--tiny", "--sides", "32", "--pairs", "4",
+                           "--no-demote", "--burst-factor", "1.0",
+                           "--replicas", "1,2"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc["replica_sweep"]) == {"r1", "r2"}
+    for r in doc["replica_sweep"].values():
+        assert r["qps"] > 0 and r["latency_ms"]["n"] == 4
+    # a single-CPU test host oversubscribes r2 and says so
+    assert doc["replica_sweep"]["r2"]["oversubscribed"] \
+        == (doc["visible_devices"] < 2)
+
+
+def test_run_report_renders_replica_section(tmp_path, capsys):
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc, _ = pool_service(n=2, latency_s=0.01, max_batch=1)
+        svc.start()
+        img = u8()
+        for f in [svc.submit(img, img) for _ in range(6)]:
+            f.result(timeout=60)
+        svc.stop()
+    assert run_report.main([log_path, "--serving"]) == 0
+    out = capsys.readouterr().out
+    assert "replicas:" in out
+    assert "rep0:" in out and "rep1:" in out
+    assert "exactly one terminal outcome" in out

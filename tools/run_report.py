@@ -166,9 +166,11 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
     """The serving postmortem: request-outcome accounting (the outcome-total
     invariant ``admitted == results + deadlines + quarantines +
     admitted_sheds``; a nonzero ``unresolved`` means requests died without
-    an outcome — the kill-mid-drain signature), per-bucket latency
-    percentiles, the queue-depth trajectory (from ``serve_batch`` events),
-    and the health-state timeline."""
+    an outcome — the kill-mid-drain signature), per-bucket AND per-replica
+    latency/outcome percentiles (the replica-pool postmortem: batches,
+    retries, deaths/resurrections per replica id), the queue-depth
+    trajectory (from ``serve_batch`` events), and the health-state timeline
+    (replica-tagged entries are replica lifecycle edges)."""
     admits = [e for e in events if e.get("event") == "serve_admit"]
     results = [e for e in events if e.get("event") == "serve_result"]
     deadlines = [e for e in events if e.get("event") == "serve_deadline"
@@ -218,6 +220,54 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
         step = len(traj) / 64.0
         traj = [traj[int(i * step)] for i in range(64)]
 
+    # per-replica accounting (the pool postmortem): batches/results/retries
+    # per replica id, its latency percentiles, and its death/resurrection
+    # count from the replica-tagged serve_health events
+    replicas: Dict[str, Dict[str, Any]] = {}
+
+    def _rep(rid) -> Dict[str, Any]:
+        return replicas.setdefault(str(rid), {
+            "batches": 0, "batch_walls": [], "results": 0, "latencies": [],
+            "retries": 0, "deaths": 0, "resurrections": 0, "probes": 0,
+        })
+
+    for e in batches:
+        if e.get("replica") is not None:
+            r = _rep(e["replica"])
+            r["batches"] += 1
+            if isinstance(e.get("wall_s"), (int, float)):
+                r["batch_walls"].append(e["wall_s"])
+    for e in results:
+        if e.get("replica") is not None:
+            r = _rep(e["replica"])
+            r["results"] += 1
+            if isinstance(e.get("wall_ms"), (int, float)):
+                r["latencies"].append(e["wall_ms"])
+    for e in events:
+        ev, rid = e.get("event"), e.get("replica")
+        if rid is None:
+            continue
+        if ev == "retry" and e.get("scope") == "serving":
+            _rep(rid)["retries"] += 1
+        elif ev == "serve_health" and e.get("state") == "DEAD":
+            _rep(rid)["deaths"] += 1
+        elif ev == "serve_health" and e.get("state") == "READY":
+            _rep(rid)["resurrections"] += 1
+        elif ev == "serve_replica_probe":
+            _rep(rid)["probes"] += 1
+    replica_table = {}
+    for rid, r in sorted(replicas.items()):
+        replica_table[rid] = {
+            "batches": r["batches"],
+            "batch_wall_s": _percentiles(r["batch_walls"]),
+            "results": r["results"],
+            "latency_ms": _percentiles(r["latencies"]),
+            "retries": r["retries"],
+            "deaths": r["deaths"],
+            "resurrections": r["resurrections"],
+            "probes": r["probes"],
+        }
+
     return {
         "outcomes": {
             "admitted": len(admits),
@@ -247,9 +297,12 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
         "queue_depth_trajectory": traj,
         "shed_reasons": shed_reasons,
         "deadline_where": deadline_where,
+        "replicas": replica_table,
         "health_timeline": [
             {"t": e.get("t"), "state": e.get("state"),
-             "reason": e.get("reason")}
+             "reason": e.get("reason"),
+             **({"replica": e["replica"]}
+                if e.get("replica") is not None else {})}
             for e in events if e.get("event") == "serve_health"
         ],
         "drains": [
@@ -497,10 +550,25 @@ def render_serving(report: Dict[str, Any]) -> str:
     if sv["deadline_where"]:
         lines.append("  deadlines by checkpoint: " + ", ".join(
             f"{k}={v}" for k, v in sorted(sv["deadline_where"].items())))
+    if sv.get("replicas"):
+        lines.append("  replicas:")
+        for rid, r in sv["replicas"].items():
+            chaos = ""
+            if r["deaths"] or r["resurrections"]:
+                chaos = (f"  deaths={r['deaths']} "
+                         f"resurrections={r['resurrections']} "
+                         f"probes={r['probes']}")
+            lines.append(
+                f"    {rid}: batches={r['batches']}  results={r['results']}"
+                f"  retries={r['retries']}{chaos}")
+            if r["latency_ms"]:
+                lines.append(
+                    f"      latency {_fmt_stats(r['latency_ms'], 'ms')}")
     if sv["health_timeline"]:
         lines.append("  health timeline:")
         for h in sv["health_timeline"]:
-            lines.append(f"    -> {h['state']}"
+            who = f"[{h['replica']}] " if h.get("replica") else ""
+            lines.append(f"    -> {who}{h['state']}"
                          + (f"  ({h['reason']})" if h.get("reason") else ""))
     if sv["queue_depth_trajectory"]:
         depths = [p["queue_depth"] for p in sv["queue_depth_trajectory"]

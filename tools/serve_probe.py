@@ -18,10 +18,18 @@ tier-1 suite cannot make honestly:
      measured capacity: shed fraction, admitted-work latency (the admitted
      stream must NOT deadline-blow while the overflow sheds).
 
+  4. **Replica-pool scaling** (``--replicas 1,2,4``) — closed-loop
+     capacity per pool size, one fresh service per size with one
+     ``BatchMatchEngine`` per visible device: does a 4-chip pool serve ~4x
+     the qps of one chip, and where does routing overhead eat the scaling?
+     The numbers feed the bench's ``serve_capacity_qps_r{k}`` perf-store
+     family.
+
 Usage::
 
     python tools/serve_probe.py [--sides 400,512] [--pairs 48] [--tiny]
-        [--no-demote] [--burst-factor 3.0] [--json out.json]
+        [--no-demote] [--burst-factor 3.0] [--replicas 1,2,4]
+        [--json out.json]
 
 ``--tiny`` runs the CPU-sized smoke configuration (tiny backbone, 64 px) so
 the probe's own plumbing is testable without a TPU.  Output: one JSON
@@ -57,7 +65,7 @@ def _percentiles(xs: List[float]) -> Dict[str, float]:
 
 
 def probe(sides: List[int], n_pairs: int, tiny: bool, demote: bool,
-          burst_factor: float) -> Dict[str, Any]:
+          burst_factor: float, replicas: List[int] = (1,)) -> Dict[str, Any]:
     import warnings
 
     import jax
@@ -195,6 +203,43 @@ def probe(sides: List[int], n_pairs: int, tiny: bool, demote: bool,
         out["health"] = service.health()
     finally:
         service.stop()
+
+    # 4. replica-pool scaling sweep (ISSUE 10): closed-loop capacity per
+    # pool size — the serving twin of the bench's serve_capacity_qps_r{k}
+    # family.  Each pool gets a FRESH service (its own engines, committed
+    # per device); capacity numbers only mean something at one replica per
+    # device (replicas > devices shares devices round-robin and measures
+    # pool mechanics, not hardware scaling — flagged in the output).
+    if len(replicas) > 1 or replicas[0] != 1:
+        import jax as _jax
+
+        ndev = len(_jax.devices())
+        sweep: Dict[str, Any] = {}
+        side = sides[0]
+        pairs = [pair(side) for _ in range(8)]
+        for r in replicas:
+            scfg_r = ServingConfig(
+                max_queue=max(2 * n_pairs, 64), max_batch=8,
+                max_in_flight_per_client=max(2 * n_pairs, 64),
+                buckets=((side, side),), max_buckets=2,
+                warm_buckets=((side, side),), replicas=r,
+            )
+            svc_r = MatchService(cfg, params, scfg_r).start()
+            try:
+                t0 = time.perf_counter()
+                futs = [svc_r.submit(*pairs[i % 8]) for i in range(n_pairs)]
+                walls = [f.result(timeout=600).wall_s * 1e3 for f in futs]
+                span = time.perf_counter() - t0
+                sweep[f"r{r}"] = {
+                    "replicas": r,
+                    "qps": round(n_pairs / span, 2),
+                    "latency_ms": _percentiles(walls),
+                    "oversubscribed": r > ndev,
+                }
+            finally:
+                svc_r.stop()
+        out["replica_sweep"] = sweep
+        out["visible_devices"] = ndev
     return out
 
 
@@ -213,6 +258,11 @@ def main(argv=None) -> int:
                     help="skip the injected-failure demotion measurement")
     ap.add_argument("--burst-factor", type=float, default=3.0,
                     help="overload burst rate as a multiple of capacity")
+    ap.add_argument("--replicas", default="1",
+                    help="comma-separated pool sizes for the scaling sweep "
+                         "(default 1 = no sweep); run on a multi-chip host "
+                         "with one replica per visible device — e.g. "
+                         "--replicas 1,2,4 on a v5e-4")
     ap.add_argument("--json", default=None, help="also write the JSON here")
     args = ap.parse_args(argv)
 
@@ -226,8 +276,9 @@ def main(argv=None) -> int:
     os.environ.setdefault("NCNET_TPU_LOG_LEVEL", "error")
     try:
         sides = [int(s) for s in args.sides.split(",") if s]
+        replicas = [int(r) for r in args.replicas.split(",") if r] or [1]
         out = probe(sides, args.pairs, args.tiny, not args.no_demote,
-                    args.burst_factor)
+                    args.burst_factor, replicas=replicas)
     finally:
         if level_was_unset:
             os.environ.pop("NCNET_TPU_LOG_LEVEL", None)

@@ -35,6 +35,7 @@ import json
 import os
 import statistics
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -68,10 +69,51 @@ def recent_median_step_wall(events_path: str,
     return float(statistics.median(walls))
 
 
+def replica_batch_cadence(events_path: str,
+                          tail: int = 256) -> Dict[str, Dict[str, Any]]:
+    """Per-replica ``serve_batch`` cadence from a replica-pool service's
+    event log: ``{replica_id: {"last_t", "median_wall_s", "n"}}`` (empty
+    when the log has no replica-tagged batches — a training run, or a
+    pre-pool serving log).  ``last_t`` is the wall-clock timestamp of the
+    replica's most recent completed batch."""
+    try:
+        _, events = replay_events(events_path)
+    except (OSError, ValueError):
+        return {}
+    per: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("event") != "serve_batch" or e.get("replica") is None:
+            continue
+        d = per.setdefault(str(e["replica"]), {"walls": [], "last_t": None})
+        if isinstance(e.get("wall_s"), (int, float)) and e["wall_s"] > 0:
+            d["walls"].append(e["wall_s"])
+        if isinstance(e.get("t"), (int, float)):
+            d["last_t"] = e["t"]
+    out: Dict[str, Dict[str, Any]] = {}
+    for rid, d in per.items():
+        walls = d["walls"][-tail:]
+        out[rid] = {
+            "last_t": d["last_t"],
+            "median_wall_s": (float(statistics.median(walls))
+                              if walls else None),
+            "n": len(walls),
+        }
+    return out
+
+
 def judge(heartbeat_path: str, events_path: Optional[str] = None,
           factor: float = 10.0, min_age: float = 60.0) -> Dict[str, Any]:
     """One liveness verdict: ``{"status": "alive"|"stalled"|"missing", ...}``
-    with the evidence (age, threshold, median step wall, last payload)."""
+    with the evidence (age, threshold, median step wall, last payload).
+
+    Replica-pool awareness: the service is alive if ANY replica shows
+    recent batch cadence — one wedged replica (whose lane stops emitting
+    ``serve_batch``) must not flag a healthy pool as STALLED.  Normally the
+    pool-wide heartbeat (bumped per dispatched batch on any replica)
+    already says so; the per-replica check is the backstop when the
+    heartbeat file is stale or unwritable but the event log shows a lane
+    still draining, and the ``replicas`` breakdown in the verdict names
+    which lanes are fresh vs wedged either way."""
     age = Heartbeat.age_s(heartbeat_path)
     if age is None:
         return {"status": "missing", "heartbeat": heartbeat_path}
@@ -80,16 +122,44 @@ def judge(heartbeat_path: str, events_path: Optional[str] = None,
             os.path.dirname(os.path.abspath(heartbeat_path)), "events.jsonl")
     median = recent_median_step_wall(events_path)
     threshold = max(min_age, factor * median) if median else min_age
+    status = "stalled" if age > threshold else "alive"
+    # per-replica cadence: the breakdown always ships; a recent lane also
+    # overrides a stale heartbeat
+    cadence = replica_batch_cadence(events_path)
+    replicas: Dict[str, Any] = {}
+    alive_via = None
+    now = time.time()
+    for rid, c in sorted(cadence.items()):
+        rep_threshold = max(min_age, factor * c["median_wall_s"]) \
+            if c["median_wall_s"] else min_age
+        rep_age = (now - c["last_t"]) if c["last_t"] else None
+        recent = rep_age is not None and rep_age <= rep_threshold
+        replicas[rid] = {
+            "last_batch_age_s": round(rep_age, 3) if rep_age is not None
+            else None,
+            "median_wall_s": (round(c["median_wall_s"], 6)
+                              if c["median_wall_s"] else None),
+            "threshold_s": round(rep_threshold, 3),
+            "n": c["n"],
+            "recent": recent,
+        }
+        if status == "stalled" and recent and alive_via is None:
+            alive_via = f"replica_cadence:{rid}"
+            status = "alive"
     verdict: Dict[str, Any] = {
-        "status": "stalled" if age > threshold else "alive",
+        "status": status,
         "heartbeat": heartbeat_path,
         "age_s": round(age, 3),
         "threshold_s": round(threshold, 3),
         "median_step_wall_s": round(median, 6) if median else None,
         "factor": factor,
         "min_age_s": min_age,
-        "events": events_path if median else None,
+        "events": events_path if (median or replicas) else None,
     }
+    if replicas:
+        verdict["replicas"] = replicas
+    if alive_via:
+        verdict["alive_via"] = alive_via
     payload = Heartbeat.read(heartbeat_path)
     if payload:
         verdict["last_beat"] = payload
@@ -127,10 +197,17 @@ def main(argv=None) -> int:
                    if verdict["median_step_wall_s"]
                    else f"no step cadence; floor {verdict['min_age_s']}s")
         beat = verdict.get("last_beat") or {}
-        print(f"{verdict['status'].upper()}: heartbeat age "
+        via = (f" [alive via {verdict['alive_via']}]"
+               if verdict.get("alive_via") else "")
+        print(f"{verdict['status'].upper()}{via}: heartbeat age "
               f"{verdict['age_s']}s vs threshold {verdict['threshold_s']}s "
               f"({cadence}); last beat: step {beat.get('step')}, "
               f"pid {beat.get('pid')}, run {beat.get('run')}")
+        for rid, r in (verdict.get("replicas") or {}).items():
+            tag = "fresh" if r["recent"] else "wedged/idle"
+            print(f"  replica {rid}: last batch "
+                  f"{r['last_batch_age_s']}s ago vs {r['threshold_s']}s "
+                  f"({tag}; n={r['n']})")
     return {"alive": 0, "missing": 2, "stalled": 3}[verdict["status"]]
 
 
