@@ -698,6 +698,12 @@ def bench_jax(res=None):
                 max_in_flight_per_client=256,
                 buckets=((IMAGE, IMAGE),), max_buckets=2,
                 warm_buckets=((IMAGE, IMAGE),),
+                # the live telemetry plane rides along: the scrape-cost
+                # metric below prices it, and the SLO tracker feeds the
+                # budget-burn gate (objective = the latency digest range —
+                # generous, so burn only moves when serving actually
+                # breaks: deadline blows, admitted sheds, quarantines)
+                introspect_port=0, slo_ms=2000.0,
             )
             service = MatchService(cfg16, params, scfg).start()
             try:
@@ -752,6 +758,32 @@ def bench_jax(res=None):
                         pass
                 out["serve_shed_pct"] = round(
                     100.0 * len(sheds_b) / n_burst, 2)
+                # live-plane cost + SLO burn (ISSUE 11): one /metrics
+                # scrape per serving scenario.  The plane must be FREE —
+                # a scrape that costs a meaningful fraction of the batch
+                # cadence would perturb the very latencies it reports, so
+                # the bench hard-fails at 1% rather than quietly shipping
+                # a heavy endpoint.
+                if service.introspect_url is not None:
+                    from ncnet_tpu.serving.introspect import scrape_wall_ms
+
+                    scrape_ms = scrape_wall_ms(service.introspect_url)
+                    out["serve_scrape_wall_ms"] = round(scrape_ms, 3)
+                    batch_snap = service.metrics().get("batch_wall_s", {})
+                    cadence_ms = 1e3 * batch_snap.get(
+                        "p50_s", batch_snap.get("mean_s", 0.0))
+                    if cadence_ms and scrape_ms >= 0.01 * cadence_ms:
+                        raise RuntimeError(
+                            f"/metrics scrape costs {scrape_ms:.3f} ms — "
+                            f">=1% of the {cadence_ms:.1f} ms batch "
+                            "cadence; the telemetry plane must be free")
+                # cumulative error-budget burn over every phase above
+                # (lower-is-better in the perf store via the burn_pct
+                # token): 0 while serving keeps its promises, jumps the
+                # run something starts deadline-blowing or shedding
+                # admitted work
+                out["slo_budget_burn_pct"] = \
+                    service.health()["slo"]["budget_burn_pct"]
             finally:
                 service.stop()
             # replica-pool scaling (ISSUE 10): closed-loop capacity at pool

@@ -273,7 +273,10 @@ _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  "entropy",
                  # serving: shed fraction at a FIXED offered load (the bench
                  # scenario pins the load, so more shedding = less capacity)
-                 "shed_pct")
+                 "shed_pct",
+                 # SLO error-budget burn (serving/slo.py): a rising burn is
+                 # the serving plane's accuracy-of-promise regressing
+                 "burn_pct")
 
 
 def metric_direction(name: str) -> Optional[str]:

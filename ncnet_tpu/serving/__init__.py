@@ -21,11 +21,14 @@ from ncnet_tpu.serving.health import (  # noqa: F401
     ADMITTING,
     DEGRADED,
     DRAINING,
+    HEALTH_DOC_SCHEMA,
     READY,
     STARTING,
     STOPPED,
     HealthMachine,
+    build_health_document,
 )
+from ncnet_tpu.serving.introspect import IntrospectionServer  # noqa: F401
 from ncnet_tpu.serving.replica import (  # noqa: F401
     REPLICA_DEAD,
     REPLICA_READY,
@@ -43,6 +46,7 @@ from ncnet_tpu.serving.request import (  # noqa: F401
     bucket_label,
 )
 from ncnet_tpu.serving.service import MatchService, ServingConfig  # noqa: F401
+from ncnet_tpu.serving.slo import SLOTracker  # noqa: F401
 
 __all__ = [
     "ADMITTING",
@@ -51,7 +55,11 @@ __all__ = [
     "DEGRADED",
     "DRAINING",
     "DeadlineExceeded",
+    "HEALTH_DOC_SCHEMA",
     "HealthMachine",
+    "IntrospectionServer",
+    "SLOTracker",
+    "build_health_document",
     "MatchFuture",
     "MatchRequest",
     "MatchResult",

@@ -32,6 +32,12 @@ from typing import Any, Dict, List, Optional
 
 from ncnet_tpu.observability import events as obs_events
 
+# schema version of the UNIFIED health document (build_health_document):
+# bump when the nesting or field meanings change, so the multi-host router
+# / watchdog / chaos tests scraping /healthz can refuse documents they do
+# not understand instead of silently misreading them
+HEALTH_DOC_SCHEMA = 1
+
 STARTING = "STARTING"
 READY = "READY"
 DEGRADED = "DEGRADED"
@@ -87,12 +93,63 @@ class HealthMachine:
     def admitting(self) -> bool:
         return self.state in ADMITTING
 
-    def probe(self) -> Dict[str, Any]:
-        """The health-endpoint payload: current state + how long it has
-        held + why (the serving twin of the heartbeat's last payload)."""
+    def probe(self, history: int = 8) -> Dict[str, Any]:
+        """This machine's section of the unified health document: current
+        state + how long it has held + why + the recent transition
+        timeline (newest last, bounded so the document stays a probe, not
+        a log)."""
         return {
             "state": self.state,
             "since": self.since,
             "age_s": round(max(0.0, time.time() - self.since), 3),
             "reason": self.reason,
+            "history": [dict(h) for h in self.history[-history:]],
         }
+
+
+def build_health_document(machine: HealthMachine,
+                          replicas: List[Dict[str, Any]], *,
+                          queue: Dict[str, Any],
+                          counters: Dict[str, Any],
+                          slo: Optional[Dict[str, Any]] = None,
+                          activity: Optional[Dict[str, Any]] = None,
+                          ) -> Dict[str, Any]:
+    """THE one health document (``HEALTH_DOC_SCHEMA``-versioned) — the
+    ``/healthz`` body, ``MatchService.health()`` return value, the final
+    ``serve_health_doc`` event payload that ``run_report --serving``
+    renders, and the dict the future multi-host router will route on.
+
+    Before this builder the service-level probe (``HealthMachine.probe``)
+    and the per-replica rows (``Replica.probe``) were merged ad hoc at each
+    consumer and drifted independently; now every consumer reads the same
+    nesting:
+
+      * ``state`` — the service state, mirrored top-level (the one field a
+        load balancer needs without parsing the rest);
+      * ``service`` — the health machine's probe (state/age/reason/recent
+        transition history);
+      * ``pool`` — ``ready``/``total`` capacity + every replica's row
+        (``Replica.probe()``: id, state, score, EWMA wall, load, counters);
+      * ``queue`` — depth, in-flight batches, pipeline depth, the elastic
+        queue bound, and the registered bucket ladder;
+      * ``counters`` — the terminal-outcome accounting;
+      * ``slo`` — the error-budget tracker's snapshot (when configured);
+      * ``activity`` — seconds since the pool last dispatched (or idled
+        deliberately): the HTTP-reachable liveness signal
+        ``stall_watchdog --url`` judges instead of a heartbeat mtime.
+    """
+    ready = sum(1 for r in replicas if r.get("state") == "READY")
+    doc: Dict[str, Any] = {
+        "schema": HEALTH_DOC_SCHEMA,
+        "state": machine.state,
+        "service": machine.probe(),
+        "pool": {"ready": ready, "total": len(replicas),
+                 "replicas": list(replicas)},
+        "queue": dict(queue),
+        "counters": dict(counters),
+    }
+    if slo is not None:
+        doc["slo"] = slo
+    if activity is not None:
+        doc["activity"] = activity
+    return doc

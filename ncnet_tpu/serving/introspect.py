@@ -1,0 +1,381 @@
+"""Live introspection plane for the resident match service.
+
+Every observability layer before this one is write-then-replay: the event
+log, the perf store, ``run_report`` all explain a run *after* the fact.  A
+resident service fronting real traffic needs the READ side live — a
+supervisor probes readiness, a router reads per-host capacity, an operator
+watches queue depth NOW, not at the postmortem.  This module is that
+surface: a stdlib ``http.server`` thread bolted onto a running
+:class:`~ncnet_tpu.serving.service.MatchService`, serving three endpoints:
+
+  * ``GET /metrics``  — Prometheus exposition (``observability/export.py``)
+    of the serving plane: queue depth, per-bucket and per-replica latency
+    histograms (cumulative ``_bucket``/``_sum``/``_count``), the
+    outcome-total counters, replica health scores, quality-signal digests,
+    and the SLO error-budget counters.  Metric names follow the
+    ``ncnet_serve_*`` scheme (README "Live observability"); bucket/replica
+    identities ride as labels, never name fragments.
+  * ``GET /healthz``  — the unified, schema-versioned health document
+    (``serving/health.py::build_health_document``) as JSON: HTTP 200 while
+    the service admits (STARTING/READY/DEGRADED), 503 once it drains or
+    stops.  This is the exact dict the future multi-host fan-out router
+    consumes to route on per-host health/capacity/latency.
+  * ``GET /statusz``  — the human page: replica table, bucket ladder,
+    queue/active-request counts, SLO burn, recent health timeline.
+
+Fail-open like every telemetry layer: the server runs on daemon threads, a
+handler exception answers 500 instead of propagating, ``start()`` failures
+are the caller's to swallow (``MatchService.start`` logs and serves
+without the plane), and killing this thread mid-scrape leaves serving
+untouched — proven by the tier-1 kill-mid-scrape test.  The endpoints only
+READ service state under its condition lock (an RLock, so the nested
+``health()`` call is safe) and never mutate scheduling state.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from ncnet_tpu.observability.export import Family, render
+from ncnet_tpu.observability.metrics import Counter, Histogram
+
+# registry-key prefixes whose identity suffix becomes a label (the curated
+# families below); everything else in the registry is either mirrored by a
+# curated family or internal
+_BUCKET_HIST_PREFIX = "serve_wall_ms_"
+_REPLICA_HIST_PREFIX = "replica_wall_ms_"
+_QUALITY_HIST_PREFIX = "q_"
+
+
+def metrics_families(service) -> List[Family]:
+    """The curated ``ncnet_serve_*`` family set for one service, built
+    under the service lock so counters/histograms and the health document
+    are one consistent cut."""
+    lat = Family("ncnet_serve_latency_ms", "histogram",
+                 "end-to-end request latency per shape bucket")
+    rep_hist = Family("ncnet_serve_replica_batch_wall_ms", "histogram",
+                      "batch wall per replica")
+    quality = Family("ncnet_serve_quality", "histogram",
+                     "per-pair match-quality signal digests "
+                     "(observability/quality.py)")
+    with service._cond:
+        doc = service.health()
+        reg_items = dict(service._registry._metrics)
+        replica_counters = [
+            (name, m.value) for name, m in sorted(reg_items.items())
+            if isinstance(m, Counter) and name.startswith("replica_")
+        ]
+        # histogram families render INSIDE the lock: counts and sum must
+        # be one cut, or a fetcher landing mid-scrape could put a value in
+        # _sum that _count does not yet count — exactly the consistency
+        # the scrape tests pin
+        for name, h in sorted(reg_items.items()):
+            if not isinstance(h, Histogram) or not h.count:
+                continue
+            if name.startswith(_BUCKET_HIST_PREFIX):
+                lat.add_histogram(h, bucket=name[len(_BUCKET_HIST_PREFIX):])
+            elif name.startswith(_REPLICA_HIST_PREFIX):
+                rep_hist.add_histogram(
+                    h, replica=name[len(_REPLICA_HIST_PREFIX):])
+            elif name.startswith(_QUALITY_HIST_PREFIX):
+                quality.add_histogram(
+                    h, signal=name[len(_QUALITY_HIST_PREFIX):])
+    fams: List[Family] = []
+
+    up = Family("ncnet_serve_up", "gauge",
+                "1 while the service admits (STARTING/READY/DEGRADED)")
+    up.add(1 if doc["state"] in ("STARTING", "READY", "DEGRADED") else 0)
+    fams.append(up)
+    state = Family("ncnet_serve_state", "gauge",
+                   "service health state (1 on the active state's series)")
+    state.add(1, state=doc["state"])
+    fams.append(state)
+
+    outcomes = Family(
+        "ncnet_serve_requests_total", "counter",
+        "terminal outcomes of admitted requests (the outcome-total "
+        "contract), plus admissions under outcome=\"admitted\"")
+    for outcome, n in sorted(doc["counters"].items()):
+        outcomes.add(n, outcome=outcome)
+    fams.append(outcomes)
+
+    q = doc["queue"]
+    fams.append(Family("ncnet_serve_queue_depth", "gauge",
+                       "requests queued across shape buckets")
+                .add(q["depth"]))
+    fams.append(Family("ncnet_serve_effective_max_queue", "gauge",
+                       "the elastic queue bound at live capacity")
+                .add(q["effective_max_queue"]))
+    fams.append(Family("ncnet_serve_inflight_batches", "gauge",
+                       "dispatched batches not yet fetched")
+                .add(q["inflight_batches"]))
+    fams.append(Family("ncnet_serve_pipeline_depth", "gauge",
+                       "per-replica in-flight depth target")
+                .add(q["pipeline_depth"]))
+
+    pool = doc["pool"]
+    fams.append(Family("ncnet_serve_replicas", "gauge",
+                       "pool capacity by readiness")
+                .add(pool["ready"], status="ready")
+                .add(pool["total"], status="total"))
+    rep_up = Family("ncnet_serve_replica_up", "gauge",
+                    "1 = replica READY, 0 = DEAD awaiting resurrection")
+    rep_score = Family("ncnet_serve_replica_health_score", "gauge",
+                       "routing cost (lower = preferred)")
+    rep_wall = Family("ncnet_serve_replica_wall_ewma_ms", "gauge",
+                      "batch-wall EWMA per replica")
+    rep_load = Family("ncnet_serve_replica_load", "gauge",
+                      "batches owned (queued for fetch + fetching)")
+    for r in pool["replicas"]:
+        rep_up.add(1 if r["state"] == "READY" else 0, replica=r["id"])
+        rep_score.add(r["score"], replica=r["id"])
+        if r.get("ewma_wall_ms") is not None:
+            rep_wall.add(r["ewma_wall_ms"], replica=r["id"])
+        rep_load.add(r["load"], replica=r["id"])
+    fams.extend([rep_up, rep_score, rep_wall, rep_load])
+
+    rep_batches = Family("ncnet_serve_replica_batches_total", "counter",
+                         "batches completed per replica")
+    rep_failures = Family("ncnet_serve_replica_failures_total", "counter",
+                          "batch failures per replica")
+    for name, value in replica_counters:
+        if name.startswith("replica_batches_"):
+            rep_batches.add(value,
+                            replica=name[len("replica_batches_"):])
+        elif name.startswith("replica_failures_"):
+            rep_failures.add(value,
+                             replica=name[len("replica_failures_"):])
+    fams.extend([rep_batches, rep_failures])
+
+    fams.extend([lat, rep_hist, quality])
+
+    slo = doc.get("slo")
+    if slo is not None:
+        slo_fam = Family(
+            "ncnet_serve_slo_requests_total", "counter",
+            "SLO classification of admitted terminal outcomes")
+        slo_fam.add(slo["ok"], slo_class="ok")
+        for cls, n in sorted(slo["bad"].items()):
+            slo_fam.add(n, slo_class=cls)
+        fams.append(slo_fam)
+        fams.append(Family("ncnet_serve_slo_admitted_total", "counter",
+                           "admitted requests judged against the SLO")
+                    .add(slo["admitted"]))
+        fams.append(Family(
+            "ncnet_serve_slo_budget_burn_pct", "gauge",
+            "cumulative error-budget burn (100 = budget spent)")
+            .add(slo["budget_burn_pct"]))
+        fams.append(Family(
+            "ncnet_serve_slo_window_burn_pct", "gauge",
+            "error-budget burn over the sliding window")
+            .add(slo["window"]["burn_pct"]))
+        obj = Family("ncnet_serve_slo_objective_ms", "gauge",
+                     "latency objective per bucket (default under "
+                     "bucket=\"default\")")
+        if slo["objectives"]["default_ms"] is not None:
+            obj.add(slo["objectives"]["default_ms"], bucket="default")
+        for b, ms in sorted(slo["objectives"]["by_bucket"].items()):
+            obj.add(ms, bucket=b)
+        fams.append(obj)
+
+    act = doc.get("activity")
+    if act is not None:
+        fams.append(Family("ncnet_serve_activity_age_seconds", "gauge",
+                           "seconds since the pool last dispatched or "
+                           "deliberately idled").add(act["age_s"]))
+        fams.append(Family("ncnet_serve_batches_dispatched_total",
+                           "counter", "batches dispatched pool-wide")
+                    .add(act["batches"]))
+    return fams
+
+
+def render_statusz(service) -> str:
+    """The human page: one consistent cut of the health document rendered
+    as fixed-width text (``/statusz`` convention — glanceable, greppable,
+    no JSON tooling needed)."""
+    doc = service.health()
+    lines: List[str] = []
+    add = lines.append
+    svc = doc["service"]
+    add("ncnet_tpu match service — statusz")
+    add(f"state: {doc['state']}  (for {svc['age_s']}s"
+        + (f", reason: {svc['reason']}" if svc.get("reason") else "") + ")")
+    q = doc["queue"]
+    add(f"queue: depth={q['depth']}/{q['effective_max_queue']}  "
+        f"inflight_batches={q['inflight_batches']}  "
+        f"pipeline_depth={q['pipeline_depth']}")
+    c = doc["counters"]
+    active = c["admitted"] - (c["results"] + c["deadline"]
+                              + c["quarantined"] + c["shed"])
+    add(f"requests: admitted={c['admitted']}  results={c['results']}  "
+        f"deadline={c['deadline']}  quarantined={c['quarantined']}  "
+        f"shed={c['shed']}  active={max(0, active)}")
+    add("")
+    add(f"bucket ladder: {', '.join(q['buckets']) or '(none registered)'}")
+    add("")
+    pool = doc["pool"]
+    add(f"replicas ({pool['ready']}/{pool['total']} ready):")
+    add(f"  {'id':<8} {'state':<6} {'score':>10} {'ewma_ms':>9} "
+        f"{'load':>4} {'batches':>8} {'failures':>8} {'deaths':>6}")
+    for r in pool["replicas"]:
+        ewma = r.get("ewma_wall_ms")
+        add(f"  {r['id']:<8} {r['state']:<6} {r['score']:>10.4f} "
+            f"{(f'{ewma:.2f}' if ewma is not None else '-'):>9} "
+            f"{r['load']:>4} {r['batches']:>8} {r['failures']:>8} "
+            f"{r['deaths']:>6}")
+    slo = doc.get("slo")
+    if slo is not None and slo["admitted"]:
+        add("")
+        w = slo["window"]
+        add(f"SLO: burn={slo['budget_burn_pct']}% of budget "
+            f"({slo['bad_total']}/{slo['admitted']} bad, budget "
+            f"{slo['objectives']['budget_pct']}%)  window: "
+            f"{w['bad']}/{w['n']} bad = {w['burn_pct']}%")
+    add("")
+    add("recent health timeline:")
+    for h in svc.get("history", []):
+        add(f"  -> {h['state']}"
+            + (f"  ({h['reason']})" if h.get("reason") else ""))
+    return "\n".join(lines) + "\n"
+
+
+def scrape_wall_ms(base_url: str, n: int = 5, timeout: float = 30.0) -> float:
+    """Median wall of ``n`` ``/metrics`` scrapes over real HTTP, in ms —
+    THE scrape-cost methodology, shared by bench.py's 1%-of-cadence gate
+    and serve_probe's real-device measurement so the two can never
+    silently measure different things."""
+    import statistics
+    import time as _time
+    import urllib.request
+
+    url = base_url.rstrip("/") + "/metrics"
+    walls = []
+    for _ in range(int(n)):
+        t0 = _time.perf_counter()
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            r.read()
+        walls.append(_time.perf_counter() - t0)
+    return float(statistics.median(walls)) * 1e3
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ncnet-introspect/1"
+    protocol_version = "HTTP/1.1"
+
+    # the library logger is the one console sink; per-request access lines
+    # are noise there and a bare print would break the tier-1 pin
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        pass
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        intro = getattr(self.server, "introspect", None)
+        try:
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            if intro is None:
+                code, ctype, body = 503, "text/plain; charset=utf-8", \
+                    "introspection detached\n"
+            elif path == "/metrics":
+                code = 200
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                body = intro.metrics_text()
+            elif path == "/healthz":
+                doc = intro.health_doc()
+                code = 200 if doc.get("state") in (
+                    "STARTING", "READY", "DEGRADED") else 503
+                ctype = "application/json; charset=utf-8"
+                body = json.dumps(doc, sort_keys=True) + "\n"
+            elif path == "/statusz":
+                code, ctype = 200, "text/plain; charset=utf-8"
+                body = intro.statusz_text()
+            elif path == "/":
+                code, ctype = 200, "text/plain; charset=utf-8"
+                body = "endpoints: /metrics /healthz /statusz\n"
+            else:
+                code, ctype, body = 404, "text/plain; charset=utf-8", \
+                    f"no such endpoint {path}; try /metrics /healthz " \
+                    "/statusz\n"
+        except Exception as e:  # noqa: BLE001 — the plane fails open: a
+            # renderer bug answers 500, it never propagates into serving
+            code, ctype = 500, "text/plain; charset=utf-8"
+            body = f"introspection error: {type(e).__name__}: {e}\n"
+        try:
+            payload = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except OSError:
+            pass  # client went away mid-write: its problem, not serving's
+
+
+class IntrospectionServer:
+    """The ``/metrics`` + ``/healthz`` + ``/statusz`` thread for one
+    service.  ``port=0`` binds an ephemeral port (tests, multi-service
+    hosts); read it back via :attr:`port` / :attr:`url` after
+    :meth:`start`.  Binds loopback by default — exposing the plane beyond
+    the host is a deployment decision (``ServingConfig.introspect_host``),
+    not a default."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        self._service = service
+        self._host = host
+        self._want_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._scrapes = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "IntrospectionServer":
+        if self._httpd is not None:
+            raise RuntimeError("introspection server already started")
+        httpd = ThreadingHTTPServer((self._host, self._want_port), _Handler)
+        httpd.daemon_threads = True
+        httpd.introspect = self
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="match-introspect", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self._host}:{self.port}" if self._httpd else None
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except Exception:  # noqa: BLE001 — shutdown of a dead socket is
+            pass           # not worth more than the attempt
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+
+    # -- endpoint payloads (also the in-process API the tests drive) --------
+
+    def metrics_text(self) -> str:
+        self._scrapes += 1
+        fams = metrics_families(self._service)
+        fams.append(Family("ncnet_serve_scrapes_total", "counter",
+                           "scrapes answered by this introspection server")
+                    .add(self._scrapes))
+        return render(fams)
+
+    def health_doc(self) -> Dict[str, Any]:
+        return self._service.health()
+
+    def statusz_text(self) -> str:
+        return render_statusz(self._service)

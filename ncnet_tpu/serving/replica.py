@@ -135,7 +135,10 @@ class Replica:
         self.consecutive_failures += 1
 
     def probe(self) -> Dict[str, Any]:
-        """One replica's row in the service health payload."""
+        """One replica's row in the UNIFIED health document
+        (``serving/health.py::build_health_document`` nests these under
+        ``pool.replicas`` — the one place this shape is consumed, so the
+        row and the service-level probe can no longer drift apart)."""
         return {
             "id": self.id,
             "state": self.state,
@@ -149,6 +152,10 @@ class Replica:
             "failures": self.failures,
             "deaths": self.deaths,
             "demotions": self.demotions,
+            # how long it has been dead (None while READY): the /statusz
+            # operator signal for "is resurrection overdue"
+            "dead_age_s": (round(time.monotonic() - self.dead_since, 3)
+                           if self.dead_since is not None else None),
         }
 
 

@@ -207,6 +207,14 @@ class MatchRequest:
     # is off-budget (the failure was the replica's, not the request's);
     # once no fresh READY replica remains, failures charge the budget
     failed_on: set = dataclasses.field(default_factory=set)
+    # trace-timeline stamps (time.monotonic, like submitted_t/deadline_t):
+    # the service stamps dispatch and fetch-begin so every terminal outcome
+    # can attribute its end-to-end wall to queue vs device vs fetch time
+    # (a request re-dispatched by failover keeps its LAST stamps — the
+    # attribution covers the attempt that terminated it, and the queue
+    # segment absorbs the earlier failed round trips)
+    dispatched_t: Optional[float] = None
+    fetch_begin_t: Optional[float] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t
@@ -215,6 +223,32 @@ class MatchRequest:
         if self.deadline_t is None:
             return None
         return self.deadline_t - now
+
+    def timeline_ms(self, settled_t: float) -> Dict[str, float]:
+        """Phase attribution of this request's life, in milliseconds:
+        ``queue_ms`` (admission → dispatch: queueing + bucket coalescing),
+        ``device_ms`` (dispatch → fetch-begin: in flight on the replica,
+        the async device execution overlapping the fetch lane's backlog),
+        ``fetch_ms`` (fetch-begin → settle: the blocking device→host pull
+        and settlement).  Phases the request never reached are absent
+        (a dequeue-evicted deadline has only ``queue_ms``), and
+        ``total_ms`` is the SUM of the rendered segments — the identity
+        the Perfetto timeline export and the tier-1 chain rely on."""
+        segs: Dict[str, float] = {}
+        queue_end = self.dispatched_t if self.dispatched_t is not None \
+            else settled_t
+        segs["queue_ms"] = round(
+            max(0.0, queue_end - self.submitted_t) * 1e3, 3)
+        if self.dispatched_t is not None:
+            dev_end = self.fetch_begin_t \
+                if self.fetch_begin_t is not None else settled_t
+            segs["device_ms"] = round(
+                max(0.0, dev_end - self.dispatched_t) * 1e3, 3)
+            if self.fetch_begin_t is not None:
+                segs["fetch_ms"] = round(
+                    max(0.0, settled_t - self.fetch_begin_t) * 1e3, 3)
+        segs["total_ms"] = round(sum(segs.values()), 3)
+        return segs
 
 
 def as_pair_image(x: Any, name: str) -> np.ndarray:

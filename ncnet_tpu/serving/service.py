@@ -91,7 +91,9 @@ from ncnet_tpu.serving.health import (
     STARTING,
     STOPPED,
     HealthMachine,
+    build_health_document,
 )
+from ncnet_tpu.serving.slo import SLOTracker
 from ncnet_tpu.serving.replica import (
     REPLICA_READY,
     Replica,
@@ -143,6 +145,17 @@ class ServingConfig:
     heartbeat_path: Optional[str] = None
     latency_hist_ms: float = 2000.0     # per-bucket latency digest range
     install_sigterm: bool = False       # SIGTERM -> drain (PreemptionHandler style)
+    # SLO / error budget (serving/slo.py)
+    slo_ms: Optional[float] = None      # default per-request latency objective
+    slo_ms_by_bucket: Tuple[Tuple[str, float], ...] = ()  # bucket-label overrides
+    slo_budget_pct: float = 1.0         # allowed SLO-bad fraction of admitted (%)
+    slo_window: int = 256               # sliding window for the live burn signal
+    slo_emit_every: int = 32            # `slo` event cadence (terminal outcomes)
+    # live introspection plane (serving/introspect.py): /metrics + /healthz
+    # + /statusz.  None = off; 0 = ephemeral port (read back via
+    # MatchService.introspect_url)
+    introspect_port: Optional[int] = None
+    introspect_host: str = "127.0.0.1"
     # match extraction
     do_softmax: bool = True
     scale: str = "centered"
@@ -257,6 +270,22 @@ class MatchService:
         # these back the health probe and the drain summary)
         self._n = {"admitted": 0, "results": 0, "deadline": 0,
                    "quarantined": 0, "shed": 0}
+        # SLO error-budget tracker: fed under the service lock at every
+        # terminal outcome, surfaced on /metrics + /healthz + `slo` events
+        self._slo = SLOTracker(
+            default_ms=serving.slo_ms,
+            by_bucket=serving.slo_ms_by_bucket,
+            budget_pct=serving.slo_budget_pct,
+            window=serving.slo_window,
+            emit_every=serving.slo_emit_every,
+            registry=self._registry,
+        )
+        # monotonic stamp of the pool's last dispatch (or deliberate idle
+        # tick): the HTTP-reachable liveness signal /healthz exports for
+        # stall_watchdog --url — same semantics as the heartbeat beats
+        # (a wedged fetch with nothing else dispatching stops advancing it)
+        self._activity_t = time.monotonic()
+        self._introspect = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -265,6 +294,23 @@ class MatchService:
     def start(self) -> "MatchService":
         if self._worker is not None:
             raise RuntimeError("service already started")
+        if self.cfg.introspect_port is not None:
+            # fail-open: a port clash (or any bind failure) costs the
+            # introspection plane, never the serving plane
+            from ncnet_tpu.serving.introspect import IntrospectionServer
+
+            try:
+                self._introspect = IntrospectionServer(
+                    self, host=self.cfg.introspect_host,
+                    port=self.cfg.introspect_port).start()
+            except Exception as e:  # noqa: BLE001 — telemetry never
+                # kills the service it observes
+                self._introspect = None
+                log.warning(
+                    f"introspection endpoint failed to bind "
+                    f"{self.cfg.introspect_host}:{self.cfg.introspect_port}"
+                    f" ({type(e).__name__}: {e}); serving without "
+                    "/metrics + /healthz", kind="io")
         obs_events.emit(
             "serve_start",
             max_queue=self.cfg.max_queue, max_batch=self.cfg.max_batch,
@@ -272,6 +318,11 @@ class MatchService:
             default_deadline_s=self.cfg.default_deadline_s,
             fetch_timeout_s=self.cfg.fetch_timeout_s,
             replicas=[r.id for r in self._pool.replicas],
+            # the SLO objectives ride in the log so run_report --slo can
+            # replay a dead service with the exact live thresholds
+            slo=self._slo.config(),
+            introspect_port=(self._introspect.port
+                             if self._introspect is not None else None),
         )
         if self.cfg.install_sigterm and \
                 threading.current_thread() is threading.main_thread():
@@ -465,6 +516,8 @@ class MatchService:
                 self._registry.counter("shed").inc()
             obs_events.emit("serve_shed", request=req.id, client=client,
                             reason="stopped", admitted=True)
+            self._observe_slo(req, "shed")
+            self._emit_timeline(req, "overloaded")
             self._terminal(req)
             raise exc
         return req.future
@@ -474,25 +527,44 @@ class MatchService:
     # ------------------------------------------------------------------
 
     def health(self) -> Dict[str, Any]:
-        """The probe payload: health state + queue/in-flight depth +
-        outcome counters + active buckets + the per-replica pool rows."""
+        """The unified, schema-versioned health document
+        (``serving/health.py::build_health_document``): service state +
+        transition history, pool capacity + per-replica rows, queue/
+        in-flight depth + bucket ladder, outcome counters, the SLO
+        error-budget snapshot, and the activity age.  The same dict serves
+        ``/healthz``, the chaos tests, and the final ``serve_health_doc``
+        event ``run_report --serving`` renders."""
+        now = time.monotonic()
         with self._cond:
-            return {
-                **self._health.probe(),
-                "queue_depth": self._queued_locked(),
-                "inflight_batches": self._pool.inflight_total(),
-                "buckets": [bucket_label(b) for b in self._bucketer.buckets],
-                "counters": dict(self._n),
-                "pipeline_depth": self._controller.depth,
-                "replicas": [r.probe() for r in self._pool.replicas],
-                "ready_replicas": len(self._pool.ready()),
-                "effective_max_queue":
-                    self._admission.effective_max_queue(),
-            }
+            return build_health_document(
+                self._health,
+                [r.probe() for r in self._pool.replicas],
+                queue={
+                    "depth": self._queued_locked(),
+                    "inflight_batches": self._pool.inflight_total(),
+                    "pipeline_depth": self._controller.depth,
+                    "effective_max_queue":
+                        self._admission.effective_max_queue(),
+                    "buckets": [bucket_label(b)
+                                for b in self._bucketer.buckets],
+                },
+                counters=dict(self._n),
+                slo=self._slo.snapshot(),
+                activity={
+                    "age_s": round(max(0.0, now - self._activity_t), 3),
+                    "batches": self._batch_seq,
+                },
+            )
 
     @property
     def state(self) -> str:
         return self._health.state
+
+    @property
+    def introspect_url(self) -> Optional[str]:
+        """Base URL of the live introspection plane (None when disabled or
+        bind failed) — ``<url>/metrics`` etc."""
+        return self._introspect.url if self._introspect is not None else None
 
     def metrics(self) -> Dict[str, Any]:
         return self._registry.snapshot()
@@ -553,6 +625,12 @@ class MatchService:
                         break
                     if not self._queued_locked() and not busy:
                         self._controller.note_gap()
+                        # a deliberately idle pool is alive: advance the
+                        # /healthz activity stamp exactly where the idle
+                        # heartbeat fires (and even when no heartbeat file
+                        # is configured), so a wedged fetch — with nothing
+                        # else dispatching — stops BOTH liveness signals
+                        self._activity_t = time.monotonic()
                         self._idle_beat()
                     # fetcher completions, submits, and stop/drain all
                     # notify; the timeout bounds resurrection-probe and
@@ -727,6 +805,13 @@ class MatchService:
             self._on_batch_failure(batch, e, phase="dispatch",
                                    replica=replica)
             return
+        # trace-timeline stamps: queue phase ends here; a failover
+        # re-dispatch re-stamps (the attribution covers the terminating
+        # attempt, the queue segment absorbs earlier failed round trips)
+        now_dispatch = time.monotonic()
+        for req in batch:
+            req.dispatched_t = now_dispatch
+            req.fetch_begin_t = None
         self._batch_seq += 1
         if self._heartbeat is not None:
             # the liveness contract (tools/stall_watchdog.py): one beat per
@@ -735,6 +820,7 @@ class MatchService:
             self._heartbeat.beat(step=self._batch_seq,
                                  state=self._health.state)
         with self._cond:
+            self._activity_t = now_dispatch  # /healthz liveness signal
             replica.last_bucket = bucket
             replica.pending.append(
                 _InFlight(handle, batch, bucket, replica, time.monotonic(),
@@ -773,6 +859,9 @@ class MatchService:
     def _drain_batch(self, inf: _InFlight) -> None:
         from ncnet_tpu.evaluation.pipeline import call_with_watchdog
 
+        fetch_begin = time.monotonic()
+        for req in inf.batch:
+            req.fetch_begin_t = fetch_begin  # device phase ends here
         try:
             table = call_with_watchdog(
                 inf.replica.fetch, (inf.handle,),
@@ -825,12 +914,17 @@ class MatchService:
                     f"serve_wall_ms_{bucket_label(inf.bucket)}",
                     0.0, self.cfg.latency_hist_ms,
                 ).add(req_wall * 1e3)
+            wall_ms = round(req_wall * 1e3, 3)
             obs_events.emit(
                 "serve_result", request=req.id, client=req.client,
                 bucket=bucket_label(inf.bucket),
-                wall_ms=round(req_wall * 1e3, 3), batch_size=len(inf.batch),
+                wall_ms=wall_ms, batch_size=len(inf.batch),
                 replica=rid,
             )
+            # SLO judged on the SAME rounded wall the event records, so
+            # run_report --slo replaying the log reclassifies identically
+            self._observe_slo(req, "result", wall_ms=wall_ms)
+            self._emit_timeline(req, "result", replica=rid)
             if quality:
                 from ncnet_tpu.observability.quality import emit_quality
 
@@ -941,6 +1035,15 @@ class MatchService:
             else:
                 quarantine.append(req)
         if requeue:
+            for req in requeue:
+                # the failed attempt's timeline stamps are dead evidence: a
+                # requeued request is QUEUED again (re-stamped at its next
+                # dispatch), and one that terminates while parked — e.g. a
+                # deadline eviction behind an all-dead pool — must
+                # attribute the wait to the queue phase, not to a fetch
+                # that never completed
+                req.dispatched_t = None
+                req.fetch_begin_t = None
             routes = {r.id for r in survivors} or {"(awaiting capacity)"}
             log.warning(
                 f"serving batch {phase} failed on {replica.id} ({kind}: "
@@ -1013,6 +1116,8 @@ class MatchService:
         obs_events.emit("serve_quarantine", request=req.id,
                         client=req.client, kind=kind,
                         attempts=req.attempts, error=str(exc)[:300])
+        self._observe_slo(req, "quarantined")
+        self._emit_timeline(req, "quarantined")
         if self._manifest is not None:
             self._manifest.quarantine(req.id, kind, str(exc), req.attempts)
         self._terminal(req)
@@ -1027,7 +1132,48 @@ class MatchService:
             self._registry.counter("deadline_exceeded").inc()
         obs_events.emit("serve_deadline", request=req.id, client=req.client,
                         where=where, admitted=True)
+        self._observe_slo(req, "deadline")
+        self._emit_timeline(req, "deadline", where=where)
         self._terminal(req)
+
+    # ------------------------------------------------------------------
+    # SLO accounting + per-request trace timelines (every settle path
+    # passes through these right after its terminal event)
+    # ------------------------------------------------------------------
+
+    def _observe_slo(self, req: MatchRequest, outcome: str,
+                     wall_ms: Optional[float] = None) -> None:
+        """Feed one admitted terminal outcome to the error-budget tracker
+        (under the service lock, like every counter) and emit the periodic
+        ``slo`` event OUTSIDE it — the fsync must not serialize admission."""
+        with self._cond:
+            due = self._slo.observe(
+                outcome, bucket=bucket_label(req.bucket), wall_ms=wall_ms)
+            snap = self._slo.snapshot() if due else None
+        if snap is not None:
+            obs_events.emit("slo", **snap)
+
+    def _emit_timeline(self, req: MatchRequest, outcome: str, *,
+                       replica: Optional[str] = None,
+                       where: Optional[str] = None) -> None:
+        """One ``request_timeline`` event per terminal outcome: the
+        queue/device/fetch attribution (``MatchRequest.timeline_ms`` — the
+        segments SUM to ``total_ms`` by construction) plus the wall-clock
+        submission instant ``t0``, so ``tools/trace_export.py`` can lay the
+        request out as Perfetto async slices keyed by its id."""
+        now_m = time.monotonic()
+        fields: Dict[str, Any] = dict(
+            request=req.id, client=req.client,
+            bucket=bucket_label(req.bucket), outcome=outcome,
+            attempts=req.attempts,
+            t0=round(time.time() - (now_m - req.submitted_t), 6),
+        )
+        if replica is not None:
+            fields["replica"] = replica
+        if where is not None:
+            fields["where"] = where
+        fields.update(req.timeline_ms(now_m))
+        obs_events.emit("request_timeline", **fields)
 
     def _terminal(self, req: MatchRequest) -> None:
         """Close one admitted request's accounting (every settle path ends
@@ -1148,15 +1294,28 @@ class MatchService:
             self._n["shed"] += 1
             obs_events.emit("serve_shed", request=req.id, client=req.client,
                             reason=reason, admitted=True)
+            self._observe_slo(req, "shed")
+            self._emit_timeline(req, "overloaded")
             self._terminal(req)
         obs_events.emit(
             "serve_drain", drained=self._draining and crashed is None,
             leftover=len(leftovers), **{f"n_{k}": v
                                         for k, v in self._n.items()},
         )
+        # the FINAL slo event: the cumulative budget counters every replay
+        # consumer (run_report --slo) must reproduce exactly from the
+        # terminal events above it in this same log
+        obs_events.emit("slo", final=True, **self._slo.snapshot())
         self._registry.flush(scope="serving")
         with self._cond:
             if self._health.state != STOPPED:
                 self._health.to(
                     STOPPED, "crashed" if crashed is not None else "clean")
             self._cond.notify_all()
+        # last act of the worker: durably record the unified health
+        # document (run_report --serving renders it), then take the
+        # introspection plane down with the service it describes
+        obs_events.emit("serve_health_doc", doc=self.health())
+        if self._introspect is not None:
+            self._introspect.stop()
+            self._introspect = None

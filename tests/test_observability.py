@@ -667,10 +667,18 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # outside it and only this pin keeps them honest
     # (the ncnet_tpu/serving directory walk recursively covers every
     # serving module, incl. the PR 10 replica.py — no per-file entries)
+    # (the ISSUE 11 live-plane modules are pinned explicitly even where
+    # the directory walks already cover them: serving/introspect.py and
+    # observability/export.py RENDER the scrape payloads and serve_top is
+    # a stdout-document tool — a bare print in any of them would corrupt
+    # an exposition document or the tool's parseable output)
     for target in ("ncnet_tpu/observability/quality.py",
+                   "ncnet_tpu/observability/export.py",
                    "ncnet_tpu/serving",
+                   "ncnet_tpu/serving/introspect.py",
                    "tools/quality_drift.py",
-                   "tools/serve_probe.py"):
+                   "tools/serve_probe.py",
+                   "tools/serve_top.py"):
         hits = check_no_bare_print.find_bare_prints(
             os.path.join(_REPO, target))
         assert hits == [], f"bare print() in {target}: {hits}"

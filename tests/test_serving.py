@@ -174,7 +174,7 @@ def test_shed_request_does_not_consume_bucket_budget():
         with pytest.raises(Overloaded) as e:
             svc.submit(u8(40, 3), u8(40, 3))   # NEW shape, queue full
         assert e.value.reason == "queue_full"
-        assert svc.health()["buckets"] == ["32x32-32x32"]  # no leaked slot
+        assert svc.health()["queue"]["buckets"] == ["32x32-32x32"]  # no leaked slot
         f1.result(timeout=30)
         f2.result(timeout=30)
         # the previously-shed shape is admissible once there is room
@@ -297,7 +297,7 @@ def test_two_resolutions_two_buckets(tiny_params):
         svc.stop()
     assert r1.bucket == ((32, 32), (32, 32))
     assert r2.bucket == ((64, 64), (64, 64))
-    assert sorted(svc.health()["buckets"]) == ["32x32-32x32", "64x64-64x64"]
+    assert sorted(svc.health()["queue"]["buckets"]) == ["32x32-32x32", "64x64-64x64"]
 
 
 # ---------------------------------------------------------------------------

@@ -265,7 +265,7 @@ def test_slow_replica_is_deprioritized():
     finally:
         faults.clear()
         svc.stop()
-    rep = {r["id"]: r for r in health["replicas"]}
+    rep = {r["id"]: r for r in health["pool"]["replicas"]}
     assert rep["rep0"]["batches"] + rep["rep1"]["batches"] == 24
     assert rep["rep1"]["batches"] >= 1  # it did serve — just rarely
     assert rep["rep0"]["batches"] >= 3 * rep["rep1"]["batches"]
@@ -302,13 +302,13 @@ def test_acceptance_chain_kill_resurrect_drain(tmp_path):
         for f in futs:
             f.result(timeout=60)
         assert all(f.outcome == "result" for f in futs)
-        assert wait_until(lambda: svc.health()["ready_replicas"] == 3)
+        assert wait_until(lambda: svc.health()["pool"]["ready"] == 3)
         h = svc.health()
         assert h["state"] == DEGRADED
-        assert {r["id"]: r["state"] for r in h["replicas"]}["rep2"] \
+        assert {r["id"]: r["state"] for r in h["pool"]["replicas"]}["rep2"] \
             == REPLICA_DEAD
         # elastic admission: the advertised queue shrank with the pool
-        assert h["effective_max_queue"] < svc.cfg.max_queue
+        assert h["queue"]["effective_max_queue"] < svc.cfg.max_queue
         # probes fire while the fault is armed — and fail
         assert wait_until(lambda: any(
             r["probes"] for r in
@@ -317,7 +317,7 @@ def test_acceptance_chain_kill_resurrect_drain(tmp_path):
         ), timeout=5.0, interval=0.1)
         # phase 3: heal the chip; the probe resurrects rep2
         faults.clear()
-        assert wait_until(lambda: svc.health()["ready_replicas"] == 4)
+        assert wait_until(lambda: svc.health()["pool"]["ready"] == 4)
         assert svc.state == READY  # capacity DEGRADED recovered, no tier down
         # phase 4: rep2 takes traffic again
         futs = [svc.submit(img, img) for _ in range(16)]
@@ -384,7 +384,7 @@ def test_all_replicas_dead_sheds_then_recovers(tmp_path):
         faults.install(FaultPlan(dead_replica_ids=("rep0", "rep1")))
         f1 = svc.submit(img, img)
         f_dl = svc.submit(img, img, deadline_s=0.3)
-        assert wait_until(lambda: svc.health()["ready_replicas"] == 0)
+        assert wait_until(lambda: svc.health()["pool"]["ready"] == 0)
         assert svc.state == DEGRADED
         assert f1.outcome is None  # parked behind the probes, not lost
         with pytest.raises(Overloaded) as e:
@@ -402,7 +402,7 @@ def test_all_replicas_dead_sheds_then_recovers(tmp_path):
         faults.clear()
         assert f1.result(timeout=60).request_id == f1.request_id
         assert f1.outcome == "result"
-        assert wait_until(lambda: svc.health()["ready_replicas"] == 2)
+        assert wait_until(lambda: svc.health()["pool"]["ready"] == 2)
         assert svc.state == READY
         svc.stop()
     _, events = obs_events.replay_events(log_path)
@@ -435,7 +435,7 @@ def test_single_replica_pool_keeps_pr8_tier_recovery(tmp_path):
         svc.stop()
     assert engines[0].retraces == 1  # the recovery really retraced
     assert ops.demoted_fused_tiers()
-    rep = svc.health()["replicas"][0]
+    rep = svc.health()["pool"]["replicas"][0]
     assert rep["deaths"] == 0
     # the demotion its failure forced feeds the routing penalty + probe
     assert rep["demotions"] == 1
@@ -556,8 +556,8 @@ svc.stop()
 print(json.dumps({{
     "n_results": len(tables),
     "table_rows": int(tables[0].shape[0]),
-    "replicas": [r["id"] for r in health["replicas"]],
-    "devices": sorted({{r["device"] for r in health["replicas"]}}),
+    "replicas": [r["id"] for r in health["pool"]["replicas"]],
+    "devices": sorted({{r["device"] for r in health["pool"]["replicas"]}}),
 }}))
 """
 

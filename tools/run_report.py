@@ -162,6 +162,82 @@ def build_quality_section(events: List[dict],
     return section
 
 
+def build_slo_section(events: List[dict]) -> Dict[str, Any]:
+    """Recompute the SLO error-budget counters from the event log — the
+    replay twin of the live tracker (``ncnet_tpu/serving/slo.py``).
+
+    Classification uses the SAME values the live tracker saw: the latency
+    objectives stamped into ``serve_start``, the rounded ``wall_ms`` of
+    each ``serve_result``, and the ``admitted`` flags on
+    ``serve_deadline``/``serve_shed`` — so a complete log replays to
+    counters that match the service's final ``/metrics`` scrape EXACTLY
+    (the scrape-vs-replay consistency bar).  ``admitted`` here counts
+    terminal outcomes of admitted requests, exactly like the tracker: on a
+    clean drain it equals the admission count, after a crash it counts
+    what actually terminated."""
+    cfg: Optional[Dict[str, Any]] = None
+    for e in events:
+        if e.get("event") == "serve_start" and isinstance(e.get("slo"),
+                                                          dict):
+            cfg = e["slo"]  # latest service start wins (resume lineage)
+    default_ms = cfg.get("default_ms") if cfg else None
+    by_bucket = dict((cfg or {}).get("by_bucket") or {})
+    budget_pct = float((cfg or {}).get("budget_pct") or 1.0)
+
+    def objective(bucket: str) -> Optional[float]:
+        return by_bucket.get(bucket, default_ms)
+
+    bad = {"deadline": 0, "quarantined": 0, "shed": 0, "latency": 0}
+    admitted = ok = 0
+    for e in events:
+        ev = e.get("event")
+        if ev == "serve_result":
+            admitted += 1
+            obj = objective(str(e.get("bucket")))
+            wall = e.get("wall_ms")
+            if obj is not None and isinstance(wall, (int, float)) \
+                    and wall > obj:
+                bad["latency"] += 1
+            else:
+                ok += 1
+        elif ev == "serve_deadline" and e.get("admitted") is not False:
+            admitted += 1
+            bad["deadline"] += 1
+        elif ev == "serve_quarantine":
+            admitted += 1
+            bad["quarantined"] += 1
+        elif ev == "serve_shed" and e.get("admitted") is True:
+            admitted += 1
+            bad["shed"] += 1
+    bad_total = sum(bad.values())
+    burn = (round(100.0 * (bad_total / admitted) / (budget_pct / 100.0), 4)
+            if admitted else 0.0)
+    section: Dict[str, Any] = {
+        "objectives": cfg,
+        "admitted": admitted,
+        "ok": ok,
+        "bad": bad,
+        "bad_total": bad_total,
+        "budget_burn_pct": burn,
+    }
+    slo_events = [e for e in events if e.get("event") == "slo"]
+    if slo_events:
+        last = slo_events[-1]
+        section["slo_events"] = len(slo_events)
+        section["final_event"] = {
+            k: last.get(k) for k in
+            ("admitted", "ok", "bad", "bad_total", "budget_burn_pct",
+             "final") if k in last}
+        # the consistency verdict itself: does the replay reproduce the
+        # tracker's final counters?  (False on a torn log whose terminal
+        # events outlived the final slo event, or vice versa.)
+        section["matches_final_event"] = all(
+            section.get(k) == last.get(k)
+            for k in ("admitted", "ok", "bad", "bad_total",
+                      "budget_burn_pct"))
+    return section
+
+
 def build_serving_section(events: List[dict]) -> Dict[str, Any]:
     """The serving postmortem: request-outcome accounting (the outcome-total
     invariant ``admitted == results + deadlines + quarantines +
@@ -298,6 +374,13 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
         "shed_reasons": shed_reasons,
         "deadline_where": deadline_where,
         "replicas": replica_table,
+        # the unified health document the service recorded as its last act
+        # (serving/health.py::build_health_document) — the postmortem's
+        # final state-of-the-world, schema-versioned
+        "final_health_doc": next(
+            (e.get("doc") for e in reversed(events)
+             if e.get("event") == "serve_health_doc"
+             and isinstance(e.get("doc"), dict)), None),
         "health_timeline": [
             {"t": e.get("t"), "state": e.get("state"),
              "reason": e.get("reason"),
@@ -433,6 +516,7 @@ def build_report(paths: List[str],
         report["spans"] = build_span_breakdown(events)
     if any(str(e.get("event", "")).startswith("serve_") for e in events):
         report["serving"] = build_serving_section(events)
+        report["slo"] = build_slo_section(events)
     if any(e.get("event") == "quality" for e in events):
         device_kind = next(
             (r["header"].get("device_kind") for r in runs
@@ -580,6 +664,44 @@ def render_serving(report: Dict[str, Any]) -> str:
     for d in sv["drains"]:
         lines.append(f"  drain: drained={d.get('drained')} "
                      f"leftover={d.get('leftover')}")
+    fh = sv.get("final_health_doc")
+    if fh:
+        pool = fh.get("pool", {})
+        lines.append(
+            f"  final health doc (schema {fh.get('schema')}): "
+            f"state={fh.get('state')}  pool "
+            f"{pool.get('ready')}/{pool.get('total')} ready  "
+            f"counters={fh.get('counters')}")
+    return "\n".join(lines)
+
+
+def render_slo(report: Dict[str, Any]) -> str:
+    s = report.get("slo")
+    if not s or not s["admitted"]:
+        return "(no admitted serving outcomes in the log)"
+    lines = ["SLO / error budget (replayed from the event log):"]
+    cfg = s.get("objectives") or {}
+    obj = cfg.get("default_ms")
+    lines.append(
+        f"  objective: {obj if obj is not None else 'none'} ms default"
+        + (f", per-bucket {cfg['by_bucket']}" if cfg.get("by_bucket")
+           else "")
+        + f"; budget {cfg.get('budget_pct', 1.0)}% bad")
+    b = s["bad"]
+    lines.append(
+        f"  outcomes: admitted={s['admitted']}  ok={s['ok']}  "
+        f"bad={s['bad_total']} (latency={b['latency']} "
+        f"deadline={b['deadline']} quarantined={b['quarantined']} "
+        f"shed={b['shed']})")
+    lines.append(f"  budget burn: {s['budget_burn_pct']}% "
+                 "(100 = budget exactly spent)")
+    if "matches_final_event" in s:
+        tag = "consistent" if s["matches_final_event"] else "MISMATCH"
+        lines.append(
+            f"  scrape-vs-replay: {tag} with the service's final slo "
+            f"event ({s['slo_events']} slo event(s) in the log)")
+        if not s["matches_final_event"]:
+            lines.append(f"    final event: {s['final_event']}")
     return "\n".join(lines)
 
 
@@ -682,6 +804,11 @@ def main(argv=None) -> int:
                          "accounting (the outcome-total invariant), "
                          "per-bucket latency, queue-depth trajectory, "
                          "health-state timeline")
+    ap.add_argument("--slo", action="store_true",
+                    help="append the SLO section: error-budget counters "
+                         "recomputed from the log (objectives from "
+                         "serve_start), burn %%, and the consistency "
+                         "verdict against the service's final slo event")
     args = ap.parse_args(argv)
     quality_ref = None
     if args.quality or args.quality_ref:
@@ -702,6 +829,9 @@ def main(argv=None) -> int:
         if args.serving:
             print()
             print(render_serving(report))
+        if args.slo:
+            print()
+            print(render_slo(report))
     return 0
 
 

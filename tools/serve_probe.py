@@ -97,6 +97,9 @@ def probe(sides: List[int], n_pairs: int, tiny: bool, demote: bool,
         max_in_flight_per_client=max(2 * n_pairs, 64),
         buckets=buckets, max_buckets=len(buckets) ** 2,
         warm_buckets=buckets,
+        # the live plane rides along so the probe prices a real-device
+        # /metrics scrape under load (ISSUE 11's "the plane must be free")
+        introspect_port=0,
     )
     out: Dict[str, Any] = {
         "device_kind": str(jax.devices()[0].device_kind),
@@ -133,6 +136,16 @@ def probe(sides: List[int], n_pairs: int, tiny: bool, demote: bool,
         # so THAT bucket's capacity is the one their rates must key off
         cap_qps = side_caps[sides[0]]
         out["capacity_qps"] = round(cap_qps, 2)
+
+        # live-plane scrape cost on the attached device (/metrics over
+        # loopback): the same methodology the bench's 1%-of-cadence gate
+        # enforces (serving/introspect.py::scrape_wall_ms), measured here
+        # under the probe's own load
+        if service.introspect_url is not None:
+            from ncnet_tpu.serving.introspect import scrape_wall_ms
+
+            out["scrape_wall_ms"] = round(
+                scrape_wall_ms(service.introspect_url), 3)
 
         # 2. demotion under load: inject a device failure mid-stream and
         # time the serving pause around the demote-retrace-recompile

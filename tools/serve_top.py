@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""``top`` for the resident match service: a live console over the
+introspection plane.
+
+Polls a running service's ``/metrics`` (Prometheus text,
+``ncnet_tpu/observability/export.py``) and ``/healthz`` (the unified
+schema-versioned health document) and renders the operator view: service
+state, queue depth against the elastic bound, the replica table (state,
+routing score, EWMA wall, load, failures), per-bucket latency p50/p95/p99
+derived from the cumulative ``_bucket`` series, and the SLO error-budget
+burn.  The endpoints are the ones any scraping stack consumes — this tool
+adds nothing the plane does not already export, it only renders it.
+
+Usage::
+
+    python tools/serve_top.py http://127.0.0.1:8080 [--interval 2]
+        [--once] [--json]
+
+``--once`` renders a single frame and exits (0 = service reachable and
+admitting, 3 = reachable but draining/stopped, 2 = unreachable) — the
+scripting / smoke-test mode.  Without it the tool refreshes in place
+(ANSI clear) every ``--interval`` seconds until interrupted.  ``--json``
+emits the merged raw payloads instead of the rendered frame (``--once``
+implied).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ncnet_tpu.observability.export import (  # noqa: E402
+    histogram_percentile,
+    parse_prometheus,
+)
+
+
+def _out(text: str) -> None:
+    sys.stdout.write(text)
+
+
+def fetch(base: str, timeout: float = 5.0
+          ) -> Tuple[Optional[Dict[str, Any]], Optional[Dict[str, Any]],
+                     Optional[str]]:
+    """One poll: ``(health_doc, metric_families, error)``.  A 503 from
+    ``/healthz`` is a VALID answer (a draining service reports itself);
+    only transport failures return an error."""
+    base = base.rstrip("/")
+    try:
+        try:
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=timeout) as r:
+                health = json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                raise
+            health = json.loads(e.read().decode("utf-8"))
+        with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
+            fams = parse_prometheus(r.read().decode("utf-8"))
+    except Exception as e:  # noqa: BLE001 — every transport failure is
+        # the same verdict: the plane is unreachable
+        return None, None, f"{type(e).__name__}: {e}"
+    return health, fams, None
+
+
+def _bucket_latencies(fams: Dict[str, Any]) -> List[Dict[str, Any]]:
+    fam = fams.get("ncnet_serve_latency_ms")
+    if not fam:
+        return []
+    by_bucket: Dict[str, List] = {}
+    for name, labels, value in fam["samples"]:
+        if "bucket" in labels:
+            by_bucket.setdefault(labels["bucket"], []).append(
+                (name, labels, value))
+    rows = []
+    for bucket, samples in sorted(by_bucket.items()):
+        n = next((v for nm, lb, v in samples if nm.endswith("_count")), 0)
+        rows.append({
+            "bucket": bucket, "n": int(n),
+            "p50": histogram_percentile(samples, 50),
+            "p95": histogram_percentile(samples, 95),
+            "p99": histogram_percentile(samples, 99),
+        })
+    return rows
+
+
+def render_frame(health: Dict[str, Any], fams: Dict[str, Any],
+                 base: str) -> str:
+    lines: List[str] = []
+    add = lines.append
+    svc = health.get("service", {})
+    q = health.get("queue", {})
+    pool = health.get("pool", {})
+    add(f"ncnet serve_top — {base}  (healthz schema "
+        f"{health.get('schema')})")
+    add(f"state: {health.get('state')}  for {svc.get('age_s')}s"
+        + (f"  reason: {svc.get('reason')}" if svc.get("reason") else ""))
+    add(f"queue: {q.get('depth')}/{q.get('effective_max_queue')}  "
+        f"inflight batches: {q.get('inflight_batches')}  "
+        f"pipeline depth: {q.get('pipeline_depth')}  "
+        f"replicas ready: {pool.get('ready')}/{pool.get('total')}")
+    c = health.get("counters", {})
+    add(f"outcomes: admitted={c.get('admitted')} results={c.get('results')}"
+        f" deadline={c.get('deadline')} quarantined={c.get('quarantined')}"
+        f" shed={c.get('shed')}")
+    slo = health.get("slo")
+    if slo and slo.get("admitted"):
+        w = slo["window"]
+        add(f"SLO burn: {slo['budget_burn_pct']}% of budget cumulative  |  "
+            f"window({w['n']}): {w['burn_pct']}%  "
+            f"[bad: {slo['bad']}]")
+    add("")
+    add(f"{'replica':<8} {'state':<6} {'score':>10} {'ewma_ms':>9} "
+        f"{'load':>4} {'batches':>8} {'fail':>5} {'deaths':>6} "
+        f"{'dead_s':>7}")
+    for r in pool.get("replicas", []):
+        ewma = r.get("ewma_wall_ms")
+        dead = r.get("dead_age_s")
+        add(f"{r['id']:<8} {r['state']:<6} {r['score']:>10.4f} "
+            f"{(f'{ewma:.2f}' if ewma is not None else '-'):>9} "
+            f"{r['load']:>4} {r['batches']:>8} {r['failures']:>5} "
+            f"{r['deaths']:>6} "
+            f"{(f'{dead:.1f}' if dead is not None else '-'):>7}")
+    lat = _bucket_latencies(fams)
+    if lat:
+        add("")
+        add(f"{'bucket':<16} {'n':>6} {'p50_ms':>9} {'p95_ms':>9} "
+            f"{'p99_ms':>9}")
+        for row in lat:
+            fmt = lambda v: f"{v:.2f}" if v is not None else "-"  # noqa: E731
+            add(f"{row['bucket']:<16} {row['n']:>6} {fmt(row['p50']):>9} "
+                f"{fmt(row['p95']):>9} {fmt(row['p99']):>9}")
+    act = health.get("activity")
+    if act is not None:
+        add("")
+        add(f"activity: last dispatch/idle tick {act.get('age_s')}s ago  "
+            f"({act.get('batches')} batches dispatched)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Live console over a match service's /metrics + "
+                    "/healthz introspection plane")
+    ap.add_argument("url", help="base URL of the introspection endpoint "
+                                "(e.g. http://127.0.0.1:8080)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripting mode): "
+                         "0 admitting, 3 draining/stopped, 2 unreachable")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged raw payloads as one JSON "
+                         "document (implies --once)")
+    args = ap.parse_args(argv)
+
+    while True:
+        health, fams, err = fetch(args.url)
+        if err is not None:
+            _out(f"unreachable: {args.url} ({err})\n")
+            if args.once or args.json:
+                return 2
+        elif args.json:
+            doc = {"healthz": health,
+                   "metrics": {name: fam["samples"]
+                               for name, fam in sorted(fams.items())}}
+            _out(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+            return 0 if health.get("state") in (
+                "STARTING", "READY", "DEGRADED") else 3
+        else:
+            frame = render_frame(health, fams, args.url)
+            if not args.once:
+                _out("\x1b[2J\x1b[H")  # clear + home: refresh in place
+            _out(frame)
+            if args.once:
+                return 0 if health.get("state") in (
+                    "STARTING", "READY", "DEGRADED") else 3
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except KeyboardInterrupt:
+        raise SystemExit(0)

@@ -22,9 +22,26 @@ rather than a guessed constant:
 Exit codes: 0 = alive, 3 = STALLED, 2 = no heartbeat file (not started, or
 already cleaned up — distinct so supervisors can treat it differently).
 
+**Remote mode (``--url``)**: a serving process with the introspection plane
+(``ncnet_tpu/serving/introspect.py``) exports the same liveness signal over
+HTTP — ``/healthz``'s ``activity.age_s`` is seconds since the pool last
+dispatched a batch or deliberately idled, exactly the heartbeat-mtime
+semantics.  ``--url http://host:port`` polls that instead of a local file,
+so the watchdog runs from ANOTHER host (the multi-host deployment shape)
+with no shared filesystem.  The event-log cadence backstop keeps its PR 10
+semantics in BOTH modes: when ``--events`` is readable, the stall
+threshold derives from the run's own batch cadence and a recent
+per-replica lane overrides a stale primary signal (one wedged replica
+cannot flag a healthy pool STALLED); without a readable log the threshold
+degrades to ``--min-age`` alone.  An unreachable endpoint maps to the
+``missing`` verdict (exit 2) — "not started or already gone", the same
+supervisor semantics as a missing heartbeat file.
+
 Usage::
 
     python tools/stall_watchdog.py <telemetry_dir>/heartbeat.json
+        [--events <events.jsonl>] [--factor 10] [--min-age 60] [--json]
+    python tools/stall_watchdog.py --url http://host:8080
         [--events <events.jsonl>] [--factor 10] [--min-age 60] [--json]
 """
 
@@ -101,6 +118,91 @@ def replica_batch_cadence(events_path: str,
     return out
 
 
+def _apply_replica_backstop(verdict: Dict[str, Any], events_path: str,
+                            factor: float, min_age: float) -> None:
+    """The PR 10 backstop, shared by both modes: per-replica ``serve_batch``
+    cadence always ships in the verdict, and a recent lane overrides a
+    stale primary signal (heartbeat mtime or HTTP activity age) — one
+    wedged replica must not flag a healthy pool STALLED."""
+    cadence = replica_batch_cadence(events_path)
+    replicas: Dict[str, Any] = {}
+    alive_via = None
+    now = time.time()
+    for rid, c in sorted(cadence.items()):
+        rep_threshold = max(min_age, factor * c["median_wall_s"]) \
+            if c["median_wall_s"] else min_age
+        rep_age = (now - c["last_t"]) if c["last_t"] else None
+        recent = rep_age is not None and rep_age <= rep_threshold
+        replicas[rid] = {
+            "last_batch_age_s": round(rep_age, 3) if rep_age is not None
+            else None,
+            "median_wall_s": (round(c["median_wall_s"], 6)
+                              if c["median_wall_s"] else None),
+            "threshold_s": round(rep_threshold, 3),
+            "n": c["n"],
+            "recent": recent,
+        }
+        if verdict["status"] == "stalled" and recent and alive_via is None:
+            alive_via = f"replica_cadence:{rid}"
+            verdict["status"] = "alive"
+    if replicas:
+        verdict["replicas"] = replicas
+    if alive_via:
+        verdict["alive_via"] = alive_via
+
+
+def judge_url(url: str, events_path: Optional[str] = None,
+              factor: float = 10.0, min_age: float = 60.0,
+              timeout: float = 5.0) -> Dict[str, Any]:
+    """Remote liveness verdict over the introspection plane: the primary
+    signal is ``/healthz``'s ``activity.age_s`` (seconds since the pool
+    last dispatched or deliberately idled — the HTTP twin of the heartbeat
+    mtime), thresholded by the event-log cadence when one is readable.
+    Unreachable ⇒ ``missing`` (exit 2), same as a missing heartbeat file."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    base = url.rstrip("/")
+    if not base.endswith("/healthz"):
+        base += "/healthz"
+    try:
+        try:
+            with urllib.request.urlopen(base, timeout=timeout) as r:
+                doc = _json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                raise
+            # 503 is a DRAINING/STOPPED service answering honestly — the
+            # plane is alive even though probes should stop routing
+            doc = _json.loads(e.read().decode("utf-8"))
+    except Exception as e:  # noqa: BLE001 — any transport failure is the
+        # same verdict: nothing is answering there
+        return {"status": "missing", "url": base,
+                "error": f"{type(e).__name__}: {e}"}
+    age = (doc.get("activity") or {}).get("age_s")
+    if not isinstance(age, (int, float)):
+        return {"status": "missing", "url": base,
+                "error": "healthz document has no activity.age_s"}
+    median = recent_median_step_wall(events_path) if events_path else None
+    threshold = max(min_age, factor * median) if median else min_age
+    verdict: Dict[str, Any] = {
+        "status": "stalled" if age > threshold else "alive",
+        "mode": "url",
+        "url": base,
+        "state": doc.get("state"),
+        "age_s": round(float(age), 3),
+        "threshold_s": round(threshold, 3),
+        "median_step_wall_s": round(median, 6) if median else None,
+        "factor": factor,
+        "min_age_s": min_age,
+        "events": events_path if median else None,
+    }
+    if events_path:
+        _apply_replica_backstop(verdict, events_path, factor, min_age)
+    return verdict
+
+
 def judge(heartbeat_path: str, events_path: Optional[str] = None,
           factor: float = 10.0, min_age: float = 60.0) -> Dict[str, Any]:
     """One liveness verdict: ``{"status": "alive"|"stalled"|"missing", ...}``
@@ -122,44 +224,21 @@ def judge(heartbeat_path: str, events_path: Optional[str] = None,
             os.path.dirname(os.path.abspath(heartbeat_path)), "events.jsonl")
     median = recent_median_step_wall(events_path)
     threshold = max(min_age, factor * median) if median else min_age
-    status = "stalled" if age > threshold else "alive"
-    # per-replica cadence: the breakdown always ships; a recent lane also
-    # overrides a stale heartbeat
-    cadence = replica_batch_cadence(events_path)
-    replicas: Dict[str, Any] = {}
-    alive_via = None
-    now = time.time()
-    for rid, c in sorted(cadence.items()):
-        rep_threshold = max(min_age, factor * c["median_wall_s"]) \
-            if c["median_wall_s"] else min_age
-        rep_age = (now - c["last_t"]) if c["last_t"] else None
-        recent = rep_age is not None and rep_age <= rep_threshold
-        replicas[rid] = {
-            "last_batch_age_s": round(rep_age, 3) if rep_age is not None
-            else None,
-            "median_wall_s": (round(c["median_wall_s"], 6)
-                              if c["median_wall_s"] else None),
-            "threshold_s": round(rep_threshold, 3),
-            "n": c["n"],
-            "recent": recent,
-        }
-        if status == "stalled" and recent and alive_via is None:
-            alive_via = f"replica_cadence:{rid}"
-            status = "alive"
     verdict: Dict[str, Any] = {
-        "status": status,
+        "status": "stalled" if age > threshold else "alive",
+        "mode": "heartbeat",
         "heartbeat": heartbeat_path,
         "age_s": round(age, 3),
         "threshold_s": round(threshold, 3),
         "median_step_wall_s": round(median, 6) if median else None,
         "factor": factor,
         "min_age_s": min_age,
-        "events": events_path if (median or replicas) else None,
     }
-    if replicas:
-        verdict["replicas"] = replicas
-    if alive_via:
-        verdict["alive_via"] = alive_via
+    # per-replica cadence: the breakdown always ships; a recent lane also
+    # overrides a stale heartbeat
+    _apply_replica_backstop(verdict, events_path, factor, min_age)
+    verdict["events"] = events_path \
+        if (median or verdict.get("replicas")) else None
     payload = Heartbeat.read(heartbeat_path)
     if payload:
         verdict["last_beat"] = payload
@@ -168,12 +247,21 @@ def judge(heartbeat_path: str, events_path: Optional[str] = None,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="Judge a training run's liveness from its heartbeat "
-                    "file + event log")
-    ap.add_argument("heartbeat", help="path to heartbeat.json")
+        description="Judge a training run's or serving process's liveness "
+                    "from its heartbeat file + event log, or remotely via "
+                    "the serving introspection plane (--url)")
+    ap.add_argument("heartbeat", nargs="?", default=None,
+                    help="path to heartbeat.json (omit when using --url)")
+    ap.add_argument("--url", default=None,
+                    help="poll a serving process's /healthz instead of a "
+                         "heartbeat file (base URL or full /healthz URL) — "
+                         "the cross-host mode; --events still feeds the "
+                         "cadence threshold + replica backstop when the "
+                         "log is readable from here")
     ap.add_argument("--events", default=None,
                     help="event log for the step-wall cadence (default: "
-                         "events.jsonl beside the heartbeat file)")
+                         "events.jsonl beside the heartbeat file; no "
+                         "default in --url mode)")
     ap.add_argument("--factor", type=float, default=10.0,
                     help="stall threshold = factor x median step wall "
                          "(default 10)")
@@ -183,26 +271,41 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the verdict as one JSON document")
     args = ap.parse_args(argv)
+    if (args.heartbeat is None) == (args.url is None):
+        ap.error("give exactly one of: a heartbeat path, or --url")
 
-    verdict = judge(args.heartbeat, events_path=args.events,
-                    factor=args.factor, min_age=args.min_age)
+    if args.url is not None:
+        verdict = judge_url(args.url, events_path=args.events,
+                            factor=args.factor, min_age=args.min_age)
+    else:
+        verdict = judge(args.heartbeat, events_path=args.events,
+                        factor=args.factor, min_age=args.min_age)
     if args.json:
         print(json.dumps(verdict, indent=2, sort_keys=True))
     elif verdict["status"] == "missing":
-        print(f"no heartbeat at {verdict['heartbeat']} (run not started, "
-              "telemetry off, or already cleaned up)")
+        where = verdict.get("heartbeat") or verdict.get("url")
+        print(f"no liveness signal at {where} (run not started, telemetry "
+              "off, endpoint unreachable, or already cleaned up)"
+              + (f" [{verdict['error']}]" if verdict.get("error") else ""))
     else:
         cadence = (f"median step wall {verdict['median_step_wall_s']}s "
                    f"x {verdict['factor']}"
                    if verdict["median_step_wall_s"]
                    else f"no step cadence; floor {verdict['min_age_s']}s")
-        beat = verdict.get("last_beat") or {}
         via = (f" [alive via {verdict['alive_via']}]"
                if verdict.get("alive_via") else "")
-        print(f"{verdict['status'].upper()}{via}: heartbeat age "
-              f"{verdict['age_s']}s vs threshold {verdict['threshold_s']}s "
-              f"({cadence}); last beat: step {beat.get('step')}, "
-              f"pid {beat.get('pid')}, run {beat.get('run')}")
+        if verdict.get("mode") == "url":
+            print(f"{verdict['status'].upper()}{via}: activity age "
+                  f"{verdict['age_s']}s vs threshold "
+                  f"{verdict['threshold_s']}s ({cadence}); service state "
+                  f"{verdict.get('state')} at {verdict['url']}")
+        else:
+            beat = verdict.get("last_beat") or {}
+            print(f"{verdict['status'].upper()}{via}: heartbeat age "
+                  f"{verdict['age_s']}s vs threshold "
+                  f"{verdict['threshold_s']}s "
+                  f"({cadence}); last beat: step {beat.get('step')}, "
+                  f"pid {beat.get('pid')}, run {beat.get('run')}")
         for rid, r in (verdict.get("replicas") or {}).items():
             tag = "fresh" if r["recent"] else "wedged/idle"
             print(f"  replica {rid}: last batch "

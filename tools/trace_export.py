@@ -17,6 +17,14 @@ https://ui.perfetto.dev and chrome://tracing load directly:
   * non-span events (step, checkpoint_commit, tier_selected, retry,
     quarantine, …) become instant ("i") markers on a dedicated track, so
     the trace shows the run's milestones against its time structure;
+  * ``request_timeline`` events (the serving plane's per-request
+    attribution, ncnet_tpu/serving/service.py) become Perfetto ASYNC
+    nestable slices keyed by request id: one enclosing ``req <id>
+    [<outcome>]`` slice spanning the request's end-to-end wall, with the
+    queue/device/fetch segments nested inside it — laid out from the
+    event's ``t0`` (wall-clock submission) plus the segment durations,
+    which sum to ``total_ms`` by construction, so the slices tile the
+    request exactly;
   * ``quality`` and ``metrics`` events become counter ("C") tracks —
     Perfetto renders them as stacked value-over-time plots, so a
     match-quality drift (observability/quality.py) is visible on the SAME
@@ -67,6 +75,49 @@ def _finite_mean(vals) -> "float | None":
           if isinstance(v, (int, float)) and not isinstance(v, bool)
           and float(v) == float(v)]
     return sum(xs) / len(xs) if xs else None
+
+
+def timeline_events(e: dict, pid: int) -> List[Dict[str, Any]]:
+    """Render one ``request_timeline`` event as Perfetto async nestable
+    slices ("b"/"e" pairs sharing ``id`` = the request id): the enclosing
+    request slice plus its queue/device/fetch segments in submission
+    order.  Returns [] when the event carries no usable total."""
+    total_ms = e.get("total_ms")
+    t0 = e.get("t0")
+    if not isinstance(total_ms, (int, float)) \
+            or not isinstance(t0, (int, float)):
+        return []
+    rid = str(e.get("request", "?"))
+    run = e.get("run", "?")
+    # request ids restart per service process: scope the async id by run
+    # so two lineages in one file cannot interleave their slices
+    async_id = f"{run}/{rid}"
+    cat = "serve_request"
+    outcome = e.get("outcome", "?")
+    args = {k: e[k] for k in
+            ("request", "client", "bucket", "outcome", "replica", "where",
+             "attempts", "queue_ms", "device_ms", "fetch_ms", "total_ms")
+            if k in e}
+    out: List[Dict[str, Any]] = []
+
+    def slice_pair(name: str, start_s: float, dur_ms: float,
+                   slice_args: Dict[str, Any]) -> None:
+        out.append({"ph": "b", "cat": cat, "id": async_id, "name": name,
+                    "pid": pid, "tid": 0, "ts": _us(start_s),
+                    "args": slice_args})
+        out.append({"ph": "e", "cat": cat, "id": async_id, "name": name,
+                    "pid": pid, "tid": 0,
+                    "ts": _us(start_s + dur_ms * 1e-3)})
+
+    slice_pair(f"req {rid} [{outcome}]", t0, total_ms, args)
+    cursor = float(t0)
+    for seg in ("queue_ms", "device_ms", "fetch_ms"):
+        dur = e.get(seg)
+        if not isinstance(dur, (int, float)):
+            continue
+        slice_pair(seg[:-3], cursor, float(dur), {seg: dur})
+        cursor += float(dur) * 1e-3
+    return out
 
 
 def counter_events(e: dict) -> List[Dict[str, Any]]:
@@ -144,6 +195,11 @@ def build_trace(paths: List[str]) -> Dict[str, Any]:
         for e in events:
             run = e.get("run", "?")
             pid = pid_for(run, head)
+            if e.get("event") == "request_timeline":
+                # the per-request attribution renders as async slices (no
+                # instant marker — the slices ARE the event's display)
+                trace_events.extend(timeline_events(e, pid))
+                continue
             if e.get("event") == "quality" or \
                     isinstance(e.get("metrics"), dict):
                 # value-over-time payloads render as counter tracks —
