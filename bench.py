@@ -832,6 +832,110 @@ def bench_jax(res=None):
 
         out = _with_retries(_serving_metrics, label="serving") or {}
         res.update(out)
+
+    # multi-host router scenario (ISSUE 12): h backend PROCESSES behind a
+    # serving/router.py::MatchRouter — closed-loop capacity at pod sizes
+    # h=1,2 (route_capacity_qps_h{k}: the fan-out scaling trajectory),
+    # open-loop p95 at 70% of the h=2 capacity (route_p95_ms: queueing +
+    # wire + routing overhead measured, not hidden), and the shed fraction
+    # under a pinned ~3x paced burst (route_shed_pct).  Backends are
+    # CPU-forced tiny-arch subprocesses ON PURPOSE: two processes cannot
+    # share one TPU, and the quantity this family gates is the WIRE+ROUTER
+    # overhead trajectory (framing, HTTP, scoring, failover bookkeeping),
+    # which is device-independent — perf_regress --check gates it with the
+    # inferred directions (qps higher, _ms lower, shed_pct lower).
+    flag = os.environ.get("NCNET_BENCH_SERVE")
+    on_tpu = "TPU" in jax.devices()[0].device_kind
+    if (flag not in ("0", "") if flag is not None else on_tpu) \
+            and res.get("route_capacity_qps_h1") is None:
+
+        def _router_metrics():
+            import sys as _sys
+
+            _tools = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools")
+            if _tools not in _sys.path:
+                _sys.path.insert(0, _tools)
+            import serve_probe as _sp
+
+            from ncnet_tpu.serving import MatchRouter, RouterConfig
+            from ncnet_tpu.utils.faults import paced_burst
+
+            side = 64
+            rng_r = np.random.default_rng(17)
+            pairs = [
+                (rng_r.integers(0, 255, (side, side, 3), dtype=np.uint8),
+                 rng_r.integers(0, 255, (side, side, 3), dtype=np.uint8))
+                for _ in range(8)
+            ]
+            out = {}
+            for h in (1, 2):
+                procs = _sp.spawn_backends(h, side)
+                router = None
+                try:
+                    # router construction INSIDE the try: a ctor/start
+                    # failure must still SIGTERM the spawned backends, or
+                    # orphaned resident processes skew every later metric
+                    router = MatchRouter(
+                        [u for _, u in procs],
+                        RouterConfig(max_queue=128,
+                                     max_in_flight_per_client=256),
+                    ).start()
+                    t0 = time.perf_counter()
+                    futs = [router.submit(*pairs[i % 8])
+                            for i in range(32)]
+                    for f in futs:
+                        f.result(timeout=300)
+                    cap = 32 / (time.perf_counter() - t0)
+                    out[f"route_capacity_qps_h{h}"] = round(cap, 2)
+                    if h == 2:
+                        # open loop at 70% of pod capacity: pinned offered
+                        # rate, so p95 includes real queueing + wire delay
+                        import itertools
+
+                        counter = itertools.count()
+                        submit = lambda: router.submit(  # noqa: E731
+                            *pairs[next(counter) % 8])
+                        rate = max(cap * 0.7, 1.0)
+                        futs, _ = paced_burst(
+                            submit, rate, max(int(rate * 4), 16))
+                        lat = []
+                        for f in futs:
+                            try:
+                                lat.append(
+                                    f.result(timeout=300).wall_s * 1e3)
+                            except Exception:  # noqa: BLE001 — successes
+                                pass
+                        if lat:
+                            out["route_p95_ms"] = round(
+                                float(np.percentile(lat, 95)), 2)
+                        # ~2 s paced at 3x pod capacity: the shed wall
+                        burst_rate = cap * 3
+                        n_burst = max(int(burst_rate * 2), 64)
+                        futs_b, sheds_b = paced_burst(
+                            submit, burst_rate, n_burst)
+                        for f in futs_b:
+                            try:
+                                f.result(timeout=300)
+                            except Exception:  # noqa: BLE001 — outcomes
+                                pass
+                        out["route_shed_pct"] = round(
+                            100.0 * len(sheds_b) / n_burst, 2)
+                finally:
+                    if router is not None:
+                        router.stop()
+                    _sp.stop_backends(procs)
+            return out
+
+        try:
+            res.update(_router_metrics())
+        except Exception as e:  # noqa: BLE001 — a router-scenario failure
+            # must not discard the serving metrics already measured
+            import sys as _sys
+
+            print(f"bench router scenario failed ({type(e).__name__}: "
+                  f"{str(e)[:200]}); keeping the metrics already measured",
+                  file=_sys.stderr)
     for k in [k for k, v in res.items() if v is None]:  # prune in place so a
         del res[k]  # shared res dict keeps already-captured metrics on retry
 

@@ -8,10 +8,16 @@ resurrection probes, elastic admission control with classified
 per-request deadlines checked at admission/dequeue/fetch, demote-retrace
 survival of device failures with zero lost requests, SIGTERM drain, a
 STARTING/READY/DEGRADED/DRAINING/STOPPED health machine for probes, and
-full replica-tagged event/metric/quality telemetry.  See README "Serving" /
-"Replicated serving" for the API, overload semantics and chaos knobs;
-tests/test_serving.py and tests/test_serving_pool.py are the fault-injected
-proof of the invariants.
+full replica-tagged event/metric/quality telemetry.  On top of the
+single-process service sits the multi-host tier: a versioned wire data
+plane (``wire.py`` + ``POST /match`` on the introspection server) and a
+fronting ``MatchRouter`` (``router.py``) that scores per-host backends
+from their ``/healthz`` documents, fails over across process/network
+boundaries off-budget, propagates backend backpressure, and drains in
+coordination with its backends.  See README "Serving" / "Replicated
+serving" / "Multi-host serving" for the API, overload semantics and chaos
+knobs; tests/test_serving.py, tests/test_serving_pool.py and
+tests/test_router.py are the fault-injected proof of the invariants.
 """
 
 from ncnet_tpu.serving.admission import AdmissionController  # noqa: F401
@@ -35,6 +41,21 @@ from ncnet_tpu.serving.replica import (  # noqa: F401
     Replica,
     ReplicaPool,
 )
+from ncnet_tpu.serving.router import (  # noqa: F401
+    BACKEND_DEAD,
+    BACKEND_DRAINING,
+    BACKEND_READY,
+    ROUTER_DOC_SCHEMA,
+    Backend,
+    MatchRouter,
+    RouterConfig,
+    build_router_document,
+)
+from ncnet_tpu.serving.wire import (  # noqa: F401
+    WIRE_SCHEMA,
+    MatchClient,
+    WireError,
+)
 from ncnet_tpu.serving.request import (  # noqa: F401
     TERMINAL_OUTCOMES,
     DeadlineExceeded,
@@ -51,6 +72,10 @@ from ncnet_tpu.serving.slo import SLOTracker  # noqa: F401
 __all__ = [
     "ADMITTING",
     "AdmissionController",
+    "BACKEND_DEAD",
+    "BACKEND_DRAINING",
+    "BACKEND_READY",
+    "Backend",
     "BatchMatchEngine",
     "DEGRADED",
     "DRAINING",
@@ -58,24 +83,31 @@ __all__ = [
     "HEALTH_DOC_SCHEMA",
     "HealthMachine",
     "IntrospectionServer",
-    "SLOTracker",
-    "build_health_document",
+    "MatchClient",
     "MatchFuture",
     "MatchRequest",
     "MatchResult",
+    "MatchRouter",
     "MatchService",
     "Overloaded",
     "READY",
     "REPLICA_DEAD",
     "REPLICA_READY",
+    "ROUTER_DOC_SCHEMA",
     "Replica",
     "ReplicaPool",
     "RequestQuarantined",
+    "RouterConfig",
+    "SLOTracker",
     "STARTING",
     "STOPPED",
     "ServingConfig",
     "ShapeBucketer",
     "TERMINAL_OUTCOMES",
+    "WIRE_SCHEMA",
+    "WireError",
     "bucket_label",
+    "build_health_document",
+    "build_router_document",
     "pad_to_bucket",
 ]

@@ -36,6 +36,20 @@ honest as replicas die (fewer drains per wall → longer hints) and
 resurrect (hints shrink back), which is what keeps shed clients from
 hammering a half-dead pool at full-pool cadence.
 
+**The capacity-units contract** (:meth:`AdmissionController.note_capacity`).
+One controller serves two tiers, so the ``ready``/``total`` feed is defined
+once: they are **drain-lane units**, not processes and not devices.  A
+single-process ``MatchService`` feeds its READY/total REPLICA counts (one
+lane per engine); the multi-host ``MatchRouter`` feeds the SUM of ready
+replicas across its live backends over the pod's provisioned total — the
+pod's true drain lanes, which is what its queue bound must track (a router
+admitting against its *local* device count would buffer a dead pod's worth
+of work, and one admitting per *backend process* would halve its bound
+when a 4-replica host loses one chip).  Both tiers' elastic bounds then
+compose: each backend sheds at its own live-replica bound, the router
+sheds at the pod's, and the same ``units``-scaled cadence maths keeps both
+tiers' ``retry_after_s`` hints honest.
+
 The controller holds no lock of its own: the service serializes every call
 under its condition lock, and the throughput EWMA is a single float write.
 """
@@ -96,9 +110,12 @@ class AdmissionController:
         )
 
     def note_capacity(self, ready: int, total: int) -> None:
-        """Pool membership changed (replica death/resurrection): the
-        elastic queue bound and the retry-after cadence both re-derive from
-        the live READY count."""
+        """Live capacity changed.  ``ready``/``total`` are DRAIN-LANE
+        UNITS (the module-docstring contract): READY/total replicas for a
+        pool-backed service, the pod-wide sum of ready replicas across
+        live backends for a router — never the local process's device
+        count.  The elastic queue bound and the retry-after cadence both
+        re-derive from the live unit count."""
         self._ready = max(0, int(ready))
         self._total = max(1, int(total))
 
@@ -109,8 +126,10 @@ class AdmissionController:
 
     def effective_max_queue(self) -> int:
         """The live queue bound: ``max_queue`` scaled by the ready/total
-        replica fraction (elastic pools only), floored at one batch so a
-        single surviving replica still coalesces full batches."""
+        unit fraction (elastic pools only), floored at one batch so a
+        single surviving drain lane still coalesces full batches (the
+        router's drain unit is one request — ``max_batch=1`` — so its
+        floor is one)."""
         if not self.elastic or self._total <= 1:
             return self.max_queue
         share = self.max_queue * self._ready / self._total

@@ -61,9 +61,14 @@ ADMITTING = (STARTING, READY, DEGRADED)
 
 
 class HealthMachine:
-    """The service's state cell; mutated only under the service lock."""
+    """The service's state cell; mutated only under the service lock.
+    ``event`` names the transition event emitted into the log —
+    ``serve_health`` for a ``MatchService``, ``route_health`` for the
+    multi-host ``MatchRouter`` (same machine, same transition rules, so
+    ``run_report`` reconstructs either tier's timeline the same way)."""
 
-    def __init__(self):
+    def __init__(self, event: str = "serve_health"):
+        self.event = event
         self.state = STARTING
         self.since = time.time()
         self.reason: Optional[str] = None
@@ -72,9 +77,9 @@ class HealthMachine:
         ]
 
     def to(self, state: str, reason: str = "") -> bool:
-        """Transition (emitting ``serve_health``); returns False when the
-        machine is already there (idempotent re-entry is not an error —
-        DEGRADED may be requested per failed batch)."""
+        """Transition (emitting the machine's transition event); returns
+        False when the machine is already there (idempotent re-entry is not
+        an error — DEGRADED may be requested per failed batch)."""
         if state == self.state:
             return False
         if state not in _ALLOWED[self.state]:
@@ -86,7 +91,7 @@ class HealthMachine:
         self.reason = reason or None
         self.history.append(
             {"state": state, "t": self.since, "reason": reason or None})
-        obs_events.emit("serve_health", state=state, reason=reason or None)
+        obs_events.emit(self.event, state=state, reason=reason or None)
         return True
 
     @property

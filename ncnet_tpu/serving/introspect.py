@@ -22,6 +22,12 @@ surface: a stdlib ``http.server`` thread bolted onto a running
     consumes to route on per-host health/capacity/latency.
   * ``GET /statusz``  — the human page: replica table, bucket ladder,
     queue/active-request counts, SLO burn, recent health timeline.
+  * ``POST /match``   — the wire DATA plane (``serving/wire.py``): one
+    framed uint8 pair in, the classified terminal outcome (match+quality
+    table, or overloaded/deadline/quarantined) out, with the edge's
+    deadline budget and client identity propagated into this service's
+    admission control.  This is the endpoint the multi-host
+    ``serving/router.py::MatchRouter`` fans out to.
 
 Fail-open like every telemetry layer: the server runs on daemon threads, a
 handler exception answers 500 instead of propagating, ``start()`` failures
@@ -292,7 +298,8 @@ class _Handler(BaseHTTPRequestHandler):
                 body = intro.statusz_text()
             elif path == "/":
                 code, ctype = 200, "text/plain; charset=utf-8"
-                body = "endpoints: /metrics /healthz /statusz\n"
+                body = "endpoints: /metrics /healthz /statusz " \
+                    "(+ POST /match)\n"
             else:
                 code, ctype, body = 404, "text/plain; charset=utf-8", \
                     f"no such endpoint {path}; try /metrics /healthz " \
@@ -301,8 +308,33 @@ class _Handler(BaseHTTPRequestHandler):
             # renderer bug answers 500, it never propagates into serving
             code, ctype = 500, "text/plain; charset=utf-8"
             body = f"introspection error: {type(e).__name__}: {e}\n"
+        self._respond(code, ctype, body.encode("utf-8"))
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server contract
+        """The wire data plane (serving/wire.py): ``POST /match`` admits
+        one framed request against the fronted service/router and blocks
+        this connection's thread until its terminal outcome — the
+        multi-host twin of a local ``submit(...).result()``."""
+        intro = getattr(self.server, "introspect", None)
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if intro is None or path != "/match":
+            self._respond(503 if intro is None else 404,
+                          "text/plain; charset=utf-8",
+                          b"POST accepts only /match\n")
+            return
         try:
-            payload = body.encode("utf-8")
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n) if n > 0 else b""
+            code, ctype, payload = intro.match_payload(body)
+        except Exception as e:  # noqa: BLE001 — same fail-open contract
+            # as do_GET: a data-plane handler bug answers 500
+            code, ctype = 500, "text/plain; charset=utf-8"
+            payload = f"match error: {type(e).__name__}: {e}\n" \
+                .encode("utf-8")
+        self._respond(code, ctype, payload)
+
+    def _respond(self, code: int, ctype: str, payload: bytes) -> None:
+        try:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
@@ -379,3 +411,14 @@ class IntrospectionServer:
 
     def statusz_text(self) -> str:
         return render_statusz(self._service)
+
+    def match_payload(self, body: bytes):
+        """``POST /match`` body → ``(status, content_type, payload)`` —
+        one wire request submitted to the fronted service with the
+        propagated deadline budget + client identity
+        (``serving/wire.py::serve_match``).  The router's introspection
+        plane inherits this unchanged, so a router is itself a valid wire
+        backend (tiers chain)."""
+        from ncnet_tpu.serving.wire import serve_match
+
+        return serve_match(self._service.submit, body)

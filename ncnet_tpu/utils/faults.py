@@ -73,6 +73,15 @@ engine's ResilientJit carries label ``serve_batch``) and
     with zero lost requests) or slows (``slow_replica_ids``: a per-fetch
     sleep the health-scored router must de-prioritize) individual pool
     replicas.
+  * ``backend_fault_hook(url, phase)`` — serving/wire.py MatchClient: the
+    multi-host twin of the replica hook — kills (``dead_backend_urls``:
+    ConnectionError until cleared, the backend-process-death shape the
+    router must fail over across) or stalls (``hang_backend_urls``: a
+    pre-send sleep whose late result must classify DeadlineExceeded, not
+    land as a zombie success) individual wire backends.  Real process
+    kills and real socket hangs are exercised by tests/test_router.py
+    against spawned ``tools/serve_backend.py`` processes; this hook is the
+    in-process deterministic seam.
 
 Arming: programmatic via :func:`install`/:func:`clear` (or the
 :func:`injected` context manager) in-process, or the ``NCNET_TPU_FAULTS``
@@ -167,6 +176,19 @@ class FaultPlan:
     # degraded-chip shape the health-scored router must de-prioritize
     slow_replica_ids: Tuple[str, ...] = ()
     slow_replica_seconds: float = 0.25
+    # --- multi-host router faults (ncnet_tpu/serving/wire.py layer) ---
+    # backend base-url substrings whose wire sends raise ConnectionError —
+    # the cross-process chip-death shape WITHOUT a real process to kill
+    # (the chaos suite also SIGKILLs real serve_backend processes; this
+    # hook covers the in-process router tests): the backend stays dead
+    # until the plan is cleared, then a /healthz probe resurrects it
+    dead_backend_urls: Tuple[str, ...] = ()
+    # backend base-url substrings whose wire sends sleep
+    # hang_backend_seconds BEFORE the request leaves — the slow-network /
+    # stalled-peer shape: a response landing after the edge budget must
+    # classify DeadlineExceeded, never a zombie success
+    hang_backend_urls: Tuple[str, ...] = ()
+    hang_backend_seconds: float = 0.5
 
 
 _plan: Optional[FaultPlan] = None
@@ -384,6 +406,25 @@ def replica_fault_hook(replica_id: str, phase: str) -> None:
         raise InjectedDeviceError(
             f"injected replica death ({replica_id}, {phase})"
         )
+
+
+def backend_fault_hook(base_url: str, phase: str) -> None:
+    """The multi-host chaos seam (serving/wire.py MatchClient.match).
+
+    ``hang_backend_urls`` sleep before the request leaves — the stalled-
+    peer shape whose late result the router's post-flight deadline check
+    must classify.  ``dead_backend_urls`` raise ``ConnectionError`` — a
+    backend-process death without a process: the router must re-route
+    off-budget, quarantine the BACKEND after its failure streak, and
+    resurrect it via a /healthz probe once the plan clears."""
+    p = _active()
+    if p is None:
+        return
+    if any(s and s in base_url for s in p.hang_backend_urls):
+        time.sleep(p.hang_backend_seconds)
+    if any(s and s in base_url for s in p.dead_backend_urls):
+        raise ConnectionError(
+            f"injected backend death ({base_url}, {phase})")
 
 
 def queue_overflow_burst(submit: Callable[[], object], n: int):

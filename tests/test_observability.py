@@ -672,10 +672,18 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # observability/export.py RENDER the scrape payloads and serve_top is
     # a stdout-document tool — a bare print in any of them would corrupt
     # an exposition document or the tool's parseable output)
+    # (the ISSUE 12 multi-host modules are pinned explicitly for the same
+    # reason: wire.py FRAMES the data-plane payloads and router.py runs
+    # inside the routing hot path — a bare print in either corrupts a wire
+    # exchange or reopens the side channel.  tools/serve_backend.py is NOT
+    # pinned: like the other tools' CLIs its stdout IS its interface — the
+    # one startup JSON line spawners block on)
     for target in ("ncnet_tpu/observability/quality.py",
                    "ncnet_tpu/observability/export.py",
                    "ncnet_tpu/serving",
                    "ncnet_tpu/serving/introspect.py",
+                   "ncnet_tpu/serving/router.py",
+                   "ncnet_tpu/serving/wire.py",
                    "tools/quality_drift.py",
                    "tools/serve_probe.py",
                    "tools/serve_top.py"):

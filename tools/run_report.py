@@ -396,6 +396,140 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
     }
 
 
+def build_router_section(events: List[dict]) -> Dict[str, Any]:
+    """The router-tier postmortem (the PR 12 multi-host twin of
+    :func:`build_serving_section`): the outcome-total identity recomputed
+    at the ROUTER level (``route_admit == route_result + admitted
+    route_deadline + route_quarantine + admitted route_shed``; nonzero
+    ``unresolved`` = edge requests that died without an outcome),
+    backend-tagged accounting (requests/results/retries/backpressure/
+    latency per backend id, deaths and resurrections from the
+    ``route_backend`` lifecycle events), shed/deadline classification, and
+    the router health timeline."""
+    admits = [e for e in events if e.get("event") == "route_admit"]
+    results = [e for e in events if e.get("event") == "route_result"]
+    deadlines = [e for e in events if e.get("event") == "route_deadline"
+                 and e.get("admitted") is not False]
+    quarantines = [e for e in events
+                   if e.get("event") == "route_quarantine"]
+    sheds = [e for e in events if e.get("event") == "route_shed"]
+    sheds_admitted = [e for e in sheds if e.get("admitted") is True]
+    terminals = (len(results) + len(deadlines) + len(quarantines)
+                 + len(sheds_admitted))
+
+    def _key(e: dict):
+        # keyed (run, request) like the serving section: router request
+        # ids restart at q1 per process and a restarted router appends to
+        # the same log
+        return (e.get("run"), e.get("request"))
+
+    settled = {_key(e) for e in results + quarantines}
+    settled |= {_key(e) for e in deadlines}
+    settled |= {_key(e) for e in sheds_admitted}
+    lost = [f"{e.get('request')} (run {e.get('run')})" for e in admits
+            if _key(e) not in settled]
+
+    lat_all = [e["wall_ms"] for e in results
+               if isinstance(e.get("wall_ms"), (int, float))]
+    shed_reasons: Dict[str, int] = {}
+    for e in sheds:
+        r = str(e.get("reason", "unknown"))
+        shed_reasons[r] = shed_reasons.get(r, 0) + 1
+    deadline_where: Dict[str, int] = {}
+    for e in [e for e in events if e.get("event") == "route_deadline"]:
+        w = str(e.get("where", "unknown"))
+        deadline_where[w] = deadline_where.get(w, 0) + 1
+
+    # backend-tagged accounting: results/latency per backend, retries and
+    # backpressure from the scope="router" retry events, lifecycle from
+    # route_backend, probes from route_backend_probe
+    backends: Dict[str, Dict[str, Any]] = {}
+
+    def _bk(bid) -> Dict[str, Any]:
+        return backends.setdefault(str(bid), {
+            "results": 0, "latencies": [], "backend_wall_ms": [],
+            "retries": 0, "backpressure": 0, "deaths": 0,
+            "resurrections": 0, "draining": 0, "probes": 0,
+        })
+
+    for e in results:
+        if e.get("backend") is not None:
+            b = _bk(e["backend"])
+            b["results"] += 1
+            if isinstance(e.get("wall_ms"), (int, float)):
+                b["latencies"].append(e["wall_ms"])
+            if isinstance(e.get("backend_wall_ms"), (int, float)):
+                b["backend_wall_ms"].append(e["backend_wall_ms"])
+    for e in events:
+        ev, bid = e.get("event"), e.get("backend")
+        if bid is None:
+            continue
+        if ev == "retry" and e.get("scope") == "router":
+            b = _bk(bid)
+            if e.get("via") == "backpressure":
+                b["backpressure"] += 1
+            else:
+                b["retries"] += 1
+        elif ev == "route_backend" and e.get("state") == "DEAD":
+            _bk(bid)["deaths"] += 1
+        elif ev == "route_backend" and e.get("state") == "READY":
+            _bk(bid)["resurrections"] += 1
+        elif ev == "route_backend" and e.get("state") == "DRAINING":
+            _bk(bid)["draining"] += 1
+        elif ev == "route_backend_probe":
+            _bk(bid)["probes"] += 1
+    backend_table = {}
+    for bid, b in sorted(backends.items()):
+        backend_table[bid] = {
+            "results": b["results"],
+            "latency_ms": _percentiles(b["latencies"]),
+            # the fan-out overhead evidence: edge wall minus the wall the
+            # backend itself reported for the same requests
+            "backend_wall_ms": _percentiles(b["backend_wall_ms"]),
+            "retries": b["retries"],
+            "backpressure": b["backpressure"],
+            "deaths": b["deaths"],
+            "resurrections": b["resurrections"],
+            "draining": b["draining"],
+            "probes": b["probes"],
+        }
+
+    return {
+        "outcomes": {
+            "admitted": len(admits),
+            "results": len(results),
+            "deadline_exceeded": len(deadlines),
+            "quarantined": len(quarantines),
+            "shed_admitted": len(sheds_admitted),
+            "shed_at_admission": len(sheds) - len(sheds_admitted),
+            "terminals": terminals,
+            "unresolved": max(0, len(admits) - terminals),
+        },
+        "lost_requests": lost,
+        "latency_ms": _percentiles(lat_all),
+        "shed_reasons": shed_reasons,
+        "deadline_where": deadline_where,
+        "backends": backend_table,
+        "final_health_doc": next(
+            (e.get("doc") for e in reversed(events)
+             if e.get("event") == "route_health_doc"
+             and isinstance(e.get("doc"), dict)), None),
+        "health_timeline": [
+            {"t": e.get("t"), "state": e.get("state"),
+             "reason": e.get("reason"),
+             **({"backend": e["backend"]}
+                if e.get("backend") is not None else {})}
+            for e in events
+            if e.get("event") in ("route_health", "route_backend")
+        ],
+        "drains": [
+            {k: e.get(k) for k in e
+             if k.startswith("n_") or k in ("t", "drained", "leftover")}
+            for e in events if e.get("event") == "route_drain"
+        ],
+    }
+
+
 def build_report(paths: List[str],
                  quality_ref: Optional[str] = None) -> Dict[str, Any]:
     """Aggregate one report dict over every given event log."""
@@ -517,6 +651,8 @@ def build_report(paths: List[str],
     if any(str(e.get("event", "")).startswith("serve_") for e in events):
         report["serving"] = build_serving_section(events)
         report["slo"] = build_slo_section(events)
+    if any(str(e.get("event", "")).startswith("route_") for e in events):
+        report["router"] = build_router_section(events)
     if any(e.get("event") == "quality" for e in events):
         device_kind = next(
             (r["header"].get("device_kind") for r in runs
@@ -675,6 +811,73 @@ def render_serving(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_router(report: Dict[str, Any]) -> str:
+    rt = report.get("router")
+    if not rt:
+        return "(no router events in the log)"
+    lines = ["router (multi-host tier):"]
+    o = rt["outcomes"]
+    lines.append(
+        f"  outcomes: admitted={o['admitted']}  results={o['results']}  "
+        f"deadline={o['deadline_exceeded']}  quarantined={o['quarantined']}"
+        f"  shed_admitted={o['shed_admitted']}  "
+        f"shed_at_admission={o['shed_at_admission']}")
+    if o["unresolved"]:
+        lines.append(
+            f"  UNRESOLVED: {o['unresolved']} admitted request(s) died "
+            f"without an outcome: "
+            f"{', '.join(str(r) for r in rt['lost_requests'][:16])}")
+    else:
+        lines.append("  outcome-total: every admitted request reached "
+                     "exactly one terminal outcome")
+    if rt["latency_ms"]:
+        lines.append(f"  latency:  {_fmt_stats(rt['latency_ms'], 'ms')}")
+    if rt["shed_reasons"]:
+        lines.append("  shed by reason: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(rt["shed_reasons"].items())))
+    if rt["deadline_where"]:
+        lines.append("  deadlines by checkpoint: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(rt["deadline_where"].items())))
+    if rt.get("backends"):
+        lines.append("  backends:")
+        for bid, b in rt["backends"].items():
+            chaos = ""
+            if b["deaths"] or b["resurrections"] or b["draining"]:
+                chaos = (f"  deaths={b['deaths']} "
+                         f"resurrections={b['resurrections']} "
+                         f"draining={b['draining']} probes={b['probes']}")
+            lines.append(
+                f"    {bid}: results={b['results']}  retries={b['retries']}"
+                f"  backpressure={b['backpressure']}{chaos}")
+            if b["latency_ms"]:
+                lines.append(
+                    f"      edge latency {_fmt_stats(b['latency_ms'], 'ms')}")
+            if b["backend_wall_ms"]:
+                lines.append(
+                    f"      backend wall "
+                    f"{_fmt_stats(b['backend_wall_ms'], 'ms')} "
+                    "(edge minus this = fan-out overhead)")
+    if rt["health_timeline"]:
+        lines.append("  health timeline:")
+        for h in rt["health_timeline"]:
+            who = f"[{h['backend']}] " if h.get("backend") else ""
+            lines.append(f"    -> {who}{h['state']}"
+                         + (f"  ({h['reason']})" if h.get("reason") else ""))
+    for d in rt["drains"]:
+        lines.append(f"  drain: drained={d.get('drained')} "
+                     f"leftover={d.get('leftover')}")
+    fh = rt.get("final_health_doc")
+    if fh:
+        pod = fh.get("pod", {})
+        lines.append(
+            f"  final health doc (schema {fh.get('schema')}): "
+            f"state={fh.get('state')}  pod "
+            f"{pod.get('ready')}/{pod.get('total')} backends ready "
+            f"({pod.get('replicas_ready')}/{pod.get('replicas_total')} "
+            f"replica units)  counters={fh.get('counters')}")
+    return "\n".join(lines)
+
+
 def render_slo(report: Dict[str, Any]) -> str:
     s = report.get("slo")
     if not s or not s["admitted"]:
@@ -803,7 +1006,10 @@ def main(argv=None) -> int:
                     help="append the serving section: request-outcome "
                          "accounting (the outcome-total invariant), "
                          "per-bucket latency, queue-depth trajectory, "
-                         "health-state timeline")
+                         "health-state timeline — plus the router section "
+                         "(backend-tagged accounting, the outcome-total "
+                         "identity recomputed at the router level) when "
+                         "the log holds route_* events")
     ap.add_argument("--slo", action="store_true",
                     help="append the SLO section: error-budget counters "
                          "recomputed from the log (objectives from "
@@ -829,6 +1035,9 @@ def main(argv=None) -> int:
         if args.serving:
             print()
             print(render_serving(report))
+            if report.get("router"):
+                print()
+                print(render_router(report))
         if args.slo:
             print()
             print(render_slo(report))

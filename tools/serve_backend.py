@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Run ONE per-host match backend: a resident ``MatchService`` behind the
+introspection server's ``/healthz``/``/metrics`` control plane and
+``POST /match`` wire data plane (``ncnet_tpu/serving/wire.py``).
+
+This is the process a ``serving/router.py::MatchRouter`` fans out to — and
+the process the multi-host chaos suite (tests/test_router.py) SIGKILLs,
+restarts, and drains.  Lifecycle contract:
+
+  * on start it prints exactly ONE JSON line to stdout —
+    ``{"url": "http://host:port", "pid": ...}`` — and nothing else
+    (spawners block on that line to learn the ephemeral port);
+  * SIGTERM begins the coordinated drain: the service finishes admitted
+    work while its ``/healthz`` answers 503, so the fronting router
+    demotes this host out of routing BEFORE the drain completes; the
+    process exits 0 once STOPPED;
+  * a fixed ``--port`` supports the restart-in-place shape (a supervisor
+    reviving a killed host at the same address, which the router's
+    resurrection probes then re-admit).
+
+Engines: ``--tiny`` builds the real tiny-backbone model (CPU-honest walls,
+pays one small compile); ``--fake-engine`` substitutes the chaos suite's
+deterministic fake device (``--latency`` per batch) so process-level fault
+tests run with zero compiles.  ``--events`` binds the host's own event log
+(torn-tail tolerant, so a SIGKILLed host's log still replays).
+
+Usage::
+
+    python tools/serve_backend.py [--port 0] [--host 127.0.0.1]
+        [--tiny | --fake-engine] [--replicas N] [--latency 0.02]
+        [--max-queue 64] [--max-batch 4] [--events events.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+class FakeEngine:
+    """The chaos suite's device stand-in (tests/test_serving_pool.py
+    protocol): real service/replica code paths, no jit compiles — what the
+    process-kill chaos chain runs so spawning 3 hosts costs milliseconds,
+    not compiles."""
+
+    half_precision = False
+
+    def __init__(self, latency_s: float = 0.02):
+        self.latency_s = latency_s
+
+    @staticmethod
+    def split(table):
+        from ncnet_tpu.serving import BatchMatchEngine
+
+        return BatchMatchEngine.split(table)
+
+    def dispatch(self, src, tgt):
+        from ncnet_tpu.utils import faults
+
+        faults.device_error_hook("fake_serve")
+        return (src.shape[0], time.monotonic())
+
+    def fetch(self, handle):
+        import numpy as np
+
+        b, t0 = handle
+        while time.monotonic() - t0 < self.latency_s:
+            time.sleep(0.005)
+        table = np.zeros((b, 6, 16), np.float32)
+        table[:, 4, :] = 1.0
+        table[:, 5, :5] = [0.5, 0.1, 0.4, 0.9, 0.8]
+        return table
+
+    def retrace(self):
+        pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="One per-host match backend: MatchService + /healthz "
+                    "control plane + /match wire data plane")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="introspection/data-plane port (0 = ephemeral, "
+                         "printed in the startup JSON line; fixed for the "
+                         "restart-in-place shape)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="real tiny-backbone engines (CPU-honest walls)")
+    ap.add_argument("--fake-engine", action="store_true",
+                    help="deterministic fake device (no compiles) — the "
+                         "process-level chaos configuration")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engines in this host's pool (fake or real)")
+    ap.add_argument("--latency", type=float, default=0.02,
+                    help="fake-engine seconds per batch")
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--bucket-side", type=int, default=32,
+                    help="square bucket side (fixed single-bucket ladder)")
+    ap.add_argument("--events", default=None,
+                    help="bind this host's event log here (torn-tail "
+                         "tolerant across SIGKILL)")
+    args = ap.parse_args(argv)
+    if args.tiny == args.fake_engine:
+        ap.error("give exactly one of --tiny / --fake-engine")
+
+    from ncnet_tpu.observability import events as obs_events
+    from ncnet_tpu.serving import MatchService, ServingConfig
+
+    if args.events:
+        from ncnet_tpu.observability import EventLog
+
+        obs_events.set_global_sink(EventLog(args.events))
+
+    side = int(args.bucket_side)
+    serving_kw = dict(
+        max_queue=args.max_queue, max_batch=args.max_batch,
+        max_in_flight_per_client=max(args.max_queue, 64),
+        bucket_multiple=side, max_image_side=side,
+        buckets=((side, side),), max_buckets=2,
+        install_sigterm=True,
+        introspect_host=args.host, introspect_port=args.port,
+    )
+    if args.fake_engine:
+        engines = [FakeEngine(latency_s=args.latency)
+                   for _ in range(max(1, args.replicas))]
+        service = MatchService(engine=engines,
+                               serving=ServingConfig(**serving_kw))
+    else:
+        import warnings
+
+        import jax
+
+        from ncnet_tpu import models
+        from ncnet_tpu.config import ModelConfig
+
+        cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                          ncons_channels=(1,), half_precision=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # random trunk: serving only
+            params = models.init_ncnet(cfg, jax.random.key(0))
+        service = MatchService(cfg, params, ServingConfig(
+            **serving_kw, replicas=max(1, args.replicas),
+            warm_buckets=((side, side),)))
+
+    service.start()
+    if service.introspect_url is None:
+        print(json.dumps({"error": f"failed to bind {args.host}:"
+                          f"{args.port}"}), flush=True)
+        service.stop()
+        return 1
+    print(json.dumps({"url": service.introspect_url, "pid": os.getpid()}),
+          flush=True)
+    # serve until a drain (SIGTERM via the service's handler, or a stop()
+    # from another thread) runs to completion; the poll keeps the main
+    # thread interruptible for the signal handler
+    try:
+        while service.state != "STOPPED":
+            time.sleep(0.1)
+            if service.state == "DRAINING":
+                # join the worker's drain so exit is clean and ordered
+                service.stop()
+    except KeyboardInterrupt:
+        service.stop(drain=False)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,15 +25,26 @@ tier-1 suite cannot make honestly:
      The numbers feed the bench's ``serve_capacity_qps_r{k}`` perf-store
      family.
 
+  5. **Multi-host router walls** (``--router N``) — spawns N REAL backend
+     subprocesses (CPU-forced host devices, tiny arch — the fan-out
+     overhead is wire+routing, which is exactly what this phase prices)
+     behind a ``serving/router.py::MatchRouter`` and sweeps the pod's
+     walls: closed-loop capacity through the router, the failover pause
+     around a SIGKILLed backend mid-stream (with the zero-lost outcome
+     accounting), and the shed wall under a paced over-capacity burst.
+     ``--router`` replaces the local-service phases — it measures the pod
+     tier, not this process's devices.
+
 Usage::
 
     python tools/serve_probe.py [--sides 400,512] [--pairs 48] [--tiny]
         [--no-demote] [--burst-factor 3.0] [--replicas 1,2,4]
-        [--json out.json]
+        [--router N] [--json out.json]
 
 ``--tiny`` runs the CPU-sized smoke configuration (tiny backbone, 64 px) so
-the probe's own plumbing is testable without a TPU.  Output: one JSON
-document (stdout, plus ``--json`` path).
+the probe's own plumbing is testable without a TPU (``--router N --tiny``
+is the tier-1 smoke of the whole pod tier).  Output: one JSON document
+(stdout, plus ``--json`` path).
 """
 
 from __future__ import annotations
@@ -256,6 +267,154 @@ def probe(sides: List[int], n_pairs: int, tiny: bool, demote: bool,
     return out
 
 
+def spawn_backends(n: int, side: int, *, fake: bool = False,
+                   latency_s: float = 0.02, max_queue: int = 64):
+    """Spawn ``n`` serve_backend subprocesses (CPU-forced — the pod tier's
+    fan-out overhead is wire+routing, measured honestly off-device) and
+    block for their startup JSON lines.  Returns ``[(Popen, url), ...]``;
+    the caller owns teardown (:func:`stop_backends`)."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "serve_backend.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off")
+    procs = []
+    for _ in range(n):
+        cmd = [sys.executable, script, "--bucket-side", str(side),
+               "--max-queue", str(max_queue)]
+        cmd += ["--fake-engine", "--latency", str(latency_s)] if fake \
+            else ["--tiny"]
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, text=True, env=env))
+    out = []
+    try:
+        for p in procs:
+            line = p.stdout.readline()
+            doc = json.loads(line) if line.strip() else {}
+            if "url" not in doc:
+                raise RuntimeError(f"backend failed to start: {doc}")
+            out.append((p, doc["url"]))
+    except Exception:
+        # ANY startup failure (bad bind, unparseable line) must kill the
+        # WHOLE spawn set — including children not read yet — or orphaned
+        # resident backends keep burning CPU under every later metric
+        for p in procs:
+            p.kill()
+        raise
+    return out
+
+
+def stop_backends(procs) -> None:
+    import signal as _signal
+
+    for p, _ in procs:
+        if p.poll() is None:
+            p.send_signal(_signal.SIGTERM)
+    for p, _ in procs:
+        try:
+            p.wait(timeout=20)
+        except Exception:  # noqa: BLE001 — a wedged child gets the axe
+            p.kill()
+
+
+def probe_router(n_backends: int, side: int, n_pairs: int,
+                 burst_factor: float, tiny: bool) -> Dict[str, Any]:
+    """The pod-tier sweep: capacity/failover/shed walls through a real
+    ``MatchRouter`` over ``n_backends`` spawned backend processes."""
+    import numpy as np
+
+    from ncnet_tpu.serving import MatchRouter, RouterConfig
+    from ncnet_tpu.utils.faults import paced_burst
+
+    side = min(side, 64) if tiny else side
+    procs = spawn_backends(n_backends, side)
+    rng = np.random.default_rng(0)
+
+    def pair():
+        return (rng.integers(0, 255, (side, side, 3), dtype=np.uint8),
+                rng.integers(0, 255, (side, side, 3), dtype=np.uint8))
+
+    out: Dict[str, Any] = {"backends": n_backends, "side": side,
+                           "n_pairs": n_pairs}
+    router = None
+    try:
+        # router construction INSIDE the try: a ctor/start failure must
+        # still SIGTERM the spawned backend processes
+        router = MatchRouter(
+            [url for _, url in procs],
+            RouterConfig(probe_period_s=0.5, resurrect_after_s=0.5,
+                         max_queue=max(2 * n_pairs, 64),
+                         max_in_flight_per_client=max(2 * n_pairs, 64)),
+        ).start()
+        pairs = [pair() for _ in range(8)]
+        # 1. closed-loop capacity through the router
+        t0 = time.perf_counter()
+        futs = [router.submit(*pairs[i % 8]) for i in range(n_pairs)]
+        walls = [f.result(timeout=600).wall_s * 1e3 for f in futs]
+        span = time.perf_counter() - t0
+        cap_qps = n_pairs / span
+        out["capacity_qps"] = round(cap_qps, 2)
+        out["latency_ms"] = _percentiles(walls)
+
+        # 2. failover wall: SIGKILL one backend mid-stream, measure the
+        # serving pause and prove zero lost admitted requests
+        if n_backends > 1:
+            victim_proc, victim_url = procs[0]
+            futs = [router.submit(*pairs[i % 8])
+                    for i in range(max(n_pairs, 16))]
+            victim_proc.kill()  # SIGKILL: no drain, no goodbye
+            ticks, lost = [], 0
+            t0 = time.perf_counter()
+            for f in futs:
+                try:
+                    f.result(timeout=600)
+                except Exception:  # noqa: BLE001 — classified outcomes
+                    pass
+                if f.outcome is None:
+                    lost += 1
+                ticks.append(time.perf_counter())
+            gaps = np.diff(np.asarray([t0] + ticks))
+            out["failover"] = {
+                "killed": victim_url,
+                "lost": lost,
+                "pause_ms": round(float(np.max(gaps)) * 1e3, 1),
+                "median_gap_ms": round(float(np.median(gaps)) * 1e3, 1),
+                "router_state": router.state,
+                "backend_states": {b.id: b.state
+                                   for b in router.backends},
+            }
+
+        # 3. shed wall: paced burst at burst_factor x the measured
+        # capacity (the paced_burst docstring explains the gate-soundness)
+        p0 = pair()
+        burst_rate = max(cap_qps * burst_factor, 1.0)
+        n_burst = max(int(burst_rate * 2), 32)
+        futs_b, sheds = paced_burst(
+            lambda: router.submit(*p0), burst_rate, n_burst)
+        lat = []
+        for f in futs_b:
+            try:
+                lat.append(f.result(timeout=600).wall_s * 1e3)
+            except Exception:  # noqa: BLE001 — shed accounting below
+                pass
+        out["burst"] = {
+            "offered": n_burst,
+            "rate_qps": round(burst_rate, 2),
+            "shed_pct": round(100.0 * len(sheds) / n_burst, 2),
+            "admitted_latency_ms": _percentiles(lat),
+            "retry_after_s": (round(sheds[0].retry_after_s, 3)
+                              if sheds and sheds[0].retry_after_s
+                              else None),
+        }
+        out["health"] = router.health()
+    finally:
+        if router is not None:
+            router.stop()
+        stop_backends(procs)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Probe the resident match service on the attached "
@@ -276,6 +435,12 @@ def main(argv=None) -> int:
                          "(default 1 = no sweep); run on a multi-chip host "
                          "with one replica per visible device — e.g. "
                          "--replicas 1,2,4 on a v5e-4")
+    ap.add_argument("--router", type=int, default=0,
+                    help="spawn N backend subprocesses (CPU-forced) behind "
+                         "a MatchRouter and sweep the POD tier instead of "
+                         "the local service: capacity through the router, "
+                         "the SIGKILL failover pause + zero-lost "
+                         "accounting, and the shed wall")
     ap.add_argument("--json", default=None, help="also write the JSON here")
     args = ap.parse_args(argv)
 
@@ -290,8 +455,13 @@ def main(argv=None) -> int:
     try:
         sides = [int(s) for s in args.sides.split(",") if s]
         replicas = [int(r) for r in args.replicas.split(",") if r] or [1]
-        out = probe(sides, args.pairs, args.tiny, not args.no_demote,
-                    args.burst_factor, replicas=replicas)
+        if args.router > 0:
+            out = {"router": probe_router(
+                args.router, sides[0], args.pairs, args.burst_factor,
+                args.tiny)}
+        else:
+            out = probe(sides, args.pairs, args.tiny, not args.no_demote,
+                        args.burst_factor, replicas=replicas)
     finally:
         if level_was_unset:
             os.environ.pop("NCNET_TPU_LOG_LEVEL", None)

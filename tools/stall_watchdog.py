@@ -37,6 +37,14 @@ degrades to ``--min-age`` alone.  An unreachable endpoint maps to the
 ``missing`` verdict (exit 2) — "not started or already gone", the same
 supervisor semantics as a missing heartbeat file.
 
+``--url`` also judges a multi-host **router** (``serving/router.py``): the
+primary signal is the router document's aggregate ``activity.age_s``
+(advances when ANY backend settles a result), and the document's
+per-backend rows (``last_result_age_s`` vs a threshold from each
+backend's own wall EWMA) ship as a staleness breakdown — a recent backend
+overrides a stale aggregate, the router-tier mirror of the per-replica
+backstop, with no shared filesystem needed.
+
 Usage::
 
     python tools/stall_watchdog.py <telemetry_dir>/heartbeat.json
@@ -151,6 +159,44 @@ def _apply_replica_backstop(verdict: Dict[str, Any], events_path: str,
         verdict["alive_via"] = alive_via
 
 
+def _apply_backend_backstop(verdict: Dict[str, Any], doc: Dict[str, Any],
+                            factor: float, min_age: float) -> None:
+    """The router-tier backstop (mirror of the PR 10 per-replica one, but
+    sourced from the ``/healthz`` document itself — cross-host, no shared
+    filesystem needed): a router document carries per-backend rows with
+    ``last_result_age_s`` and the backend's wall EWMA, so the verdict
+    ships a per-backend staleness breakdown and a RECENT backend overrides
+    a stale aggregate activity stamp — one wedged host cannot flag a
+    healthy pod STALLED."""
+    rows = (doc.get("pod") or {}).get("backends")
+    if not isinstance(rows, list) or not rows:
+        return
+    backends: Dict[str, Any] = {}
+    alive_via = None
+    for row in rows:
+        if not isinstance(row, dict) or row.get("id") is None:
+            continue
+        ewma_ms = row.get("ewma_wall_ms")
+        threshold = max(min_age, factor * ewma_ms / 1e3) \
+            if isinstance(ewma_ms, (int, float)) and ewma_ms > 0 else min_age
+        age = row.get("last_result_age_s")
+        recent = isinstance(age, (int, float)) and age <= threshold
+        backends[str(row["id"])] = {
+            "state": row.get("state"),
+            "last_result_age_s": age if isinstance(age, (int, float))
+            else None,
+            "threshold_s": round(threshold, 3),
+            "recent": recent,
+        }
+        if verdict["status"] == "stalled" and recent and alive_via is None:
+            alive_via = f"backend_cadence:{row['id']}"
+            verdict["status"] = "alive"
+    if backends:
+        verdict["backends"] = backends
+    if alive_via:
+        verdict["alive_via"] = alive_via
+
+
 def judge_url(url: str, events_path: Optional[str] = None,
               factor: float = 10.0, min_age: float = 60.0,
               timeout: float = 5.0) -> Dict[str, Any]:
@@ -158,7 +204,12 @@ def judge_url(url: str, events_path: Optional[str] = None,
     signal is ``/healthz``'s ``activity.age_s`` (seconds since the pool
     last dispatched or deliberately idled — the HTTP twin of the heartbeat
     mtime), thresholded by the event-log cadence when one is readable.
-    Unreachable ⇒ ``missing`` (exit 2), same as a missing heartbeat file."""
+    Judges a ``MatchRouter``'s document the same way (its aggregate
+    activity stamp advances on any backend's result), plus a per-backend
+    staleness breakdown read from the document's backend rows — the
+    cross-host mirror of the per-replica backstop, so one wedged host
+    cannot flag a healthy pod STALLED.  Unreachable ⇒ ``missing``
+    (exit 2), same as a missing heartbeat file."""
     import json as _json
     import urllib.error
     import urllib.request
@@ -191,6 +242,7 @@ def judge_url(url: str, events_path: Optional[str] = None,
         "mode": "url",
         "url": base,
         "state": doc.get("state"),
+        "role": doc.get("role", "service"),
         "age_s": round(float(age), 3),
         "threshold_s": round(threshold, 3),
         "median_step_wall_s": round(median, 6) if median else None,
@@ -200,6 +252,7 @@ def judge_url(url: str, events_path: Optional[str] = None,
     }
     if events_path:
         _apply_replica_backstop(verdict, events_path, factor, min_age)
+    _apply_backend_backstop(verdict, doc, factor, min_age)
     return verdict
 
 
@@ -311,6 +364,11 @@ def main(argv=None) -> int:
             print(f"  replica {rid}: last batch "
                   f"{r['last_batch_age_s']}s ago vs {r['threshold_s']}s "
                   f"({tag}; n={r['n']})")
+        for bid, b in (verdict.get("backends") or {}).items():
+            tag = "fresh" if b["recent"] else "wedged/idle"
+            print(f"  backend {bid} [{b.get('state')}]: last result "
+                  f"{b['last_result_age_s']}s ago vs {b['threshold_s']}s "
+                  f"({tag})")
     return {"alive": 0, "missing": 2, "stalled": 3}[verdict["status"]]
 
 
