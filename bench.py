@@ -208,7 +208,20 @@ def bench_jax(res=None):
             fwd16 = jax.jit(
                 lambda p, s, t: models.ncnet_forward(cfg16, p, s, t).corr
             )
-            cost = fwd16.lower(params, src, src).compile().cost_analysis()
+            compiled16 = fwd16.lower(params, src, src).compile()
+            cost = compiled16.cost_analysis()
+            # memory ledger of the bench forward (observability/memory.py):
+            # XLA's own accounting — temp bytes are the serving-relevant
+            # per-program footprint, gated lower-is-better by perf_regress
+            from ncnet_tpu.observability import memory as obs_memory
+
+            mem16 = obs_memory.analysis_dict(compiled16)
+            if mem16 and mem16.get("temp_bytes") is not None:
+                res["mem_forward_temp_bytes"] = mem16["temp_bytes"]
+                obs_memory.record_program(
+                    "bench_forward",
+                    f"{IMAGE}x{IMAGE}xb{BATCH}", analysis=compiled16,
+                    tier="bf16", source="bench")
             flops = float(cost.get("flops", 0.0))
             kind = jax.devices()[0].device_kind
             peak = _PEAK_TFLOPS.get(kind)
@@ -486,6 +499,39 @@ def bench_jax(res=None):
                 )
         except Exception:
             pass
+
+    # memory ledger of the bf16 FILTER stage alone (one AOT analysis
+    # compile; the measured twin of the roofline's accounted bytes): temp
+    # bytes here are the 4D-volume working set items 2-3 of the roadmap
+    # promise to shrink — the series their PRs will gate against
+    def _filter_memory():
+        from ncnet_tpu.models.ncnet import ncnet_filter
+        from ncnet_tpu.observability import memory as obs_memory
+        from ncnet_tpu.ops import correlation_4d as corr4
+
+        feat_shape = jax.eval_shape(
+            lambda p, x: extract_features(cfg16, p, x),
+            params,
+            jax.ShapeDtypeStruct((BATCH, IMAGE, IMAGE, 3), jnp.float32),
+        ).shape
+
+        def filt(p, fa, fb):
+            corr = corr4(fa.astype(jnp.bfloat16), fb.astype(jnp.bfloat16))
+            return ncnet_filter(cfg16, p, corr).corr
+
+        sds = jax.ShapeDtypeStruct(feat_shape, jnp.float32)
+        compiled_f = jax.jit(filt).lower(params, sds, sds).compile()
+        mem_f = obs_memory.analysis_dict(compiled_f)
+        if not mem_f or mem_f.get("temp_bytes") is None:
+            return None
+        obs_memory.record_program(
+            "bench_filter", f"{feat_shape[1]}x{feat_shape[2]}xb{BATCH}",
+            analysis=compiled_f, tier="bf16", source="bench")
+        return mem_f["temp_bytes"]
+
+    # the AOT compile behind this rides the same flaky remote-compile
+    # tunnel as every other metric: retried, never silently dropped
+    put("mem_filter_temp_bytes", _filter_memory, label="mem_filter")
 
     # correlation-only (BASELINE north-star: ms/pair 4D-corr fwd) — feature
     # shape derived from the configured backbone via eval_shape (free), so a
@@ -1104,6 +1150,18 @@ def bench_jax(res=None):
                 lambda fold=fold: measure_train(fold_bs, half=True,
                                                 fold_pos_neg=fold),
                 label=key_name)
+
+    # the run's measured HBM high-water mark, taken LAST so it covers
+    # every program the bench executed (None on backends without
+    # memory_stats — the metric is simply absent)
+    try:
+        from ncnet_tpu.observability.memory import hbm_stats
+
+        stats = hbm_stats()
+        if stats and stats.get("peak_bytes_in_use") is not None:
+            res["mem_peak_hbm_bytes"] = stats["peak_bytes_in_use"]
+    except Exception:
+        pass
     return res
 
 

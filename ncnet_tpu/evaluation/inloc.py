@@ -583,6 +583,17 @@ def run_inloc_eval(
     from ncnet_tpu.observability.quality import active_tier
 
     quality_registry = MetricsRegistry(scope="inloc_eval")
+    # memory observability at query boundaries (observability/memory.py):
+    # rate-limited device_snapshot events (HBM pressure beside the query
+    # timeline — the InLoc volume is the repo's biggest allocation) and
+    # the live-array leak sentinel (a handle retained across queries grows
+    # without bound at ~90 MB per preprocessed pano)
+    from ncnet_tpu.observability.device import DeviceMonitor
+    from ncnet_tpu.observability.memory import LeakSentinel
+
+    dev_monitor = DeviceMonitor(every_s=30.0)
+    leak_sentinel = LeakSentinel(window=4, min_interval_s=1.0,
+                                 scope="inloc_eval")
 
     def on_pair_quality(signals):
         emit_quality("inloc_eval", signals,
@@ -838,6 +849,10 @@ def run_inloc_eval(
                 wall_s=round(time.perf_counter() - t_q, 6),
                 pipeline_depth=depth_ctl.depth,
             )
+            # memory plane at the query boundary: HBM snapshot (rate-
+            # limited) + live-array census for the leak sentinel
+            dev_monitor.maybe_emit(step=q + 1)
+            leak_sentinel.observe(step=q + 1)
 
     try:
         depth_ctl = _PipelineDepthController(config.pipeline_depth)

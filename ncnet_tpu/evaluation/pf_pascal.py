@@ -256,6 +256,15 @@ def _run_eval_impl(
             os.path.join(config.journal_dir, "manifest.json"), meta=header)
 
     registry = MetricsRegistry(scope="pf_pascal_eval")
+    # memory observability at batch boundaries: rate-limited HBM snapshots
+    # (before this, only `fit` ever emitted device_snapshot) and the
+    # live-array leak sentinel (observability/memory.py)
+    from ncnet_tpu.observability.device import DeviceMonitor
+    from ncnet_tpu.observability.memory import LeakSentinel
+
+    dev_monitor = DeviceMonitor(every_s=30.0)
+    leak_sentinel = LeakSentinel(window=4, min_interval_s=1.0,
+                                 scope="pf_pascal_eval")
     results = []
     quarantined_batches = []
     n_batches = len(loader)
@@ -354,6 +363,8 @@ def _run_eval_impl(
         registry.timer("fetch_wall").observe(fetch_wall)
         registry.counter("batches").inc()
         registry.gauge("pipeline_depth").set(depth_ctl.depth)
+        dev_monitor.maybe_emit(step=bi)
+        leak_sentinel.observe(step=bi)
         pck_col = arr[:, 0]
         if obs_events.get_global_sink() is not None:
             good = pck_col[~np.isnan(pck_col)]

@@ -76,17 +76,63 @@ class ResilientJit:
     without it, jit's per-shape cache would keep replaying the poisoned
     executable for every shape bucket already seen."""
 
-    def __init__(self, fn, *, label: str = "", hook: bool = True, **jit_kwargs):
+    def __init__(self, fn, *, label: str = "", hook: bool = True,
+                 ledger_program: Optional[str] = None,
+                 ledger_key_fn=None, ledger_tier=None, **jit_kwargs):
         self._fn = fn
         self._label = label
         self._hook = hook
         self._jit_kwargs = jit_kwargs
         self._jitted = jax.jit(fn, **jit_kwargs)
+        # compiled-program memory ledger (observability/memory.py): when
+        # ``ledger_program`` is set, the first successful dispatch of each
+        # shape class records lowered.compile().memory_analysis() — an AOT
+        # analysis compile, paid once per (program, shape, device kind) per
+        # MACHINE (the persisted ledger replays it for warm processes) and
+        # skipped entirely when NCNET_TPU_MEMORY_LEDGER=off
+        self._ledger_program = ledger_program
+        self._ledger_key_fn = ledger_key_fn
+        self._ledger_tier = ledger_tier
+        self._ledger_seen: set = set()
 
     def __call__(self, *args, **kwargs):
         if self._hook:
             faults.device_error_hook(self._label)
-        return self._jitted(*args, **kwargs)
+        out = self._jitted(*args, **kwargs)
+        if self._ledger_program is not None:
+            self._maybe_record_ledger(args, kwargs)
+        return out
+
+    def _maybe_record_ledger(self, args, kwargs) -> None:
+        """One ledger row per shape class actually dispatched (fail-open:
+        the ledger must never be the reason a dispatch fails)."""
+        try:
+            from ncnet_tpu.observability import memory as obs_memory
+
+            if obs_memory.ledger_path() is None:
+                return  # the plane is off: skip the analysis compile too
+            key = (self._ledger_key_fn(*args, **kwargs)
+                   if self._ledger_key_fn is not None
+                   else obs_memory.shape_class((args, kwargs)))
+            if key in self._ledger_seen:
+                return
+            self._ledger_seen.add(key)
+            tier = self._ledger_tier() if self._ledger_tier else None
+            # capture only ShapeDtypeStructs (not the live arrays — the
+            # async closure must not extend the dispatched buffers' lives);
+            # the AOT analysis compile itself runs on a background thread
+            # (ensure_program_async), never blocking this dispatch
+            jitted = self._jitted
+            sds = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+                (args, kwargs))
+
+            obs_memory.ensure_program_async(
+                self._ledger_program, key, tier=tier,
+                analyze=lambda: jitted.lower(*sds[0], **sds[1]).compile())
+        except Exception:  # noqa: BLE001 — telemetry never kills dispatch
+            pass
 
     def retrace(self) -> None:
         """Drop all cached executables; the next call re-traces (and
@@ -107,6 +153,9 @@ class ResilientJit:
             fn = self._fn
             wrapper = functools.wraps(fn)(lambda *a, **kw: fn(*a, **kw))
             self._jitted = jax.jit(wrapper, **self._jit_kwargs)
+            # the retraced programs run a different tier ladder: their
+            # memory footprints are fresh evidence, re-record per shape
+            self._ledger_seen.clear()
 
 
 def recover_from_device_failure(exc: BaseException, *retraceables,
@@ -139,6 +188,12 @@ def recover_from_device_failure(exc: BaseException, *retraceables,
     chooser the single authority on tier selection."""
     if not isinstance(exc, RUNTIME_DEVICE_ERRORS):
         return None
+    # a RESOURCE_EXHAUSTED surfacing through this path is a MEMORY failure:
+    # one memory_postmortem event per failure (idempotent across seams —
+    # a serving failure handler may have already reported this exception)
+    from ncnet_tpu.observability import memory as obs_memory
+
+    obs_memory.report_oom(exc, scope="demote_retrace")
     if not isinstance(exc, faults.InjectedDeviceError):
         # a REAL device error on a backend with no Pallas at all cannot be
         # tier-related: demoting would only grant pointless off-budget
@@ -674,7 +729,15 @@ def make_point_matcher(config: ModelConfig, params, *, do_softmax: bool = True,
         table = jnp.stack([v.astype(jnp.float32).ravel() for v in m])
         return append_quality_row(table, out.corr)
 
-    jitted = ResilientJit(run, label="point_matcher")
+    jitted = ResilientJit(
+        run, label="point_matcher",
+        # compiled-program memory ledger: one row per pair-shape class the
+        # warm matcher actually serves (observability/memory.py)
+        ledger_program="point_matcher",
+        ledger_key_fn=lambda p, s, t: (
+            f"{s.shape[1]}x{s.shape[2]}-{t.shape[1]}x{t.shape[2]}xb1"),
+        ledger_tier=lambda: active_tier(config.half_precision),
+    )
 
     def dispatch(src, tgt):
         """Enqueue upload + forward + match extraction without blocking."""

@@ -63,6 +63,16 @@ from ncnet_tpu.observability.perfstore import (  # noqa: F401
     metric_direction,
     resolve_store_path,
 )
+from ncnet_tpu.observability.memory import (  # noqa: F401
+    LeakSentinel,
+    hbm_stats,
+    is_oom,
+    ledger_rows,
+    live_array_census,
+    predicted_footprint_bytes,
+    record_program,
+    report_oom,
+)
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -104,4 +114,12 @@ __all__ = [
     "maybe_record",
     "metric_direction",
     "resolve_store_path",
+    "LeakSentinel",
+    "hbm_stats",
+    "is_oom",
+    "ledger_rows",
+    "live_array_census",
+    "predicted_footprint_bytes",
+    "record_program",
+    "report_oom",
 ]

@@ -50,9 +50,14 @@ def device_snapshot() -> List[Dict[str, Any]]:
         except Exception:  # noqa: BLE001 — optional per-backend API
             stats = None
         if stats:
-            # keep the two numbers watchdogs act on; the full dict is large
-            # and backend-specific
-            for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+            # keep the numbers watchdogs and the memory plane act on (the
+            # full dict is large and backend-specific): the three pressure
+            # watermarks, plus the reservation and largest-free-block
+            # figures where the backend exposes them — without those two,
+            # fragmentation (plenty of free bytes, no block big enough for
+            # a correlation volume) is invisible
+            for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use",
+                        "bytes_reserved", "largest_free_block_bytes"):
                 if key in stats:
                     entry[key] = int(stats[key])
         out.append(entry)

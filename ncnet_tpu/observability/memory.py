@@ -1,0 +1,631 @@
+"""Memory observability: the compiled-program ledger, live HBM pressure,
+the leak sentinel, and OOM postmortems.
+
+NCNet's defining cost is memory — the full 4D correlation volume caps
+resolution, the resident VJP's stage-1 working set sits right at the v5e
+VMEM ceiling, and every serving bucket multiplies a compiled program's HBM
+footprint — yet until this module the telemetry stack measured walls,
+quality and SLOs while memory was three numbers in a rate-limited
+``device_snapshot``.  Four planes, one home:
+
+  * **Compiled-program memory ledger** — every jit compile seam (the
+    serving bucket warmup, the fused-lane tier probes, ``make_train_step``,
+    ``make_point_matcher``) records XLA's own accounting,
+    ``lowered.compile().memory_analysis()`` (argument / output / temp /
+    generated-code bytes), keyed by ``(program, shape_class, tier,
+    device_kind)``.  Rows are emitted as schema-versioned ``memory_ledger``
+    events AND persisted beside the tier cache
+    (``~/.cache/ncnet_tpu/memory_ledger.json``, knob
+    ``NCNET_TPU_MEMORY_LEDGER`` — a path, or ``0``/``off``), so a warm
+    process still knows its footprints without re-compiling for analysis.
+  * **Live HBM pressure** — :func:`hbm_stats` reads a device's
+    ``memory_stats()`` watermarks (bytes_in_use / peak / limit / reserved /
+    largest free block, fill %).  The serving plane samples it per
+    dispatched batch and exports ``ncnet_serve_hbm_*`` gauges with the
+    bucket ladder's *predicted* aggregate footprint (sum of ledger
+    temp+output bytes over warmed programs) shown against ``bytes_limit``
+    — headroom BEFORE admitting a new bucket, not after the OOM.
+  * **Leak sentinel** — :class:`LeakSentinel` takes a
+    ``jax.live_arrays()`` census (count + bytes by shape class) at
+    batch/epoch boundaries; a shape class whose count grows strictly
+    across the whole trailing window is named in a
+    ``memory_leak_suspect`` event.
+  * **OOM postmortem** — :func:`report_oom` classifies a
+    ``RESOURCE_EXHAUSTED`` surfacing through the demote-retrace path as a
+    memory failure and emits ONE ``memory_postmortem`` event per failure
+    bundling the live HBM snapshot, the ledger rows of the failed program,
+    and the live-array census — rendered by ``run_report --memory``.
+
+Everything here is fail-open (the telemetry-never-kills-the-run
+discipline): a backend without ``memory_analysis``/``memory_stats``/
+``live_arrays`` degrades to silence, an unwritable ledger file degrades to
+events-only, and every public entry point absorbs its own exceptions.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from ncnet_tpu.observability import events as _events
+
+SCHEMA_VERSION = 1
+LEDGER_ENV = "NCNET_TPU_MEMORY_LEDGER"
+
+# the program label of the batched serving engine's jit seam — the serving
+# plane sums this program's ledger rows into its predicted-footprint gauge
+# (serving/engine.py labels its ResilientJit identically)
+SERVE_PROGRAM = "serve_batch"
+
+_lock = threading.Lock()
+# rows recorded (or cache-replayed) THIS process, keyed by the ledger key:
+# the "warmed programs" set the serving predicted-footprint gauge sums
+_runtime_rows: Dict[str, Dict[str, Any]] = {}
+# on-disk mirror state, tier_cache-style: loaded once per resolved path
+_state: Dict[str, object] = {"loaded": False, "path": None, "doc": None}
+
+
+# ---------------------------------------------------------------------------
+# ledger persistence (beside the tier cache; same fail-open rules)
+# ---------------------------------------------------------------------------
+
+
+def ledger_path() -> Optional[str]:
+    """Resolved ledger file path, or None when disabled via the env knob."""
+    raw = os.environ.get(LEDGER_ENV)
+    if raw is not None:
+        raw = raw.strip()
+        if raw.lower() in ("", "0", "off", "none"):
+            return None
+        return raw
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "ncnet_tpu", "memory_ledger.json")
+
+
+def _device_kind() -> str:
+    from ncnet_tpu.observability.events import local_device_kind
+
+    return local_device_kind() or "unknown"
+
+
+def ledger_key(program: str, shape_class: str, tier: Optional[str],
+               device_kind: str) -> str:
+    """Stable string key of one ledger row: the (program, shape-class,
+    tier, device_kind) identity the tentpole keys everything by."""
+    return f"{program}|{shape_class}|{tier or 'xla'}|{device_kind}"
+
+
+def _empty_doc() -> dict:
+    return {"kind": "ncnet_tpu_memory_ledger", "schema": SCHEMA_VERSION,
+            "rows": {}}
+
+
+def _load_locked() -> dict:
+    """The parsed on-disk doc (cached in-process).  Missing/corrupt/foreign/
+    newer-schema files read as empty and are overwritten wholesale on the
+    next record — the tier-cache invalidation rule."""
+    path = ledger_path()
+    if _state["loaded"] and path == _state["path"]:
+        return _state["doc"]  # type: ignore[return-value]
+    doc = _empty_doc()
+    if path is not None:
+        try:
+            import json
+
+            with open(path) as f:
+                cand = json.load(f)
+            if (isinstance(cand, dict)
+                    and cand.get("kind") == "ncnet_tpu_memory_ledger"
+                    and cand.get("schema") == SCHEMA_VERSION
+                    and isinstance(cand.get("rows"), dict)):
+                doc = cand
+        except (OSError, ValueError):
+            pass
+    _state.update(loaded=True, path=path, doc=doc)
+    return doc
+
+
+def _save_locked(doc: dict) -> None:
+    path = ledger_path()
+    if path is None:
+        return
+    try:
+        from ncnet_tpu.utils.io import atomic_write_json
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        atomic_write_json(path, doc)
+    except (OSError, ValueError):
+        pass  # fail-open: events-only is still a working ledger
+
+
+def _reset_state() -> None:
+    """Tests: forget the in-process mirror AND the runtime rows — the
+    in-process analog of starting a fresh process."""
+    with _lock:
+        _state.update(loaded=False, path=None, doc=None)
+        _runtime_rows.clear()
+        _pending_keys.clear()
+
+
+# ---------------------------------------------------------------------------
+# compiled-program analysis
+# ---------------------------------------------------------------------------
+
+_ANALYSIS_FIELDS = (
+    ("argument_bytes", "argument_size_in_bytes"),
+    ("output_bytes", "output_size_in_bytes"),
+    ("temp_bytes", "temp_size_in_bytes"),
+    ("alias_bytes", "alias_size_in_bytes"),
+    ("generated_code_bytes", "generated_code_size_in_bytes"),
+)
+
+
+def analysis_dict(compiled: Any) -> Optional[Dict[str, int]]:
+    """``compiled.memory_analysis()`` (a jax AOT ``Compiled``, or the
+    analysis object itself, or an already-plain dict) reduced to the byte
+    fields the ledger stores, plus ``total_bytes`` (arguments + outputs +
+    temps − aliased).  None when the backend exposes no analysis."""
+    try:
+        ma = compiled
+        if hasattr(ma, "memory_analysis"):
+            ma = ma.memory_analysis()
+        if ma is None:
+            return None
+        out: Dict[str, int] = {}
+        for name, attr in _ANALYSIS_FIELDS:
+            v = ma.get(name) if isinstance(ma, dict) else getattr(
+                ma, attr, None)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[name] = int(v)
+        if not out:
+            return None
+        out["total_bytes"] = (out.get("argument_bytes", 0)
+                              + out.get("output_bytes", 0)
+                              + out.get("temp_bytes", 0)
+                              - out.get("alias_bytes", 0))
+        return out
+    except Exception:  # noqa: BLE001 — analysis is optional per backend
+        return None
+
+
+def shape_class(tree: Any, max_leaves: int = 3) -> str:
+    """Compact, deterministic shape-class string for one args pytree: the
+    ``max_leaves`` largest array leaves as ``dtype[d0xd1x...]`` plus the
+    leaf count — same shapes always map to the same key, and a params
+    pytree with hundreds of leaves stays one short string."""
+    try:
+        import jax
+
+        leaves = [x for x in jax.tree.leaves(tree)
+                  if hasattr(x, "shape") and hasattr(x, "dtype")]
+        import numpy as np
+
+        def nbytes(a) -> int:
+            try:
+                return int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+            except Exception:  # noqa: BLE001 — exotic dtypes: size 0
+                return 0
+
+        def label(a) -> str:
+            return (f"{np.dtype(a.dtype).name}"
+                    f"[{'x'.join(str(d) for d in a.shape)}]")
+
+        top = sorted(leaves, key=lambda a: (-nbytes(a), label(a)))
+        parts = [label(a) for a in top[:max_leaves]]
+        if len(leaves) > max_leaves:
+            parts.append(f"+{len(leaves) - max_leaves}leaves")
+        return ",".join(parts) or "scalar"
+    except Exception:  # noqa: BLE001 — a key we cannot build is no key
+        return "unknown"
+
+
+def _evict_stale_tiers_locked(row: Dict[str, Any]) -> None:
+    """Drop runtime rows for the same (program, shape_class, device_kind)
+    under a DIFFERENT tier: after a demote-retrace the re-recorded program
+    replaced the old tier's executable, and keeping both would double-count
+    the shape in :func:`predicted_footprint_bytes`.  The persisted file
+    keeps every tier's analysis (it is a cross-process cache — the chooser
+    may pick either tier in a future process); only the live "warmed"
+    registry is single-tier per shape."""
+    for key, old in list(_runtime_rows.items()):
+        if (old["program"] == row["program"]
+                and old["shape_class"] == row["shape_class"]
+                and old["device_kind"] == row["device_kind"]
+                and old["tier"] != row["tier"]):
+            del _runtime_rows[key]
+
+
+def record_program(program: str, shape_cls: str, *,
+                   analysis: Any = None, tier: Optional[str] = None,
+                   device_kind: Optional[str] = None,
+                   source: str = "probe") -> Optional[Dict[str, Any]]:
+    """Record one compiled program's memory accounting: build the row,
+    register it in-process, persist it beside the tier cache, and emit the
+    ``memory_ledger`` event.  ``analysis`` may be a jax ``Compiled``, a
+    ``CompiledMemoryStats``, or a plain dict of byte fields.  Returns the
+    row (None when no analysis is extractable) — always fail-open."""
+    try:
+        fields = analysis_dict(analysis)
+        if fields is None:
+            return None
+        kind = device_kind or _device_kind()
+        row: Dict[str, Any] = {
+            "schema": SCHEMA_VERSION, "program": str(program),
+            "shape_class": str(shape_cls), "tier": tier or "xla",
+            "device_kind": kind, **fields,
+        }
+        key = ledger_key(program, shape_cls, tier, kind)
+        with _lock:
+            _evict_stale_tiers_locked(row)
+            _runtime_rows[key] = row
+            if ledger_path() is not None:
+                doc = _load_locked()
+                if doc["rows"].get(key) != row:
+                    doc["rows"][key] = dict(row)
+                    _save_locked(doc)
+        _events.emit("memory_ledger", source=source, **row)
+        return row
+    except Exception:  # noqa: BLE001 — the ledger never kills the compile
+        return None
+
+
+def ensure_program(program: str, shape_cls: str, *,
+                   analyze: Callable[[], Any],
+                   tier: Optional[str] = None,
+                   device_kind: Optional[str] = None
+                   ) -> Optional[Dict[str, Any]]:
+    """The warm-process seam: return the ledger row for this key, analyzing
+    (one AOT ``lower().compile()`` — the cost the persistence exists to
+    avoid) only on a genuine miss.  A hit — in-process or persisted — still
+    emits the ``memory_ledger`` event (``source="cache"``), so every warmed
+    program of every run has its row in the event log, warm or cold."""
+    try:
+        kind = device_kind or _device_kind()
+        key = ledger_key(program, shape_cls, tier, kind)
+        with _lock:
+            row = _runtime_rows.get(key)
+            if row is None and ledger_path() is not None:
+                cand = _load_locked()["rows"].get(key)
+                if isinstance(cand, dict) and cand.get(
+                        "schema") == SCHEMA_VERSION:
+                    row = dict(cand)
+                    _evict_stale_tiers_locked(row)
+                    _runtime_rows[key] = row
+        if row is not None:
+            _events.emit("memory_ledger", source="cache", **row)
+            return row
+        return record_program(program, shape_cls, analysis=analyze(),
+                              tier=tier, device_kind=kind)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+# in-flight background analyses (ensure_program_async misses), plus the
+# keys they are computing — a second miss on a key already being analyzed
+# (the multi-replica warmup dispatches identical programs back-to-back)
+# must not spawn a duplicate AOT compile
+_pending: List[threading.Thread] = []
+_pending_keys: Dict[str, threading.Thread] = {}
+
+
+def ensure_program_async(program: str, shape_cls: str, *,
+                         analyze: Callable[[], Any],
+                         tier: Optional[str] = None,
+                         device_kind: Optional[str] = None
+                         ) -> Optional[Dict[str, Any]]:
+    """:func:`ensure_program` with the analysis compile OFF the caller's
+    thread: a cache hit (in-process or persisted) resolves and emits
+    synchronously; a genuine miss schedules ``analyze`` — an AOT
+    ``lower().compile()`` that can take seconds-to-minutes on a tunneled
+    TPU — on a background daemon thread so the dispatch path never blocks
+    on it.  Returns the row on a hit, None when the analysis was
+    scheduled; :func:`flush_pending` joins outstanding analyses (the
+    serving warmup drains them so the predicted-footprint gauge is
+    complete by READY)."""
+    try:
+        kind = device_kind or _device_kind()
+        key = ledger_key(program, shape_cls, tier, kind)
+        with _lock:
+            row = _runtime_rows.get(key)
+            if row is None and ledger_path() is not None:
+                cand = _load_locked()["rows"].get(key)
+                if isinstance(cand, dict) and cand.get(
+                        "schema") == SCHEMA_VERSION:
+                    row = dict(cand)
+                    _evict_stale_tiers_locked(row)
+                    _runtime_rows[key] = row
+        if row is not None:
+            _events.emit("memory_ledger", source="cache", **row)
+            return row
+
+        def work():
+            try:
+                record_program(program, shape_cls, analysis=analyze(),
+                               tier=tier, device_kind=kind)
+            except Exception:  # noqa: BLE001 — fail-open off-thread too
+                pass
+            finally:
+                with _lock:
+                    _pending_keys.pop(key, None)
+
+        with _lock:
+            if key in _pending_keys or key in _runtime_rows:
+                # already being analyzed — or its analysis landed between
+                # the cache check above and here: don't compile twice
+                return None
+            t = threading.Thread(target=work, name="memory-ledger-analyze",
+                                 daemon=True)
+            # prune finished threads here too: processes that never call
+            # flush_pending (training, eval) must not accumulate dead
+            # Thread objects for their whole lifetime
+            _pending[:] = [p for p in _pending if p.is_alive()]
+            _pending.append(t)
+            _pending_keys[key] = t
+        t.start()
+        return None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def flush_pending(timeout: Optional[float] = None) -> None:
+    """Join in-flight background ledger analyses (bounded by ``timeout``
+    across ALL of them) and prune finished threads — called at the end of
+    the serving warmup, and by tests that assert on ledger events."""
+    with _lock:
+        threads = list(_pending)
+    deadline = (time.monotonic() + timeout) if timeout is not None else None
+    for t in threads:
+        t.join(None if deadline is None
+               else max(0.0, deadline - time.monotonic()))
+    with _lock:
+        _pending[:] = [t for t in _pending if t.is_alive()]
+
+
+def ledger_rows(program: Optional[str] = None,
+                device_kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Rows known to THIS process (recorded fresh or replayed from the
+    persisted file by :func:`ensure_program`), optionally filtered —
+    the "warmed programs" set the serving plane sums."""
+    with _lock:
+        rows = [dict(r) for r in _runtime_rows.values()]
+    return [r for r in rows
+            if (program is None or r["program"] == program)
+            and (device_kind is None or r["device_kind"] == device_kind)]
+
+
+def predicted_footprint_bytes(program: Optional[str] = None,
+                              device_kind: Optional[str] = None
+                              ) -> Optional[int]:
+    """Predicted aggregate device footprint of the warmed programs: the sum
+    of ledger temp+output bytes over this process's rows (arguments are
+    shared staging, generated code is negligible next to the volume).  None
+    when nothing is warmed — a gauge that guesses is worse than no gauge."""
+    rows = ledger_rows(program=program, device_kind=device_kind)
+    if not rows:
+        return None
+    return sum(int(r.get("temp_bytes", 0)) + int(r.get("output_bytes", 0))
+               for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# live HBM pressure
+# ---------------------------------------------------------------------------
+
+_HBM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "bytes_reserved", "largest_free_block_bytes")
+
+
+def hbm_stats(device: Any = None) -> Optional[Dict[str, Any]]:
+    """One device's ``memory_stats()`` watermarks (+ ``fill_pct`` when a
+    limit is known), or None when the backend exposes none (CPU) — the
+    plane stays silent, it never errors."""
+    try:
+        if device is None:
+            import jax
+
+            devices = jax.local_devices()
+            if not devices:
+                return None
+            device = devices[0]
+        stats = device.memory_stats()
+        if not stats:
+            return None
+        out: Dict[str, Any] = {"device": int(getattr(device, "id", 0))}
+        for key in _HBM_KEYS:
+            if key in stats:
+                out[key] = int(stats[key])
+        if len(out) <= 1:
+            return None
+        in_use, limit = out.get("bytes_in_use"), out.get("bytes_limit")
+        if in_use is not None and limit:
+            out["fill_pct"] = round(100.0 * in_use / limit, 2)
+        return out
+    except Exception:  # noqa: BLE001 — optional per-backend API
+        return None
+
+
+# ---------------------------------------------------------------------------
+# leak sentinel
+# ---------------------------------------------------------------------------
+
+
+def live_array_census(max_classes: int = 64) -> Optional[Dict[str, Any]]:
+    """``jax.live_arrays()`` grouped by shape class: total count/bytes plus
+    the per-class breakdown (largest ``max_classes`` classes by bytes).
+    None when the census cannot be taken."""
+    try:
+        import jax
+        import numpy as np
+
+        by: Dict[str, Dict[str, int]] = {}
+        n_total = 0
+        b_total = 0
+        for a in jax.live_arrays():
+            try:
+                cls = (f"{np.dtype(a.dtype).name}"
+                       f"[{'x'.join(str(d) for d in a.shape)}]")
+                nb = int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+            except Exception:  # noqa: BLE001 — exotic arrays: skip
+                continue
+            d = by.setdefault(cls, {"n": 0, "bytes": 0})
+            d["n"] += 1
+            d["bytes"] += nb
+            n_total += 1
+            b_total += nb
+        top = dict(sorted(by.items(),
+                          key=lambda kv: -kv[1]["bytes"])[:max_classes])
+        return {"n": n_total, "bytes": b_total, "classes": len(by),
+                "by_class": top}
+    except Exception:  # noqa: BLE001 — no census beats a crashed loop
+        return None
+
+
+class LeakSentinel:
+    """Trailing-window growth detector over live-array censuses.
+
+    ``observe(step=...)`` takes one census (at a batch/epoch boundary).
+    When a shape class's count has grown STRICTLY across every consecutive
+    delta of the full window (``window`` deltas, so ``window+1``
+    censuses), it is named in a ``memory_leak_suspect`` event along with
+    its byte growth; the window then resets, so an ongoing leak re-fires
+    once per window rather than once per batch.  Steady-state churn — a
+    class whose count fluctuates, or stays flat — never trips it.
+    ``min_interval_s`` rate-limits the census itself for hot loops."""
+
+    def __init__(self, window: int = 4, min_growth_bytes: int = 0,
+                 min_interval_s: float = 0.0, scope: str = ""):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.min_growth_bytes = int(min_growth_bytes)
+        self.min_interval_s = float(min_interval_s)
+        self.scope = scope
+        self._censuses: Deque[Dict[str, Any]] = deque(maxlen=window + 1)
+        self._last_t: Optional[float] = None
+        # serving calls observe() from every per-replica fetcher thread:
+        # an unsynchronized window would interleave censuses and mask real
+        # monotone growth
+        self._obs_lock = threading.Lock()
+
+    def observe(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Take one census; returns the emitted suspect event's fields when
+        the detector fired, else None.  Fail-open end to end; safe to call
+        from several threads (one census at a time)."""
+        try:
+            with self._obs_lock:
+                now = time.monotonic()
+                if self._last_t is not None and self.min_interval_s > 0 \
+                        and now - self._last_t < self.min_interval_s:
+                    return None
+                census = live_array_census()
+                if census is None:
+                    return None
+                self._last_t = now
+                self._censuses.append(census)
+                if len(self._censuses) < self.window + 1:
+                    return None
+                suspects = self._suspects()
+                if not suspects:
+                    return None
+                fields: Dict[str, Any] = {
+                    "scope": self.scope, "window": self.window,
+                    "suspects": suspects,
+                    "live_n": census["n"], "live_bytes": census["bytes"],
+                }
+                if step is not None:
+                    fields["step"] = int(step)
+                self._censuses.clear()  # re-arm: one event per full window
+            _events.emit("memory_leak_suspect", **fields)
+            return fields
+        except Exception:  # noqa: BLE001 — the sentinel never kills the loop
+            return None
+
+    def _suspects(self) -> List[Dict[str, Any]]:
+        seq = list(self._censuses)
+        first, last = seq[0]["by_class"], seq[-1]["by_class"]
+        out: List[Dict[str, Any]] = []
+        for cls in last:
+            counts = []
+            for c in seq:
+                d = c["by_class"].get(cls)
+                if d is None:
+                    break
+                counts.append(d["n"])
+            if len(counts) != len(seq):
+                continue  # absent somewhere in the window: not monotone
+            if all(b > a for a, b in zip(counts, counts[1:])):
+                growth = last[cls]["bytes"] - first[cls]["bytes"]
+                if growth >= self.min_growth_bytes:
+                    out.append({
+                        "shape_class": cls,
+                        "n_first": counts[0], "n_last": counts[-1],
+                        "bytes_first": first[cls]["bytes"],
+                        "bytes_last": last[cls]["bytes"],
+                        "growth_bytes": growth,
+                    })
+        out.sort(key=lambda s: -s["growth_bytes"])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# OOM postmortem
+# ---------------------------------------------------------------------------
+
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory",
+                "allocation failure", "failed to allocate")
+# bare "oom" must be word-bounded: a path like ".../reading_room_3.mat" in
+# an IO error contains the substring but is not a memory failure
+_OOM_RE = re.compile(r"\boom\b", re.IGNORECASE)
+
+# exceptions already reported: the demote-retrace ladder sees one failure
+# at several seams (the serving failure handler AND the shared
+# recover_from_device_failure), and each injected RESOURCE_EXHAUSTED must
+# produce exactly ONE memory_postmortem
+_reported: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Whether an exception is a memory failure: a runtime device error
+    whose message carries a RESOURCE_EXHAUSTED / out-of-memory marker."""
+    try:
+        msg = f"{type(exc).__name__}: {exc}".lower()
+        return any(m in msg for m in _OOM_MARKERS) \
+            or _OOM_RE.search(msg) is not None
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def report_oom(exc: BaseException, *, program: Optional[str] = None,
+               scope: str = "", **extra: Any) -> bool:
+    """Classify ``exc`` as a memory failure and emit ONE
+    ``memory_postmortem`` event bundling the last HBM snapshot, the ledger
+    rows of the failed program, and the live-array census.  Returns True
+    when the event was emitted; False for non-OOM errors or an exception
+    already reported at another seam of the same failure's ladder."""
+    try:
+        if not is_oom(exc):
+            return False
+        if exc in _reported:
+            return False
+        _reported.add(exc)
+        from ncnet_tpu.observability.device import device_snapshot
+
+        rows = ledger_rows(program=program) if program else ledger_rows()
+        _events.emit(
+            "memory_postmortem",
+            scope=scope, program=program, kind="oom",
+            error=f"{type(exc).__name__}: {exc}"[:500],
+            snapshot=device_snapshot(),
+            ledger=rows[:16],
+            census=live_array_census(max_classes=16),
+            **extra,
+        )
+        return True
+    except Exception:  # noqa: BLE001 — the postmortem never compounds the OOM
+        return False

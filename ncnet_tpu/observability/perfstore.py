@@ -276,7 +276,12 @@ _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  "shed_pct",
                  # SLO error-budget burn (serving/slo.py): a rising burn is
                  # the serving plane's accuracy-of-promise regressing
-                 "burn_pct")
+                 "burn_pct",
+                 # memory observability (observability/memory.py): program
+                 # temp/peak-HBM byte series (mem_*_temp_bytes,
+                 # mem_peak_hbm_bytes) gate exactly like walls — a 2x
+                 # footprint jump fails perf_regress --check
+                 "_bytes")
 
 
 def metric_direction(name: str) -> Optional[str]:

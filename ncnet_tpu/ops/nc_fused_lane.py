@@ -208,6 +208,24 @@ def fused_lane_feasible(ha, wa, hb, wb, kernels, channels) -> bool:
     return True
 
 
+def _record_probe_memory(program: str, tier: str, ha, wa, hb, wb,
+                         kernels, channels, compiled) -> None:
+    """Ledger row from a successful compile probe — the analysis object is
+    already in hand, so the row is free (observability/memory.py).  The
+    shape-class string mirrors ``tier_cache.signature_key``."""
+    try:
+        from ncnet_tpu.observability import memory as obs_memory
+
+        obs_memory.record_program(
+            program,
+            f"{ha}x{wa}x{hb}x{wb}"
+            f"|k={','.join(str(k) for k in kernels)}"
+            f"|c={','.join(str(c) for c in channels)}",
+            analysis=compiled, tier=tier, source="tier_probe")
+    except Exception:  # noqa: BLE001 — the ledger never fails a probe
+        pass
+
+
 @functools.lru_cache(maxsize=8)
 def fused_lane_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
     """Real-compile probe at batch 1 (cached per shape class): Mosaic
@@ -226,7 +244,9 @@ def fused_lane_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
         def run(x, ws, bs):
             params = [{"w": w, "b": b} for w, b in zip(ws, bs)]
             return nc_stack_fused_lane(params, x)
-        jax.jit(run).lower(x, ws, bs).compile()
+        compiled = jax.jit(run).lower(x, ws, bs).compile()
+        _record_probe_memory("nc_fused_lane_probe", "fused_lane",
+                             ha, wa, hb, wb, kernels, channels, compiled)
         return True
     except Exception:
         return False
@@ -550,7 +570,9 @@ def fused_resident_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
             params = [{"w": w, "b": b} for w, b in zip(ws, bs)]
             return nc_stack_resident(params, x)
 
-        jax.jit(run).lower(x, ws, bs).compile()
+        compiled = jax.jit(run).lower(x, ws, bs).compile()
+        _record_probe_memory("nc_resident_probe", "resident",
+                             ha, wa, hb, wb, kernels, channels, compiled)
         return True
     except Exception:
         return False

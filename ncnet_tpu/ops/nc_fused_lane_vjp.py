@@ -418,7 +418,11 @@ def fused_vjp_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
             params = [{"w": w, "b": b} for w, b in zip(ws, bs)]
             return nc_stack_fused_vjp(params, x, g)
 
-        jax.jit(run).lower(x, g, ws, bs).compile()
+        compiled = jax.jit(run).lower(x, g, ws, bs).compile()
+        from ncnet_tpu.ops.nc_fused_lane import _record_probe_memory
+
+        _record_probe_memory("nc_vjp_probe", "resident_vjp",
+                             ha, wa, hb, wb, kernels, channels, compiled)
         return True
     except Exception:
         return False

@@ -72,7 +72,20 @@ class BatchMatchEngine:
             # pair's signals ride as row 5 → (B, 6, N), narrow grids skip
             return append_quality_rows(table, out.corr)
 
-        self._jitted = ResilientJit(run, label="serve_batch")
+        from ncnet_tpu.observability.quality import active_tier
+
+        self._jitted = ResilientJit(
+            run, label="serve_batch",
+            # compiled-program memory ledger (observability/memory.py):
+            # one row per (bucket, padded batch) program this engine
+            # compiles — the serving plane sums these rows into its
+            # predicted-footprint gauge (memory.SERVE_PROGRAM)
+            ledger_program="serve_batch",
+            ledger_key_fn=lambda p, s, t: (
+                f"{s.shape[1]}x{s.shape[2]}-{t.shape[1]}x{t.shape[2]}"
+                f"xb{s.shape[0]}"),
+            ledger_tier=lambda: active_tier(self.half_precision),
+        )
 
     def dispatch(self, src_u8: np.ndarray, tgt_u8: np.ndarray):
         """Enqueue upload + forward + match extraction; returns the

@@ -118,6 +118,7 @@ def build_health_document(machine: HealthMachine,
                           counters: Dict[str, Any],
                           slo: Optional[Dict[str, Any]] = None,
                           activity: Optional[Dict[str, Any]] = None,
+                          memory: Optional[Dict[str, Any]] = None,
                           ) -> Dict[str, Any]:
     """THE one health document (``HEALTH_DOC_SCHEMA``-versioned) — the
     ``/healthz`` body, ``MatchService.health()`` return value, the final
@@ -142,6 +143,10 @@ def build_health_document(machine: HealthMachine,
       * ``activity`` — seconds since the pool last dispatched (or idled
         deliberately): the HTTP-reachable liveness signal
         ``stall_watchdog --url`` judges instead of a heartbeat mtime.
+      * ``memory`` — the memory observability section (when the service
+        tracks one): the warmed ladder's predicted footprint from the
+        compiled-program ledger, per-replica HBM watermarks, and the
+        headroom against ``bytes_limit``.
     """
     ready = sum(1 for r in replicas if r.get("state") == "READY")
     doc: Dict[str, Any] = {
@@ -157,4 +162,6 @@ def build_health_document(machine: HealthMachine,
         doc["slo"] = slo
     if activity is not None:
         doc["activity"] = activity
+    if memory is not None:
+        doc["memory"] = memory
     return doc

@@ -195,6 +195,42 @@ def metrics_families(service) -> List[Family]:
         fams.append(Family("ncnet_serve_batches_dispatched_total",
                            "counter", "batches dispatched pool-wide")
                     .add(act["batches"]))
+
+    # memory observability (observability/memory.py): the warmed ladder's
+    # PREDICTED footprint from the compiled-program ledger, and the live
+    # per-replica HBM watermarks sampled at every dispatched batch.  A
+    # backend without memory_stats (CPU) simply has no hbm_bytes series —
+    # the predicted gauge still renders from the ledger alone.
+    mem = doc.get("memory")
+    if mem is not None:
+        if mem.get("predicted_ladder_bytes") is not None:
+            fams.append(Family(
+                "ncnet_serve_hbm_predicted_ladder_bytes", "gauge",
+                "predicted aggregate footprint of the warmed bucket "
+                "ladder (sum of ledger temp+output bytes)")
+                .add(mem["predicted_ladder_bytes"]))
+        if mem.get("headroom_bytes") is not None:
+            fams.append(Family(
+                "ncnet_serve_hbm_headroom_bytes", "gauge",
+                "bytes_limit minus the predicted ladder footprint "
+                "(negative = the ladder cannot all be resident)")
+                .add(mem["headroom_bytes"]))
+        hbm_bytes = Family("ncnet_serve_hbm_bytes", "gauge",
+                           "per-replica HBM watermarks (memory_stats)")
+        hbm_fill = Family("ncnet_serve_hbm_fill_pct", "gauge",
+                          "bytes_in_use / bytes_limit per replica")
+        for rid, s in sorted((mem.get("hbm") or {}).items()):
+            for stat in ("bytes_in_use", "peak_bytes_in_use",
+                         "bytes_limit", "bytes_reserved",
+                         "largest_free_block_bytes"):
+                if s.get(stat) is not None:
+                    hbm_bytes.add(s[stat], replica=rid, stat=stat)
+            if s.get("fill_pct") is not None:
+                hbm_fill.add(s["fill_pct"], replica=rid)
+        if hbm_bytes.samples:
+            fams.append(hbm_bytes)
+        if hbm_fill.samples:
+            fams.append(hbm_fill)
     return fams
 
 
@@ -223,15 +259,33 @@ def render_statusz(service) -> str:
     add(f"bucket ladder: {', '.join(q['buckets']) or '(none registered)'}")
     add("")
     pool = doc["pool"]
+    hbm = (doc.get("memory") or {}).get("hbm") or {}
     add(f"replicas ({pool['ready']}/{pool['total']} ready):")
     add(f"  {'id':<8} {'state':<6} {'score':>10} {'ewma_ms':>9} "
-        f"{'load':>4} {'batches':>8} {'failures':>8} {'deaths':>6}")
+        f"{'load':>4} {'batches':>8} {'failures':>8} {'deaths':>6} "
+        f"{'hbm%':>6}")
     for r in pool["replicas"]:
         ewma = r.get("ewma_wall_ms")
+        fill = (hbm.get(r["id"]) or {}).get("fill_pct")
         add(f"  {r['id']:<8} {r['state']:<6} {r['score']:>10.4f} "
             f"{(f'{ewma:.2f}' if ewma is not None else '-'):>9} "
             f"{r['load']:>4} {r['batches']:>8} {r['failures']:>8} "
-            f"{r['deaths']:>6}")
+            f"{r['deaths']:>6} "
+            f"{(f'{fill:.1f}' if fill is not None else '-'):>6}")
+    mem = doc.get("memory")
+    if mem is not None and (mem.get("predicted_ladder_bytes") is not None
+                            or hbm):
+        add("")
+        pred = mem.get("predicted_ladder_bytes")
+        line = (f"memory: predicted ladder "
+                f"{pred / 2 ** 20:.1f} MiB over "
+                f"{mem.get('ledger_programs')} warmed program(s)"
+                if pred is not None else
+                "memory: no warmed programs in the ledger")
+        head = mem.get("headroom_bytes")
+        if head is not None:
+            line += f"  headroom vs bytes_limit {head / 2 ** 20:.1f} MiB"
+        add(line)
     slo = doc.get("slo")
     if slo is not None and slo["admitted"]:
         add("")

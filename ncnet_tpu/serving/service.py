@@ -82,6 +82,8 @@ import numpy as np
 
 from ncnet_tpu.observability import MetricsRegistry, events as obs_events
 from ncnet_tpu.observability import get_logger
+from ncnet_tpu.observability import memory as obs_memory
+from ncnet_tpu.observability.device import DeviceMonitor
 from ncnet_tpu.serving.admission import AdmissionController
 from ncnet_tpu.serving.buckets import ShapeBucketer, pad_to_bucket
 from ncnet_tpu.serving.health import (
@@ -286,6 +288,15 @@ class MatchService:
         # (a wedged fetch with nothing else dispatching stops advancing it)
         self._activity_t = time.monotonic()
         self._introspect = None
+        # memory observability (observability/memory.py): per-replica HBM
+        # watermarks sampled at every dispatched batch (CPU backends expose
+        # none — the plane stays silent), a rate-limited device_snapshot
+        # emitter on the worker tick, and the live-array leak sentinel fed
+        # at batch boundaries
+        self._hbm: Dict[str, Dict[str, Any]] = {}
+        self._dev_monitor = DeviceMonitor(every_s=30.0)
+        self._leak = obs_memory.LeakSentinel(
+            window=4, min_interval_s=1.0, scope="serving")
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -554,7 +565,28 @@ class MatchService:
                     "age_s": round(max(0.0, now - self._activity_t), 3),
                     "batches": self._batch_seq,
                 },
+                memory=self._memory_doc_locked(),
             )
+
+    def _memory_doc_locked(self) -> Dict[str, Any]:
+        """The health document's memory section: the bucket ladder's
+        PREDICTED aggregate footprint (sum of ledger temp+output bytes over
+        this process's warmed serve programs) set against the live
+        ``bytes_limit``, plus the latest per-replica HBM watermarks — the
+        headroom an operator reads BEFORE admitting a new bucket."""
+        predicted = obs_memory.predicted_footprint_bytes(
+            program=obs_memory.SERVE_PROGRAM)
+        doc: Dict[str, Any] = {
+            "predicted_ladder_bytes": predicted,
+            "ledger_programs": len(obs_memory.ledger_rows(
+                program=obs_memory.SERVE_PROGRAM)),
+            "hbm": {rid: dict(s) for rid, s in sorted(self._hbm.items())},
+        }
+        limits = [s.get("bytes_limit") for s in self._hbm.values()
+                  if isinstance(s.get("bytes_limit"), int)]
+        if limits and predicted is not None:
+            doc["headroom_bytes"] = min(limits) - predicted
+        return doc
 
     @property
     def state(self) -> str:
@@ -610,6 +642,10 @@ class MatchService:
             while True:
                 if self._drain_requested:
                     self.request_drain("sigterm")
+                # rate-limited device_snapshot on the worker tick: HBM
+                # pressure is visible in the event log even while the
+                # service idles (before this, only `fit` ever emitted one)
+                self._dev_monitor.maybe_emit(step=self._batch_seq)
                 self._maybe_resurrect()
                 self._evict_expired()
                 self._fill_pipeline()
@@ -698,6 +734,11 @@ class MatchService:
             obs_events.emit("serve_warm", bucket=bucket_label(bucket),
                             batch_sizes=self._batch_ladder(),
                             replicas=warmed)
+        # drain the warm programs' background ledger analyses (bounded) so
+        # the predicted-footprint gauge is complete by the time the
+        # service reports READY — their compile cost overlaps the ladder's
+        # own warm compiles above instead of riding a live request
+        obs_memory.flush_pending(timeout=120.0)
 
     def _evict_expired(self) -> None:
         """Evict deadline-expired QUEUED requests even when no replica can
@@ -819,8 +860,20 @@ class MatchService:
             # beats only when no survivor is dispatching either
             self._heartbeat.beat(step=self._batch_seq,
                                  state=self._health.state)
+        # live HBM watermark, sampled per dispatched batch (a cheap host
+        # call; None on backends without memory_stats — the plane stays
+        # silent, never errors).  A replica without a pinned device
+        # (engine-injection pools) is NOT sampled: defaulting to device 0
+        # would attribute one chip's watermarks to every lane
+        hbm = (obs_memory.hbm_stats(replica.device)
+               if replica.device is not None else None)
         with self._cond:
             self._activity_t = now_dispatch  # /healthz liveness signal
+            if hbm is not None:
+                self._hbm[replica.id] = hbm
+                self._registry.gauge(
+                    f"hbm_bytes_in_use_{replica.id}").set(
+                        hbm.get("bytes_in_use"))
             replica.last_bucket = bucket
             replica.pending.append(
                 _InFlight(handle, batch, bucket, replica, time.monotonic(),
@@ -891,6 +944,9 @@ class MatchService:
             size=len(inf.batch), wall_s=round(wall, 6), queue_depth=qd,
             inflight=inflight, seq=inf.seq, replica=rid,
         )
+        # leak sentinel census at the batch boundary (rate-limited inside;
+        # a growing shape class emits memory_leak_suspect)
+        self._leak.observe(step=inf.seq)
         tables, quality = self._split_table(inf.replica, table)
         tier = self._active_tier(inf.replica)
         for i, req in enumerate(inf.batch):
@@ -974,6 +1030,14 @@ class MatchService:
         from ncnet_tpu.evaluation.resilience import classify_failure
 
         kind = classify_failure(exc)
+        # a RESOURCE_EXHAUSTED batch failure is a MEMORY failure: bundle
+        # the HBM snapshot, the failed program's ledger rows, and the
+        # live-array census into ONE memory_postmortem (idempotent — the
+        # demote-retrace path below may see the same exception again)
+        obs_memory.report_oom(
+            exc, program=obs_memory.SERVE_PROGRAM, scope="serving",
+            replica=replica.id, phase=phase,
+            bucket=bucket_label(batch[0].bucket) if batch else None)
         with self._cond:
             self._controller.note_failure()
             replica.note_failure()

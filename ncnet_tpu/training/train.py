@@ -292,8 +292,22 @@ def make_train_step(
 
     from ncnet_tpu.models.ncnet import ResilientJit
 
-    return ResilientJit(step, label="train_step",
-                        donate_argnums=(0,) if donate else ())
+    def _batch_shape_key(state, batch):
+        # key on the BATCH alone (params/opt shapes are constant within a
+        # process): a handful of leaves instead of the full state pytree —
+        # this runs on every step dispatch, so it must stay cheap
+        from ncnet_tpu.observability.memory import shape_class
+
+        return shape_class(batch)
+
+    return ResilientJit(
+        step, label="train_step",
+        # compiled-program memory ledger (observability/memory.py): the
+        # train step's footprint — temp bytes ARE the backward's working
+        # set, the quantity the remat/custom-grad knobs exist to shrink
+        ledger_program="train_step",
+        ledger_key_fn=_batch_shape_key,
+        donate_argnums=(0,) if donate else ())
 
 
 def make_eval_step(model_config: ModelConfig):

@@ -17,6 +17,7 @@ import os
 # env vars at tmp paths explicitly via monkeypatch.
 os.environ["NCNET_TPU_PERF_STORE"] = "off"
 os.environ["NCNET_TPU_TIER_CACHE"] = "off"
+os.environ["NCNET_TPU_MEMORY_LEDGER"] = "off"
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -1,0 +1,606 @@
+"""Memory observability (ISSUE 13): the compiled-program ledger, the live
+HBM plane, the leak sentinel, OOM postmortems, and their consumers.
+
+The acceptance bars executed here:
+
+  * ledger round-trip with a fake ``memory_analysis`` — record, persist,
+    warm-process cache replay (no second analysis compile);
+  * CPU-backend graceful degradation — no ``memory_stats`` ⇒ the plane
+    stays silent, never errors;
+  * the leak sentinel flags an injected buffer-retaining loop and stays
+    green on steady-state serving;
+  * an injected ``RESOURCE_EXHAUSTED`` produces exactly ONE
+    ``memory_postmortem`` whose ledger rows name the failed program;
+  * ``run_report --memory`` replays it all from the event log alone;
+  * a warmed REAL engine ladder exposes ``ncnet_serve_hbm_*`` (the
+    predicted-footprint gauge) on ``/metrics``;
+  * ``perf_regress --check`` stays green on a seeded memory series and
+    flags an injected 2x ``temp_bytes`` regression.
+"""
+
+import json
+import os
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from ncnet_tpu import models, ops
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.observability import EventLog, events as obs_events
+from ncnet_tpu.observability import memory as mem
+from ncnet_tpu.observability.events import replay_events
+from ncnet_tpu.serving import BatchMatchEngine, MatchService, ServingConfig
+from ncnet_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import run_report  # noqa: E402
+import perf_regress  # noqa: E402
+import stall_watchdog  # noqa: E402
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                   ncons_channels=(1,))
+
+FAKE_ANALYSIS = {"argument_bytes": 1000, "output_bytes": 200,
+                 "temp_bytes": 4096, "generated_code_bytes": 64,
+                 "alias_bytes": 0}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No armed faults, no demoted tiers, no leaked sink, fresh ledger
+    state (the in-process analog of a new process)."""
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    mem._reset_state()
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    mem._reset_state()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return models.init_ncnet(TINY, jax.random.key(0))
+
+
+def u8(side=32, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+def _events_to(tmp_path, name="events.jsonl"):
+    return EventLog(str(tmp_path / name))
+
+
+# ---------------------------------------------------------------------------
+# ledger: record, persist, warm-process replay
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_round_trip_with_fake_analysis(tmp_path, monkeypatch):
+    monkeypatch.setenv(mem.LEDGER_ENV, str(tmp_path / "ledger.json"))
+    mem._reset_state()
+    log = _events_to(tmp_path)
+    with obs_events.bound(log):
+        row = mem.record_program("probe_prog", "25x25x25x25|k=5,5,5",
+                                 analysis=FAKE_ANALYSIS, tier="resident",
+                                 device_kind="TPU v5 lite")
+    log.close()
+    assert row["temp_bytes"] == 4096
+    assert row["total_bytes"] == 1000 + 200 + 4096  # args + out + temp
+    assert row["tier"] == "resident"
+
+    # the event carries the full row, schema-versioned
+    _, evs = replay_events(log.path)
+    led = [e for e in evs if e["event"] == "memory_ledger"]
+    assert len(led) == 1
+    assert led[0]["program"] == "probe_prog"
+    assert led[0]["schema"] == mem.SCHEMA_VERSION
+    assert led[0]["temp_bytes"] == 4096
+
+    # persisted beside the tier cache, keyed by (program, shape, tier, kind)
+    doc = json.loads((tmp_path / "ledger.json").read_text())
+    key = mem.ledger_key("probe_prog", "25x25x25x25|k=5,5,5",
+                         "resident", "TPU v5 lite")
+    assert doc["rows"][key]["temp_bytes"] == 4096
+
+    # warm process: forget the in-process state, ensure() replays the
+    # persisted row WITHOUT calling analyze — and still emits the event
+    mem._reset_state()
+    calls = []
+    log2 = _events_to(tmp_path, "events2.jsonl")
+    with obs_events.bound(log2):
+        row2 = mem.ensure_program(
+            "probe_prog", "25x25x25x25|k=5,5,5",
+            analyze=lambda: calls.append(1) or FAKE_ANALYSIS,
+            tier="resident", device_kind="TPU v5 lite")
+    log2.close()
+    assert calls == []  # no second analysis compile
+    assert row2["temp_bytes"] == 4096
+    _, evs2 = replay_events(log2.path)
+    cached = [e for e in evs2 if e["event"] == "memory_ledger"]
+    assert len(cached) == 1 and cached[0]["source"] == "cache"
+
+    # a genuine miss (different tier) DOES analyze
+    with obs_events.bound(None):
+        mem.ensure_program("probe_prog", "25x25x25x25|k=5,5,5",
+                           analyze=lambda: calls.append(1) or FAKE_ANALYSIS,
+                           tier="xla", device_kind="TPU v5 lite")
+    assert calls == [1]
+
+
+def test_ledger_analysis_dict_from_compiled():
+    # the real jax AOT object (CPU backend exposes the same accounting)
+    compiled = jax.jit(lambda x: x @ x.T).lower(
+        jnp.ones((8, 8), jnp.float32)).compile()
+    d = mem.analysis_dict(compiled)
+    assert d is not None and d["argument_bytes"] == 256
+    assert "total_bytes" in d
+    # garbage degrades to None, never raises
+    assert mem.analysis_dict(None) is None
+    assert mem.analysis_dict(object()) is None
+
+
+def test_predicted_footprint_sums_temp_plus_output():
+    mem.record_program("serve_batch", "a", analysis=FAKE_ANALYSIS,
+                       device_kind="cpu")
+    mem.record_program("serve_batch", "b", analysis=FAKE_ANALYSIS,
+                       device_kind="cpu")
+    mem.record_program("other", "a", analysis=FAKE_ANALYSIS,
+                       device_kind="cpu")
+    assert mem.predicted_footprint_bytes(program="serve_batch") \
+        == 2 * (4096 + 200)
+    # re-recording the same key replaces, never double-counts
+    mem.record_program("serve_batch", "a", analysis=FAKE_ANALYSIS,
+                       device_kind="cpu")
+    assert mem.predicted_footprint_bytes(program="serve_batch") \
+        == 2 * (4096 + 200)
+    # nothing warmed: None, not 0 (a gauge that guesses is worse than none)
+    mem._reset_state()
+    assert mem.predicted_footprint_bytes(program="serve_batch") is None
+
+
+def test_predicted_footprint_evicts_superseded_tier():
+    # a demote-retrace re-records the same (program, shape) under the new
+    # tier: the old tier's row must leave the warmed set, or the predicted
+    # gauge double-counts every bucket right after the recovery
+    mem.record_program("serve_batch", "a", analysis=FAKE_ANALYSIS,
+                       tier="fused_lane", device_kind="cpu")
+    mem.record_program("serve_batch", "b", analysis=FAKE_ANALYSIS,
+                       tier="fused_lane", device_kind="cpu")
+    assert mem.predicted_footprint_bytes(program="serve_batch") \
+        == 2 * (4096 + 200)
+    mem.record_program("serve_batch", "a", analysis=FAKE_ANALYSIS,
+                       tier="xla", device_kind="cpu")
+    # still 2 shapes — one row each, not 3
+    rows = mem.ledger_rows(program="serve_batch")
+    assert len(rows) == 2
+    assert {(r["shape_class"], r["tier"]) for r in rows} \
+        == {("a", "xla"), ("b", "fused_lane")}
+    assert mem.predicted_footprint_bytes(program="serve_batch") \
+        == 2 * (4096 + 200)
+
+
+def test_ensure_program_async_dedupes_in_flight_keys():
+    import threading
+
+    started = threading.Event()
+    release = threading.Event()
+    calls = []
+
+    def slow_analyze():
+        calls.append(1)
+        started.set()
+        release.wait(timeout=30.0)
+        return FAKE_ANALYSIS
+
+    assert mem.ensure_program_async(
+        "p", "s", analyze=slow_analyze, device_kind="cpu") is None
+    assert started.wait(timeout=10.0)
+    # a second miss on the SAME key while the first is in flight must not
+    # spawn a duplicate analysis compile (the multi-replica warmup shape)
+    assert mem.ensure_program_async(
+        "p", "s", analyze=slow_analyze, device_kind="cpu") is None
+    release.set()
+    mem.flush_pending(timeout=30.0)
+    assert calls == [1]
+    assert len(mem.ledger_rows(program="p")) == 1
+
+
+def test_shape_class_is_compact_and_deterministic(tiny_params):
+    a = mem.shape_class((tiny_params, jnp.zeros((2, 32, 32, 3))))
+    b = mem.shape_class((tiny_params, jnp.zeros((2, 32, 32, 3))))
+    assert a == b and len(a) < 200
+    assert a != mem.shape_class((tiny_params, jnp.zeros((4, 32, 32, 3))))
+    assert mem.shape_class(()) == "scalar"
+
+
+# ---------------------------------------------------------------------------
+# CPU-backend graceful degradation
+# ---------------------------------------------------------------------------
+
+
+def test_cpu_backend_hbm_plane_stays_silent():
+    # the CPU backend exposes no memory_stats: the plane is None/absent,
+    # never an error
+    assert mem.hbm_stats() is None
+    from ncnet_tpu.observability.device import device_snapshot
+
+    snap = device_snapshot()
+    assert snap and all("bytes_in_use" not in d for d in snap)
+    # the census still works (live_arrays is backend-independent)
+    census = mem.live_array_census()
+    assert census is not None and census["n"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# leak sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_leak_sentinel_flags_retaining_loop_and_stays_green(tmp_path):
+    log = _events_to(tmp_path)
+    retained = []
+    with obs_events.bound(log):
+        s = mem.LeakSentinel(window=3, scope="test")
+        fired = None
+        for i in range(8):
+            # the injected leak: one more live (97,) array per boundary
+            retained.append(jnp.zeros((97,), jnp.float32) + i)
+            fired = fired or s.observe(step=i)
+        assert fired is not None
+        assert any(sus["shape_class"] == "float32[97]"
+                   for sus in fired["suspects"])
+
+        # steady state: allocate-and-drop churn of the same class — counts
+        # do not grow monotonically, the sentinel stays green
+        s2 = mem.LeakSentinel(window=3, scope="steady")
+        for i in range(8):
+            _ = jnp.zeros((55,), jnp.float32) + i  # dropped immediately
+            assert s2.observe(step=i) is None
+    log.close()
+    _, evs = replay_events(log.path)
+    leaks = [e for e in evs if e["event"] == "memory_leak_suspect"]
+    assert leaks and leaks[0]["scope"] == "test"
+    assert all(e["scope"] != "steady" for e in leaks)
+
+
+def test_leak_sentinel_rearms_after_firing():
+    retained = []
+    s = mem.LeakSentinel(window=2, scope="t")
+    fires = 0
+    for i in range(12):
+        retained.append(jnp.zeros((41,), jnp.float32) + i)
+        if s.observe(step=i):
+            fires += 1
+    # window resets after each event: one fire per full window, not per step
+    assert 1 <= fires <= 4
+
+
+# ---------------------------------------------------------------------------
+# OOM postmortem
+# ---------------------------------------------------------------------------
+
+
+def test_report_oom_classifies_and_dedupes(tmp_path):
+    log = _events_to(tmp_path)
+    mem.record_program("serve_batch", "32x32-32x32xb1",
+                       analysis=FAKE_ANALYSIS, device_kind="cpu")
+    with obs_events.bound(log):
+        exc = faults.InjectedDeviceError(
+            "RESOURCE_EXHAUSTED: out of memory allocating 56000000 bytes")
+        assert mem.report_oom(exc, program="serve_batch", scope="serving")
+        # the demote-retrace seam sees the SAME exception: no second event
+        assert not mem.report_oom(exc, scope="demote_retrace")
+        # a non-OOM device error is not a memory failure
+        assert not mem.report_oom(
+            faults.InjectedDeviceError("tunnel reset"), scope="serving")
+        # bare "oom" is word-bounded: an IO error naming reading_room_3.mat
+        # must not render as an OOM postmortem
+        assert not mem.is_oom(
+            OSError("no such file: /data/reading_room_3.mat"))
+        assert mem.is_oom(faults.InjectedDeviceError("HBM OOM on core 0"))
+    log.close()
+    _, evs = replay_events(log.path)
+    pm = [e for e in evs if e["event"] == "memory_postmortem"]
+    assert len(pm) == 1
+    assert pm[0]["program"] == "serve_batch"
+    assert pm[0]["kind"] == "oom"
+    assert "RESOURCE_EXHAUSTED" in pm[0]["error"]
+    # the bundle: ledger rows naming the failed program + the census
+    assert pm[0]["ledger"] and \
+        pm[0]["ledger"][0]["program"] == "serve_batch"
+    assert pm[0]["census"]["n"] >= 0
+
+
+class _OOMEngine:
+    """FakeEngine whose FIRST dispatch dies with a RESOURCE_EXHAUSTED-
+    shaped runtime device error; subsequent dispatches serve normally."""
+
+    split = staticmethod(BatchMatchEngine.split)
+    half_precision = False
+
+    def __init__(self):
+        self.dispatches = 0
+        self.retraces = 0
+
+    def dispatch(self, src, tgt):
+        self.dispatches += 1
+        if self.dispatches == 1:
+            raise faults.InjectedDeviceError(
+                "RESOURCE_EXHAUSTED: out of memory while allocating the "
+                "correlation volume")
+        return src.shape[0]
+
+    def fetch(self, handle):
+        table = np.zeros((handle, 6, 16), np.float32)
+        table[:, 4, :] = 1.0
+        return table
+
+    def retrace(self):
+        self.retraces += 1
+
+
+def test_serving_oom_emits_exactly_one_postmortem(tmp_path):
+    mem.record_program("serve_batch", "32x32-32x32xb1",
+                       analysis=FAKE_ANALYSIS, device_kind="cpu")
+    log = _events_to(tmp_path)
+    with obs_events.bound(log):
+        engine = _OOMEngine()
+        svc = MatchService(engine=engine, serving=ServingConfig(
+            bucket_multiple=32, max_image_side=64, max_batch=2))
+        with svc:
+            r = svc.submit(u8(), u8(seed=1)).result(timeout=30.0)
+            assert r.table.shape[0] == 5  # served after the free retry
+    log.close()
+    _, evs = replay_events(log.path)
+    pm = [e for e in evs if e["event"] == "memory_postmortem"]
+    # the failure crossed BOTH seams (the serving failure handler and the
+    # demote-retrace recovery) — still exactly one postmortem
+    assert len(pm) == 1
+    assert pm[0]["program"] == "serve_batch"
+    assert pm[0]["scope"] == "serving"
+    assert pm[0]["replica"] == "rep0"
+    assert any(r["program"] == "serve_batch" for r in pm[0]["ledger"])
+    # the non-memory accounting is untouched: the request still resolved
+    results = [e for e in evs if e["event"] == "serve_result"]
+    assert len(results) == 1
+
+
+# ---------------------------------------------------------------------------
+# serving plane: warmed REAL ladder -> ledger events + /metrics gauges
+# ---------------------------------------------------------------------------
+
+
+def test_warmed_ladder_ledger_and_metrics_scrape(tmp_path, monkeypatch,
+                                                 tiny_params):
+    import urllib.request
+
+    monkeypatch.setenv(mem.LEDGER_ENV, str(tmp_path / "ledger.json"))
+    mem._reset_state()
+    log = _events_to(tmp_path)
+    with obs_events.bound(log):
+        svc = MatchService(TINY, tiny_params, ServingConfig(
+            bucket_multiple=32, max_image_side=64, max_batch=2,
+            warm_buckets=((32, 32),), introspect_port=0))
+        svc.start()
+        t0 = time.monotonic()
+        while svc.state == "STARTING" and time.monotonic() - t0 < 180:
+            time.sleep(0.05)
+        assert svc.state == "READY"
+        url = svc.introspect_url
+        txt = urllib.request.urlopen(url + "/metrics",
+                                     timeout=30).read().decode()
+        doc = svc.health()
+        statusz = urllib.request.urlopen(url + "/statusz",
+                                         timeout=30).read().decode()
+        svc.stop()
+    log.close()
+
+    # every warmed bucket program (bucket x each ladder batch size) has a
+    # memory_ledger event
+    _, evs = replay_events(log.path)
+    led = [e for e in evs if e["event"] == "memory_ledger"
+           and e["program"] == "serve_batch"]
+    assert {e["shape_class"] for e in led} == {
+        "32x32-32x32xb1", "32x32-32x32xb2"}
+
+    # /metrics exposes the predicted-footprint gauge (CPU: no hbm_bytes
+    # series, but the ledger-driven gauge still renders)
+    assert "ncnet_serve_hbm_predicted_ladder_bytes" in txt
+    predicted = mem.predicted_footprint_bytes(program="serve_batch")
+    assert predicted is not None and predicted > 0
+    line = next(l for l in txt.splitlines()
+                if l.startswith("ncnet_serve_hbm_predicted_ladder_bytes"))
+    assert int(line.split()[-1]) == predicted
+
+    # the health document carries the same memory section
+    assert doc["memory"]["predicted_ladder_bytes"] == predicted
+    assert doc["memory"]["ledger_programs"] == 2
+    assert "memory: predicted ladder" in statusz
+
+    # device_snapshot now flows from the serving worker tick too
+    assert any(e["event"] == "device_snapshot" for e in evs)
+
+    # run_report --memory replays all of it from the event log alone
+    report = run_report.build_report([log.path])
+    assert len(report["memory"]["ledger"]) == 2
+    text = run_report.render_memory(report)
+    assert "compiled-program ledger" in text
+    assert "serve_batch" in text
+    assert run_report.main([log.path, "--memory"]) == 0
+
+
+def test_hbm_gauges_render_when_stats_exist(tmp_path):
+    # the TPU-shaped path, driven with injected stats (CPU exposes none):
+    # per-replica hbm gauges + fill % + headroom vs the predicted ladder
+    from ncnet_tpu.serving.introspect import metrics_families, render_statusz
+
+    mem.record_program("serve_batch", "x", analysis=FAKE_ANALYSIS,
+                       device_kind="cpu")
+    svc = MatchService(engine=_OOMEngine(), serving=ServingConfig(
+        bucket_multiple=32, max_image_side=64))
+    svc._hbm["rep0"] = {"device": 0, "bytes_in_use": 6 << 20,
+                        "peak_bytes_in_use": 8 << 20,
+                        "bytes_limit": 16 << 20,
+                        "bytes_reserved": 1 << 20,
+                        "largest_free_block_bytes": 4 << 20,
+                        "fill_pct": 37.5}
+    fams = {f.name: f for f in metrics_families(svc)}
+    assert fams["ncnet_serve_hbm_bytes"].samples
+    labels = {(s[1].get("replica"), s[1].get("stat"))
+              for s in fams["ncnet_serve_hbm_bytes"].samples}
+    assert ("rep0", "bytes_in_use") in labels
+    assert ("rep0", "largest_free_block_bytes") in labels
+    fill = fams["ncnet_serve_hbm_fill_pct"].samples[0]
+    assert fill[2] == 37.5
+    predicted = 4096 + 200
+    head = fams["ncnet_serve_hbm_headroom_bytes"].samples[0][2]
+    assert head == (16 << 20) - predicted
+    sz = render_statusz(svc)
+    assert "37.5" in sz and "headroom" in sz
+
+
+def test_stall_watchdog_hbm_warning_is_not_a_stall():
+    verdict = {"status": "alive"}
+    doc = {"memory": {"hbm": {
+        "rep0": {"fill_pct": 95.0, "bytes_in_use": 15, "bytes_limit": 16},
+        "rep1": {"fill_pct": 20.0},
+    }}}
+    stall_watchdog._apply_hbm_warning(verdict, doc, 90.0)
+    assert verdict["status"] == "alive"  # pressure is never a stall
+    assert list(verdict["hbm_warning"]["replicas"]) == ["rep0"]
+    # below threshold / no memory section: no warning key at all
+    v2 = {"status": "alive"}
+    stall_watchdog._apply_hbm_warning(v2, {}, 90.0)
+    assert "hbm_warning" not in v2
+
+
+# ---------------------------------------------------------------------------
+# run_report --memory on a synthetic log (leaks + postmortems + trajectory)
+# ---------------------------------------------------------------------------
+
+
+def test_run_report_memory_full_rendering(tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as log, obs_events.bound(log):
+        mem.record_program("train_step", "sig", analysis=FAKE_ANALYSIS,
+                           device_kind="TPU v5 lite", tier="resident_vjp")
+        obs_events.emit("device_snapshot", devices=[
+            {"id": 0, "kind": "TPU v5 lite", "platform": "tpu",
+             "bytes_in_use": 100 << 20, "peak_bytes_in_use": 200 << 20,
+             "bytes_limit": 16 << 30, "bytes_reserved": 0,
+             "largest_free_block_bytes": 8 << 30}])
+        obs_events.emit("memory_leak_suspect", scope="serving", window=4,
+                        suspects=[{"shape_class": "float32[97]",
+                                   "n_first": 1, "n_last": 5,
+                                   "bytes_first": 388, "bytes_last": 1940,
+                                   "growth_bytes": 1552}],
+                        live_n=10, live_bytes=4096)
+        exc = faults.InjectedDeviceError("RESOURCE_EXHAUSTED: oom")
+        mem.report_oom(exc, program="train_step", scope="demote_retrace")
+
+    report = run_report.build_report([path])
+    m = report["memory"]
+    assert m["ledger"][0]["program"] == "train_step"
+    assert m["hbm_trajectory"][0]["bytes_in_use"] == 100 << 20
+    assert m["leak_suspects"][0]["suspects"][0]["shape_class"] \
+        == "float32[97]"
+    assert m["postmortems"][0]["program"] == "train_step"
+
+    text = run_report.render_memory(report)
+    assert "LEAK SUSPECTS" in text
+    assert "OOM POSTMORTEMS" in text
+    assert "float32[97]" in text
+    assert "HBM trajectory" in text
+
+    assert run_report.main([path, "--memory"]) == 0
+    out = capsys.readouterr().out
+    assert "OOM POSTMORTEMS" in out
+    # and --json carries the section as data
+    assert run_report.main([path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["memory"]["postmortems"]
+
+
+# ---------------------------------------------------------------------------
+# perf store: memory series gate lower-is-better
+# ---------------------------------------------------------------------------
+
+
+def test_memory_metrics_gate_like_walls(tmp_path, monkeypatch):
+    from ncnet_tpu.observability.perfstore import (
+        PerfStore, check_regressions, metric_direction)
+
+    for name in ("mem_forward_temp_bytes", "mem_filter_temp_bytes",
+                 "mem_peak_hbm_bytes"):
+        assert metric_direction(name) == "lower"
+
+    store_path = str(tmp_path / "history.jsonl")
+    store = PerfStore(store_path)
+    for v in (1000.0, 1010.0, 990.0, 1005.0):
+        store.append("mem_forward_temp_bytes", v, device_kind="TPU v5 lite")
+    findings = check_regressions(store.records())
+    f = next(x for x in findings if x["metric"] == "mem_forward_temp_bytes")
+    assert f["status"] == "ok"  # the seeded series is green
+
+    # injected 2x temp_bytes regression: perf_regress --check exits 1
+    store.append("mem_forward_temp_bytes", 2000.0,
+                 device_kind="TPU v5 lite")
+    assert perf_regress.main(["--check", "--store", store_path]) == 1
+    findings = check_regressions(store.records())
+    f = next(x for x in findings if x["metric"] == "mem_forward_temp_bytes")
+    assert f["status"] == "regression"
+
+
+# ---------------------------------------------------------------------------
+# ResilientJit ledger seam (the train_step / point_matcher path)
+# ---------------------------------------------------------------------------
+
+
+def test_resilient_jit_records_one_row_per_shape(tmp_path, monkeypatch):
+    from ncnet_tpu.models.ncnet import ResilientJit
+
+    monkeypatch.setenv(mem.LEDGER_ENV, str(tmp_path / "ledger.json"))
+    mem._reset_state()
+    log = _events_to(tmp_path)
+    with obs_events.bound(log):
+        jitted = ResilientJit(lambda x: x * 2, label="t",
+                              ledger_program="unit_prog")
+        jitted(jnp.ones((4, 4)))
+        jitted(jnp.ones((4, 4)))      # same shape: no second row
+        jitted(jnp.ones((8, 4)))      # new shape class: second row
+        # the analysis compile runs OFF the dispatch thread: join it
+        # before asserting on the emitted events
+        mem.flush_pending(timeout=60.0)
+    log.close()
+    _, evs = replay_events(log.path)
+    led = [e for e in evs if e["event"] == "memory_ledger"]
+    assert len(led) == 2
+    assert {e["shape_class"] for e in led} == {
+        "float32[4x4]", "float32[8x4]"}
+    assert all(e["program"] == "unit_prog" for e in led)
+
+    # the off switch skips the analysis compile entirely
+    monkeypatch.setenv(mem.LEDGER_ENV, "off")
+    mem._reset_state()
+    log2 = _events_to(tmp_path, "events2.jsonl")
+    with obs_events.bound(log2):
+        j2 = ResilientJit(lambda x: x + 1, label="t2",
+                          ledger_program="unit_prog2")
+        j2(jnp.ones((3,)))
+        mem.flush_pending(timeout=60.0)
+    log2.close()
+    _, evs2 = replay_events(log2.path)
+    assert not [e for e in evs2 if e["event"] == "memory_ledger"]

@@ -678,8 +678,12 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # exchange or reopens the side channel.  tools/serve_backend.py is NOT
     # pinned: like the other tools' CLIs its stdout IS its interface — the
     # one startup JSON line spawners block on)
+    # (the ISSUE 13 memory plane is pinned for the same reason: memory.py
+    # emits ledger/postmortem events from inside dispatch hot paths — a
+    # bare print there would reopen the side channel mid-serving)
     for target in ("ncnet_tpu/observability/quality.py",
                    "ncnet_tpu/observability/export.py",
+                   "ncnet_tpu/observability/memory.py",
                    "ncnet_tpu/serving",
                    "ncnet_tpu/serving/introspect.py",
                    "ncnet_tpu/serving/router.py",

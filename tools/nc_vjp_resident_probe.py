@@ -106,6 +106,34 @@ def main():
     # parity authority — this prints the raw field number
     print(f"parity vs XLA AD (raw random data): worst rel {worst:.3%}")
 
+    # compiled-program memory ledger rows (observability/memory.py): the
+    # MEASURED memory_analysis of the fused backward vs the XLA-AD twin,
+    # persisted beside the tier cache and emitted as memory_ledger events —
+    # so the next real-TPU session answers the ~15.7 MiB stage-1 VMEM
+    # question with measured, not accounted, numbers
+    from ncnet_tpu.observability import memory as obs_memory
+
+    sig = (f"{S}x{S}x{S}x{S}|k={','.join(str(k) for k in KS)}"
+           f"|c={','.join(str(c) for c in CHS)}")
+    try:
+        compiled = jax.jit(nc_stack_fused_vjp).lower(params, x, g).compile()
+        row = obs_memory.record_program(
+            "nc_vjp_resident_probe", sig, analysis=compiled,
+            tier="resident_vjp", source="probe")
+        print(f"ledger fused vjp: {row}")
+
+        def xla_ad(params, x, g):
+            _, vjp = jax.vjp(xla_stack, params, x)
+            return vjp(g)
+
+        compiled = jax.jit(xla_ad).lower(params, x, g).compile()
+        row = obs_memory.record_program(
+            "nc_vjp_xla_ad", sig, analysis=compiled, tier="xla",
+            source="probe")
+        print(f"ledger xla ad   : {row}")
+    except Exception as e:  # noqa: BLE001 — the ledger must not kill timing
+        print(f"ledger: FAILED {str(e)[:160]}")
+
     def make_input(key):
         k1, k2, kk = jax.random.split(key, 3)
         return (

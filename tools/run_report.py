@@ -396,6 +396,78 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
     }
 
 
+def build_memory_section(events: List[dict]) -> Dict[str, Any]:
+    """The memory postmortem (observability/memory.py), replayed from the
+    event log alone: the compiled-program ledger table (latest row per
+    (program, shape_class, tier, device_kind) key), the HBM trajectory from
+    the ``device_snapshot`` events, the leak sentinel's verdicts, and every
+    OOM postmortem with its bundled evidence."""
+    ledger: Dict[tuple, dict] = {}
+    cached = 0
+    for e in events:
+        if e.get("event") != "memory_ledger":
+            continue
+        key = (e.get("program"), e.get("shape_class"), e.get("tier"),
+               e.get("device_kind"))
+        if e.get("source") == "cache":
+            cached += 1
+        ledger[key] = {
+            "program": e.get("program"), "shape_class": e.get("shape_class"),
+            "tier": e.get("tier"), "device_kind": e.get("device_kind"),
+            "argument_bytes": e.get("argument_bytes"),
+            "output_bytes": e.get("output_bytes"),
+            "temp_bytes": e.get("temp_bytes"),
+            "generated_code_bytes": e.get("generated_code_bytes"),
+            "total_bytes": e.get("total_bytes"),
+            "source": e.get("source"),
+        }
+
+    # HBM trajectory: one point per device_snapshot entry that carried
+    # memory stats, downsampled like the queue-depth trajectory
+    traj: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("event") != "device_snapshot":
+            continue
+        for d in e.get("devices") or []:
+            if isinstance(d, dict) and d.get("bytes_in_use") is not None:
+                traj.append({
+                    "t": e.get("t"), "device": d.get("id"),
+                    "bytes_in_use": d.get("bytes_in_use"),
+                    "peak_bytes_in_use": d.get("peak_bytes_in_use"),
+                    "bytes_limit": d.get("bytes_limit"),
+                    "bytes_reserved": d.get("bytes_reserved"),
+                    "largest_free_block_bytes":
+                        d.get("largest_free_block_bytes"),
+                })
+    if len(traj) > 64:
+        step = len(traj) / 64.0
+        traj = [traj[int(i * step)] for i in range(64)]
+
+    leaks = [
+        {k: e.get(k) for k in ("t", "scope", "step", "window", "suspects",
+                               "live_n", "live_bytes") if k in e}
+        for e in events if e.get("event") == "memory_leak_suspect"
+    ]
+    postmortems = [
+        {k: e.get(k) for k in
+         ("t", "scope", "program", "kind", "error", "replica", "phase",
+          "bucket", "snapshot", "ledger", "census") if k in e}
+        for e in events if e.get("event") == "memory_postmortem"
+    ]
+    return {
+        "ledger": sorted(
+            ledger.values(),
+            key=lambda r: (str(r["program"]), str(r["shape_class"]),
+                           str(r["tier"]))),
+        "ledger_events": sum(
+            1 for e in events if e.get("event") == "memory_ledger"),
+        "ledger_cached_events": cached,
+        "hbm_trajectory": traj,
+        "leak_suspects": leaks,
+        "postmortems": postmortems,
+    }
+
+
 def build_router_section(events: List[dict]) -> Dict[str, Any]:
     """The router-tier postmortem (the PR 12 multi-host twin of
     :func:`build_serving_section`): the outcome-total identity recomputed
@@ -653,6 +725,10 @@ def build_report(paths: List[str],
         report["slo"] = build_slo_section(events)
     if any(str(e.get("event", "")).startswith("route_") for e in events):
         report["router"] = build_router_section(events)
+    if any(e.get("event") in ("memory_ledger", "memory_leak_suspect",
+                              "memory_postmortem", "device_snapshot")
+           for e in events):
+        report["memory"] = build_memory_section(events)
     if any(e.get("event") == "quality" for e in events):
         device_kind = next(
             (r["header"].get("device_kind") for r in runs
@@ -878,6 +954,91 @@ def render_router(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _fmt_bytes(v) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    if abs(v) >= 2 ** 20:
+        return f"{v / 2 ** 20:.1f}MiB"
+    if abs(v) >= 2 ** 10:
+        return f"{v / 2 ** 10:.1f}KiB"
+    return f"{int(v)}B"
+
+
+def render_memory(report: Dict[str, Any]) -> str:
+    m = report.get("memory")
+    if not m:
+        return "(no memory events in the log)"
+    lines = ["memory (observability/memory.py, replayed from the log):"]
+    add = lines.append
+    if m["ledger"]:
+        add(f"  compiled-program ledger ({len(m['ledger'])} program(s); "
+            f"{m['ledger_events']} event(s), "
+            f"{m['ledger_cached_events']} cache-replayed):")
+        width = max(len(str(r["program"])) for r in m["ledger"])
+        for r in m["ledger"]:
+            add(f"    {str(r['program']):<{width}}  "
+                f"args={_fmt_bytes(r['argument_bytes']):>9} "
+                f"out={_fmt_bytes(r['output_bytes']):>9} "
+                f"temp={_fmt_bytes(r['temp_bytes']):>9} "
+                f"total={_fmt_bytes(r['total_bytes']):>9}  "
+                f"tier={r['tier']}  [{r['shape_class']}] "
+                f"({r['device_kind']})")
+    else:
+        add("  compiled-program ledger: (no memory_ledger events)")
+    traj = m["hbm_trajectory"]
+    if traj:
+        in_use = [p["bytes_in_use"] for p in traj
+                  if isinstance(p.get("bytes_in_use"), (int, float))]
+        peaks = [p["peak_bytes_in_use"] for p in traj
+                 if isinstance(p.get("peak_bytes_in_use"), (int, float))]
+        limit = next((p["bytes_limit"] for p in traj
+                      if p.get("bytes_limit")), None)
+        add(f"  HBM trajectory ({len(traj)} snapshot(s)): "
+            f"first={_fmt_bytes(in_use[0]) if in_use else '-'} "
+            f"max={_fmt_bytes(max(in_use)) if in_use else '-'} "
+            f"last={_fmt_bytes(in_use[-1]) if in_use else '-'} "
+            f"peak={_fmt_bytes(max(peaks)) if peaks else '-'}"
+            + (f" limit={_fmt_bytes(limit)}" if limit else ""))
+    else:
+        add("  HBM trajectory: (no device_snapshot memory stats — CPU "
+            "backend, or the monitor never fired)")
+    if m["leak_suspects"]:
+        add(f"  LEAK SUSPECTS ({len(m['leak_suspects'])} event(s)):")
+        for e in m["leak_suspects"]:
+            for s in (e.get("suspects") or [])[:8]:
+                add(f"    [{e.get('scope')}] {s['shape_class']}: "
+                    f"n {s['n_first']} -> {s['n_last']}, "
+                    f"+{_fmt_bytes(s['growth_bytes'])} over "
+                    f"window {e.get('window')}")
+    else:
+        add("  leak sentinel: no suspects (green)")
+    if m["postmortems"]:
+        add(f"  OOM POSTMORTEMS ({len(m['postmortems'])}):")
+        for p in m["postmortems"]:
+            add(f"    [{p.get('scope')}] program={p.get('program')} "
+                + (f"replica={p['replica']} " if p.get("replica") else "")
+                + f"error={str(p.get('error'))[:120]}")
+            for r in (p.get("ledger") or [])[:4]:
+                add(f"      ledger: {r.get('program')} "
+                    f"[{r.get('shape_class')}] "
+                    f"temp={_fmt_bytes(r.get('temp_bytes'))} "
+                    f"total={_fmt_bytes(r.get('total_bytes'))}")
+            census = p.get("census")
+            if census:
+                add(f"      live arrays at death: {census.get('n')} "
+                    f"({_fmt_bytes(census.get('bytes'))} across "
+                    f"{census.get('classes')} shape class(es))")
+            for d in (p.get("snapshot") or [])[:4]:
+                if isinstance(d, dict) and d.get("bytes_in_use") is not None:
+                    add(f"      device {d.get('id')}: in_use="
+                        f"{_fmt_bytes(d['bytes_in_use'])} peak="
+                        f"{_fmt_bytes(d.get('peak_bytes_in_use'))} limit="
+                        f"{_fmt_bytes(d.get('bytes_limit'))}")
+    else:
+        add("  OOM postmortems: none")
+    return "\n".join(lines)
+
+
 def render_slo(report: Dict[str, Any]) -> str:
     s = report.get("slo")
     if not s or not s["admitted"]:
@@ -1010,6 +1171,11 @@ def main(argv=None) -> int:
                          "(backend-tagged accounting, the outcome-total "
                          "identity recomputed at the router level) when "
                          "the log holds route_* events")
+    ap.add_argument("--memory", action="store_true",
+                    help="append the memory section: the compiled-program "
+                         "ledger table, the HBM trajectory, leak-sentinel "
+                         "verdicts, and OOM postmortems — all replayed "
+                         "from the event log alone")
     ap.add_argument("--slo", action="store_true",
                     help="append the SLO section: error-budget counters "
                          "recomputed from the log (objectives from "
@@ -1038,6 +1204,9 @@ def main(argv=None) -> int:
             if report.get("router"):
                 print()
                 print(render_router(report))
+        if args.memory:
+            print()
+            print(render_memory(report))
         if args.slo:
             print()
             print(render_slo(report))

@@ -120,17 +120,37 @@ def render_frame(health: Dict[str, Any], fams: Dict[str, Any],
             f"window({w['n']}): {w['burn_pct']}%  "
             f"[bad: {slo['bad']}]")
     add("")
+    mem = health.get("memory") or {}
+    hbm = mem.get("hbm") or {}
     add(f"{'replica':<8} {'state':<6} {'score':>10} {'ewma_ms':>9} "
         f"{'load':>4} {'batches':>8} {'fail':>5} {'deaths':>6} "
-        f"{'dead_s':>7}")
+        f"{'dead_s':>7} {'hbm%':>6}")
     for r in pool.get("replicas", []):
         ewma = r.get("ewma_wall_ms")
         dead = r.get("dead_age_s")
+        fill = (hbm.get(r["id"]) or {}).get("fill_pct")
         add(f"{r['id']:<8} {r['state']:<6} {r['score']:>10.4f} "
             f"{(f'{ewma:.2f}' if ewma is not None else '-'):>9} "
             f"{r['load']:>4} {r['batches']:>8} {r['failures']:>5} "
             f"{r['deaths']:>6} "
-            f"{(f'{dead:.1f}' if dead is not None else '-'):>7}")
+            f"{(f'{dead:.1f}' if dead is not None else '-'):>7} "
+            f"{(f'{fill:.1f}' if fill is not None else '-'):>6}")
+    pred = mem.get("predicted_ladder_bytes")
+    if pred is not None or hbm:
+        parts = []
+        if pred is not None:
+            parts.append(f"predicted ladder {pred / 2 ** 20:.1f} MiB "
+                         f"({mem.get('ledger_programs')} warmed program(s))")
+        head = mem.get("headroom_bytes")
+        if head is not None:
+            parts.append(f"headroom {head / 2 ** 20:.1f} MiB")
+        peaks = [s.get("peak_bytes_in_use") for s in hbm.values()
+                 if s.get("peak_bytes_in_use") is not None]
+        if peaks:
+            parts.append(f"peak in use {max(peaks) / 2 ** 20:.1f} MiB")
+        if parts:
+            add("")
+            add("memory: " + "  ".join(parts))
     lat = _bucket_latencies(fams)
     if lat:
         add("")

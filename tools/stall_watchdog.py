@@ -197,9 +197,31 @@ def _apply_backend_backstop(verdict: Dict[str, Any], doc: Dict[str, Any],
         verdict["alive_via"] = alive_via
 
 
+def _apply_hbm_warning(verdict: Dict[str, Any], doc: Dict[str, Any],
+                       warn_pct: float) -> None:
+    """HBM-pressure advisory from the health document's memory section
+    (observability/memory.py): any replica whose fill exceeds
+    ``warn_pct`` lands in ``verdict["hbm_warning"]``.  Strictly a WARNING —
+    pressure is not a stall, so the liveness status never changes here."""
+    hbm = ((doc.get("memory") or {}).get("hbm")) or {}
+    hot = {}
+    for rid, s in sorted(hbm.items()):
+        fill = s.get("fill_pct")
+        if isinstance(fill, (int, float)) and fill >= warn_pct:
+            hot[str(rid)] = {
+                "fill_pct": fill,
+                "bytes_in_use": s.get("bytes_in_use"),
+                "bytes_limit": s.get("bytes_limit"),
+            }
+    if hot:
+        verdict["hbm_warning"] = {"threshold_pct": warn_pct,
+                                  "replicas": hot}
+
+
 def judge_url(url: str, events_path: Optional[str] = None,
               factor: float = 10.0, min_age: float = 60.0,
-              timeout: float = 5.0) -> Dict[str, Any]:
+              timeout: float = 5.0,
+              hbm_warn_pct: float = 90.0) -> Dict[str, Any]:
     """Remote liveness verdict over the introspection plane: the primary
     signal is ``/healthz``'s ``activity.age_s`` (seconds since the pool
     last dispatched or deliberately idled — the HTTP twin of the heartbeat
@@ -253,6 +275,7 @@ def judge_url(url: str, events_path: Optional[str] = None,
     if events_path:
         _apply_replica_backstop(verdict, events_path, factor, min_age)
     _apply_backend_backstop(verdict, doc, factor, min_age)
+    _apply_hbm_warning(verdict, doc, hbm_warn_pct)
     return verdict
 
 
@@ -321,6 +344,10 @@ def main(argv=None) -> int:
     ap.add_argument("--min-age", type=float, default=60.0,
                     help="threshold floor in seconds (default 60; also the "
                          "whole threshold when no step cadence is readable)")
+    ap.add_argument("--hbm-warn-pct", type=float, default=90.0,
+                    help="(--url mode) warn — never flag STALLED — when any "
+                         "replica's HBM fill exceeds this percent "
+                         "(default 90)")
     ap.add_argument("--json", action="store_true",
                     help="emit the verdict as one JSON document")
     args = ap.parse_args(argv)
@@ -329,7 +356,8 @@ def main(argv=None) -> int:
 
     if args.url is not None:
         verdict = judge_url(args.url, events_path=args.events,
-                            factor=args.factor, min_age=args.min_age)
+                            factor=args.factor, min_age=args.min_age,
+                            hbm_warn_pct=args.hbm_warn_pct)
     else:
         verdict = judge(args.heartbeat, events_path=args.events,
                         factor=args.factor, min_age=args.min_age)
@@ -369,6 +397,13 @@ def main(argv=None) -> int:
             print(f"  backend {bid} [{b.get('state')}]: last result "
                   f"{b['last_result_age_s']}s ago vs {b['threshold_s']}s "
                   f"({tag})")
+        hw = verdict.get("hbm_warning")
+        if hw:
+            for rid, s in hw["replicas"].items():
+                print(f"  WARNING: replica {rid} HBM {s['fill_pct']}% full "
+                      f"(>= {hw['threshold_pct']}%; "
+                      f"{s.get('bytes_in_use')}/{s.get('bytes_limit')} "
+                      "bytes) — pressure, not a stall")
     return {"alive": 0, "missing": 2, "stalled": 3}[verdict["status"]]
 
 
