@@ -716,6 +716,33 @@ def bench_jax(res=None):
         put("inloc_matcher_s_per_pair", inloc_with_percentiles,
             label="inloc_matcher")
 
+    # cached-localization scenario (ISSUE 14, ncnet_tpu/store/): one full
+    # 10-pano InLoc query against a COLD feature store (pano features
+    # computed + committed) vs the same query WARM (verified store hits,
+    # zero database-side extractions), plus the store's deterministic hit
+    # fraction over the scenario.  All three land in the perf store —
+    # *_query_ms with the inferred lower-is-better direction and
+    # store_hit_pct via the higher-is-better hit_pct token — so
+    # perf_regress --check gates the cache win like every other wall.
+    # Same gate as the InLoc matcher (the 56M-cell volume is CPU-hostile);
+    # NCNET_BENCH_STORE=1 forces it elsewhere.
+    flag = os.environ.get("NCNET_BENCH_STORE")
+    on_tpu = "TPU" in jax.devices()[0].device_kind
+    if (flag not in ("0", "") if flag is not None else on_tpu) \
+            and res.get("inloc_cached_query_ms") is None:
+
+        def _store_cached_metrics():
+            out = {}
+            cold_s, warm_s, hit_pct = _bench_store_cached_query()
+            out["inloc_cold_query_ms"] = round(cold_s * 1e3, 2)
+            out["inloc_cached_query_ms"] = round(warm_s * 1e3, 2)
+            out["store_hit_pct"] = hit_pct
+            return out
+
+        out = _with_retries(_store_cached_metrics, label="store_cached") \
+            or {}
+        res.update(out)
+
     # resident match SERVICE under offered load (ISSUE r8): open-loop sweep
     # against ncnet_tpu/serving at the bench arch — capacity (closed loop),
     # steady-state latency percentiles at 70% of capacity (open loop, so
@@ -1222,6 +1249,80 @@ def _bench_inloc_matcher():
         float(np.percentile(per_pair, 50)),
         float(np.percentile(per_pair, 95)),
     )
+
+
+def _bench_store_cached_query():
+    """``(cold_query_s, warm_query_s, hit_pct)`` for one full InLoc query
+    (10 panos, depth-2 pipeline — the run_inloc_eval unit) against the
+    persistent feature store (ncnet_tpu/store/): cold = every pano feature
+    computed and atomically committed; warm = every pano a verified store
+    hit, so the query performs exactly ONE backbone extraction (its own).
+    Compiles are charged to a warm-up pano outside the measured set; the
+    hit fraction is deterministic by construction (warm-up: 1 miss + 1
+    hit; cold pass: 10 misses; warm pass: 10 hits → 50.0%), so the
+    perf-store series gates cache effectiveness, not traffic luck."""
+    import shutil
+    import tempfile
+    import time as _time
+    import warnings
+
+    import jax
+    import numpy as np
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu import models
+    from ncnet_tpu.evaluation.inloc import make_pair_matcher
+    from ncnet_tpu.store import FeatureStore, backbone_fingerprint
+
+    cfg = ModelConfig(
+        ncons_kernel_sizes=(3, 3), ncons_channels=(16, 1),  # IVD arch
+        half_precision=True, backbone_bf16=True, relocalization_k_size=2,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        params = models.init_ncnet(cfg, jax.random.key(0))
+    root = tempfile.mkdtemp(prefix="bench_fstore_")
+    try:
+        store = FeatureStore(
+            root,
+            backbone_fingerprint(params, image_size=3200, k_size=2,
+                                 dtype="bf16"),
+            scope="bench")
+        matcher = make_pair_matcher(
+            cfg, params, do_softmax=True, both_directions=True,
+            flip_direction=False, preprocess_image_size=3200, store=store,
+        )
+        rng = np.random.default_rng(0)
+        q = rng.integers(0, 255, (1, 4032, 3024, 3), dtype=np.uint8)
+        dbs = [
+            rng.integers(0, 255, (1, 1200, 1600, 3), dtype=np.uint8)
+            for _ in range(10)
+        ]
+        warm_pano = rng.integers(0, 255, (1, 1200, 1600, 3), dtype=np.uint8)
+        src = matcher.preprocess(q)
+        # compile + first-touch uploads charged here, NOT to either pass
+        matcher(src, matcher.prepare_db(warm_pano))
+        matcher(src, matcher.prepare_db(warm_pano))
+
+        def one_query():
+            t0 = _time.perf_counter()
+            in_flight = []
+            for db in dbs:
+                in_flight.append(
+                    matcher.dispatch(src, matcher.prepare_db(db)))
+                if len(in_flight) > 1:
+                    matcher.fetch(in_flight.pop(0))
+            while in_flight:
+                matcher.fetch(in_flight.pop(0))
+            return _time.perf_counter() - t0
+
+        cold_s = one_query()   # 10 misses: extract + commit per pano
+        warm_s = one_query()   # 10 verified hits: zero db-side extractions
+        hit_pct = store.hit_pct()
+        store.close()
+        return cold_s, warm_s, hit_pct
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def bench_torch_reference_style(iters=3):

@@ -59,6 +59,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry_dir", type=str, default="",
                    help="open a structured event log here (per-query events "
                         "+ metrics; replay with tools/run_report.py)")
+    p.add_argument("--feature_store_dir", type=str, default="",
+                   help="persistent database-side feature store: cache pano "
+                        "backbone features here (verified, crash-safe; see "
+                        "README 'Feature store'); bulk-build with "
+                        "tools/build_feature_store.py")
+    p.add_argument("--feature_store_budget_mb", type=int, default=0,
+                   help="LRU-evict store entries above this many MiB "
+                        "(0 = unbounded)")
     return p
 
 
@@ -93,6 +101,8 @@ def main(argv=None) -> int:
         quarantine=args.quarantine,
         fetch_timeout_s=args.fetch_timeout_s,
         telemetry_dir=args.telemetry_dir,
+        feature_store_dir=args.feature_store_dir,
+        feature_store_budget_mb=args.feature_store_budget_mb,
     )
     print(args)
     print("Output matches folder: " + output_folder_name(config))

@@ -262,6 +262,15 @@ class EvalInLocConfig:
     # here for the run (per-query events + an eval_summary metrics flush).
     # "" = emit only to an already-bound global sink, if any
     telemetry_dir: str = ""
+    # persistent database-side feature store (ncnet_tpu/store/; README
+    # "Feature store"): pano backbone features are cached on disk keyed by
+    # (image content digest, backbone fingerprint), verified on read,
+    # committed atomically, and recomputed transparently on any miss /
+    # corruption / IO failure — a warm store turns each query into ONE
+    # backbone extraction + cached matching.  "" = off; bulk-build with
+    # tools/build_feature_store.py.  Ignored under spatial_shards > 1.
+    feature_store_dir: str = ""
+    feature_store_budget_mb: int = 0     # LRU-evict above this (0 = unbounded)
 
 
 @dataclasses.dataclass(frozen=True)

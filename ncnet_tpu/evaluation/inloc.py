@@ -52,6 +52,7 @@ log = get_logger("eval.inloc")
 from ncnet_tpu.models.ncnet import (
     extract_features,
     ncnet_forward,
+    ncnet_forward_from_feature_pair,
     ncnet_forward_from_features,
 )
 from ncnet_tpu.ops.image import normalize_imagenet, resize_bilinear_align_corners_np
@@ -140,6 +141,18 @@ class PreparedQuery(NamedTuple):
     features: jnp.ndarray
 
 
+class PreparedDb(NamedTuple):
+    """A DATABASE image resolved through the persistent feature store by
+    ``matcher.prepare_db`` (ncnet_tpu/store/): its backbone features (a
+    verified store hit, or a recompute that was committed back) plus how
+    they were obtained — ``"hit"`` / ``"miss"`` / ``"recompute"``.
+    Dispatching a ``(PreparedQuery, PreparedDb)`` pair runs the
+    feature-pair program: ZERO backbone extractions for the pair."""
+
+    features: jnp.ndarray
+    status: str
+
+
 def extract_match_table(
     out,
     *,
@@ -186,7 +199,7 @@ def extract_match_table(
 def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
                       both_directions: bool, flip_direction: bool,
                       mesh=None, preprocess_image_size: Optional[int] = None,
-                      quality_cb=None):
+                      quality_cb=None, store=None):
     """Returns ``matcher(src, tgt) -> (xA, yA, xB, yB, score)`` numpy arrays.
 
     One jitted program per (src_shape, tgt_shape) bucket — jit's native
@@ -215,6 +228,20 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
     are passed to it as ``{signal: float}``.  ``run_inloc_eval`` wires this
     into tier-tagged ``quality`` events + the run's histogram digests; the
     default None costs nothing.
+
+    ``store``: a :class:`~ncnet_tpu.store.FeatureStore` for DATABASE-side
+    features.  ``matcher.prepare_db(raw_u8)`` resolves a pano's backbone
+    features through it (content digest of the raw image → verified hit,
+    or recompute + atomic commit) and returns a :class:`PreparedDb`;
+    dispatching a ``(PreparedQuery, PreparedDb)`` pair rides the
+    ``src_is_features=True`` jitted path extended with the target side
+    (:func:`~ncnet_tpu.models.ncnet.ncnet_forward_from_feature_pair`), so
+    a warm-store pair performs ZERO backbone extractions.  The store's
+    degradation ladder guarantees ``prepare_db`` only ever gets SLOWER
+    (recompute), never fails a query and never feeds unverified bytes.
+    ``matcher.feature_extractions`` counts executed trunk dispatches —
+    the spy the acceptance test reads ("a warm-store query performs
+    exactly one backbone extraction").
     """
     k = max(config.relocalization_k_size, 1)
 
@@ -240,6 +267,15 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         lambda p, x: extract_features(config, p, x), hook=False
     )
 
+    def run_trunk(x: jnp.ndarray) -> jnp.ndarray:
+        """THE backbone-extraction call site (query preprocess AND store
+        misses both route here).  The counter counts EXECUTED dispatches of
+        the compiled trunk program — not traces — so it is exactly the
+        "extractions per query" number the feature store exists to
+        minimize: 1 on a warm store, 1 + misses on a cold one."""
+        matcher.feature_extractions += 1
+        return feats(params, x)
+
     def prep_input(img) -> jnp.ndarray:
         """The ONE preprocessing call both input paths share — a divergence
         here would desync the PreparedQuery path from the in-dispatch path.
@@ -259,10 +295,30 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         the returned object directly)."""
         assert preprocess_image_size is not None
         x = prep_input(img)
-        return PreparedQuery(x, feats(params, x))
+        return PreparedQuery(x, run_trunk(x))
 
-    def run(p, src, tgt, sharded=False, src_is_features=False):
-        if src_is_features:
+    def prepare_db(img: np.ndarray) -> "PreparedDb":
+        """Raw uint8 ``(1, H, W, 3)`` database image → :class:`PreparedDb`
+        via the persistent store's degradation ladder: verified hit, or
+        recompute through the SAME ``prep_input`` + trunk program the
+        query path uses (so stored bytes are bit-identical to what a miss
+        computes) + atomic commit back.  Requires ``store``."""
+        assert store is not None, "prepare_db needs a FeatureStore"
+        from ncnet_tpu.store import content_digest
+
+        def compute() -> np.ndarray:
+            return np.asarray(run_trunk(prep_input(img)), dtype=np.float32)
+
+        arr, status = store.resolve(content_digest(np.asarray(img)), compute)
+        return PreparedDb(jnp.asarray(arr), status)
+
+    def run(p, src, tgt, sharded=False, src_is_features=False,
+            tgt_is_features=False):
+        if tgt_is_features:
+            # the store-backed pair: both trunks precomputed, zero
+            # extractions in this program
+            out = ncnet_forward_from_feature_pair(config, p, src, tgt)
+        elif src_is_features:
             out = ncnet_forward_from_features(config, p, src, tgt)
         else:
             out = forward(p, src, tgt, sharded)
@@ -286,7 +342,7 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
     # device errors and get the same demote-retrace recovery
     jitted = ResilientJit(
         run, label="inloc_pair",
-        static_argnames=("sharded", "src_is_features"),
+        static_argnames=("sharded", "src_is_features", "tgt_is_features"),
     )
 
     warned_shapes = set()
@@ -333,6 +389,16 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
         from ncnet_tpu.utils.profiling import annotate
 
         with annotate("inloc_pair_dispatch"):
+            if isinstance(tgt, PreparedDb):
+                # store-resolved database features: the feature-pair
+                # program (never sharded — the caller gates the store off
+                # under spatial sharding, whose forward takes images)
+                if not isinstance(src, PreparedQuery):
+                    raise ValueError(
+                        "a PreparedDb target needs a PreparedQuery source "
+                        "(both sides' features precomputed)")
+                return jitted(params, src.features, tgt.features,
+                              src_is_features=True, tgt_is_features=True)
             if isinstance(tgt, PreparedQuery):  # either position accepted
                 tgt_shape, tgt_raw = tgt.image.shape, False
             else:
@@ -379,9 +445,12 @@ def make_pair_matcher(config: ModelConfig, params, *, do_softmax: bool,
             r.retrace()
 
     matcher.preprocess = preprocess
+    matcher.prepare_db = prepare_db
     matcher.dispatch = dispatch
     matcher.fetch = fetch
     matcher.retrace = retrace
+    matcher.feature_extractions = 0  # executed trunk dispatches (the spy)
+    matcher.store = store
     return matcher
 
 
@@ -571,181 +640,18 @@ def run_inloc_eval(
     out_dir = os.path.join(config.output_root, output_folder_name(config))
     os.makedirs(out_dir, exist_ok=True)
 
-    # per-pair match-quality signals (README "Quality observability"):
-    # computed in the pair program, fetched with the match table, streamed
-    # as tier-tagged `quality` events and digested per run — the label-free
-    # accuracy monitor this eval otherwise lacks entirely (InLoc has no
-    # in-loop metric; a silent tier regression here only surfaces after the
-    # downstream PnP stage, hours later)
-    from ncnet_tpu.observability.metrics import MetricsRegistry
-    from ncnet_tpu.observability.quality import emit_quality
-
-    from ncnet_tpu.observability.quality import active_tier
-
-    quality_registry = MetricsRegistry(scope="inloc_eval")
-    # memory observability at query boundaries (observability/memory.py):
-    # rate-limited device_snapshot events (HBM pressure beside the query
-    # timeline — the InLoc volume is the repo's biggest allocation) and
-    # the live-array leak sentinel (a handle retained across queries grows
-    # without bound at ~90 MB per preprocessed pano)
-    from ncnet_tpu.observability.device import DeviceMonitor
-    from ncnet_tpu.observability.memory import LeakSentinel
-
-    dev_monitor = DeviceMonitor(every_s=30.0)
-    leak_sentinel = LeakSentinel(window=4, min_interval_s=1.0,
-                                 scope="inloc_eval")
-
-    def on_pair_quality(signals):
-        emit_quality("inloc_eval", signals,
-                     tier=active_tier(model_config.half_precision),
-                     registry=quality_registry)
-
-    matcher = make_pair_matcher(
-        model_config, params,
-        do_softmax=config.softmax,
-        both_directions=config.matching_both_directions,
-        flip_direction=config.flip_matching_direction,
-        mesh=mesh,
-        # raw uint8 in, normalize+resize on device: the upload is the
-        # dominant per-pair cost and raw bytes are 4-15x smaller
-        preprocess_image_size=config.image_size,
-        quality_cb=on_pair_quality,
-    )
-    n_cap = match_capacity(
-        config.image_size, config.k_size, config.matching_both_directions
-    )
-
     n_queries = min(config.n_queries, len(query_fns))
     # multi-host: stripe queries across processes (per-query output files
     # are independent, so hosts never contend; -1/0 → auto-detect,
     # single-host runs get the identity stripe)
     host_index, host_count = resolve_host_stripe(config)
-    # one decode-ahead worker: the next pano decodes while the device chews
-    # on the current pair (and the first pano while the query preprocesses)
-    # — the eval twin of the training loader's prefetch (the reference
-    # decodes serially, eval_inloc.py:129)
-    from concurrent.futures import ThreadPoolExecutor
-
-    def pano_jobs(q):
-        n_panos = min(config.n_panos, len(pano_fns[q]))
-        return [
-            os.path.join(config.pano_path, _as_str(pano_fns[q][idx]))
-            for idx in range(n_panos)
-        ]
-
-    def process_query(q, io_pool):
-        out_path = os.path.join(out_dir, f"{q + 1}.mat")
-        if progress:
-            log.info(str(q))
-        matches = np.zeros((1, config.n_panos, n_cap, 5))
-        jobs = pano_jobs(q)
-        # an empty shortlist row still writes its all-zeros table
-        pending = io_pool.submit(load_raw, jobs[0]) if jobs else None
-        # preprocess the query ONCE; it is reused across its ~10 pano pairs
-        src = matcher.preprocess(
-            load_raw(os.path.join(config.query_path, query_fns[q]))
-        )
-        # pipelined dispatch: pair idx+1's upload + forward are dispatched
-        # (async) before pair idx's result is pulled, so the tunnel's
-        # dispatch/transfer latency hides behind the previous pair's device
-        # compute and host-side sort/dedup.  The depth adapts to the
-        # tunnel's latency regime (see _PipelineDepthController); each
-        # in-flight slot holds one preprocessed pano (~90 MB at 3200 px).
-        depth_ctl.note_gap()  # query preprocess/IO gap is not pair latency
-        in_flight = []  # [(idx, handle)]
-
-        def drain_one(sample: bool = True):
-            idx0, handle = in_flight.pop(0)
-            # the watchdog converts a hung tunnel fetch into a retryable
-            # FetchTimeoutError that the per-query isolation absorbs
-            with span("fetch", pair=idx0):
-                xa, ya, xb, yb, score = call_with_watchdog(
-                    matcher.fetch, (handle,),
-                    timeout=config.fetch_timeout_s,
-                    label=f"InLoc query {q + 1} pair {idx0}",
-                )
-            if sample:
-                depth_ctl.note_drain()
-            else:
-                # end-of-query tail: queued pairs fetch back-to-back with no
-                # dispatch between them — not a per-pair wall; recording
-                # them would bias the controller toward spurious shrink
-                depth_ctl.note_gap()
-            store_pair(idx0, xa, ya, xb, yb, score)
-
-        def store_pair(idx, xa, ya, xb, yb, score):
-            if config.matching_both_directions:
-                # single-direction outputs stay in grid order, as in the
-                # reference (sort/dedup only happens in both-dirs mode,
-                # eval_inloc.py:151-177)
-                xa, ya, xb, yb, score = sort_and_dedup(xa, ya, xb, yb, score)
-            if len(xa) > n_cap:
-                # non-3:4-aspect pano overflowing the nominal table (the
-                # reference would crash here): keep the n_cap highest-scoring
-                # rows, preserving their current order
-                log.warning(f"{len(xa)} matches exceed capacity {n_cap}; "
-                            "keeping highest-scoring rows",
-                            kind="validation")
-                sel = np.sort(np.argsort(-score, kind="stable")[:n_cap])
-                xa, ya, xb, yb, score = (v[sel] for v in (xa, ya, xb, yb, score))
-            npts = len(xa)
-            matches[0, idx, :npts, 0] = xa[:npts]
-            matches[0, idx, :npts, 1] = ya[:npts]
-            matches[0, idx, :npts, 2] = xb[:npts]
-            matches[0, idx, :npts, 3] = yb[:npts]
-            matches[0, idx, :npts, 4] = score[:npts]
-            if progress and idx % 10 == 0:
-                log.info(">>>" + str(idx))
-
-        for idx in range(len(jobs)):
-            # decode span = the WAIT on the decode-ahead worker, i.e. the
-            # part of pano decode the pipeline failed to hide
-            with span("decode", pair=idx):
-                tgt = pending.result()
-            if idx + 1 < len(jobs):
-                pending = io_pool.submit(load_raw, jobs[idx + 1])
-            with span("dispatch", pair=idx):
-                in_flight.append((idx, matcher.dispatch(src, tgt)))
-            # `while`, not `if`: when the controller SHRINKS the depth
-            # mid-query the extra in-flight slots must actually drain, or
-            # the old deeper queue (and its ~90 MB/slot pano buffers)
-            # would persist to the end of the query.  Only the FIRST drain
-            # of the iteration is a per-pair wall sample: subsequent ones
-            # fetch already-completed results back-to-back, and their ~0 s
-            # intervals would corrupt the controller's min-wall estimate.
-            first = True
-            while len(in_flight) >= depth_ctl.depth:
-                drain_one(sample=first)
-                first = False
-        while in_flight:
-            drain_one(sample=False)
-        atomic_savemat(
-            out_path,
-            {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
-            do_compression=True,
-        )
-
-    manifest = None
-    if config.write_manifest:
-        manifest = RunManifest(
-            os.path.join(out_dir, manifest_name(host_index, host_count)),
-            meta={
-                "experiment": output_folder_name(config),
-                "n_queries": n_queries,
-                "n_panos": config.n_panos,
-                "host_index": host_index,
-                "host_count": host_count,
-            },
-        )
-    policy = FaultPolicy(retries=config.query_retries,
-                         backoff_s=config.retry_backoff_s,
-                         quarantine=config.quarantine)
-    breaker = QuarantineBreaker(policy.max_consecutive_quarantines)
 
     # observability: an explicit telemetry dir opens (and globally binds) an
     # event log for the run — per-query events here, retry/quarantine/tier
     # events from the deep layers; otherwise events flow to any sink the
-    # caller already bound, or nowhere, for free
+    # caller already bound, or nowhere, for free.  Bound BEFORE the feature
+    # store below is constructed, so its store_open / GC / health events
+    # land in THIS run's log (run_report --store replays them).
     own_sink = prev_sink = None
     n_done = 0
     if config.telemetry_dir:
@@ -768,6 +674,221 @@ def run_inloc_eval(
         own_sink.emit("run_start",
                       envelope=obs_events.run_envelope(own_sink.run_id),
                       eval="inloc", n_queries=n_queries)
+
+    store = None  # assigned below; hoisted so the failure handler can close
+    try:
+        # per-pair match-quality signals (README "Quality observability"):
+        # computed in the pair program, fetched with the match table, streamed
+        # as tier-tagged `quality` events and digested per run — the label-free
+        # accuracy monitor this eval otherwise lacks entirely (InLoc has no
+        # in-loop metric; a silent tier regression here only surfaces after the
+        # downstream PnP stage, hours later)
+        from ncnet_tpu.observability.metrics import MetricsRegistry
+        from ncnet_tpu.observability.quality import emit_quality
+
+        from ncnet_tpu.observability.quality import active_tier
+
+        quality_registry = MetricsRegistry(scope="inloc_eval")
+        # memory observability at query boundaries (observability/memory.py):
+        # rate-limited device_snapshot events (HBM pressure beside the query
+        # timeline — the InLoc volume is the repo's biggest allocation) and
+        # the live-array leak sentinel (a handle retained across queries grows
+        # without bound at ~90 MB per preprocessed pano)
+        from ncnet_tpu.observability.device import DeviceMonitor
+        from ncnet_tpu.observability.memory import LeakSentinel
+
+        dev_monitor = DeviceMonitor(every_s=30.0)
+        leak_sentinel = LeakSentinel(window=4, min_interval_s=1.0,
+                                     scope="inloc_eval")
+
+        def on_pair_quality(signals):
+            emit_quality("inloc_eval", signals,
+                         tier=active_tier(model_config.half_precision),
+                         registry=quality_registry)
+
+        # persistent database-side feature store (ncnet_tpu/store/; README
+        # "Feature store"): pano features are resolved through verified cached
+        # entries keyed by (image content digest, backbone fingerprint), so a
+        # warm query pays ONE backbone extraction (its own) instead of 1 + 10.
+        # Disabled under spatial sharding — the sharded forward takes images,
+        # not features — and fail-open by construction: any store trouble only
+        # means recompute, never a failed or wrong query.
+        store = None
+        if config.feature_store_dir:
+            if mesh is not None:
+                log.warning(
+                    "feature_store_dir ignored under spatial_shards > 1 (the "
+                    "hB-sharded forward consumes images, not cached features)",
+                    kind="validation")
+            else:
+                from ncnet_tpu.store import FeatureStore, backbone_fingerprint
+
+                fp = backbone_fingerprint(
+                    params, image_size=config.image_size, k_size=config.k_size,
+                    dtype="bf16" if model_config.half_precision else "f32")
+                store = FeatureStore(
+                    config.feature_store_dir, fp,
+                    budget_bytes=config.feature_store_budget_mb * 2 ** 20,
+                    scope="inloc_eval")
+                # superseded-generation GC: entries computed under OTHER
+                # weights can never be read again (fingerprint mismatch is a
+                # miss), so they only waste the budget
+                store.gc_superseded()
+
+        matcher = make_pair_matcher(
+            model_config, params,
+            do_softmax=config.softmax,
+            both_directions=config.matching_both_directions,
+            flip_direction=config.flip_matching_direction,
+            mesh=mesh,
+            # raw uint8 in, normalize+resize on device: the upload is the
+            # dominant per-pair cost and raw bytes are 4-15x smaller
+            preprocess_image_size=config.image_size,
+            quality_cb=on_pair_quality,
+            store=store,
+        )
+        n_cap = match_capacity(
+            config.image_size, config.k_size, config.matching_both_directions
+        )
+
+        # one decode-ahead worker: the next pano decodes while the device chews
+        # on the current pair (and the first pano while the query preprocesses)
+        # — the eval twin of the training loader's prefetch (the reference
+        # decodes serially, eval_inloc.py:129)
+        from concurrent.futures import ThreadPoolExecutor
+
+        def pano_jobs(q):
+            n_panos = min(config.n_panos, len(pano_fns[q]))
+            return [
+                os.path.join(config.pano_path, _as_str(pano_fns[q][idx]))
+                for idx in range(n_panos)
+            ]
+
+        def process_query(q, io_pool):
+            out_path = os.path.join(out_dir, f"{q + 1}.mat")
+            if progress:
+                log.info(str(q))
+            matches = np.zeros((1, config.n_panos, n_cap, 5))
+            jobs = pano_jobs(q)
+            # an empty shortlist row still writes its all-zeros table
+            pending = io_pool.submit(load_raw, jobs[0]) if jobs else None
+            # preprocess the query ONCE; it is reused across its ~10 pano pairs
+            src = matcher.preprocess(
+                load_raw(os.path.join(config.query_path, query_fns[q]))
+            )
+            # pipelined dispatch: pair idx+1's upload + forward are dispatched
+            # (async) before pair idx's result is pulled, so the tunnel's
+            # dispatch/transfer latency hides behind the previous pair's device
+            # compute and host-side sort/dedup.  The depth adapts to the
+            # tunnel's latency regime (see _PipelineDepthController); each
+            # in-flight slot holds one preprocessed pano (~90 MB at 3200 px).
+            depth_ctl.note_gap()  # query preprocess/IO gap is not pair latency
+            in_flight = []  # [(idx, handle)]
+
+            def drain_one(sample: bool = True):
+                idx0, handle = in_flight.pop(0)
+                # the watchdog converts a hung tunnel fetch into a retryable
+                # FetchTimeoutError that the per-query isolation absorbs
+                with span("fetch", pair=idx0):
+                    xa, ya, xb, yb, score = call_with_watchdog(
+                        matcher.fetch, (handle,),
+                        timeout=config.fetch_timeout_s,
+                        label=f"InLoc query {q + 1} pair {idx0}",
+                    )
+                if sample:
+                    depth_ctl.note_drain()
+                else:
+                    # end-of-query tail: queued pairs fetch back-to-back with no
+                    # dispatch between them — not a per-pair wall; recording
+                    # them would bias the controller toward spurious shrink
+                    depth_ctl.note_gap()
+                store_pair(idx0, xa, ya, xb, yb, score)
+
+            def store_pair(idx, xa, ya, xb, yb, score):
+                if config.matching_both_directions:
+                    # single-direction outputs stay in grid order, as in the
+                    # reference (sort/dedup only happens in both-dirs mode,
+                    # eval_inloc.py:151-177)
+                    xa, ya, xb, yb, score = sort_and_dedup(xa, ya, xb, yb, score)
+                if len(xa) > n_cap:
+                    # non-3:4-aspect pano overflowing the nominal table (the
+                    # reference would crash here): keep the n_cap highest-scoring
+                    # rows, preserving their current order
+                    log.warning(f"{len(xa)} matches exceed capacity {n_cap}; "
+                                "keeping highest-scoring rows",
+                                kind="validation")
+                    sel = np.sort(np.argsort(-score, kind="stable")[:n_cap])
+                    xa, ya, xb, yb, score = (v[sel] for v in (xa, ya, xb, yb, score))
+                npts = len(xa)
+                matches[0, idx, :npts, 0] = xa[:npts]
+                matches[0, idx, :npts, 1] = ya[:npts]
+                matches[0, idx, :npts, 2] = xb[:npts]
+                matches[0, idx, :npts, 3] = yb[:npts]
+                matches[0, idx, :npts, 4] = score[:npts]
+                if progress and idx % 10 == 0:
+                    log.info(">>>" + str(idx))
+
+            for idx in range(len(jobs)):
+                # decode span = the WAIT on the decode-ahead worker, i.e. the
+                # part of pano decode the pipeline failed to hide
+                with span("decode", pair=idx):
+                    tgt = pending.result()
+                if idx + 1 < len(jobs):
+                    pending = io_pool.submit(load_raw, jobs[idx + 1])
+                if store is not None:
+                    # database side through the store: verified hit, or
+                    # recompute + commit — this pair then dispatches the
+                    # zero-extraction feature-pair program either way
+                    with span("store_resolve", pair=idx):
+                        tgt = matcher.prepare_db(tgt)
+                with span("dispatch", pair=idx):
+                    in_flight.append((idx, matcher.dispatch(src, tgt)))
+                # `while`, not `if`: when the controller SHRINKS the depth
+                # mid-query the extra in-flight slots must actually drain, or
+                # the old deeper queue (and its ~90 MB/slot pano buffers)
+                # would persist to the end of the query.  Only the FIRST drain
+                # of the iteration is a per-pair wall sample: subsequent ones
+                # fetch already-completed results back-to-back, and their ~0 s
+                # intervals would corrupt the controller's min-wall estimate.
+                first = True
+                while len(in_flight) >= depth_ctl.depth:
+                    drain_one(sample=first)
+                    first = False
+            while in_flight:
+                drain_one(sample=False)
+            atomic_savemat(
+                out_path,
+                {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
+                do_compression=True,
+            )
+
+        manifest = None
+        if config.write_manifest:
+            manifest = RunManifest(
+                os.path.join(out_dir, manifest_name(host_index, host_count)),
+                meta={
+                    "experiment": output_folder_name(config),
+                    "n_queries": n_queries,
+                    "n_panos": config.n_panos,
+                    "host_index": host_index,
+                    "host_count": host_count,
+                },
+            )
+        policy = FaultPolicy(retries=config.query_retries,
+                             backoff_s=config.retry_backoff_s,
+                             quarantine=config.quarantine)
+        breaker = QuarantineBreaker(policy.max_consecutive_quarantines)
+    except BaseException:
+        # construction failed after the sink was globally bound: the
+        # run's finally below never runs, so restore/close here —
+        # a leaked global sink would swallow the NEXT run's events (and
+        # a leaked store would hold its journal handle open)
+        if store is not None:
+            store.close()
+        if own_sink is not None:
+            obs_events.set_global_sink(prev_sink)
+            own_sink.close()
+        raise
 
     def _query_loop(io_pool):
         nonlocal n_done
@@ -864,11 +985,25 @@ def run_inloc_eval(
                         kind="quarantine")
         # flush the per-run quality digests beside the completion summary
         # (one `metrics` event; the drift tool and run_report read both)
+        summary_extra = {}
+        if store is not None:
+            # the store's per-run counters + the extraction spy ride the
+            # summary: a warm run shows hits == pairs, misses == 0, and
+            # feature_extractions == completed queries (one trunk each)
+            summary_extra["store"] = store.health()
+            summary_extra["feature_extractions"] = \
+                matcher.feature_extractions
         quality_registry.flush(event="eval_summary", eval="inloc",
                                completed=n_done,
                                quarantined=(list(manifest.quarantined_ids)
-                                            if manifest is not None else []))
+                                            if manifest is not None else []),
+                               **summary_extra)
     finally:
+        if store is not None:
+            # the durable stats record run_report --store replays, then
+            # release the journal handle
+            store.flush_stats(eval="inloc")
+            store.close()
         if own_sink is not None:
             obs_events.set_global_sink(prev_sink)
             own_sink.close()

@@ -646,6 +646,32 @@ def ncnet_forward_from_features(
     return ncnet_filter(config, params, corr)
 
 
+def ncnet_forward_from_feature_pair(
+    config: ModelConfig,
+    params,
+    source_features: jnp.ndarray,
+    target_features: jnp.ndarray,
+) -> NCNetOutput:
+    """Forward with BOTH sides' backbone features precomputed — the
+    feature-store serving shape (ncnet_tpu/store/): the query's features
+    come from ``matcher.preprocess`` (computed once per query) and the
+    database side's from the persistent store, so a warm-store pair runs
+    ZERO backbone extractions.  Both feature tensors must be exactly
+    ``extract_features(config, params, img)`` outputs (f32, pre-bf16-cast
+    — the cast happens here so stored bytes are precision-independent).
+    The :func:`ncnet_forward_from_features` identity caveat applies
+    doubly: bit-stability holds within one input path, which is why the
+    store-backed eval uses this path for EVERY pair (hit and miss alike)
+    — a hit's bytes are checksum-identical to the miss's compute, so the
+    two are bitwise-interchangeable by construction."""
+    fa, fb = source_features, target_features
+    if config.half_precision:
+        fa = fa.astype(jnp.bfloat16)
+        fb = fb.astype(jnp.bfloat16)
+    corr = correlation_4d(fa, fb)
+    return ncnet_filter(config, params, corr)
+
+
 def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
                  remat_nc_layers: bool = False,
                  nc_custom_grad: bool = False,

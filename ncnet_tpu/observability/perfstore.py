@@ -265,7 +265,12 @@ _HIGHER_TOKENS = ("pck", "pairs_per_s", "pairs_per_sec", "qps",
                   # match-quality signals (observability/quality.py): the
                   # accuracy trajectory gates alongside the walls
                   "margin", "mnn_agreement", "coherence", "score_gap",
-                  "quality_score")
+                  "quality_score",
+                  # feature store (ncnet_tpu/store/): the cache-
+                  # effectiveness fraction from the bench's cached-
+                  # localization scenario — a falling hit rate is the
+                  # store silently losing its reason to exist
+                  "hit_pct")
 _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  "_step_s", "_wall_s",
                  # diffuse match distributions are worse: entropy gates

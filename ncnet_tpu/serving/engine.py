@@ -16,6 +16,17 @@ eval matchers: ``dispatch`` enqueues without blocking (jax async dispatch),
 programs so :func:`~ncnet_tpu.models.ncnet.recover_from_device_failure` can
 demote a poisoned Pallas tier and rebuild on the survivor — the service's
 degraded-mode path.
+
+**Store-backed pair path** (``store=``, ncnet_tpu/store/): with a
+persistent feature store attached, each dispatched batch resolves its
+SOURCE rows' backbone features through verified cached entries (content
+digest of the padded uint8 row + the weights fingerprint) and runs a
+cached-pair program — the localization-as-a-service shape, where the
+source side is a fixed database image repeating across requests and a warm
+store halves the extraction work per pair.  The store's degradation ladder
+(``FeatureStore.resolve``) guarantees it can only make a batch SLOWER
+(recompute), never wrong and never fatal; ``store=None`` (the default)
+leaves the engine bit-identical to the pre-store path.
 """
 
 from __future__ import annotations
@@ -40,17 +51,29 @@ class BatchMatchEngine:
 
     def __init__(self, config: ModelConfig, params, *,
                  do_softmax: bool = True, scale: str = "centered",
-                 device=None):
+                 device=None, store=None):
         import jax
         import jax.numpy as jnp
 
-        from ncnet_tpu.models.ncnet import ResilientJit, ncnet_forward
+        from ncnet_tpu.models.ncnet import (
+            ResilientJit,
+            extract_features,
+            ncnet_forward,
+            ncnet_forward_from_features,
+        )
         from ncnet_tpu.observability.quality import append_quality_rows
         from ncnet_tpu.ops import corr_to_matches
         from ncnet_tpu.ops.image import normalize_imagenet
 
         self.config = config
         self.device = device
+        # persistent feature store (ncnet_tpu/store/): when given, dispatch
+        # resolves each SOURCE row's backbone features through it (content
+        # digest of the padded uint8 row) and runs the cached-pair program
+        # — the localization-as-a-service shape where the src side is a
+        # fixed database image that repeats across requests.  Fail-open by
+        # construction: store trouble only means recompute.
+        self._store = store
         # staged once, every batch; committing the params to an explicit
         # device pins every jit dispatch there — the replica-pool seam
         # (serving/replica.py): one engine per visible device
@@ -58,10 +81,10 @@ class BatchMatchEngine:
                         if device is not None else jax.device_put(params))
         k = max(config.relocalization_k_size, 1)
 
-        def run(p, src, tgt):
-            src = normalize_imagenet(src.astype(jnp.float32))
-            tgt = normalize_imagenet(tgt.astype(jnp.float32))
-            out = ncnet_forward(config, p, src, tgt)
+        def tables_from(out):
+            """THE match-extraction tail, shared by both pair programs —
+            the store-backed path must never silently diverge from the
+            default path's table shape or quality-row wire layout."""
             m = corr_to_matches(
                 out.corr, delta4d=out.delta4d, k_size=k,
                 do_softmax=do_softmax, scale=scale,
@@ -71,6 +94,26 @@ class BatchMatchEngine:
             # the quality-row wire layout has ONE home (quality.py): the
             # pair's signals ride as row 5 → (B, 6, N), narrow grids skip
             return append_quality_rows(table, out.corr)
+
+        def run(p, src, tgt):
+            src = normalize_imagenet(src.astype(jnp.float32))
+            tgt = normalize_imagenet(tgt.astype(jnp.float32))
+            return tables_from(ncnet_forward(config, p, src, tgt))
+
+        def run_cached(p, fa, tgt):
+            # the store-backed pair: src features precomputed (verified
+            # store bytes or a just-committed recompute), tgt extracted
+            # in-program — ONE backbone extraction per pair instead of two
+            tgt = normalize_imagenet(tgt.astype(jnp.float32))
+            return tables_from(
+                ncnet_forward_from_features(config, p, fa, tgt))
+
+        def run_feat(p, src):
+            # THE extraction program store misses replay — its output
+            # bytes are what the store commits, so a hit is bitwise what a
+            # miss would have computed
+            return extract_features(
+                config, p, normalize_imagenet(src.astype(jnp.float32)))
 
         from ncnet_tpu.observability.quality import active_tier
 
@@ -86,16 +129,51 @@ class BatchMatchEngine:
                 f"xb{s.shape[0]}"),
             ledger_tier=lambda: active_tier(self.half_precision),
         )
+        # the cached-pair + extraction programs (store path only; never
+        # dispatched when store is None, so the default engine's injected-
+        # fault ordinals and numerics are untouched)
+        self._jitted_cached = ResilientJit(
+            run_cached, label="serve_batch",
+            ledger_program="serve_batch",
+            ledger_key_fn=lambda p, fa, t: (
+                f"feat{'x'.join(str(d) for d in fa.shape[1:])}"
+                f"-{t.shape[1]}x{t.shape[2]}xb{fa.shape[0]}"),
+            ledger_tier=lambda: active_tier(self.half_precision),
+        )
+        self._feat = ResilientJit(run_feat, hook=False)
+        self.feature_extractions = 0  # executed trunk dispatches (the spy)
 
     def dispatch(self, src_u8: np.ndarray, tgt_u8: np.ndarray):
         """Enqueue upload + forward + match extraction; returns the
         on-device handle without blocking.  The fault-injection seam
         (``faults.device_fail_calls``) lives on the ResilientJit dispatch,
-        exactly like the eval pair programs."""
+        exactly like the eval pair programs.
+
+        With a feature store attached, each SOURCE row resolves through it
+        first (verified hit / recompute + commit) and the batch runs the
+        cached-pair program — the resolve is the one blocking step (a miss
+        pulls the computed features to host to commit them)."""
         import jax.numpy as jnp
 
-        return self._jitted(self._params, jnp.asarray(src_u8),
-                            jnp.asarray(tgt_u8))
+        if self._store is None:
+            return self._jitted(self._params, jnp.asarray(src_u8),
+                                jnp.asarray(tgt_u8))
+        from ncnet_tpu.store import content_digest
+
+        rows = []
+        for i in range(src_u8.shape[0]):
+            row = np.ascontiguousarray(src_u8[i])
+
+            def compute(row=row) -> np.ndarray:
+                self.feature_extractions += 1
+                return np.asarray(
+                    self._feat(self._params, jnp.asarray(row[None])),
+                    dtype=np.float32)[0]
+
+            arr, _status = self._store.resolve(content_digest(row), compute)
+            rows.append(arr)
+        fa = jnp.asarray(np.stack(rows))
+        return self._jitted_cached(self._params, fa, jnp.asarray(tgt_u8))
 
     def fetch(self, handle) -> np.ndarray:
         """Block on the device result; one pull per batch."""
@@ -106,6 +184,8 @@ class BatchMatchEngine:
         dispatch re-traces through the tier chooser — the demote-retrace
         recovery seam."""
         self._jitted.retrace()
+        self._jitted_cached.retrace()
+        self._feat.retrace()
 
     @property
     def half_precision(self) -> bool:

@@ -119,6 +119,7 @@ def build_health_document(machine: HealthMachine,
                           slo: Optional[Dict[str, Any]] = None,
                           activity: Optional[Dict[str, Any]] = None,
                           memory: Optional[Dict[str, Any]] = None,
+                          store: Optional[Dict[str, Any]] = None,
                           ) -> Dict[str, Any]:
     """THE one health document (``HEALTH_DOC_SCHEMA``-versioned) — the
     ``/healthz`` body, ``MatchService.health()`` return value, the final
@@ -147,6 +148,12 @@ def build_health_document(machine: HealthMachine,
         tracks one): the warmed ladder's predicted footprint from the
         compiled-program ledger, per-replica HBM watermarks, and the
         headroom against ``bytes_limit``.
+      * ``store`` — the persistent feature store's health
+        (``FeatureStore.health()``, when one is attached): OK/DEGRADED
+        state + hit/miss/corrupt/evict counters + footprint.  A DEGRADED
+        store is an operator signal, NOT a serving outage — the store
+        fails open to recompute, so ``stall_watchdog --url`` must (and
+        does) treat store-DEGRADED as degraded-but-serving, never stalled.
     """
     ready = sum(1 for r in replicas if r.get("state") == "READY")
     doc: Dict[str, Any] = {
@@ -164,4 +171,6 @@ def build_health_document(machine: HealthMachine,
         doc["activity"] = activity
     if memory is not None:
         doc["memory"] = memory
+    if store is not None:
+        doc["store"] = store
     return doc

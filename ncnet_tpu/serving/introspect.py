@@ -231,6 +231,47 @@ def metrics_families(service) -> List[Family]:
             fams.append(hbm_bytes)
         if hbm_fill.samples:
             fams.append(hbm_fill)
+
+    # persistent feature store (ncnet_tpu/store/): the ncnet_store_*
+    # families — OK/DEGRADED, the hit/miss/corrupt/evict/degraded counters
+    # (monotone within one store lifetime), and the footprint gauges.  A
+    # DEGRADED store serves on via recompute (fail-open), so ncnet_store_up
+    # going 0 is an operator page about the DISK, not about availability.
+    st = doc.get("store")
+    if st is not None:
+        fams.append(Family(
+            "ncnet_store_up", "gauge",
+            "1 = feature store OK, 0 = DEGRADED (failing open to "
+            "recompute)").add(1 if st.get("state") == "OK" else 0))
+        c = st.get("counters") or {}
+        for metric, key, help_text in (
+                ("ncnet_store_hits_total", "hits",
+                 "verified feature-store read hits"),
+                ("ncnet_store_misses_total", "misses",
+                 "feature-store misses (recomputed + committed)"),
+                ("ncnet_store_corrupt_total", "corrupt",
+                 "entries that failed verification and were quarantined"),
+                ("ncnet_store_evictions_total", "evictions",
+                 "LRU evictions under the size budget"),
+                ("ncnet_store_degraded_ops_total", "degraded_ops",
+                 "store operations that failed open (I/O errors)")):
+            if key in c:
+                fams.append(Family(metric, "counter", help_text)
+                            .add(c[key]))
+        fams.append(Family("ncnet_store_entries", "gauge",
+                           "live entries in the current generation")
+                    .add(st.get("entries", 0)))
+        fams.append(Family("ncnet_store_bytes", "gauge",
+                           "bytes used by the current generation")
+                    .add(st.get("bytes", 0)))
+        if st.get("budget_bytes"):
+            fams.append(Family("ncnet_store_budget_bytes", "gauge",
+                               "LRU eviction budget (0 = unbounded)")
+                        .add(st["budget_bytes"]))
+        if st.get("hit_pct") is not None:
+            fams.append(Family("ncnet_store_hit_pct", "gauge",
+                               "verified-hit percentage over all lookups")
+                        .add(st["hit_pct"]))
     return fams
 
 
@@ -286,6 +327,18 @@ def render_statusz(service) -> str:
         if head is not None:
             line += f"  headroom vs bytes_limit {head / 2 ** 20:.1f} MiB"
         add(line)
+    st = doc.get("store")
+    if st is not None:
+        add("")
+        c = st.get("counters") or {}
+        hp = st.get("hit_pct")
+        add(f"feature store: {st.get('state')}"
+            + (f" ({st.get('reason')})" if st.get("reason") else "")
+            + f"  entries={st.get('entries')}"
+            f"  bytes={(st.get('bytes') or 0) / 2 ** 20:.1f} MiB"
+            + (f"  hit%={hp:.1f}" if hp is not None else "")
+            + f"  corrupt={c.get('corrupt', 0)}"
+            f"  evictions={c.get('evictions', 0)}")
     slo = doc.get("slo")
     if slo is not None and slo["admitted"]:
         add("")

@@ -158,6 +158,11 @@ class ServingConfig:
     # MatchService.introspect_url)
     introspect_port: Optional[int] = None
     introspect_host: str = "127.0.0.1"
+    # persistent database-side feature store (ncnet_tpu/store/; README
+    # "Feature store"): source-row backbone features cached on disk,
+    # verified on read, shared by every replica's engine.  None = off.
+    feature_store_dir: Optional[str] = None
+    feature_store_budget_mb: int = 0    # LRU-evict above this (0 = unbounded)
     # match extraction
     do_softmax: bool = True
     scale: str = "centered"
@@ -196,8 +201,27 @@ class MatchService:
 
     def __init__(self, model_config=None, params=None,
                  serving: ServingConfig = ServingConfig(), *,
-                 engine=None, registry: Optional[MetricsRegistry] = None):
+                 engine=None, registry: Optional[MetricsRegistry] = None,
+                 store=None):
         self.cfg = serving
+        # one persistent feature store SHARED across the pool (the store is
+        # thread-safe; entries are device-independent f32 bytes).  Built
+        # from the config when a model is given, or injected (chaos tests
+        # attach one beside fake engines to exercise the health section).
+        if store is None and serving.feature_store_dir \
+                and model_config is not None and params is not None:
+            from ncnet_tpu.store import FeatureStore, backbone_fingerprint
+
+            fp = backbone_fingerprint(
+                params, image_size="serve",
+                k_size=max(model_config.relocalization_k_size, 1),
+                dtype="bf16" if model_config.half_precision else "f32")
+            store = FeatureStore(
+                serving.feature_store_dir, fp,
+                budget_bytes=serving.feature_store_budget_mb * 2 ** 20,
+                scope="serving")
+            store.gc_superseded()
+        self._store = store
         if engine is not None:
             engines = list(engine) if isinstance(engine, (list, tuple)) \
                 else [engine]
@@ -210,6 +234,7 @@ class MatchService:
                 model_config, params, serving.replicas,
                 on_change=self._on_pool_change,
                 do_softmax=serving.do_softmax, scale=serving.scale,
+                store=self._store,
             )
         self._registry = registry or MetricsRegistry(scope="serving")
         self._bucketer = ShapeBucketer(
@@ -566,6 +591,8 @@ class MatchService:
                     "batches": self._batch_seq,
                 },
                 memory=self._memory_doc_locked(),
+                store=(self._store.health()
+                       if self._store is not None else None),
             )
 
     def _memory_doc_locked(self) -> Dict[str, Any]:
@@ -1370,6 +1397,11 @@ class MatchService:
         # consumer (run_report --slo) must reproduce exactly from the
         # terminal events above it in this same log
         obs_events.emit("slo", final=True, **self._slo.snapshot())
+        if self._store is not None:
+            # the durable per-run store stats (run_report --store replays
+            # them); the journal handle closes with the service
+            self._store.flush_stats()
+            self._store.close()
         self._registry.flush(scope="serving")
         with self._cond:
             if self._health.state != STOPPED:

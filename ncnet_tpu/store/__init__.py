@@ -1,0 +1,28 @@
+"""Persistent database-side feature store (localization-as-a-service).
+
+Public surface: :class:`FeatureStore` (verified reads, two-phase atomic
+commits, fail-open degradation, LRU eviction, generation GC) and the key
+helpers :func:`content_digest` / :func:`backbone_fingerprint` /
+:func:`weights_digest` — see ``feature_store.py`` for the design and the
+README "Feature store" section for the operator view.
+"""
+
+from ncnet_tpu.store.feature_store import (  # noqa: F401
+    SCHEMA_VERSION,
+    STORE_DEGRADED,
+    STORE_OK,
+    FeatureStore,
+    backbone_fingerprint,
+    content_digest,
+    weights_digest,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "STORE_DEGRADED",
+    "STORE_OK",
+    "FeatureStore",
+    "backbone_fingerprint",
+    "content_digest",
+    "weights_digest",
+]

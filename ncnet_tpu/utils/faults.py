@@ -83,6 +83,20 @@ engine's ResilientJit carries label ``serve_batch``) and
     against spawned ``tools/serve_backend.py`` processes; this hook is the
     in-process deterministic seam.
 
+The persistent feature store (ncnet_tpu/store/) claims a strict degradation
+ladder — a query NEVER fails and NEVER uses bad data — so its crash /
+corruption windows get deterministic seams too:
+
+  * ``store_commit_kill_hook(path)`` — SIGKILLs the process between the
+    payload write and the commit rename of the Nth entry commit (a ``.tmp``
+    carcass, no visible entry — the rerun rebuilds it).
+  * ``store_bitflip_hook(path)``     — called post-commit: flips one payload
+    bit of matching committed entries, so the next verified read must fail
+    the checksum, quarantine the entry, and recompute.
+  * ``store_io_hook(op, path)``      — raises ``OSError(ENOSPC)`` on armed
+    store operations (read/write/evict/journal): the store must fail open
+    to recompute and mark itself DEGRADED, never fail the query.
+
 Arming: programmatic via :func:`install`/:func:`clear` (or the
 :func:`injected` context manager) in-process, or the ``NCNET_TPU_FAULTS``
 environment variable (a JSON object of :class:`FaultPlan` fields) for
@@ -189,6 +203,22 @@ class FaultPlan:
     # classify DeadlineExceeded, never a zombie success
     hang_backend_urls: Tuple[str, ...] = ()
     hang_backend_seconds: float = 0.5
+    # --- feature-store faults (ncnet_tpu/store/ layer) ---
+    # entry paths containing any of these substrings get ONE payload bit
+    # flipped immediately AFTER their commit rename — the media-corruption
+    # shape the per-entry checksum exists for: the next verified read must
+    # detect it, quarantine the entry, and transparently recompute
+    store_bitflip_paths: Tuple[str, ...] = ()
+    # store operations ("read", "write", "evict", "journal") that raise
+    # OSError(ENOSPC) at their hook site — the disk-full / IO-error shape:
+    # the store must fail OPEN (query answered via recompute) and mark
+    # itself DEGRADED in health/telemetry, never fail the query
+    store_io_error_ops: Tuple[str, ...] = ()
+    # SIGKILL self during the Nth store entry commit (1-based, process-
+    # global counter), between the payload write and the rename — the
+    # two-phase-commit crash window: a rerun must see NO visible entry
+    # (only a .tmp carcass) and rebuild it
+    kill_at_store_commit: int = -1
 
 
 _plan: Optional[FaultPlan] = None
@@ -197,15 +227,17 @@ _decode_attempts: Dict[str, int] = {}
 _savemat_attempts: Dict[str, int] = {}
 _device_calls = 0
 _watchdog_calls = 0
+_store_commits = 0
 _lock = threading.Lock()
 
 
 def _reset_counters_locked() -> None:
-    global _device_calls, _watchdog_calls
+    global _device_calls, _watchdog_calls, _store_commits
     _decode_attempts.clear()
     _savemat_attempts.clear()
     _device_calls = 0
     _watchdog_calls = 0
+    _store_commits = 0
 
 
 def install(plan: FaultPlan) -> None:
@@ -475,3 +507,57 @@ def event_kill_hook(n_append: int, write_partial: Callable[[], None]) -> None:
         return
     write_partial()
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+# ---------------------------------------------------------------------------
+# feature-store hooks (ncnet_tpu/store/ layer)
+# ---------------------------------------------------------------------------
+
+
+def store_io_hook(op: str, path: str = "") -> None:
+    """Raise ``OSError(ENOSPC)`` when store operation ``op`` ("read" /
+    "write" / "evict" / "journal") is armed — the disk-full shape the
+    store's fail-open degradation ladder must absorb: the query is still
+    answered (via recompute), the store goes DEGRADED, nothing crashes."""
+    p = _active()
+    if p is None or not p.store_io_error_ops:
+        return
+    if op in p.store_io_error_ops:
+        import errno
+
+        raise OSError(errno.ENOSPC,
+                      f"injected store {op} failure (no space left)", path)
+
+
+def store_commit_kill_hook(path: str) -> None:
+    """SIGKILL self between the payload write and the commit rename of the
+    Nth store entry commit (1-based, if armed) — the two-phase-commit crash
+    window: the rerun must see only a ``.tmp`` carcass, never a torn
+    visible entry."""
+    p = _active()
+    if p is None or p.kill_at_store_commit < 0:
+        return
+    global _store_commits
+    with _lock:
+        _store_commits += 1
+        n = _store_commits
+    if n == p.kill_at_store_commit:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def store_bitflip_hook(path: str) -> None:
+    """Flip one bit of a committed store entry's PAYLOAD (the file's last
+    byte — the header line is at the front) for matching paths — the
+    silent-media-corruption shape: a later verified read must fail the
+    checksum, quarantine the entry, and recompute, never return the
+    poisoned bytes."""
+    p = _active()
+    if p is None or not p.store_bitflip_paths:
+        return
+    if not any(s and s in path for s in p.store_bitflip_paths):
+        return
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([byte[0] ^ 0x01]))

@@ -9,12 +9,49 @@ mid-``savemat`` must not leave a truncated file that a rerun then skips.
 (evaluation/resilience.py) journal completed / quarantined / in-flight work
 units through the same temp-file + ``os.replace`` commit, so a manifest read
 never sees a half-written document.
+
+Atomicity vs durability — the contract, and who opts into what:
+
+  * ATOMICITY (every writer here): a reader never observes a partial file.
+    Temp file + same-directory ``os.replace``; a crash leaves a ``.tmp``
+    carcass at worst, never a torn visible artifact.
+  * DURABILITY (``durable=True``): the committed bytes additionally survive
+    a POWER LOSS / kernel crash — the temp file is fsynced before the
+    rename and the parent directory is fsynced after it, so both the data
+    and the directory entry are on stable storage when the call returns.
+
+  Callers that opt into durability: the feature store's entry commits and
+  its eviction journal (``ncnet_tpu/store/feature_store.py``) — a store
+  whose LRU journal says an entry exists while the entry's bytes evaporated
+  with the page cache would serve a miss it believes is corruption.  The
+  eval manifests and per-query ``.mat`` artifacts deliberately do NOT: a
+  lost-but-consistent manifest or artifact only costs redone work, which
+  the per-artifact resume already tolerates, and an fsync per query would
+  serialize the eval loop behind the disk.
 """
 
 from __future__ import annotations
 
 import json
 import os
+from typing import Callable, Optional
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of directory ``path`` (makes a just-renamed entry
+    durable).  Platforms/filesystems that refuse ``open(dir)`` or the fsync
+    degrade silently — the rename is still atomic, only the power-loss
+    guarantee narrows to what the OS gives by default."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def atomic_savemat(path: str, mdict: dict, **kwargs) -> None:
@@ -40,16 +77,55 @@ def atomic_savemat(path: str, mdict: dict, **kwargs) -> None:
         raise
 
 
-def atomic_write_json(path: str, obj) -> None:
+def atomic_write_json(path: str, obj, durable: bool = False) -> None:
     """``json.dump`` to ``path`` via a same-directory temp file +
-    ``os.replace`` — atomicity (a reader never sees a partial document), not
-    durability (no fsync: a lost-but-consistent manifest only costs redone
-    work, which the per-artifact resume already tolerates)."""
+    ``os.replace`` — atomic always; ``durable=True`` additionally fsyncs
+    the temp file before and the parent directory after the rename (see
+    the module docstring for who opts in and why)."""
     tmp = path + ".tmp"
     try:
         with open(tmp, "w") as f:
             json.dump(obj, f, indent=1, sort_keys=True)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if durable:
+            fsync_dir(os.path.dirname(os.path.abspath(path)))
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path: str, data, *, durable: bool = False,
+                       commit_hook: Optional[Callable[[str], None]] = None
+                       ) -> None:
+    """Write ``data`` (bytes, or a sequence of byte chunks written back to
+    back — large payloads avoid one concatenation copy) to ``path`` via
+    the two-phase commit: temp file (pid-suffixed — concurrent writers of
+    one entry must not clobber each other's temp), optional fsync,
+    ``os.replace``, optional parent-dir fsync.  ``commit_hook(path)`` runs
+    between the (synced) payload write and the rename — the crash-window
+    test seam (the feature store passes ``faults.store_commit_kill_hook``,
+    mirroring ``atomic_savemat``'s inline kill hook): a process killed
+    there leaves a temp carcass and NO visible entry."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    parts = (data,) if isinstance(data, (bytes, bytearray)) else data
+    try:
+        with open(tmp, "wb") as f:
+            for chunk in parts:
+                f.write(chunk)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
+        if commit_hook is not None:
+            commit_hook(path)
+        os.replace(tmp, path)
+        if durable:
+            fsync_dir(os.path.dirname(os.path.abspath(path)))
     except BaseException:
         try:
             os.remove(tmp)

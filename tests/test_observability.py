@@ -681,6 +681,10 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # (the ISSUE 13 memory plane is pinned for the same reason: memory.py
     # emits ledger/postmortem events from inside dispatch hot paths — a
     # bare print there would reopen the side channel mid-serving)
+    # (the ISSUE 14 feature store is pinned for the same reason: the store
+    # runs inside the eval/serving dispatch hot paths and its tool's
+    # stdout is ONE parseable summary JSON line — a bare print in either
+    # corrupts the tool's output or reopens the side channel mid-query)
     for target in ("ncnet_tpu/observability/quality.py",
                    "ncnet_tpu/observability/export.py",
                    "ncnet_tpu/observability/memory.py",
@@ -688,6 +692,8 @@ def test_library_modules_have_no_bare_print(tmp_path):
                    "ncnet_tpu/serving/introspect.py",
                    "ncnet_tpu/serving/router.py",
                    "ncnet_tpu/serving/wire.py",
+                   "ncnet_tpu/store",
+                   "tools/build_feature_store.py",
                    "tools/quality_drift.py",
                    "tools/serve_probe.py",
                    "tools/serve_top.py"):

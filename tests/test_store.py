@@ -1,0 +1,636 @@
+"""Crash-safe feature store (ncnet_tpu/store/): the chaos ladder.
+
+The store's one invariant — a query NEVER fails because of the store and
+NEVER uses unverified bytes — is executed here under every injected fault
+the design claims to survive: SIGKILL between payload write and commit
+rename (a rerun sees no torn entry and rebuilds), a post-commit bit flip
+(detected, quarantined, recomputed bitwise-identical to the cold path),
+ENOSPC on read/write (fail-open recompute with the DEGRADED → recovered
+timeline in the event log), fingerprint skew (miss + superseded-generation
+GC), and the LRU budget with its journal.  THE acceptance chain: a
+warm-store InLoc query performs exactly ONE backbone extraction
+(spy-counted) and writes match tables bitwise-identical to the uncached
+path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from scipy.io import loadmat
+
+import jax
+
+from ncnet_tpu.config import EvalInLocConfig, ModelConfig
+from ncnet_tpu.data.synthetic import write_inloc_like
+from ncnet_tpu.evaluation.inloc import make_pair_matcher, run_inloc_eval
+from ncnet_tpu.models.ncnet import init_ncnet
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability.events import EventLog, replay_events
+from ncnet_tpu.store import (
+    FeatureStore,
+    backbone_fingerprint,
+    content_digest,
+    weights_digest,
+)
+from ncnet_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+TINY = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                   ncons_channels=(1,), half_precision=True,
+                   relocalization_k_size=2)
+
+
+@pytest.fixture
+def arr(rng):
+    return rng.standard_normal((3, 4, 8)).astype(np.float32)
+
+
+def _store(tmp_path, fp="aaaa0000-s128-k2-bf16", **kw):
+    return FeatureStore(str(tmp_path / "fstore"), fp, **kw)
+
+
+# ---------------------------------------------------------------------------
+# keys / fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_content_digest_and_fingerprint_identity():
+    """The digest covers bytes AND shape/dtype; the fingerprint covers the
+    TRUNK weights + extraction settings but deliberately NOT the NC-filter
+    params (retraining only the filter must not invalidate the database)."""
+    a = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+    assert content_digest(a) == content_digest(a.copy())
+    assert content_digest(a) != content_digest(a.reshape(4, 3, 2))
+    assert content_digest(a) != content_digest(a.astype(np.int16))
+    b = a.copy()
+    b[0, 0, 0] ^= 1
+    assert content_digest(a) != content_digest(b)
+
+    params = init_ncnet(TINY, jax.random.key(0))
+    params2 = init_ncnet(TINY, jax.random.key(1))
+    assert weights_digest(params) != weights_digest(params2)
+    # NC params excluded: a filter-only change keeps the generation
+    import copy
+
+    p3 = copy.deepcopy(params)
+    p3["nc"][0]["b"] = np.asarray(p3["nc"][0]["b"]) + 1.0
+    assert weights_digest(params) == weights_digest(p3)
+    fp = backbone_fingerprint(params, image_size=128, k_size=2, dtype="bf16")
+    assert fp != backbone_fingerprint(params, image_size=256, k_size=2,
+                                      dtype="bf16")
+    assert fp != backbone_fingerprint(params, image_size=128, k_size=1,
+                                      dtype="bf16")
+    assert fp != backbone_fingerprint(params2, image_size=128, k_size=2,
+                                      dtype="bf16")
+
+
+# ---------------------------------------------------------------------------
+# verified persistence across restarts
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_persists_across_reopen(tmp_path, arr):
+    s = _store(tmp_path)
+    d = content_digest(arr)
+    got, status = s.resolve(d, lambda: arr)
+    assert status == "miss"
+    np.testing.assert_array_equal(got, arr)
+    got2, status2 = s.resolve(
+        d, lambda: (_ for _ in ()).throw(AssertionError("must not compute")))
+    assert status2 == "hit"
+    np.testing.assert_array_equal(got2, arr)
+    s.close()
+
+    # a fresh process (new store object) reads the committed entry back
+    s2 = _store(tmp_path)
+    assert s2.entries == 1
+    got3 = s2.get(d)
+    np.testing.assert_array_equal(got3, arr)
+    assert s2.counters["hits"] == 1
+    s2.close()
+
+
+def test_fingerprint_skew_is_miss_and_gc_superseded(tmp_path, arr):
+    """New weights → a different generation directory: reads miss, and GC
+    removes the dead generation while keeping same-weights siblings (the
+    serving engine's other image_size consumer)."""
+    d = content_digest(arr)
+    old = _store(tmp_path, fp="deadbeef00000000-s128-k2-bf16")
+    old.put(d, arr)
+    old.close()
+    sibling = _store(tmp_path, fp="aaaa0000-s999-k1-f32")
+    sibling.put(d, arr)
+    sibling.close()
+
+    s = _store(tmp_path, fp="aaaa0000-s128-k2-bf16")
+    assert s.get(d) is None  # the old generation's entry is invisible
+    assert s.counters["misses"] == 1
+    assert s.gc_superseded() == 1  # deadbeef generation removed
+    root = str(tmp_path / "fstore")
+    assert sorted(n for n in os.listdir(root) if not n.startswith("quar")) \
+        == ["aaaa0000-s128-k2-bf16", "aaaa0000-s999-k1-f32"]
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos ladder: SIGKILL mid-commit / bit flip / ENOSPC
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_mid_commit_leaves_no_visible_entry(tmp_path):
+    """SIGKILL between the payload write and the commit rename: the store
+    directory holds a .tmp carcass and NO visible entry; a rerun opens
+    clean and rebuilds the entry from scratch."""
+    root = str(tmp_path / "fstore")
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import sys
+sys.path.insert(0, {_REPO!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from ncnet_tpu.store import FeatureStore, content_digest
+
+s = FeatureStore({root!r}, "aaaa0000-s128-k2-bf16")
+a = np.arange(240, dtype=np.float32).reshape(3, 80)
+s.put(content_digest(a), a)
+raise SystemExit("unreachable: the commit kill hook must have fired")
+""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["NCNET_TPU_FAULTS"] = json.dumps({"kill_at_store_commit": 1})
+    proc = subprocess.run(
+        [sys.executable, str(worker)], env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=300)
+    assert proc.returncode == -9, \
+        f"expected SIGKILL, got:\n{proc.stdout[-3000:]}"
+
+    gen = os.path.join(root, "aaaa0000-s128-k2-bf16")
+    names = os.listdir(gen)
+    assert not [n for n in names if n.endswith(".feat")], names
+    assert [n for n in names if ".feat.tmp." in n], names  # the carcass
+
+    # the rerun sees an empty generation and rebuilds
+    s = FeatureStore(root, "aaaa0000-s128-k2-bf16")
+    assert s.entries == 0
+    a = np.arange(240, dtype=np.float32).reshape(3, 80)
+    got, status = s.resolve(content_digest(a), lambda: a)
+    assert status == "miss"
+    got2 = s.get(content_digest(a))
+    np.testing.assert_array_equal(got2, a)
+    s.close()
+
+
+def test_bitflip_detected_quarantined_recomputed_bitwise(tmp_path, arr):
+    """A post-commit payload bit flip must be caught by the checksum on
+    the next read: the entry is quarantined (bytes preserved, never
+    served), the value recomputed bitwise-identical to the cold path, and
+    the rewrite serves verified hits again."""
+    events_path = str(tmp_path / "events.jsonl")
+    sink = EventLog(events_path)
+    prev = obs_events.set_global_sink(sink)
+    try:
+        s = _store(tmp_path)
+        d = content_digest(arr)
+        with faults.injected(faults.FaultPlan(store_bitflip_paths=(d,))):
+            s.put(d, arr)  # committed, then corrupted post-commit
+        got, status = s.resolve(d, lambda: arr)
+        assert status == "recompute"
+        np.testing.assert_array_equal(got, arr)  # bitwise = the cold path
+        assert s.counters["corrupt"] == 1
+        assert s.state == "OK"  # corruption is not degradation
+        qdir = os.path.join(str(tmp_path / "fstore"), "quarantine")
+        assert len(os.listdir(qdir)) == 1  # the evidence survives
+        # the rewrite is a verified hit now
+        got2, status2 = s.resolve(
+            d, lambda: (_ for _ in ()).throw(AssertionError("no compute")))
+        assert status2 == "hit"
+        np.testing.assert_array_equal(got2, arr)
+        s.close()
+    finally:
+        obs_events.set_global_sink(prev)
+        sink.close()
+    _, events = replay_events(events_path)
+    corrupt = [e for e in events if e["event"] == "store_corrupt"]
+    assert len(corrupt) == 1 and corrupt[0]["reason"] == "checksum mismatch"
+
+
+def test_enospc_fails_open_with_degraded_recovered_timeline(tmp_path, arr):
+    """Injected ENOSPC on write then read: every resolve still answers
+    (recompute), the store marks itself DEGRADED, and the first later
+    success transitions back to OK — the DEGRADED → recovered timeline
+    replayable from the event log."""
+    events_path = str(tmp_path / "events.jsonl")
+    sink = EventLog(events_path)
+    prev = obs_events.set_global_sink(sink)
+    try:
+        s = _store(tmp_path)
+        d = content_digest(arr)
+        with faults.injected(faults.FaultPlan(store_io_error_ops=("write",))):
+            got, status = s.resolve(d, lambda: arr)
+        assert status == "miss"
+        np.testing.assert_array_equal(got, arr)  # the query never failed
+        assert s.state == "DEGRADED"
+        # disk recovers: the next resolve commits and the store recovers
+        got2, status2 = s.resolve(d, lambda: arr)
+        assert status2 == "miss"  # the degraded write never landed
+        assert s.state == "OK"
+        # now injected READ failure over an existing entry: fail-open
+        # recompute (degrading mid-resolve), and the successful rewrite
+        # recovers the store within the same resolve — both transitions
+        # land in the timeline
+        with faults.injected(faults.FaultPlan(store_io_error_ops=("read",))):
+            got3, status3 = s.resolve(d, lambda: arr)
+        assert status3 == "recompute"
+        np.testing.assert_array_equal(got3, arr)
+        assert s.state == "OK"
+        got4, status4 = s.resolve(
+            d, lambda: (_ for _ in ()).throw(AssertionError("no compute")))
+        assert status4 == "hit" and s.state == "OK"
+        s.close()
+    finally:
+        obs_events.set_global_sink(prev)
+        sink.close()
+    _, events = replay_events(events_path)
+    timeline = [e["state"] for e in events if e["event"] == "store_health"]
+    assert timeline == ["DEGRADED", "OK", "DEGRADED", "OK"]
+
+
+def test_journal_failure_keeps_store_degraded(tmp_path, arr):
+    """A journal/evict failure INSIDE an otherwise-successful operation
+    must leave the store DEGRADED — the operation's own success path may
+    not erase a failure that landed while it ran (recovery requires a
+    later operation with NO failures)."""
+    s = _store(tmp_path)
+    d = content_digest(arr)
+    s.resolve(d, lambda: arr)
+    with faults.injected(faults.FaultPlan(store_io_error_ops=("journal",))):
+        got = s.get(d)  # the read succeeds; its touch journaling fails
+        np.testing.assert_array_equal(got, arr)
+        assert s.state == "DEGRADED"
+        got = s.get(d)  # still failing: stays DEGRADED, still answers
+        np.testing.assert_array_equal(got, arr)
+        assert s.state == "DEGRADED"
+    got = s.get(d)  # journal healthy again: THIS op claims recovery
+    np.testing.assert_array_equal(got, arr)
+    assert s.state == "OK"
+    s.close()
+
+
+def test_journal_compacts_in_process(tmp_path, arr):
+    """A warm long-lived process must not grow the journal one touch
+    record per hit forever: once appends dwarf the live entry set the
+    journal compacts in place to one put-record per entry."""
+    s = _store(tmp_path)
+    d = content_digest(arr)
+    s.resolve(d, lambda: arr)
+    for _ in range(200):
+        s.get(d)
+    journal = os.path.join(str(tmp_path / "fstore"),
+                           "aaaa0000-s128-k2-bf16", "journal.jsonl")
+    with open(journal) as f:
+        lines = f.readlines()
+    assert len(lines) <= 70  # compacted well below the 200+ appends
+    # and the compacted journal still round-trips the LRU index
+    s.close()
+    s2 = _store(tmp_path)
+    assert s2.entries == 1 and s2.get(d) is not None
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# LRU budget + journal
+# ---------------------------------------------------------------------------
+
+
+def test_lru_eviction_keeps_store_under_budget(tmp_path, rng):
+    arrays = [rng.standard_normal((4, 64)).astype(np.float32)
+              for _ in range(4)]
+    one = 4 * 64 * 4 + 300  # payload + header slack
+    s = _store(tmp_path, budget_bytes=2 * one)
+    digests = [content_digest(a) for a in arrays]
+    for d, a in zip(digests[:3], arrays[:3]):
+        s.resolve(d, lambda a=a: a)
+    assert s.entries == 2 and s.counters["evictions"] == 1
+    assert s.bytes_used <= 2 * one
+    assert s.get(digests[0]) is None  # the oldest was the victim
+    # touching the older survivor protects it: the NEXT eviction takes the
+    # untouched one
+    assert s.get(digests[1]) is not None
+    s.resolve(digests[0], lambda: arrays[0])  # re-add -> evicts digests[2]
+    assert s.get(digests[2]) is None
+    assert s.get(digests[1]) is not None
+
+    # the journal records the history and a reopen rebuilds LRU order
+    journal = os.path.join(str(tmp_path / "fstore"),
+                           "aaaa0000-s128-k2-bf16", "journal.jsonl")
+    ops = [json.loads(line)["op"] for line in open(journal)]
+    assert ops.count("evict") == 2 and "put" in ops and "touch" in ops
+    s.close()
+    s2 = _store(tmp_path, budget_bytes=2 * one)
+    assert s2.entries == 2
+    # journal-replayed order: digests[1]'s LAST touch postdates
+    # digests[0]'s re-put, so the reopened store evicts digests[0] first —
+    # access order survived the restart
+    s2.resolve(digests[3], lambda: arrays[3])
+    assert s2.get(digests[0]) is None
+    assert s2.contains(digests[1]) and s2.contains(digests[3])
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chain: store-backed InLoc eval
+# ---------------------------------------------------------------------------
+
+
+def _inloc_fixture(tmp_path, n_queries=2, n_panos=2):
+    root = str(tmp_path)
+    shortlist = write_inloc_like(root, n_queries=n_queries, n_panos=n_panos,
+                                 image_hw=(96, 128))
+    params = init_ncnet(TINY, jax.random.key(1))
+    kw = dict(inloc_shortlist=shortlist, k_size=2, image_size=128,
+              n_queries=n_queries, n_panos=n_panos,
+              pano_path=os.path.join(root, "pano"),
+              query_path=os.path.join(root, "query", "iphone7"))
+    return root, params, kw
+
+
+def _matches(out_dir):
+    return {n: loadmat(os.path.join(out_dir, n))["matches"]
+            for n in os.listdir(out_dir) if n.endswith(".mat")}
+
+
+def test_warm_store_eval_one_extraction_and_identical_tables(tmp_path):
+    """Acceptance: a warm-store InLoc query performs exactly ONE backbone
+    extraction (spy-counted through the matcher's trunk call site) and
+    produces match tables bitwise-identical to the uncached path; the
+    eval_summary event carries the store counters proving hits == pairs."""
+    root, params, kw = _inloc_fixture(tmp_path)
+    sd = os.path.join(root, "fstore")
+
+    plain = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "m0"), **kw),
+        model_config=TINY, params=params, progress=False)
+    cold = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "m1"),
+                        feature_store_dir=sd, **kw),
+        model_config=TINY, params=params, progress=False)
+    warm = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "m2"),
+                        feature_store_dir=sd,
+                        telemetry_dir=os.path.join(root, "t2"), **kw),
+        model_config=TINY, params=params, progress=False)
+
+    a, b, c = _matches(plain), _matches(cold), _matches(warm)
+    assert sorted(a) == sorted(b) == sorted(c) == ["1.mat", "2.mat"]
+    for name in a:
+        np.testing.assert_array_equal(a[name], b[name])
+        np.testing.assert_array_equal(a[name], c[name])
+
+    _, events = replay_events(os.path.join(root, "t2", "events.jsonl"))
+    summary = [e for e in events if e["event"] == "eval_summary"][-1]
+    # ONE extraction per query (its own trunk), zero for the database side
+    assert summary["feature_extractions"] == 2
+    st = summary["store"]
+    assert st["state"] == "OK"
+    assert st["counters"]["hits"] == 4 and st["counters"]["misses"] == 0
+    # the durable stats twin rides the same log
+    stats = [e for e in events if e["event"] == "store_stats"]
+    assert stats and stats[-1]["store"]["counters"]["hits"] == 4
+
+
+def test_eval_survives_corruption_and_enospc_with_identical_tables(tmp_path):
+    """The chaos bar on the REAL consumer: with every committed entry
+    bit-flipped post-commit (run 1) and the disk failing reads AND writes
+    (run 2), every query still completes and every match table stays
+    bitwise-identical to the uncached path — corrupt entries quarantine +
+    recompute, I/O failures fail open with the store DEGRADED in the
+    summary."""
+    root, params, kw = _inloc_fixture(tmp_path)
+    sd = os.path.join(root, "fstore")
+    plain = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "m0"), **kw),
+        model_config=TINY, params=params, progress=False)
+
+    # run 1: every entry corrupted the moment it commits → the SECOND run
+    # detects every corruption on read, quarantines, recomputes
+    with faults.injected(faults.FaultPlan(store_bitflip_paths=(".feat",))):
+        run_inloc_eval(
+            EvalInLocConfig(output_root=os.path.join(root, "m1"),
+                            feature_store_dir=sd, **kw),
+            model_config=TINY, params=params, progress=False)
+    corrupted = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "m2"),
+                        feature_store_dir=sd,
+                        telemetry_dir=os.path.join(root, "t2"), **kw),
+        model_config=TINY, params=params, progress=False)
+
+    # run 2: ENOSPC-shaped I/O errors on read and write → pure recompute
+    with faults.injected(faults.FaultPlan(
+            store_io_error_ops=("read", "write"))):
+        degraded = run_inloc_eval(
+            EvalInLocConfig(output_root=os.path.join(root, "m3"),
+                            feature_store_dir=sd,
+                            telemetry_dir=os.path.join(root, "t3"), **kw),
+            model_config=TINY, params=params, progress=False)
+
+    a = _matches(plain)
+    for out in (corrupted, degraded):
+        got = _matches(out)
+        assert sorted(got) == sorted(a)
+        for name in a:
+            np.testing.assert_array_equal(got[name], a[name])
+
+    _, ev2 = replay_events(os.path.join(root, "t2", "events.jsonl"))
+    st2 = [e for e in ev2 if e["event"] == "eval_summary"][-1]["store"]
+    assert st2["counters"]["corrupt"] == 4  # every poisoned entry caught
+    qdir = os.path.join(sd, "quarantine")
+    assert len(os.listdir(qdir)) == 4
+
+    _, ev3 = replay_events(os.path.join(root, "t3", "events.jsonl"))
+    st3 = [e for e in ev3 if e["event"] == "eval_summary"][-1]["store"]
+    assert st3["state"] == "DEGRADED"
+    assert [e["state"] for e in ev3 if e["event"] == "store_health"][:1] \
+        == ["DEGRADED"]
+
+
+def test_spatial_shards_disable_store(tmp_path):
+    """feature_store_dir under spatial sharding must warn + bypass (the
+    sharded forward takes images), not crash or silently shard-skew."""
+    root, params, kw = _inloc_fixture(tmp_path, n_queries=1, n_panos=1)
+    out = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "m"),
+                        feature_store_dir=os.path.join(root, "fstore"),
+                        spatial_shards=2, **kw),
+        model_config=TINY, params=params, progress=False)
+    assert os.path.exists(os.path.join(out, "1.mat"))
+    # the store was never opened: no generation dir appeared
+    assert not os.path.exists(os.path.join(root, "fstore"))
+
+
+# ---------------------------------------------------------------------------
+# bulk builder tool
+# ---------------------------------------------------------------------------
+
+
+def test_build_feature_store_tool_resumable_and_eval_warm(tmp_path):
+    """The offline builder populates the store a later eval reads 100%
+    warm (same fingerprint, same bytes); a rerun fast-forwards via the
+    shard manifest without recomputing anything."""
+    import build_feature_store as bfs
+
+    root, params, kw = _inloc_fixture(tmp_path)
+    sd = os.path.join(root, "fstore")
+    args = ["--store_dir", sd, "--inloc_shortlist", kw["inloc_shortlist"],
+            "--pano_path", kw["pano_path"], "--backbone", "tiny",
+            "--image_size", "128", "--k_size", "2", "--n_panos", "2"]
+    assert bfs.main(args) == 0
+    manifest = json.load(open(os.path.join(
+        sd, "build_manifest.shard0_of_1.json")))
+    assert len(manifest["completed"]) == 4
+    assert not manifest["quarantined"]
+
+    # rerun: resumable — every pano skipped via the manifest
+    assert bfs.main(args) == 0
+
+    # the eval over the tool-built store starts warm: zero misses.  The
+    # tool inits its trunk from key(1) + backbone 'tiny' — exactly the
+    # fixture's params — and the fingerprint hashes ONLY the trunk, so
+    # the generations line up by construction.
+    warm = run_inloc_eval(
+        EvalInLocConfig(output_root=os.path.join(root, "m"),
+                        feature_store_dir=sd,
+                        telemetry_dir=os.path.join(root, "t"), **kw),
+        model_config=TINY, params=params, progress=False)
+    assert sorted(_matches(warm)) == ["1.mat", "2.mat"]
+    _, events = replay_events(os.path.join(root, "t", "events.jsonl"))
+    st = [e for e in events if e["event"] == "eval_summary"][-1]["store"]
+    assert st["counters"]["hits"] == 4 and st["counters"]["misses"] == 0
+
+
+def test_build_tool_quarantines_bad_pano_and_exits_2(tmp_path):
+    """A pano that fails every decode attempt quarantines into the shard
+    manifest (exit 2) while the rest of the stripe builds."""
+    import build_feature_store as bfs
+
+    root, params, kw = _inloc_fixture(tmp_path)
+    sd = os.path.join(root, "fstore")
+    args = ["--store_dir", sd, "--inloc_shortlist", kw["inloc_shortlist"],
+            "--pano_path", kw["pano_path"], "--backbone", "tiny",
+            "--image_size", "128", "--k_size", "2", "--n_panos", "2",
+            "--retries", "1", "--retry_backoff_s", "0"]
+    with faults.injected(faults.FaultPlan(
+            decode_fail_substring="cutout_000_30")):
+        assert bfs.main(args) == 2
+    manifest = json.load(open(os.path.join(
+        sd, "build_manifest.shard0_of_1.json")))
+    assert len(manifest["quarantined"]) == 1
+    assert len(manifest["completed"]) == 3
+    # the rerun (fault cleared) completes the quarantined pano
+    assert bfs.main(args) == 0
+    manifest = json.load(open(os.path.join(
+        sd, "build_manifest.shard0_of_1.json")))
+    assert len(manifest["completed"]) == 4 and not manifest["quarantined"]
+
+
+# ---------------------------------------------------------------------------
+# serving plane: health section + metric families + watchdog advisory
+# ---------------------------------------------------------------------------
+
+
+def test_store_on_serving_health_metrics_and_watchdog(tmp_path, arr):
+    """A service with a store attached surfaces it on /healthz (the store
+    section) and /metrics (ncnet_store_* families); a DEGRADED store is an
+    operator warning, and the stall watchdog's advisory NEVER flips a
+    verdict to stalled over it."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import stall_watchdog
+
+    from ncnet_tpu.observability.export import parse_prometheus, render
+    from ncnet_tpu.serving import MatchService, ServingConfig
+    from ncnet_tpu.serving.introspect import metrics_families, render_statusz
+    from test_serving import FakeEngine
+
+    store = _store(tmp_path)
+    d = content_digest(arr)
+    store.resolve(d, lambda: arr)
+    store.resolve(d, lambda: arr)
+    svc = MatchService(engine=FakeEngine(),
+                       serving=ServingConfig(bucket_multiple=32,
+                                             max_image_side=128),
+                       store=store)
+    svc.start()
+    try:
+        doc = svc.health()
+        assert doc["store"]["state"] == "OK"
+        assert doc["store"]["hit_pct"] == 50.0
+        fams = parse_prometheus(render(metrics_families(svc)))
+        assert fams["ncnet_store_up"]["samples"][0][2] == 1
+        assert fams["ncnet_store_hits_total"]["samples"][0][2] == 1
+        assert fams["ncnet_store_misses_total"]["samples"][0][2] == 1
+        assert "feature store: OK" in render_statusz(svc)
+
+        # degrade the store (read AND write failing, so the in-resolve
+        # rewrite cannot recover it): /healthz carries it, ncnet_store_up
+        # drops, and the watchdog advisory stays NON-stalling
+        with faults.injected(faults.FaultPlan(
+                store_io_error_ops=("read", "write"))):
+            store.resolve(d, lambda: arr)
+        doc = svc.health()
+        assert doc["store"]["state"] == "DEGRADED"
+        fams = parse_prometheus(render(metrics_families(svc)))
+        assert fams["ncnet_store_up"]["samples"][0][2] == 0
+        verdict = {"status": "alive"}
+        stall_watchdog._apply_store_advisory(verdict, doc)
+        assert verdict["status"] == "alive"
+        assert verdict["store"]["state"] == "DEGRADED"
+        # belt and braces: even a hypothetical stalled verdict is not
+        # MADE stalled by the advisory (it never touches status)
+        verdict = {"status": "stalled"}
+        stall_watchdog._apply_store_advisory(verdict, doc)
+        assert verdict["status"] == "stalled"
+    finally:
+        svc.stop()
+
+
+def test_run_report_store_section(tmp_path, arr, capsys):
+    """run_report --store replays the store's event stream: counters,
+    the DEGRADED → recovered timeline, and the quarantined entries."""
+    import run_report
+
+    events_path = str(tmp_path / "events.jsonl")
+    sink = EventLog(events_path)
+    prev = obs_events.set_global_sink(sink)
+    try:
+        s = _store(tmp_path)
+        d = content_digest(arr)
+        with faults.injected(faults.FaultPlan(store_bitflip_paths=(d,))):
+            s.put(d, arr)
+        s.resolve(d, lambda: arr)  # corrupt -> quarantine -> recompute
+        with faults.injected(faults.FaultPlan(store_io_error_ops=("write",))):
+            s.resolve(content_digest(arr + 1), lambda: arr + 1)
+        s.resolve(content_digest(arr + 1), lambda: arr + 1)  # recovers
+        s.flush_stats()
+        s.close()
+    finally:
+        obs_events.set_global_sink(prev)
+        sink.close()
+
+    report = run_report.build_report([events_path])
+    st = report["store"]
+    assert st["degraded_spells"] == 1 and st["recovered"] == 1
+    assert len(st["corrupt_quarantined"]) == 1
+    final = st["final_stats"]["store"]
+    assert final["counters"]["corrupt"] == 1
+
+    assert run_report.main([events_path, "--store"]) == 0
+    out = capsys.readouterr().out
+    assert "feature store" in out
+    assert "DEGRADED" in out and "corrupt" in out

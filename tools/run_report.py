@@ -468,6 +468,56 @@ def build_memory_section(events: List[dict]) -> Dict[str, Any]:
     }
 
 
+def build_store_section(events: List[dict]) -> Dict[str, Any]:
+    """The feature-store replay (ncnet_tpu/store/): per-scope open/stats
+    records, the DEGRADED → recovered health timeline, every quarantined
+    (corrupt) entry, evictions, and GC sweeps — reconstructed from the
+    event log alone, so a dead run's cache behaviour is auditable without
+    the store directory."""
+    opens = [
+        {k: e.get(k) for k in ("t", "scope", "root", "fingerprint",
+                               "entries", "bytes", "budget_bytes", "state")
+         if k in e}
+        for e in events if e.get("event") == "store_open"
+    ]
+    timeline = [
+        {k: e.get(k) for k in ("t", "scope", "state", "reason") if k in e}
+        for e in events if e.get("event") == "store_health"
+    ]
+    corrupt = [
+        {k: e.get(k) for k in ("t", "scope", "digest", "reason",
+                               "quarantined_to") if k in e}
+        for e in events if e.get("event") == "store_corrupt"
+    ]
+    evictions = [e for e in events if e.get("event") == "store_evict"]
+    gcs = [
+        {k: e.get(k) for k in ("t", "scope", "fingerprints", "entries")
+         if k in e}
+        for e in events if e.get("event") == "store_gc"
+    ]
+    # the last stats flush per scope is the run's final counter state
+    stats: Dict[str, Any] = {}
+    for e in events:
+        if e.get("event") == "store_stats" and isinstance(
+                e.get("store"), dict):
+            stats[str(e.get("scope", "store"))] = e["store"]
+    return {
+        "opens": opens,
+        "health_timeline": timeline,
+        "degraded_spells": sum(
+            1 for e in timeline if e.get("state") == "DEGRADED"),
+        "recovered": sum(
+            1 for e in timeline if e.get("state") == "OK"),
+        "corrupt_quarantined": corrupt,
+        "evictions": len(evictions),
+        "evicted_bytes": sum(
+            e.get("bytes", 0) for e in evictions
+            if isinstance(e.get("bytes"), (int, float))),
+        "gc_sweeps": gcs,
+        "final_stats": stats,
+    }
+
+
 def build_router_section(events: List[dict]) -> Dict[str, Any]:
     """The router-tier postmortem (the PR 12 multi-host twin of
     :func:`build_serving_section`): the outcome-total identity recomputed
@@ -729,6 +779,8 @@ def build_report(paths: List[str],
                               "memory_postmortem", "device_snapshot")
            for e in events):
         report["memory"] = build_memory_section(events)
+    if any(str(e.get("event", "")).startswith("store_") for e in events):
+        report["store"] = build_store_section(events)
     if any(e.get("event") == "quality" for e in events):
         device_kind = next(
             (r["header"].get("device_kind") for r in runs
@@ -1039,6 +1091,55 @@ def render_memory(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_store(report: Dict[str, Any]) -> str:
+    s = report.get("store")
+    if not s:
+        return "(no store events in the log)"
+    lines = ["feature store (ncnet_tpu/store/, replayed from the log):"]
+    add = lines.append
+    for o in s["opens"]:
+        add(f"  open [{o.get('scope')}]: {o.get('entries')} entr(ies), "
+            f"{_fmt_bytes(o.get('bytes'))} under {o.get('fingerprint')}"
+            + (f", budget {_fmt_bytes(o['budget_bytes'])}"
+               if o.get("budget_bytes") else "")
+            + f"  ({o.get('state')})")
+    for scope, st in sorted(s["final_stats"].items()):
+        c = st.get("counters") or {}
+        hp = st.get("hit_pct")
+        add(f"  final [{scope}]: {st.get('state')}"
+            + (f" ({st.get('reason')})" if st.get("reason") else "")
+            + f"  hits={c.get('hits', 0)} misses={c.get('misses', 0)}"
+            + (f" ({hp:.1f}% hit)" if isinstance(hp, (int, float)) else "")
+            + f"  corrupt={c.get('corrupt', 0)} "
+            f"evictions={c.get('evictions', 0)} "
+            f"degraded_ops={c.get('degraded_ops', 0)}  "
+            f"entries={st.get('entries')} "
+            f"bytes={_fmt_bytes(st.get('bytes'))}")
+    if s["health_timeline"]:
+        add(f"  health timeline ({s['degraded_spells']} degraded "
+            f"spell(s), {s['recovered']} recover(ies)):")
+        for e in s["health_timeline"]:
+            add(f"    -> {e.get('state')} [{e.get('scope')}]"
+                + (f"  ({e.get('reason')})" if e.get("reason") else ""))
+    else:
+        add("  health timeline: never degraded (green)")
+    if s["corrupt_quarantined"]:
+        add(f"  CORRUPT entries quarantined "
+            f"({len(s['corrupt_quarantined'])}):")
+        for e in s["corrupt_quarantined"]:
+            add(f"    {e.get('digest')}  ({e.get('reason')}) -> "
+                f"{e.get('quarantined_to')}")
+    else:
+        add("  corruption: none detected")
+    if s["evictions"]:
+        add(f"  evictions: {s['evictions']} "
+            f"({_fmt_bytes(s['evicted_bytes'])} reclaimed)")
+    for g in s["gc_sweeps"]:
+        add(f"  GC [{g.get('scope')}]: removed {g.get('entries')} "
+            f"entr(ies) of superseded generation(s) {g.get('fingerprints')}")
+    return "\n".join(lines)
+
+
 def render_slo(report: Dict[str, Any]) -> str:
     s = report.get("slo")
     if not s or not s["admitted"]:
@@ -1181,6 +1282,11 @@ def main(argv=None) -> int:
                          "recomputed from the log (objectives from "
                          "serve_start), burn %%, and the consistency "
                          "verdict against the service's final slo event")
+    ap.add_argument("--store", action="store_true",
+                    help="append the feature-store section: hit/miss/"
+                         "corrupt/evict counters, the DEGRADED->recovered "
+                         "health timeline, quarantined entries, and GC "
+                         "sweeps replayed from the event log")
     args = ap.parse_args(argv)
     quality_ref = None
     if args.quality or args.quality_ref:
@@ -1210,6 +1316,9 @@ def main(argv=None) -> int:
         if args.slo:
             print()
             print(render_slo(report))
+        if args.store:
+            print()
+            print(render_store(report))
     return 0
 
 

@@ -119,6 +119,17 @@ def render_frame(health: Dict[str, Any], fams: Dict[str, Any],
         add(f"SLO burn: {slo['budget_burn_pct']}% of budget cumulative  |  "
             f"window({w['n']}): {w['burn_pct']}%  "
             f"[bad: {slo['bad']}]")
+    st = health.get("store")
+    if st:
+        c = st.get("counters") or {}
+        hp = st.get("hit_pct")
+        add(f"store: {st.get('state')}"
+            + (f" ({st.get('reason')})" if st.get("reason") else "")
+            + f"  hit%: {f'{hp:.1f}' if hp is not None else '-'}"
+            f"  entries: {st.get('entries')}"
+            f"  {(st.get('bytes') or 0) / 2 ** 20:.1f} MiB"
+            f"  corrupt: {c.get('corrupt', 0)}"
+            f"  evict: {c.get('evictions', 0)}")
     add("")
     mem = health.get("memory") or {}
     hbm = mem.get("hbm") or {}

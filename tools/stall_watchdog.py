@@ -37,6 +37,11 @@ degrades to ``--min-age`` alone.  An unreachable endpoint maps to the
 ``missing`` verdict (exit 2) — "not started or already gone", the same
 supervisor semantics as a missing heartbeat file.
 
+A health document carrying a feature-store section (ncnet_tpu/store/)
+ships a store advisory in the verdict: a DEGRADED store fails OPEN (every
+query still answered, via recompute), so store-DEGRADED is rendered as a
+warning about the disk and NEVER flags the process STALLED.
+
 ``--url`` also judges a multi-host **router** (``serving/router.py``): the
 primary signal is the router document's aggregate ``activity.age_s``
 (advances when ANY backend settles a result), and the document's
@@ -197,6 +202,26 @@ def _apply_backend_backstop(verdict: Dict[str, Any], doc: Dict[str, Any],
         verdict["alive_via"] = alive_via
 
 
+def _apply_store_advisory(verdict: Dict[str, Any],
+                          doc: Dict[str, Any]) -> None:
+    """Feature-store advisory from the health document's ``store`` section
+    (ncnet_tpu/store/): a DEGRADED store FAILS OPEN — every query is still
+    answered via recompute — so degraded-but-serving is an operator
+    warning about the DISK, never a stall.  This helper surfaces the state
+    in the verdict and deliberately never touches the liveness status."""
+    st = doc.get("store")
+    if not isinstance(st, dict):
+        return
+    c = st.get("counters") or {}
+    verdict["store"] = {
+        "state": st.get("state"),
+        "reason": st.get("reason"),
+        "hit_pct": st.get("hit_pct"),
+        "corrupt": c.get("corrupt", 0),
+        "degraded_ops": c.get("degraded_ops", 0),
+    }
+
+
 def _apply_hbm_warning(verdict: Dict[str, Any], doc: Dict[str, Any],
                        warn_pct: float) -> None:
     """HBM-pressure advisory from the health document's memory section
@@ -276,6 +301,7 @@ def judge_url(url: str, events_path: Optional[str] = None,
         _apply_replica_backstop(verdict, events_path, factor, min_age)
     _apply_backend_backstop(verdict, doc, factor, min_age)
     _apply_hbm_warning(verdict, doc, hbm_warn_pct)
+    _apply_store_advisory(verdict, doc)
     return verdict
 
 
@@ -404,6 +430,18 @@ def main(argv=None) -> int:
                       f"(>= {hw['threshold_pct']}%; "
                       f"{s.get('bytes_in_use')}/{s.get('bytes_limit')} "
                       "bytes) — pressure, not a stall")
+        st = verdict.get("store")
+        if st:
+            if st.get("state") == "DEGRADED":
+                print(f"  WARNING: feature store DEGRADED "
+                      f"({st.get('reason')}; "
+                      f"degraded_ops={st.get('degraded_ops')}) — failing "
+                      "open to recompute: degraded-but-serving, NOT a "
+                      "stall")
+            else:
+                hp = st.get("hit_pct")
+                print(f"  feature store {st.get('state')}"
+                      + (f" (hit% {hp})" if hp is not None else ""))
     return {"alive": 0, "missing": 2, "stalled": 3}[verdict["status"]]
 
 
