@@ -533,6 +533,137 @@ def bench_jax(res=None):
     # tunnel as every other metric: retried, never silently dropped
     put("mem_filter_temp_bytes", _filter_memory, label="mem_filter")
 
+    # ------------------------------------------------------------------
+    # high-resolution coarse-to-fine scenario (ISSUE 15): the 2× feature
+    # grid the dense volume prices out of — sparse (coarse2fine, k=4) vs
+    # dense filter walls and ledger-measured temp footprints at the SAME
+    # shape.  All four series are perf-store-ingested (name tokens `_ms` /
+    # `_bytes` gate lower-is-better), so both the speed and the memory
+    # claim ride `perf_regress --check`.  TPU-gated like the PF eval wall
+    # (a 50⁴ dense volume on a CPU backend is minutes per iteration);
+    # NCNET_BENCH_SPARSE=1 forces, =0 skips.
+    # ------------------------------------------------------------------
+    _SPARSE_K = 4
+
+    def _sparse_gate():
+        import os as _os
+
+        flag = _os.environ.get("NCNET_BENCH_SPARSE")
+        on_tpu_ = "TPU" in jax.devices()[0].device_kind
+        return flag not in ("0", "") if flag is not None else on_tpu_
+
+    def _sparse_shapes():
+        feat_shape = jax.eval_shape(
+            lambda p, x: extract_features(cfg16, p, x),
+            params,
+            jax.ShapeDtypeStruct((1, IMAGE, IMAGE, 3), jnp.float32),
+        ).shape
+        return 2 * feat_shape[1], feat_shape[3]  # 2× side, channels
+
+    cfg_sp = cfg16.replace(sparse_topk=_SPARSE_K)
+
+    def _highres_input(s2, cdim):
+        def make(key):
+            k1, k2 = jax.random.split(key)
+            return (
+                jax.random.normal(k1, (1, s2, s2, cdim), jnp.float32) * 0.05,
+                jax.random.normal(k2, (1, s2, s2, cdim), jnp.float32) * 0.05,
+            )
+        return make
+
+    if _sparse_gate():
+        from ncnet_tpu.models.ncnet import coarse2fine_filter, ncnet_filter
+        from ncnet_tpu.ops import (
+            correlation_4d as _corr4,
+            pool_features,
+            topk_candidates,
+        )
+
+        s2, cdim = _sparse_shapes()
+
+        # coarse select stage alone: pool → coarse corr → coarse filter →
+        # per-row top-k (the candidate-selection overhead the fine stage's
+        # savings must beat)
+        def _topk_select(fa, fb):
+            fac = pool_features(fa.astype(jnp.bfloat16), cfg_sp.sparse_factor)
+            fbc = pool_features(fb.astype(jnp.bfloat16), cfg_sp.sparse_factor)
+            coarse = ncnet_filter(cfg16, params, _corr4(fac, fbc)).corr
+            return topk_candidates(coarse, _SPARSE_K).astype(jnp.float32)
+
+        put(
+            "topk_select_ms",
+            lambda: _timeit_scan(
+                chain_step(_topk_select), _highres_input(s2, cdim),
+                per=1, n_long=8),
+            label="topk_select",
+        )
+
+        # the full coarse-to-fine filter (coarse pass + selection + gathered
+        # fine refinement + scatter) — the sparse stand-in for the dense
+        # filter stage at 2× resolution
+        put(
+            "sparse_fine_wall_ms",
+            lambda: _timeit_scan(
+                chain_step(
+                    lambda fa, fb: coarse2fine_filter(
+                        cfg_sp, params, fa, fb).corr),
+                _highres_input(s2, cdim), per=1, n_long=6),
+            label="sparse_fine",
+        )
+
+        # dense at the SAME 2× shape: may OOM/fail where sparse runs —
+        # exactly the headline; a missing value here IS the result then
+        put(
+            "filter_wall_ms_dense_highres",
+            lambda: _timeit_scan(
+                chain_step(
+                    lambda fa, fb: ncnet_filter(
+                        cfg16, params,
+                        _corr4(fa.astype(jnp.bfloat16),
+                               fb.astype(jnp.bfloat16))).corr),
+                _highres_input(s2, cdim), per=1, n_long=6),
+            label="filter_dense_highres",
+        )
+        if res.get("sparse_fine_wall_ms") is not None \
+                and res.get("filter_wall_ms_dense_highres") \
+                and res.get("filter_wall_ms_sparse_vs_dense") is None:
+            res["filter_wall_ms_sparse_vs_dense"] = round(
+                res["sparse_fine_wall_ms"]
+                / res["filter_wall_ms_dense_highres"], 4)
+
+        # ledger-measured temp footprints of both programs at the 2× shape
+        # (observability/memory.py): THE memory claim of ROADMAP item 2,
+        # gated lower-is-better by perf_regress
+        def _sparse_memory(fn, program, tier):
+            from ncnet_tpu.observability import memory as obs_memory
+
+            sds = jax.ShapeDtypeStruct((1, s2, s2, cdim), jnp.float32)
+            compiled = jax.jit(fn).lower(params, sds, sds).compile()
+            mem = obs_memory.analysis_dict(compiled)
+            if not mem or mem.get("temp_bytes") is None:
+                return None
+            obs_memory.record_program(
+                program, f"{s2}x{s2}x{cdim}xb1|k={_SPARSE_K}",
+                analysis=compiled, tier=tier, source="bench")
+            return mem["temp_bytes"]
+
+        put(
+            "mem_filter_temp_bytes_sparse",
+            lambda: _sparse_memory(
+                lambda p, fa, fb: coarse2fine_filter(cfg_sp, p, fa, fb).corr,
+                "bench_sparse_filter", "coarse2fine"),
+            label="mem_sparse_filter",
+        )
+        put(
+            "mem_filter_temp_bytes_dense_highres",
+            lambda: _sparse_memory(
+                lambda p, fa, fb: ncnet_filter(
+                    cfg16, p, _corr4(fa.astype(jnp.bfloat16),
+                                     fb.astype(jnp.bfloat16))).corr,
+                "bench_filter_highres", "bf16"),
+            label="mem_dense_filter_highres",
+        )
+
     # correlation-only (BASELINE north-star: ms/pair 4D-corr fwd) — feature
     # shape derived from the configured backbone via eval_shape (free), so a
     # config change cannot silently decouple this metric from the model

@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "backbone features here (verified, crash-safe; see "
                         "README 'Feature store'); bulk-build with "
                         "tools/build_feature_store.py")
+    p.add_argument("--sparse_topk", type=int, default=0,
+                   help="coarse-to-fine sparse matching (requires --k_size "
+                        "1; 0 = dense, the default — README 'Coarse-to-fine "
+                        "matching')")
     p.add_argument("--feature_store_budget_mb", type=int, default=0,
                    help="LRU-evict store entries above this many MiB "
                         "(0 = unbounded)")
@@ -103,6 +107,7 @@ def main(argv=None) -> int:
         telemetry_dir=args.telemetry_dir,
         feature_store_dir=args.feature_store_dir,
         feature_store_budget_mb=args.feature_store_budget_mb,
+        sparse_topk=args.sparse_topk,
     )
     print(args)
     print("Output matches folder: " + output_folder_name(config))

@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry_dir", type=str, default="",
                    help="open a structured event log here (per-batch eval "
                         "events + metrics; replay with tools/run_report.py)")
+    p.add_argument("--sparse_topk", type=int, default=0,
+                   help="coarse-to-fine sparse matching: filter a pooled "
+                        "coarse volume, keep the top-k candidate target "
+                        "neighbourhoods per coarse source cell, and "
+                        "evaluate fine correlation only there (0 = dense, "
+                        "the default; README 'Coarse-to-fine matching')")
     return p
 
 
@@ -74,6 +80,7 @@ def main(argv=None) -> int:
         fetch_timeout_s=args.fetch_timeout_s,
         decode_retries=args.decode_retries,
         telemetry_dir=args.telemetry_dir,
+        sparse_topk=args.sparse_topk,
     )
     stats = run_eval(
         config,

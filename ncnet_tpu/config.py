@@ -31,6 +31,21 @@ class ModelConfig:
     symmetric_mode: bool = True
     normalize_features: bool = True
     relocalization_k_size: int = 0       # >1 enables maxpool4d relocalization
+    # coarse-to-fine sparse correlation (ops/sparse_topk.py +
+    # ops/sparse_corr.py; README "Coarse-to-fine matching"): 0 = dense (the
+    # unchanged default); k > 0 filters a pooled coarse volume first, keeps
+    # the top-k candidate target neighbourhoods per coarse source cell, and
+    # evaluates + NC-filters fine correlation only on the gathered tiles —
+    # fine-stage FLOPs/bytes scale with k·patch⁴ instead of (hw)².  Falls
+    # back dense when the shape class is ineligible (relocalization on,
+    # dims not divisible by the factor) or the "coarse2fine" tier was
+    # demoted at runtime (ops.demote_fused_tier).
+    sparse_topk: int = 0
+    sparse_factor: int = 2               # coarse pooling factor (stride-16
+                                         # features → stride-32 at 2)
+    sparse_halo: int = -1                # fine-cell patch halo around each
+                                         # candidate block; -1 = auto (one
+                                         # coarse ring = factor cells)
     half_precision: bool = False         # bf16 volume + NC weights (TPU-native fp16 analog)
     backbone_bf16: bool = False          # run the (frozen) trunk in bfloat16 —
                                          # TPU-native fast path with no reference
@@ -176,6 +191,11 @@ class EvalPFPascalConfig:
     eval_dataset_path: str = "datasets/pf-pascal/"
     pck_alpha: float = 0.1
     pck_procedure: str = "scnet"
+    # coarse-to-fine sparse matching passthrough (ModelConfig.sparse_topk):
+    # >0 evaluates with the sparse pipeline at this k (applies when the
+    # eval constructs the net itself; a caller-supplied net keeps its own
+    # ModelConfig).  0 = dense, the unchanged default.
+    sparse_topk: int = 0
     # fault tolerance (evaluation/resilience.py; README "Resilient
     # inference" — no reference analog: the reference loses all accumulated
     # PCK on any crash):
@@ -221,6 +241,11 @@ class EvalInLocConfig:
     output_root: str = "matches"
     # TPU-native addition: shard the 4D volume spatially over this many devices.
     spatial_shards: int = 1
+    # coarse-to-fine sparse matching passthrough (ModelConfig.sparse_topk):
+    # >0 evaluates with the sparse pipeline at this k.  Requires k_size=1 —
+    # maxpool4d relocalization composes with the dense volume only, so a
+    # sparse run at the default k_size=2 falls back dense with a warning.
+    sparse_topk: int = 0
     # dispatch/fetch pipeline depth of the eval loop. 0 = adaptive: start at
     # the low-latency optimum of 2 (r3 sweep: 0.62/0.285/0.47/0.51 s/pair at
     # depths 1/2/3/4) and deepen to at most 4 when the per-pair wall EWMA

@@ -528,6 +528,13 @@ def output_folder_name(config: EvalInLocConfig) -> str:
     (eval_inloc.py:60-71)."""
     name = os.path.basename(config.inloc_shortlist).split(".")[0]
     name += f"_SZ_NEW_{config.image_size}_K_{config.k_size}"
+    if config.sparse_topk and config.k_size <= 1 and config.spatial_shards <= 1:
+        # the coarse-to-fine tier changes the tables below full coverage:
+        # its runs must not share (and silently overwrite) a dense run's
+        # folder.  Appended only when the knob actually engages — with
+        # k_size>1 or spatial sharding the pipeline chooser keeps every
+        # pair dense and the outputs are the dense run's.
+        name += f"_SPARSE{config.sparse_topk}"
     if config.matching_both_directions:
         name += "_BOTHDIRS"
     elif config.flip_matching_direction:
@@ -622,6 +629,32 @@ def run_inloc_eval(
         # — and the device resize quantization, match_capacity, and the
         # output folder name must all agree on one k
         model_config = model_config.replace(relocalization_k_size=config.k_size)
+    if config.sparse_topk:
+        # coarse-to-fine sparse matching (README "Coarse-to-fine matching"):
+        # applies per shape bucket through the forward's pipeline chooser.
+        # maxpool4d relocalization composes with the dense volume only, so
+        # the default k_size=2 keeps every pair dense — warn loudly rather
+        # than let the knob silently do nothing
+        if config.k_size > 1:
+            log.warning(
+                f"sparse_topk={config.sparse_topk} with k_size="
+                f"{config.k_size}: relocalization pooling keeps the dense "
+                "path (pass --k_size 1 to run the coarse2fine tier)",
+                kind="validation")
+        if config.spatial_shards > 1:
+            # the hB-sharded forward builds its own correlation volume and
+            # never consults the pipeline chooser, while NON-shardable
+            # shape buckets would fall back through it — one run must not
+            # mix sparse and dense tables per pair, so the knob is dropped
+            # wholesale here (the feature-store-under-sharding rule)
+            log.warning(
+                f"sparse_topk={config.sparse_topk} ignored under "
+                f"spatial_shards={config.spatial_shards} (the hB-sharded "
+                "forward is dense; a mixed sparse/dense run would be "
+                "per-pair inconsistent)", kind="validation")
+        else:
+            model_config = model_config.replace(
+                sparse_topk=config.sparse_topk)
 
     mesh = None
     if config.spatial_shards > 1:

@@ -207,6 +207,12 @@ def _run_eval_impl(
 
     if net is None:
         mc = (model_config or ModelConfig()).replace(checkpoint=config.checkpoint)
+        if config.sparse_topk:
+            # coarse-to-fine sparse matching (README "Coarse-to-fine
+            # matching"): the knob rides the ModelConfig so the forward's
+            # pipeline chooser sees it; ineligible shape classes fall back
+            # dense inside ncnet_match_volume
+            mc = mc.replace(sparse_topk=config.sparse_topk)
         net = NCNet(mc)
 
     dataset = PFPascalDataset(

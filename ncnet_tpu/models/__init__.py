@@ -9,12 +9,14 @@ from ncnet_tpu.models.backbone import (
 from ncnet_tpu.models.ncnet import (
     NCNet,
     NCNetOutput,
+    coarse2fine_filter,
     extract_features,
     init_ncnet,
     make_point_matcher,
     ncnet_filter,
     ncnet_forward,
     ncnet_forward_from_features,
+    ncnet_match_volume,
     neigh_consensus,
 )
 from ncnet_tpu.models.checkpoint import (
@@ -35,9 +37,11 @@ __all__ = [
     "init_ncnet",
     "load_params",
     "make_point_matcher",
+    "coarse2fine_filter",
     "ncnet_filter",
     "ncnet_forward",
     "ncnet_forward_from_features",
+    "ncnet_match_volume",
     "neigh_consensus",
     "save_params",
 ]

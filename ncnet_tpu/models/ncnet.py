@@ -199,10 +199,15 @@ def recover_from_device_failure(exc: BaseException, *retraceables,
         # tier-related: demoting would only grant pointless off-budget
         # retries of a bit-identical program.  (Injected errors bypass the
         # gate — they exist to simulate a Pallas-capable rig's failure on
-        # the CPU test backend.)
+        # the CPU test backend.)  Exception: when the coarse2fine sparse
+        # PIPELINE is routing traffic, demoting it to dense is a genuinely
+        # different program on any backend (the sparse path can OOM or
+        # fail where dense would not), so the gate lets it through.
+        from ncnet_tpu.ops import last_selected_tier
         from ncnet_tpu.ops.conv4d import _pallas_available
 
-        if not _pallas_available():
+        if not _pallas_available() \
+                and last_selected_tier("pipeline") != "coarse2fine":
             return None
     from ncnet_tpu.ops import demote_fused_tier
 
@@ -642,8 +647,7 @@ def ncnet_forward_from_features(
     if config.half_precision:
         fa = fa.astype(jnp.bfloat16)
         fb = fb.astype(jnp.bfloat16)
-    corr = correlation_4d(fa, fb)
-    return ncnet_filter(config, params, corr)
+    return ncnet_match_volume(config, params, fa, fb)
 
 
 def ncnet_forward_from_feature_pair(
@@ -668,8 +672,117 @@ def ncnet_forward_from_feature_pair(
     if config.half_precision:
         fa = fa.astype(jnp.bfloat16)
         fb = fb.astype(jnp.bfloat16)
+    return ncnet_match_volume(config, params, fa, fb)
+
+
+def ncnet_match_volume(config: ModelConfig, params, fa: jnp.ndarray,
+                       fb: jnp.ndarray) -> NCNetOutput:
+    """Correlation + filtering of a feature pair, behind the match-pipeline
+    tier dispatch: the DENSE path (full 4D correlation → :func:`ncnet_filter`)
+    or the COARSE-TO-FINE sparse path (:func:`coarse2fine_filter`) when
+    ``config.sparse_topk`` > 0, the shape class is eligible, and the
+    "coarse2fine" tier is not demoted (``ops/sparse_corr.py::
+    choose_match_pipeline`` is the one authority; the decision happens at
+    trace time, so a post-demotion ``ResilientJit.retrace`` lands the next
+    dispatch on the dense fallback exactly like the fused-stack ladder).
+    Every feature-pair forward converges here, which is what wires the
+    sparse tier through ``make_point_matcher``, the serving engine, and
+    both eval entry points without touching their downstream wire shapes."""
+    from ncnet_tpu.ops.sparse_corr import choose_match_pipeline
+    from ncnet_tpu.ops.sparse_topk import resolve_halo
+
+    tier = choose_match_pipeline(
+        fa.shape[1], fa.shape[2], fb.shape[1], fb.shape[2],
+        sparse_topk=config.sparse_topk,
+        factor=config.sparse_factor,
+        halo=resolve_halo(config.sparse_halo, config.sparse_factor),
+        reloc_k=config.relocalization_k_size,
+    )
+    if tier == "coarse2fine":
+        return coarse2fine_filter(config, params, fa, fb)
     corr = correlation_4d(fa, fb)
     return ncnet_filter(config, params, corr)
+
+
+def coarse2fine_filter(config: ModelConfig, params, fa: jnp.ndarray,
+                       fb: jnp.ndarray) -> NCNetOutput:
+    """The coarse-to-fine sparse match pipeline (ROADMAP item 2; README
+    "Coarse-to-fine matching"):
+
+      1. **coarse pass** — pool both feature grids by ``config.sparse_factor``
+         (stride-32 at the default 2), build the coarse 4D volume
+         (``1/factor⁴`` of the dense cells), and run the UNCHANGED dense
+         filter on it (:func:`ncnet_filter` — mutual matching + the full NC
+         consensus stack, same weights: conv4d is resolution-agnostic);
+      2. **candidate selection** — per-row top-k over the filtered coarse
+         volume (``ops/sparse_topk.topk_candidates``, static-shape coverage
+         contract);
+      3. **sparse fine pass** — gather the candidates' fine feature patches,
+         correlate, mutual-match with cross-tile scatter-max vectors, run
+         the NC stack on the folded tiles (``neigh_consensus`` — its own
+         tier chooser routes the tile batch through the resident Pallas
+         kernels where the shape class compiles), gate again, and scatter
+         the filtered scores back onto the dense volume shape
+         (``ops/sparse_corr.sparse_refine``).
+
+    The returned :class:`NCNetOutput` carries a bitwise wire-compatible
+    dense-shaped volume (zeros off the candidate support), so match
+    extraction, quality signals, serving and the InLoc writers all run
+    unchanged.  Callers must gate eligibility through
+    ``choose_match_pipeline`` (:func:`ncnet_match_volume` does)."""
+    from ncnet_tpu.ops.sparse_corr import sparse_refine
+    from ncnet_tpu.ops.sparse_topk import (
+        pool_features,
+        resolve_halo,
+        topk_candidates,
+    )
+
+    nc_params = params["nc"]
+    if config.half_precision:
+        nc_params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), nc_params)
+        fa = fa.astype(jnp.bfloat16)
+        fb = fb.astype(jnp.bfloat16)
+    factor = config.sparse_factor
+    halo = resolve_halo(config.sparse_halo, factor)
+    # coarse pass: the dense machinery at 1/factor² resolution (ncnet_filter
+    # re-casts under half_precision — idempotent)
+    fac = pool_features(fa, factor, renormalize=config.normalize_features)
+    fbc = pool_features(fb, factor, renormalize=config.normalize_features)
+    coarse = ncnet_filter(config, params, correlation_4d(fac, fbc))
+    # SYMMETRIC candidate selection: per coarse source cell over targets AND
+    # per coarse target cell over sources.  Selection in one direction only
+    # leaves the OTHER direction's extraction uncovered (a target cell no
+    # source cell selected has an all-zero column → a garbage argmax row in
+    # the B→A match table), and both eval paths read both directions —
+    # corr_to_matches' default is per-target-cell, InLoc extracts both.
+    cand_ab = topk_candidates(coarse.corr, config.sparse_topk)
+    cand_ba = topk_candidates(
+        jnp.transpose(coarse.corr, (0, 3, 4, 1, 2)), config.sparse_topk)
+
+    def stack_fn(vol: jnp.ndarray) -> jnp.ndarray:
+        return neigh_consensus(nc_params, vol,
+                               symmetric=config.symmetric_mode)
+
+    def stack_fn_t(vol: jnp.ndarray) -> jnp.ndarray:
+        # the role-swapped tile family's stack: the symmetric stack commutes
+        # with A↔B volume transposition, so it applies as-is; an asymmetric
+        # stack must be conjugated by the transpose to filter the swapped
+        # tiles identically to their dense orientation
+        if config.symmetric_mode:
+            return stack_fn(vol)
+        vt = jnp.transpose(vol, (0, 3, 4, 1, 2))
+        return jnp.transpose(stack_fn(vt), (0, 3, 4, 1, 2))
+
+    vol_ab = sparse_refine(fa, fb, cand_ab, factor=factor, halo=halo,
+                           stack_fn=stack_fn)
+    vol_ba = sparse_refine(fb, fa, cand_ba, factor=factor, halo=halo,
+                           stack_fn=stack_fn_t)
+    # merge the two families on the dense frame by max — duplicates (a tile
+    # selected in both directions) carry the same filtered value, and at
+    # full coverage each family alone already equals the dense volume
+    corr = jnp.maximum(vol_ab, jnp.transpose(vol_ba, (0, 3, 4, 1, 2)))
+    return NCNetOutput(corr, None)
 
 
 def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,

@@ -227,11 +227,21 @@ def active_tier(eligible: bool = True, stage: str = "forward") -> str:
     under the wrong tier series.  For eligible programs the label is the
     stage chooser's most recent decision — per STAGE, not per shape, so a
     mixed-shape eligible run is tagged with its latest decision (shapes are
-    constant within one eval/training run, where this is exact)."""
-    if not eligible:
-        return "xla"
+    constant within one eval/training run, where this is exact).
+
+    The match-PIPELINE tier outranks the fused-stack tier: when the most
+    recent pipeline decision (``ops/sparse_corr.choose_match_pipeline``,
+    consulted by every feature-pair forward trace) routed through the
+    coarse-to-fine sparse path, the signals describe THAT pipeline's
+    volume — regardless of which fused-stack tier the coarse/tile stacks
+    used inside it, and regardless of precision eligibility (the sparse
+    pipeline runs in fp32 too)."""
     from ncnet_tpu.ops import last_selected_tier
 
+    if stage == "forward" and last_selected_tier("pipeline") == "coarse2fine":
+        return "coarse2fine"
+    if not eligible:
+        return "xla"
     return last_selected_tier(stage) or "xla"
 
 

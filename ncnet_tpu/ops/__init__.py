@@ -38,11 +38,24 @@ from ncnet_tpu.ops.nc_fused_lane_vjp import (  # noqa: F401
     nc_stack_fused_vjp,
 )
 from ncnet_tpu.ops.pooling import maxpool4d_with_argmax
+from ncnet_tpu.ops.sparse_topk import (  # noqa: F401
+    candidate_recall,
+    pool_features,
+    topk_candidates,
+)
+from ncnet_tpu.ops.sparse_corr import (  # noqa: F401
+    choose_match_pipeline,
+    coarse2fine_feasible,
+    sparse_fine_corr,
+    sparse_mutual_matching,
+    sparse_refine,
+)
 from ncnet_tpu.ops.matching import (
     Matches,
     mutual_argmax_agreement,
     mutual_matching,
     corr_to_matches,
+    scatter_sparse_scores,
     nearest_neighbor_point_tnf,
     bilinear_interp_point_tnf,
     normalize_axis,
@@ -84,6 +97,15 @@ __all__ = [
     "nc_stack_resident",
     "reset_fused_tier_demotions",
     "maxpool4d_with_argmax",
+    "candidate_recall",
+    "pool_features",
+    "topk_candidates",
+    "choose_match_pipeline",
+    "coarse2fine_feasible",
+    "sparse_fine_corr",
+    "sparse_mutual_matching",
+    "sparse_refine",
+    "scatter_sparse_scores",
     "mutual_argmax_agreement",
     "mutual_matching",
     "corr_to_matches",

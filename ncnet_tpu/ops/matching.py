@@ -58,6 +58,67 @@ def mutual_argmax_agreement(corr: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(agree.astype(jnp.float32), axis=1)
 
 
+def scatter_sparse_scores(
+    values: jnp.ndarray,
+    ia: jnp.ndarray,
+    ja: jnp.ndarray,
+    ib: jnp.ndarray,
+    jb: jnp.ndarray,
+    shape: tuple,
+) -> jnp.ndarray:
+    """Scatter sparse tile scores back onto the dense volume shape.
+
+    The sparse-aware half of match extraction (coarse-to-fine pipeline,
+    ``ops/sparse_corr.py``): filtered tile values land on their global fine
+    cells in a ZERO-initialized ``(B, hA, wA, hB, wB)`` volume, so every
+    dense consumer — :func:`corr_to_matches`, ``extract_match_table``, the
+    quality-signal extractor, the serving wire tables — runs unchanged on a
+    bitwise-compatible wire shape.  Semantics:
+
+      * uncovered cells stay 0 — the filtered volume is non-negative (every
+        NC layer ReLUs), so a zero background reproduces the dense
+        argmax/score behavior wherever coverage contains the per-row maxima
+        (an all-zero column argmaxes to index 0 with score 0, exactly like
+        a dense volume that is zero there);
+      * cells covered by several overlapping tiles resolve by max — patch
+        halos overlap by construction, and near-edge tiles recompute the
+        same cell with more or less truncated conv support; max keeps the
+        best-supported estimate and is deterministic regardless of tile
+        order.
+
+    The scatter targets the volume reshaped to ``(B, hA·wA, hB·wB)`` through
+    TWO linearized int32 indices (source cell ``ia·wA+ja``, target cell
+    ``ib·wB+jb``) — two index arrays the size of ``values`` instead of four,
+    keeping the scatter's temp footprint a small multiple of the sparse
+    cell count (the memory claim the ledger gates,
+    ``mem_filter_temp_bytes_sparse``).  Each HALF of the split stays far
+    inside int32 at any resolution (hw < 2³¹ per side), where a single
+    fully-linearized index would silently wrap above 2³¹ cells — already
+    reached at ~3× InLoc feature resolution, exactly the workloads the
+    sparse path exists for (jit-mode scatter drops or misplaces wrapped
+    indices without erroring).
+
+    Args:
+      values: ``(B, N, K, p, p, p, p)`` tile scores (dims: source rows/cols,
+        target rows/cols).
+      ia, ja: ``(N, p)`` int32 fine source row/col indices per source patch.
+      ib, jb: ``(B, N, K, p)`` int32 fine target row/col indices.
+      shape: ``(hA, wA, hB, wB)`` dense fine-grid dims.
+    """
+    b = values.shape[0]
+    ha, wa, hb, wb = (int(d) for d in shape)
+    lin_a = (ia[None, :, None, :, None, None, None].astype(jnp.int32) * wa
+             + ja[None, :, None, None, :, None, None])
+    lin_b = (ib[:, :, :, None, None, :, None] * wb
+             + jb[:, :, :, None, None, None, :])
+    lin_a = jnp.broadcast_to(lin_a, values.shape).reshape(b, -1)
+    lin_b = jnp.broadcast_to(lin_b, values.shape).reshape(b, -1)
+    flat = jnp.zeros((b, ha * wa, hb * wb), values.dtype)
+    flat = flat.at[jnp.arange(b)[:, None], lin_a, lin_b].max(
+        values.reshape(b, -1))
+    return flat.reshape(b, ha, wa, hb, wb)
+
+
 def normalize_axis(x, length):
     """Pixel coord (1-indexed convention) → [-1, 1] (point_tnf.py:6-7)."""
     return (x - 1 - (length - 1) / 2) * 2 / (length - 1)

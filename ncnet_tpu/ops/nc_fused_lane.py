@@ -708,8 +708,14 @@ _runtime_demoted: set = set()
 # "resident_vjp" — the training backward tier (ops/nc_fused_lane_vjp.py) —
 # is demotable only by NAME (training's recovery passes it explicitly via
 # recover_from_device_failure(prefer_tier=...)), so an eval-loop device
-# failure never wastes a demotion cycle on a tier the eval path cannot run
-_TIER_ORDER = ("resident", "perlayer")
+# failure never wastes a demotion cycle on a tier the eval path cannot run.
+# "coarse2fine" — the sparse match PIPELINE (ops/sparse_corr.py) — sits
+# above the fused-stack tiers (it replaces the whole dense volume, so when
+# it is routing traffic it is the first failure suspect), but the ladder
+# walk skips it unless it IS the active pipeline (see demote_fused_tier):
+# demoting a tier no traffic runs would burn the recovery's free retry on
+# a bit-identical program.
+_TIER_ORDER = ("coarse2fine", "resident", "perlayer")
 _ALL_TIERS = ("resident_vjp",) + _TIER_ORDER
 
 
@@ -730,11 +736,18 @@ def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
         # previous process already disabled would burn the recovery cycle
         # without changing the program
         dead = _runtime_demoted | tier_cache.persistent_demotions()
+        tier = None
         for t in _TIER_ORDER:
+            if t == "coarse2fine" \
+                    and _last_selected.get("pipeline") != "coarse2fine":
+                # the sparse pipeline is only a failure suspect when it is
+                # actually routing traffic (sparse_topk off, or already on
+                # dense fallback: demoting it changes no program)
+                continue
             if t not in dead:
                 tier = t
                 break
-        else:
+        if tier is None:
             return None
     elif tier not in _ALL_TIERS or tier in _runtime_demoted:
         return None
@@ -785,14 +798,18 @@ _last_selected: dict = {}
 
 def last_selected_tier(stage: str = "forward"):
     """The tier name the stage's chooser most recently decided on for ANY
-    shape ('resident' / 'perlayer' / 'resident_vjp' / 'xla'), or None when
-    the chooser has not run this process (a pure-XLA path that never
-    consulted it — fp32/CPU volumes)."""
+    shape ('resident' / 'perlayer' / 'resident_vjp' / 'xla'; for the
+    "pipeline" stage: 'coarse2fine' / 'dense' — ops/sparse_corr.py's
+    match-pipeline chooser), or None when the chooser has not run this
+    process (a pure-XLA path that never consulted it — fp32/CPU volumes)."""
     return _last_selected.get(stage)
 
 
-def _emit_tier_selected(stage: str, sig, tier, cached: bool = False) -> None:
-    _last_selected[stage] = tier or "xla"
+def _emit_tier_selected(stage: str, sig, tier, cached: bool = False,
+                        none_label: str = "xla") -> None:
+    # none_label: what a None decision means for the stage — "xla" for the
+    # fused-stack choosers, "dense" for the match-pipeline chooser
+    _last_selected[stage] = tier or none_label
     if _emitted_choices.get((stage, sig)) == tier:
         return
     _emitted_choices[(stage, sig)] = tier
@@ -800,7 +817,7 @@ def _emit_tier_selected(stage: str, sig, tier, cached: bool = False) -> None:
 
     ha, wa, hb, wb, kernels, channels = sig
     _obs_events.emit(
-        "tier_selected", stage=stage, tier=tier or "xla",
+        "tier_selected", stage=stage, tier=tier or none_label,
         shape=[ha, wa, hb, wb], kernels=list(kernels),
         channels=list(channels), cached=bool(cached),
     )

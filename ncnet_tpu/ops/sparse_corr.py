@@ -1,0 +1,493 @@
+"""Sparse/gathered fine-level correlation + NC refinement (coarse-to-fine).
+
+The fine half of the coarse-to-fine pipeline (selection: ``ops/sparse_topk``):
+given per-coarse-source-cell candidate target neighbourhoods, evaluate and
+FILTER correlation only on the gathered ``(source patch × candidate patch)``
+tiles — fine-level FLOPs and bytes scale with ``k·patch⁴`` per coarse cell
+instead of ``(hw)²``, which is what opens 2–4× feature resolution and
+shrinks the serving bucket footprints (ROADMAP item 2).
+
+Tile semantics (the whole module's contract):
+
+  * one tile per ``(coarse source cell n, candidate c)``: the source side is
+    the cell's ``patch×patch`` fine block (halo-expanded, origin-clamped —
+    the sparse_topk coverage contract), the target side the candidate's;
+  * the tile values are the exact dense correlation restricted to the tile
+    (gathered features, same f32-accumulated inner product);
+  * mutual-matching gating uses CROSS-TILE scatter-max vectors — the max
+    over every *covered* cell of a source row / target column, exactly the
+    dense ``ops.matching.mutual_matching`` formula with "max over all"
+    relaxed to "max over covered" (equal whenever coverage contains the
+    row/column maxima; exact at k = full coverage);
+  * the NC stack runs on the tiles as a folded batch of small dense 4D
+    volumes with zero padding at patch edges — conv support truncates at
+    the halo boundary (the standard sparse-refinement approximation; exact
+    for cells whose receptive field lies inside the patch);
+  * filtered scores scatter back to a zero-initialized DENSE volume
+    (:func:`ncnet_tpu.ops.matching.scatter_sparse_scores`, duplicates
+    resolved by max) so every downstream consumer — ``extract_match_table``,
+    the quality-signal extractor, the serving wire format, the InLoc .mat
+    writers — runs UNCHANGED on a bitwise-compatible wire shape.
+
+Kernel tiers (the ``choose_fused_stack`` discipline):
+
+  * **XLA reference tier** (:func:`gather_tile_corr`): pure gathers + one
+    einsum.  Always available; CPU tests and correctness never depend on
+    Mosaic.
+  * **Pallas gather-into-VMEM tier** (:func:`gather_tile_corr_pallas`): a
+    scalar-prefetch grid kernel alongside ``nc_fused_lane.py`` — the
+    candidate indices ride ahead of the grid as prefetched scalars and
+    drive the BlockSpec index maps, so each grid step DMAs only the
+    candidate's ``patch``-row bands of the target feature map into VMEM
+    (a gather ring the pallas pipeline double-buffers) and contracts them
+    against the resident source patch on the MXU.  Feasibility-gated,
+    real-compile-probed, tier-cached; any failure falls back to the XLA
+    tier.  The NC refinement of the gathered tiles then reuses the
+    resident fused-lane kernel family (tiles are exactly its shape class,
+    batch-folded), completing the Pallas path end to end.
+
+The pipeline itself is a first-class named tier, ``"coarse2fine"``
+(:func:`choose_match_pipeline`): demotable at runtime like
+resident/perlayer (``ops.demote_fused_tier``), persisted across restarts
+through the tier cache's negative entries, with dense as the fallback edge.
+"""
+
+from __future__ import annotations
+
+import functools
+import os as _os
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ncnet_tpu.ops.sparse_topk import (
+    block_origins,
+    candidate_origins,
+    patch_side,
+)
+
+# VMEM working-set budget for the gather kernel (the nc_fused_lane rule)
+_VMEM_BUDGET = 13 * 2 ** 20
+
+# mutual-matching epsilon — MUST equal ops.matching.mutual_matching's so
+# the k=full sparse path reproduces the dense gating bit-for-bit
+_MM_EPS = 1e-5
+
+
+class SparseTiles(NamedTuple):
+    """Gathered correlation tiles plus their global fine-grid indexing.
+
+    ``values``: ``(B, N, K, p, p, p, p)`` — tile (n, c) holds the raw (or
+    filtered) correlation of source patch n against candidate patch (n, c);
+    dims are (source rows, source cols, target rows, target cols).
+    ``ia``/``ja``: ``(N, p)`` int32 — fine source row/col indices of patch
+    n's rows/cols (static per shape: one source patch per coarse cell).
+    ``ib``/``jb``: ``(B, N, K, p)`` int32 — fine target row/col indices of
+    each candidate patch.
+    """
+
+    values: jnp.ndarray
+    ia: jnp.ndarray
+    ja: jnp.ndarray
+    ib: jnp.ndarray
+    jb: jnp.ndarray
+
+
+def source_patch_index(ha: int, wa: int, factor: int,
+                       patch: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Static ``(ia, ja)`` of :class:`SparseTiles`: per coarse source cell
+    (row-major over the ``(ha/factor, wa/factor)`` coarse grid), the fine
+    row/col indices of its halo-expanded patch."""
+    oi = block_origins(ha // factor, factor, patch, ha)   # (Hc,)
+    oj = block_origins(wa // factor, factor, patch, wa)   # (Wc,)
+    rows = oi[:, None] + np.arange(patch)[None, :]        # (Hc, p)
+    cols = oj[:, None] + np.arange(patch)[None, :]        # (Wc, p)
+    hc, wc = len(oi), len(oj)
+    ia = np.repeat(rows, wc, axis=0)                      # (Hc·Wc, p)
+    ja = np.tile(cols, (hc, 1))                           # (Hc·Wc, p)
+    return ia.astype(np.int32), ja.astype(np.int32)
+
+
+def gather_source_patches(fa: jnp.ndarray, ia: np.ndarray,
+                          ja: np.ndarray) -> jnp.ndarray:
+    """``(B, N, p, p, C)`` source feature patches (XLA gather — the source
+    side is a regular halo view; both tiers share it)."""
+    return fa[:, ia[:, :, None], ja[:, None, :], :]
+
+
+def gather_target_patches(fb: jnp.ndarray, ib: jnp.ndarray,
+                          jb: jnp.ndarray) -> jnp.ndarray:
+    """``(B, N, K, p, p, C)`` candidate feature patches (XLA gather tier)."""
+    b = fb.shape[0]
+    bidx = jnp.arange(b)[:, None, None, None, None]
+    return fb[bidx, ib[..., :, None], jb[..., None, :], :]
+
+
+def gather_tile_corr(fa: jnp.ndarray, fb: jnp.ndarray, tiles: SparseTiles,
+                     accumulate_dtype=jnp.float32) -> jnp.ndarray:
+    """XLA reference tier: tile correlation values ``(B, N, K, p, p, p, p)``
+    — the dense ``correlation_4d`` inner product restricted to the gathered
+    patches (same f32 MXU accumulation, cast back to the feature dtype)."""
+    fa_p = gather_source_patches(fa, tiles.ia, tiles.ja)
+    fb_p = gather_target_patches(fb, tiles.ib, tiles.jb)
+    out = jnp.einsum(
+        "bnijc,bnkpqc->bnkijpq", fa_p, fb_p,
+        preferred_element_type=accumulate_dtype,
+    )
+    if accumulate_dtype is not None and fa.dtype != accumulate_dtype:
+        out = out.astype(fa.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pallas gather-into-VMEM tier
+#
+# Grid (B, N, K); candidate band rows + column starts ride as PREFETCHED
+# SCALARS so the target feature map's BlockSpec index maps can gather just
+# the candidate's rows: the patch is ``patch = bands·factor`` rows tall and
+# its clamped origin is a multiple of ``factor`` whenever the halo is
+# (sparse_topk.candidate_origins), so ``bands`` stacked (factor, wB, C)
+# row-band blocks cover it exactly — each grid step DMAs only those bands
+# into VMEM (double-buffered by the pallas pipeline: the gather ring), lane-
+# slices the patch columns at the prefetched start, and contracts the
+# (p², C) source patch against the (p², C) gathered target patch on the MXU.
+# ---------------------------------------------------------------------------
+
+
+def _gather_corr_kernel(rband_ref, cstart_ref, fa_ref, *band_refs,
+                        out_ref, patch, factor, c_dim):
+    from jax.experimental import pallas as pl
+
+    bi, ni, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    j0 = cstart_ref[bi, ni, ki]
+    bands = [
+        ref[0, :, pl.ds(j0, patch), :]            # (factor, patch, C)
+        for ref in band_refs
+    ]
+    bt = jnp.concatenate(bands, axis=0)           # (patch, patch, C)
+    bt = bt.reshape(patch * patch, c_dim)         # leading-dim collapse only
+    a = fa_ref[0, 0]                              # (patch², C)
+    y = jax.lax.dot_general(
+        a, bt, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                             # (p², p²)
+    out_ref[0, 0, 0] = y.astype(out_ref.dtype)
+
+
+def sparse_gather_feasible(hb: int, wb: int, c_dim: int, patch: int,
+                           factor: int, halo: int,
+                           itemsize: int = 2) -> bool:
+    """Whether the gather kernel's per-step VMEM working set fits: the
+    band blocks (double-buffered), the resident source patch, the f32 dot
+    output — and the band-alignment precondition (halo a multiple of the
+    factor, so candidate origins land on band boundaries)."""
+    if halo % factor != 0 or patch % factor != 0:
+        return False
+    bands = patch // factor
+    band_bytes = 2 * bands * factor * wb * c_dim * itemsize  # double-buffered
+    a_bytes = 2 * patch * patch * c_dim * itemsize
+    out_bytes = (patch * patch) ** 2 * (4 + itemsize)
+    bt_bytes = patch * patch * c_dim * itemsize
+    return band_bytes + a_bytes + out_bytes + bt_bytes <= _VMEM_BUDGET
+
+
+def gather_tile_corr_pallas(
+    fa_p2: jnp.ndarray, fb: jnp.ndarray,
+    row_blocks: jnp.ndarray, col_starts: jnp.ndarray,
+    *, patch: int, factor: int, interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas gather tier: ``(B, N, K, p², p²)`` tile correlations.
+
+    Args:
+      fa_p2: ``(B, N, p², C)`` source patches (pre-gathered, pre-reshaped —
+        XLA's half of the layout work).
+      fb: ``(B, hB, wB, C)`` full target feature map (stays in HBM; only
+        candidate bands reach VMEM).
+      row_blocks: ``(B, N, K)`` int32 — candidate patch origin row divided
+        by ``factor`` (the band block index; the alignment precondition is
+        ``sparse_gather_feasible``'s to check).
+      col_starts: ``(B, N, K)`` int32 — candidate patch origin column.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n, p2, c_dim = fa_p2.shape
+    k = row_blocks.shape[2]
+    bands = patch // factor
+    kern = functools.partial(
+        _kernel_entry, patch=patch, factor=factor, c_dim=c_dim)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n, k),
+        in_specs=[
+            pl.BlockSpec((1, 1, p2, c_dim),
+                         lambda bi, ni, ki, rref, cref: (bi, ni, 0, 0)),
+        ] + [
+            pl.BlockSpec(
+                (1, factor, fb.shape[2], c_dim),
+                lambda bi, ni, ki, rref, cref, d=d: (
+                    bi, rref[bi, ni, ki] + d, 0, 0),
+            )
+            for d in range(bands)
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, p2, p2),
+            lambda bi, ni, ki, rref, cref: (bi, ni, ki, 0, 0)),
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, k, p2, p2), fa_p2.dtype),
+        interpret=interpret,
+    )(row_blocks, col_starts, fa_p2, *([fb] * bands))
+
+
+def _kernel_entry(rband_ref, cstart_ref, fa_ref, *rest, patch, factor, c_dim):
+    *band_refs, out_ref = rest
+    _gather_corr_kernel(rband_ref, cstart_ref, fa_ref, *band_refs,
+                        out_ref=out_ref, patch=patch, factor=factor,
+                        c_dim=c_dim)
+
+
+@functools.lru_cache(maxsize=8)
+def sparse_gather_compiles(b, n, k, hb, wb, c_dim, patch, factor,
+                           dtype_name: str) -> bool:
+    """Real-compile probe for the gather kernel (per shape class, cached;
+    consults/feeds the persistent tier cache) — Mosaic legality depends on
+    concrete shapes, so the chooser verifies an actual compile and any
+    failure keeps the XLA gather tier."""
+    from ncnet_tpu.ops import tier_cache
+
+    sig = (b, n, hb, wb, (k, patch), (factor, c_dim))
+    hit = tier_cache.lookup("sparse_gather", sig)
+    if hit is not None and hit[0] == "gather":
+        return True
+    try:
+        dt = jnp.dtype(dtype_name)
+        fa_p2 = jax.ShapeDtypeStruct((b, n, patch * patch, c_dim), dt)
+        fb = jax.ShapeDtypeStruct((b, hb, wb, c_dim), dt)
+        rb = jax.ShapeDtypeStruct((b, n, k), jnp.int32)
+        cs = jax.ShapeDtypeStruct((b, n, k), jnp.int32)
+        compiled = jax.jit(functools.partial(
+            gather_tile_corr_pallas, patch=patch, factor=factor,
+        )).lower(fa_p2, fb, rb, cs).compile()
+        try:
+            from ncnet_tpu.observability import memory as obs_memory
+
+            obs_memory.record_program(
+                "sparse_gather_probe",
+                f"{b}x{n}x{k}|{hb}x{wb}x{c_dim}|p={patch}",
+                analysis=compiled, tier="gather", source="tier_probe")
+        except Exception:  # noqa: BLE001 — the ledger never fails a probe
+            pass
+        tier_cache.record("sparse_gather", sig, "gather")
+        return True
+    except Exception:
+        return False
+
+
+def _use_pallas_gather(b, n, k, hb, wb, c_dim, patch, factor, halo,
+                       dtype) -> bool:
+    if _os.environ.get("NCNET_SPARSE_GATHER", "").lower() in ("0", "off"):
+        return False
+    from ncnet_tpu.ops.conv4d import _pallas_available
+
+    if not _pallas_available() or dtype != jnp.bfloat16:
+        return False
+    if not sparse_gather_feasible(hb, wb, c_dim, patch, factor, halo,
+                                  itemsize=jnp.dtype(dtype).itemsize):
+        return False
+    return sparse_gather_compiles(b, n, k, hb, wb, c_dim, patch, factor,
+                                  jnp.dtype(dtype).name)
+
+
+# ---------------------------------------------------------------------------
+# sparse mutual matching + refinement orchestration
+# ---------------------------------------------------------------------------
+
+
+def sparse_mutual_matching(t: SparseTiles, eps: float = _MM_EPS,
+                           grid_a: Tuple[int, int] = None,
+                           grid_b: Tuple[int, int] = None) -> SparseTiles:
+    """Mutual-matching gating on the sparse structure.
+
+    The dense formula (``ops.matching.mutual_matching``, same eps and
+    parenthesization) with its "max over all A / all B cells" computed as
+    scatter-max over every COVERED cell across tiles: a fine cell covered
+    by several overlapping tiles contributes each tile's value, so the
+    per-row/per-column vectors are exact over the covered support.  Equal
+    to the dense gating whenever coverage contains the row/column maxima
+    (always at k = full; on peak-dominated volumes whenever top-k covers
+    the peaks)."""
+    v = t.values
+    b = v.shape[0]
+    ha, wa = grid_a
+    hb, wb = grid_b
+    neg = jnp.asarray(-jnp.inf, v.dtype)
+    # max over covered target cells per fine SOURCE cell (dense max_over_b)
+    per_a = v.max(axis=(2, 5, 6))                          # (B, N, p, p)
+    max_b = jnp.full((b, ha, wa), neg, v.dtype).at[
+        :, t.ia[:, :, None], t.ja[:, None, :]].max(per_a)
+    # max over covered source cells per fine TARGET cell (dense max_over_a)
+    per_b = v.max(axis=(3, 4))                             # (B, N, K, p, p)
+    bidx = jnp.arange(b)[:, None, None, None, None]
+    max_a = jnp.full((b, hb, wb), neg, v.dtype).at[
+        bidx, t.ib[..., :, None], t.jb[..., None, :]].max(per_b)
+    g_b = max_b[:, t.ia[:, :, None], t.ja[:, None, :]]     # (B, N, p, p)
+    g_a = max_a[bidx, t.ib[..., :, None], t.jb[..., None, :]]  # (B,N,K,p,p)
+    ratio_b = v / (g_a[:, :, :, None, None, :, :] + eps)
+    ratio_a = v / (g_b[:, :, None, :, :, None, None] + eps)
+    return t._replace(values=v * (ratio_a * ratio_b))
+
+
+def sparse_fine_corr(fa: jnp.ndarray, fb: jnp.ndarray, cand: jnp.ndarray,
+                     *, factor: int, halo: int) -> SparseTiles:
+    """Gathered raw fine correlation tiles for the candidate set.
+
+    Dispatches the tile contraction to the Pallas gather tier when the
+    shape class compiles (TPU, bf16, VMEM-feasible, band-aligned halo),
+    else the XLA gather tier — correctness never depends on Mosaic."""
+    b, ha, wa, c_dim = fa.shape
+    hb, wb = fb.shape[1], fb.shape[2]
+    patch = patch_side(factor, halo)
+    wc = wb // factor
+    ia, ja = source_patch_index(ha, wa, factor, patch)
+    oi, oj = candidate_origins(cand, wc, factor, patch, hb, wb)
+    rng = jnp.arange(patch, dtype=jnp.int32)
+    ib = oi[..., None] + rng                               # (B, N, K, p)
+    jb = oj[..., None] + rng
+    tiles = SparseTiles(None, jnp.asarray(ia), jnp.asarray(ja), ib, jb)
+    n, k = cand.shape[1], cand.shape[2]
+    if _use_pallas_gather(b, n, k, hb, wb, c_dim, patch, factor, halo,
+                          fa.dtype):
+        fa_p2 = gather_source_patches(fa, ia, ja).reshape(
+            b, n, patch * patch, c_dim)
+        v = gather_tile_corr_pallas(
+            fa_p2, fb, oi // factor, oj, patch=patch, factor=factor,
+        ).reshape(b, n, k, patch, patch, patch, patch)
+        return tiles._replace(values=v)
+    return tiles._replace(values=gather_tile_corr(fa, fb, tiles))
+
+
+def core_mask(tiles: SparseTiles, cand: jnp.ndarray, wc: int,
+              wac: int, factor: int) -> jnp.ndarray:
+    """``(B, N, K, p, p, p, p)``-broadcastable 0/1 mask of each tile's CORE
+    — the coarse cell's own ``factor×factor`` fine block on both the source
+    and the candidate side.  Core cells are the tile's READOUT: with
+    ``halo ≥`` the stack's receptive radius their conv support lies inside
+    the patch, so their filtered values equal the dense computation
+    exactly; halo cells exist only to provide that support, and their
+    truncated values must neither feed the post-filter mutual-matching
+    maxima nor win the scatter against an exact duplicate from the cell's
+    own home tile."""
+    ic = cand // wc
+    jc = cand % wc
+    # source side: a patch position is core iff its global fine index
+    # pools back to the patch's own coarse cell.  Source patches are
+    # row-major over the (Hc, Wc) source coarse grid
+    # (source_patch_index), so cell n decodes as (n // wac, n % wac).
+    n = tiles.ia.shape[0]
+    a_cell = jnp.arange(n)
+    ra = (tiles.ia // factor) == (a_cell // wac)[:, None]        # (N, p)
+    ca = (tiles.ja // factor) == (a_cell % wac)[:, None]         # (N, p)
+    rb = (tiles.ib // factor) == ic[..., None]                   # (B,N,K,p)
+    cb = (tiles.jb // factor) == jc[..., None]
+    m = (
+        ra[None, :, None, :, None, None, None]
+        & ca[None, :, None, None, :, None, None]
+        & rb[:, :, :, None, None, :, None]
+        & cb[:, :, :, None, None, None, :]
+    )
+    return m
+
+
+def sparse_refine(
+    fa: jnp.ndarray, fb: jnp.ndarray, cand: jnp.ndarray, *,
+    factor: int, halo: int,
+    stack_fn: Callable[[jnp.ndarray], jnp.ndarray],
+) -> jnp.ndarray:
+    """The full sparse fine pass: gather → gate → NC-filter → core readout
+    → gate → scatter back dense.
+
+    ``stack_fn`` maps a scalar 4D volume batch ``(T, p, p, p, p)`` through
+    the NC consensus stack (the caller closes over params/symmetric mode —
+    ``models.ncnet.neigh_consensus``, whose own tier chooser routes the
+    folded tiles through the resident Pallas kernel family where the shape
+    class compiles).  Only each tile's CORE cells (:func:`core_mask`) are
+    read out — their conv support is complete, so at full coverage the
+    scattered volume reproduces the dense filter exactly (up to float
+    reassociation); halo cells are support-only.  Returns the DENSE
+    ``(B, hA, wA, hB, wB)`` volume with filtered scores scattered onto
+    their fine cells (zeros elsewhere, duplicates resolved by max) —
+    bitwise wire-compatible with the dense filter's output shape.
+    """
+    from ncnet_tpu.ops.matching import scatter_sparse_scores
+
+    b, ha, wa, _ = fa.shape
+    hb, wb = fb.shape[1], fb.shape[2]
+    patch = patch_side(factor, halo)
+    wc = wb // factor
+    tiles = sparse_fine_corr(fa, fb, cand, factor=factor, halo=halo)
+    tiles = sparse_mutual_matching(tiles, grid_a=(ha, wa), grid_b=(hb, wb))
+    n, k = cand.shape[1], cand.shape[2]
+    folded = tiles.values.reshape(b * n * k, patch, patch, patch, patch)
+    filtered = stack_fn(folded).reshape(
+        b, n, k, patch, patch, patch, patch)
+    # core readout: zero the support-only halo cells (filtered values are
+    # post-ReLU non-negative, so 0 is the identity for every max downstream)
+    filtered = filtered * core_mask(tiles, cand, wc, wa // factor,
+                                    factor).astype(
+        filtered.dtype)
+    tiles = sparse_mutual_matching(
+        tiles._replace(values=filtered), grid_a=(ha, wa), grid_b=(hb, wb))
+    return scatter_sparse_scores(
+        tiles.values, tiles.ia, tiles.ja, tiles.ib, tiles.jb,
+        (ha, wa, hb, wb))
+
+
+# ---------------------------------------------------------------------------
+# pipeline tier: "coarse2fine" as a first-class demotable tier
+# ---------------------------------------------------------------------------
+
+
+def coarse2fine_feasible(ha: int, wa: int, hb: int, wb: int, *,
+                         sparse_topk: int, factor: int, halo: int,
+                         reloc_k: int = 0) -> bool:
+    """Whether the coarse-to-fine pipeline applies to this shape class:
+    the knob is on, relocalization pooling is off (maxpool4d composes with
+    the dense volume only — the sparse analog is future work), every fine
+    dim pools by the factor, and the patches fit the fine grids."""
+    if sparse_topk <= 0 or factor <= 1 or reloc_k > 1:
+        return False
+    if any(d % factor for d in (ha, wa, hb, wb)):
+        return False
+    patch = patch_side(factor, halo)
+    return min(ha, wa) >= patch and min(hb, wb) >= patch
+
+
+def choose_match_pipeline(ha: int, wa: int, hb: int, wb: int, *,
+                          sparse_topk: int, factor: int, halo: int,
+                          reloc_k: int = 0) -> Optional[str]:
+    """The one authority for the match-pipeline tier at a shape class:
+    ``"coarse2fine"`` (sparse pipeline) or ``None`` (dense — the fallback
+    edge).  Demotions apply exactly like the fused-stack tiers': a runtime
+    failure of the sparse path (``ops.demote_fused_tier("coarse2fine")``,
+    or the ladder walk when it is the active pipeline) disables it for the
+    process AND persists through the tier cache's negative entries, so a
+    crashed sparse tier greets the next process already demoted.  Every
+    consult stamps the decision for the quality layer's tier tagging
+    (``observability/quality.active_tier``)."""
+    from ncnet_tpu.ops import nc_fused_lane as _nfl
+    from ncnet_tpu.ops import tier_cache
+
+    tier = None
+    if coarse2fine_feasible(ha, wa, hb, wb, sparse_topk=sparse_topk,
+                            factor=factor, halo=halo, reloc_k=reloc_k):
+        dead = (_nfl.demoted_fused_tiers()
+                | tier_cache.persistent_demotions())
+        if "coarse2fine" not in dead:
+            tier = "coarse2fine"
+    sig = (ha, wa, hb, wb, (factor,), (sparse_topk,))
+    _nfl._emit_tier_selected("pipeline", sig, tier, none_label="dense")
+    return tier

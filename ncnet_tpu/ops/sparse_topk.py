@@ -1,0 +1,169 @@
+"""Coarse-pass candidate selection for coarse-to-fine sparse correlation.
+
+The dense pipeline's defining cost is the full 4D volume: every (source
+cell, target cell) pair is materialized and filtered — O((hw)²) cells.
+*Dual-Resolution Correspondence Networks* (arXiv:2006.08844) and
+*XResolution Correspondence Networks* (arXiv:2012.09842) break that wall by
+filtering at COARSE resolution first and evaluating fine correlation only
+around the top-k candidate target neighbourhoods of each source cell.  This
+module is the selection half of that pipeline (the gathered fine evaluation
+lives in ``ops/sparse_corr.py``):
+
+  * :func:`pool_features` — stride-f average pooling of the backbone grid
+    (stride-16 features → stride-32 at ``factor=2``), re-L2-normalized so
+    the coarse correlation stays a cosine similarity;
+  * :func:`topk_candidates` — in-graph ``lax.top_k`` over the FILTERED
+    coarse volume, one candidate row per coarse source cell;
+  * the **coverage-padding contract** (:func:`topk_candidates`,
+    :func:`candidate_origins`, :func:`block_origins`): every shape is
+    static no matter what ``k`` or where a candidate sits.  ``k`` larger
+    than the coarse target grid pads the candidate row by repeating the
+    top-1 candidate (duplicates are harmless downstream — the sparse
+    scatter resolves them by max), and every candidate's fine patch origin
+    is clamped into the volume such that the patch ALWAYS contains the
+    candidate's full ``factor×factor`` fine block.  Callers can therefore
+    jit one program per shape bucket exactly like the dense path.
+
+Pure ``jnp`` throughout — jittable, shardable, differentiable-free
+(selection is an argmax-family op; the training path keeps the dense
+volume).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ncnet_tpu.ops.norm import feature_l2_norm
+
+
+def resolve_halo(halo: int, factor: int) -> int:
+    """The fine-cell patch halo: ``halo < 0`` means auto — one coarse ring
+    (``factor`` fine cells), the measured sweet spot between tile cost
+    (patch⁴ cells per candidate) and filter-support truncation.  A halo
+    below the NC stack's receptive radius truncates conv support at patch
+    edges — the standard sparse-refinement approximation; raise it toward
+    ``sum((k−1)/2)`` when fidelity at patch borders matters more than
+    FLOPs."""
+    return factor if halo < 0 else halo
+
+
+def patch_side(factor: int, halo: int) -> int:
+    """Fine patch side: the candidate's ``factor``-cell block plus the halo
+    on each side."""
+    return factor + 2 * halo
+
+
+def pool_features(f: jnp.ndarray, factor: int,
+                  renormalize: bool = True) -> jnp.ndarray:
+    """Average-pool a feature grid ``(B, H, W, C)`` by ``factor`` (dims must
+    divide) and optionally re-L2-normalize per location — the stride-32
+    proxy of a dual-resolution trunk's coarse head.  Pooling runs in f32
+    (bf16 feature sums at factor² terms would lose mantissa) and casts back
+    to the input dtype."""
+    b, h, w, c = f.shape
+    assert h % factor == 0 and w % factor == 0, (
+        f"feature grid {h}x{w} does not pool by {factor}"
+    )
+    pooled = f.astype(jnp.float32).reshape(
+        b, h // factor, factor, w // factor, factor, c
+    ).mean(axis=(2, 4))
+    if renormalize:
+        pooled = feature_l2_norm(pooled)
+    return pooled.astype(f.dtype)
+
+
+def topk_candidates(coarse_corr: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Per-row top-k candidate selection over the filtered coarse volume.
+
+    Args:
+      coarse_corr: ``(B, Hc, Wc, hc, wc)`` FILTERED coarse volume (the
+        coarse NC pass's output — selection quality rides on the filter's
+        consensus, exactly the Dual-Resolution recipe).
+      k: requested candidates per coarse source cell (static).
+
+    Returns:
+      ``(B, Hc·Wc, k)`` int32 — flattened coarse target indices (row-major
+      ``i·wc + j``), best first.  Coverage padding: when ``k`` exceeds the
+      coarse target grid the trailing slots repeat the top-1 candidate, so
+      the shape contract holds for any (k, grid) combination and the
+      compiled program is reusable across k sweeps.
+    """
+    b, ha, wa, hb, wb = coarse_corr.shape
+    flat = coarse_corr.reshape(b, ha * wa, hb * wb).astype(jnp.float32)
+    k_eff = min(int(k), hb * wb)
+    _, idx = jax.lax.top_k(flat, k_eff)
+    idx = idx.astype(jnp.int32)
+    if k > k_eff:
+        pad = jnp.broadcast_to(idx[:, :, :1], (b, ha * wa, k - k_eff))
+        idx = jnp.concatenate([idx, pad], axis=2)
+    return idx
+
+
+def block_origins(n_coarse: int, factor: int, patch: int,
+                  length: int) -> np.ndarray:
+    """Static fine-grid patch origins for every coarse cell along one axis:
+    ``clip(c·factor − halo, 0, length − patch)``.  The clamp is the
+    coverage contract's edge rule — a patch near the border shifts inward
+    instead of shrinking, so it stays static-shaped AND still contains the
+    cell's full ``factor``-cell block (``patch ≥ factor + halo`` makes the
+    shifted window cover it; asserted by construction in
+    ``patch_side``)."""
+    halo = (patch - factor) // 2
+    c = np.arange(n_coarse) * factor - halo
+    return np.clip(c, 0, length - patch).astype(np.int32)
+
+
+def candidate_origins(
+    cand: jnp.ndarray, wc: int, factor: int, patch: int,
+    hb: int, wb: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fine-grid patch origins ``(oi, ob)`` of candidate coarse cells.
+
+    Args:
+      cand: ``(B, N, K)`` int32 flattened coarse target indices.
+      wc: coarse target grid width (``cand`` decodes as ``(c // wc,
+        c % wc)``).
+      factor, patch: fine cells per coarse cell / patch side.
+      hb, wb: fine target grid dims.
+
+    Returns the same-rule clamped origins as :func:`block_origins`, shaped
+    like ``cand``.  Origins are multiples of ``factor`` whenever the halo
+    is (the Pallas gather tier's band-alignment precondition,
+    ``ops/sparse_corr.py``)."""
+    halo = (patch - factor) // 2
+    ic = cand // wc
+    jc = cand % wc
+    oi = jnp.clip(ic * factor - halo, 0, hb - patch).astype(jnp.int32)
+    oj = jnp.clip(jc * factor - halo, 0, wb - patch).astype(jnp.int32)
+    return oi, oj
+
+
+def candidate_recall(cand: np.ndarray, dense_corr: np.ndarray,
+                     factor: int) -> float:
+    """Selection-quality diagnostic: the fraction of fine source cells
+    whose DENSE fine-volume argmax target cell falls inside one of their
+    coarse source cell's candidate neighbourhoods.  1.0 means top-k
+    coverage provably contains every true argmax (the sparse match table
+    then reproduces the dense one row-for-row on peak-dominated volumes —
+    tests/test_sparse_corr.py); the recall-vs-k curve is the k-tuning
+    instrument (``tools/sparse_corr_probe.py``)."""
+    cand = np.asarray(cand)
+    dense_corr = np.asarray(dense_corr, dtype=np.float64)
+    b, ha, wa, hb, wb = dense_corr.shape
+    wc = wb // factor
+    wac = wa // factor
+    flat = dense_corr.reshape(b, ha * wa, hb * wb)
+    best = np.argmax(flat, axis=2)                      # (B, n_a) fine B idx
+    bi, bj = best // wb, best % wb
+    best_coarse = (bi // factor) * wc + (bj // factor)  # (B, n_a)
+    a = np.arange(ha * wa)
+    coarse_a = (a // wa // factor) * wac + ((a % wa) // factor)
+    hit = 0
+    for bb in range(b):
+        rows = cand[bb, coarse_a]                       # (n_a, K)
+        hit += np.mean(np.any(rows == best_coarse[bb][:, None], axis=1))
+    return float(hit / b)

@@ -69,9 +69,20 @@ SYNTH_SCRAMBLED = 4          # trailing pairs whose target is unrelated
 SYNTH_IMAGE_HW = (96, 96)
 SYNTH_SHIFT = (16, 16)
 SYNTH_BATCH = 2
+# the coarse-to-fine tier's pinned fixture knobs: the same eval re-run with
+# ModelConfig.sparse_topk = this k seeds/gates the "coarse2fine" series
+# (6x6 feature grid -> 3x3 coarse grid; k=2 of 9 candidates).  The sparse
+# fixture's shift is COARSE-ALIGNED (2 fine cells = 1 coarse cell at
+# factor 2): the pooled coarse correlation is then crisp, top-k coverage
+# contains the true cells, and the sparse confident pairs score PCK 1.0
+# exactly like dense — the fixture demonstrates the lossless-under-coverage
+# regime rather than the tiny 3x3 grid's pooling blur.
+SYNTH_SPARSE_K = 2
+SYNTH_SPARSE_SHIFT = (32, 32)
 
 
-def synthetic_reference_run(workdir: str, perturb: bool = False):
+def synthetic_reference_run(workdir: str, perturb: bool = False,
+                            sparse: bool = False):
     """Run the pinned deterministic synthetic PF-Pascal eval on this
     backend; returns ``(stats, events_path)``.
 
@@ -87,6 +98,12 @@ def synthetic_reference_run(workdir: str, perturb: bool = False):
     ``perturb=True`` coarsely quantizes the filtered volume before match
     extraction — the injected stand-in for a low-precision kernel-tier
     regression the drift gate must flag.
+
+    ``sparse=True`` re-runs the same pinned fixture through the
+    coarse-to-fine sparse pipeline (``ModelConfig.sparse_topk =
+    SYNTH_SPARSE_K``): its quality events are tier-tagged ``coarse2fine``,
+    which seeds — and then gates — that tier's own reference series (the
+    label-free proof the sparse tier loses no accuracy, ISSUE 15).
     """
     import jax.numpy as jnp
     import numpy as np
@@ -98,8 +115,9 @@ def synthetic_reference_run(workdir: str, perturb: bool = False):
     from ncnet_tpu.evaluation.pf_pascal import run_eval
 
     data = os.path.join(workdir, "data")
+    shift = SYNTH_SPARSE_SHIFT if sparse else SYNTH_SHIFT
     write_pf_pascal_like(data, n_pairs=SYNTH_PAIRS, image_hw=SYNTH_IMAGE_HW,
-                         shift=SYNTH_SHIFT, seed=SYNTH_SEED)
+                         shift=shift, seed=SYNTH_SEED)
     # scramble the trailing pairs' targets: unrelated texture, keypoints
     # kept — low PCK AND diffuse (low-confidence) match distributions
     rng = np.random.default_rng(SYNTH_SEED + 1)
@@ -110,6 +128,8 @@ def synthetic_reference_run(workdir: str, perturb: bool = False):
 
     cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
                       ncons_channels=(1,))
+    if sparse:
+        cfg = cfg.replace(sparse_topk=SYNTH_SPARSE_K)
     net = models.NCNet(cfg, seed=0)
     iw = np.zeros((3, 3, 3, 3, 1, 1), np.float32)
     iw[1, 1, 1, 1, 0, 0] = 1.0
@@ -225,7 +245,15 @@ def main(argv=None) -> int:
         work = tempfile.mkdtemp(prefix="quality_ref_")
         _err(f"running the pinned synthetic reference eval under {work}\n")
         _, events_path = synthetic_reference_run(work)
-        logs = [events_path] + logs
+        # the same pinned fixture through the coarse-to-fine sparse
+        # pipeline: seeds the "coarse2fine" tier's own reference series
+        # beside the dense tiers' (one file carries every tier the tier-1
+        # drift tests gate)
+        work_sp = tempfile.mkdtemp(prefix="quality_ref_sparse_")
+        _err("running the sparse (coarse2fine) synthetic reference eval "
+             f"under {work_sp}\n")
+        _, sparse_events = synthetic_reference_run(work_sp, sparse=True)
+        logs = [events_path, sparse_events] + logs
 
     if not logs:
         _err("quality_drift: no event logs given\n")

@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Coarse-to-fine sparse correlation probe: per-k compile/memory/wall
+characterization of the sparse pipeline against the dense filter.
+
+The coarse2fine tier's acceptance rides on the PR 13 memory ledger
+(``mem_filter_temp_bytes_sparse`` < dense at the same shape) and the perf
+store's wall series — both need MEASURED numbers from a real device.  This
+probe produces them for the next TPU-attached session:
+
+  * for each requested ``k``: AOT-compile the full sparse filter program
+    (coarse pass + top-k + gathered fine refinement) at the given feature
+    shape, record its ``memory_analysis()`` row into the compiled-program
+    memory ledger (program ``sparse_corr_probe``, keyed per k), and report
+    temp/peak bytes beside the dense filter program's at the same shape;
+  * the Pallas gather-into-VMEM tier's feasibility verdict and (on TPU) its
+    real-compile probe outcome per shape class — the gather-ring VMEM
+    accounting of ``ops/sparse_corr.sparse_gather_feasible``;
+  * with ``--time`` (TPU session): steady-state walls, sparse vs dense.
+
+``--tiny`` is the CPU smoke kept tier-1 (tests/test_sparse_corr.py): a
+miniature shape through every rung that works without Mosaic — XLA tile
+gather vs the interpret-mode Pallas gather kernel (bitwise), k=full vs
+dense volume parity, a recall-vs-k curve, and the AOT memory accounting
+path (fail-open where the backend lacks ``memory_analysis``).
+
+Usage::
+
+    python tools/sparse_corr_probe.py --k 1,2,4,8 --size 50 [--time]
+    python tools/sparse_corr_probe.py --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_out = sys.stdout.write
+_err = sys.stderr.write
+
+
+def _params_for(kernels, channels, key_seed=1):
+    import jax
+
+    from ncnet_tpu.ops import conv4d_init
+
+    key = jax.random.key(key_seed)
+    nc = []
+    c_in = 1
+    for k, c_out in zip(kernels, channels):
+        key, sub = jax.random.split(key)
+        w, b = conv4d_init(sub, k, c_in, c_out)
+        nc.append({"w": w, "b": b})
+        c_in = c_out
+    return {"nc": nc}
+
+
+def _aot_memory(fn, *sds):
+    """(compiled, analysis-dict|None) — the analysis is fail-open (CPU
+    backends may lack memory_analysis)."""
+    import jax
+
+    from ncnet_tpu.observability import memory as obs_memory
+
+    compiled = jax.jit(fn).lower(*sds).compile()
+    return compiled, (obs_memory.analysis_dict(compiled) or None)
+
+
+def probe(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models.ncnet import coarse2fine_filter, ncnet_filter
+    from ncnet_tpu.observability import memory as obs_memory
+    from ncnet_tpu.ops import correlation_4d
+    from ncnet_tpu.ops.sparse_corr import sparse_gather_feasible
+    from ncnet_tpu.ops.sparse_topk import patch_side, resolve_halo
+
+    kernels = tuple(int(v) for v in args.kernels.split(","))
+    channels = tuple(int(v) for v in args.channels.split(","))
+    ks = [int(v) for v in args.k.split(",")]
+    s, c_dim, b = args.size, args.c, args.batch
+    halo = resolve_halo(args.halo, args.factor)
+    patch = patch_side(args.factor, halo)
+    dt = jnp.bfloat16 if args.bf16 else jnp.float32
+    params = _params_for(kernels, channels)
+    sds = jax.ShapeDtypeStruct((b, s, s, c_dim), dt)
+    report = {
+        "size": s, "channels": c_dim, "batch": b, "factor": args.factor,
+        "halo": halo, "patch": patch, "dtype": jnp.dtype(dt).name,
+        "device_kind": jax.devices()[0].device_kind,
+        "gather_vmem_feasible": sparse_gather_feasible(
+            s, s, c_dim, patch, args.factor, halo,
+            itemsize=jnp.dtype(dt).itemsize),
+        "k": {},
+    }
+
+    cfg = ModelConfig(ncons_kernel_sizes=kernels, ncons_channels=channels,
+                      half_precision=args.bf16, sparse_factor=args.factor,
+                      sparse_halo=args.halo)
+
+    def dense_fn(p, fa, fb):
+        return ncnet_filter(cfg, p, correlation_4d(fa, fb)).corr
+
+    try:
+        _, dense_mem = _aot_memory(dense_fn, params, sds, sds)
+        report["dense"] = dense_mem
+    except Exception as e:  # the dense volume may simply not compile/fit
+        report["dense"] = {"error": str(e)[:200]}
+        dense_mem = None
+
+    for k in ks:
+        cfg_k = cfg.replace(sparse_topk=k)
+
+        def sparse_fn(p, fa, fb, cfg_k=cfg_k):
+            return coarse2fine_filter(cfg_k, p, fa, fb).corr
+
+        row = {}
+        try:
+            compiled, mem = _aot_memory(sparse_fn, params, sds, sds)
+            row["memory"] = mem
+            obs_memory.record_program(
+                "sparse_corr_probe", f"{s}x{s}x{c_dim}xb{b}|k={k}|p={patch}",
+                analysis=compiled, tier="coarse2fine", source="probe")
+            if dense_mem and mem and mem.get("temp_bytes") \
+                    and dense_mem.get("temp_bytes"):
+                row["temp_vs_dense"] = round(
+                    mem["temp_bytes"] / dense_mem["temp_bytes"], 4)
+        except Exception as e:
+            row["error"] = str(e)[:300]
+        report["k"][k] = row
+
+    if args.time:
+        import time as _time
+
+        import numpy as np
+
+        def wall(fn):
+            rng = np.random.default_rng(0)
+            fa = jnp.asarray(rng.normal(size=(b, s, s, c_dim)) * 0.05, dt)
+            fb = jnp.asarray(rng.normal(size=(b, s, s, c_dim)) * 0.05, dt)
+            jitted = jax.jit(fn)
+            jax.block_until_ready(jitted(params, fa, fb))  # compile
+            walls = []
+            for _ in range(args.reps):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(jitted(params, fa, fb))
+                walls.append((_time.perf_counter() - t0) * 1e3)
+            return float(np.median(walls))
+
+        try:
+            report["dense_wall_ms"] = round(wall(dense_fn), 3)
+        except Exception as e:
+            report["dense_wall_ms"] = None
+            _err(f"dense wall failed: {str(e)[:200]}\n")
+        for k in ks:
+            cfg_k = cfg.replace(sparse_topk=k)
+            try:
+                report["k"][k]["wall_ms"] = round(wall(
+                    lambda p, fa, fb, cfg_k=cfg_k:
+                    coarse2fine_filter(cfg_k, p, fa, fb).corr), 3)
+            except Exception as e:
+                _err(f"sparse wall k={k} failed: {str(e)[:200]}\n")
+
+    _out(json.dumps(report, indent=2, sort_keys=True, default=str) + "\n")
+    return 0
+
+
+def tiny(args) -> int:
+    """CPU smoke: every Mosaic-free rung of the sparse pipeline at a
+    miniature shape.  Exit nonzero on any parity failure — this is the
+    tier-1 guard that keeps the probe runnable for the TPU session."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models.ncnet import ncnet_filter, ncnet_match_volume
+    from ncnet_tpu.ops import candidate_recall, correlation_4d, \
+        feature_l2_norm, pool_features, topk_candidates
+    from ncnet_tpu.ops.sparse_corr import (
+        gather_source_patches,
+        gather_tile_corr_pallas,
+        source_patch_index,
+        sparse_fine_corr,
+    )
+    from ncnet_tpu.ops.sparse_topk import candidate_origins, patch_side
+
+    rng = np.random.default_rng(7)
+    b, s, c_dim, factor, halo = 1, 8, 16, 2, 2
+    patch = patch_side(factor, halo)
+    fa = feature_l2_norm(jnp.asarray(
+        rng.normal(size=(b, s, s, c_dim)).astype(np.float32)))
+    fb = feature_l2_norm(jnp.asarray(
+        rng.normal(size=(b, s, s, c_dim)).astype(np.float32)))
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3, 3),
+                      ncons_channels=(4, 1))
+    params = _params_for(cfg.ncons_kernel_sizes, cfg.ncons_channels)
+    n_cells = (s // factor) ** 2
+
+    # 1) XLA gather tier vs interpret-mode Pallas gather kernel: bitwise
+    cand = jnp.asarray(
+        rng.integers(0, n_cells, (b, n_cells, 3)).astype(np.int32))
+    tiles = sparse_fine_corr(fa, fb, cand, factor=factor, halo=halo)
+    ia, ja = source_patch_index(s, s, factor, patch)
+    oi, oj = candidate_origins(cand, s // factor, factor, patch, s, s)
+    fa_p2 = gather_source_patches(fa, ia, ja).reshape(
+        b, n_cells, patch * patch, c_dim)
+    v_pl = gather_tile_corr_pallas(
+        fa_p2, fb, oi // factor, oj, patch=patch, factor=factor,
+        interpret=True,
+    ).reshape(tiles.values.shape)
+    d = float(jnp.max(jnp.abs(v_pl - tiles.values)))
+    _out(f"gather kernel (interpret) vs XLA tier: max|diff| = {d}\n")
+    if d != 0.0:
+        _err("FAIL: gather tiers disagree\n")
+        return 1
+
+    # 2) k = full coverage reproduces the dense filtered volume
+    dense = ncnet_filter(cfg, params, correlation_4d(fa, fb)).corr
+    sparse = ncnet_match_volume(
+        cfg.replace(sparse_topk=n_cells, sparse_factor=factor,
+                    sparse_halo=halo), params, fa, fb).corr
+    d = float(jnp.max(jnp.abs(dense - sparse)))
+    _out(f"k=full sparse vs dense volume: max|diff| = {d}\n")
+    if not np.allclose(np.asarray(dense), np.asarray(sparse),
+                       atol=1e-5, rtol=1e-4):
+        _err("FAIL: k=full does not reproduce the dense volume\n")
+        return 1
+
+    # 3) recall-vs-k curve is monotone to 1.0
+    coarse = ncnet_filter(
+        cfg, params,
+        correlation_4d(pool_features(fa, factor), pool_features(fb, factor))
+    ).corr
+    raw = np.asarray(correlation_4d(fa, fb))
+    recalls = [candidate_recall(
+        np.asarray(topk_candidates(coarse, k)), raw, factor)
+        for k in (1, 4, n_cells)]
+    _out(f"recall @ k=1,4,full: {[round(r, 3) for r in recalls]}\n")
+    if recalls[-1] != 1.0 or any(
+            recalls[i] > recalls[i + 1] + 1e-9 for i in range(2)):
+        _err("FAIL: recall curve not monotone to 1.0\n")
+        return 1
+
+    # 4) AOT memory accounting path (fail-open off-TPU)
+    from ncnet_tpu.models.ncnet import coarse2fine_filter
+
+    cfg_k = cfg.replace(sparse_topk=2, sparse_factor=factor,
+                        sparse_halo=halo)
+    sds = jax.ShapeDtypeStruct((b, s, s, c_dim), jnp.float32)
+    _, mem = _aot_memory(
+        lambda p, x, y: coarse2fine_filter(cfg_k, p, x, y).corr,
+        params, sds, sds)
+    _out(f"sparse AOT memory analysis: "
+         f"{'unavailable on this backend' if mem is None else mem}\n")
+    _out("tiny smoke: OK\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-k compile/memory/wall probe of the coarse-to-fine "
+                    "sparse correlation pipeline")
+    ap.add_argument("--k", default="1,2,4,8",
+                    help="comma-separated candidate counts to probe")
+    ap.add_argument("--size", type=int, default=50,
+                    help="fine feature grid side (50 = 2x the PF-Pascal "
+                         "bench grid)")
+    ap.add_argument("--c", type=int, default=256,
+                    help="feature channels (1024 = resnet101 layer3)")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--factor", type=int, default=2)
+    ap.add_argument("--halo", type=int, default=-1,
+                    help="-1 = auto (one coarse ring)")
+    ap.add_argument("--kernels", default="5,5,5")
+    ap.add_argument("--channels", default="16,16,1")
+    ap.add_argument("--bf16", action="store_true", default=True)
+    ap.add_argument("--no-bf16", dest="bf16", action="store_false")
+    ap.add_argument("--time", action="store_true",
+                    help="measure steady-state walls (TPU session)")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU smoke: miniature parity/recall/memory pass "
+                         "(tier-1)")
+    args = ap.parse_args(argv)
+    if args.tiny:
+        return tiny(args)
+    return probe(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
