@@ -296,6 +296,20 @@ class EvalInLocConfig:
     # tools/build_feature_store.py.  Ignored under spatial_shards > 1.
     feature_store_dir: str = ""
     feature_store_budget_mb: int = 0     # LRU-evict above this (0 = unbounded)
+    # in-system retrieval shortlist (ncnet_tpu/retrieval/; README "Sharded
+    # retrieval"): point this at a coarse index manifest (or glob of
+    # per-stripe manifests) built by tools/build_coarse_index.py and the
+    # eval re-ranks each query's precomputed .mat candidate row by coarse-
+    # volume similarity before fine matching — the top retrieval_topk
+    # candidates are matched, in retrieval order.  The precomputed .mat
+    # order stays the fallback: a query whose row coverage (fraction of
+    # row panos the index + store could actually score) falls below
+    # retrieval_min_coverage is matched in the original .mat order, with a
+    # warning and a retrieval_fallback event — degraded input is reported,
+    # never silently used.  "" = off (bitwise-identical legacy behavior).
+    retrieval_index: str = ""
+    retrieval_topk: int = 0              # 0 → n_panos
+    retrieval_min_coverage: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -535,6 +535,11 @@ def output_folder_name(config: EvalInLocConfig) -> str:
         # k_size>1 or spatial sharding the pipeline chooser keeps every
         # pair dense and the outputs are the dense run's.
         name += f"_SPARSE{config.sparse_topk}"
+    if config.retrieval_index:
+        # the in-system shortlist changes WHICH panos each table row holds:
+        # retrieval runs must not share (or skip-resume against) a
+        # precomputed-order run's folder
+        name += f"_RETR{config.retrieval_topk or config.n_panos}"
     if config.matching_both_directions:
         name += "_BOTHDIRS"
     elif config.flip_matching_direction:
@@ -709,6 +714,7 @@ def run_inloc_eval(
                       eval="inloc", n_queries=n_queries)
 
     store = None  # assigned below; hoisted so the failure handler can close
+    retrieval_store = None  # ditto — the in-system shortlist's coarse store
     try:
         # per-pair match-quality signals (README "Quality observability"):
         # computed in the pair program, fetched with the match table, streamed
@@ -768,6 +774,46 @@ def run_inloc_eval(
                 # miss), so they only waste the budget
                 store.gc_superseded()
 
+        # in-system retrieval shortlist (ncnet_tpu/retrieval/; README
+        # "Sharded retrieval"): a coarse index + verified store re-rank each
+        # query's precomputed .mat candidate row before fine matching.
+        # Fail-open like the feature store: index/store/descriptor trouble
+        # falls back to the precomputed .mat order with a warning + a
+        # retrieval_fallback event — degraded retrieval may widen a query's
+        # candidate order, never fail it and never silently truncate it.
+        retrieval = None
+        if config.retrieval_index:
+            import re as _re
+
+            from ncnet_tpu.retrieval.index import (
+                load_index_manifests,
+                local_shortlist,
+            )
+            from ncnet_tpu.retrieval.scoring import (
+                coarse_volume_from_features,
+                pooled_descriptor,
+                raw_coarse_volume,
+            )
+            from ncnet_tpu.store import FeatureStore as _CoarseStore
+
+            r_index = load_index_manifests(config.retrieval_index)
+            # raw-extractor indexes encode their fine grid in the synthetic
+            # fingerprint (raw-s<grid>-k0-f32-c<factor>); the query
+            # descriptor must pool from the same grid to stay comparable
+            _m = _re.search(r"^raw-s(\d+)-", r_index["fingerprint"])
+            retrieval_store = _CoarseStore(
+                os.path.dirname(os.path.abspath(r_index["sources"][0])),
+                r_index["fingerprint"], scope="inloc_retrieval")
+            retrieval = {"index": r_index,
+                         "grid": int(_m.group(1)) if _m else 16,
+                         "topk": int(config.retrieval_topk
+                                     or config.n_panos)}
+            log.info(
+                f"retrieval shortlist on: {len(r_index['panos'])} indexed "
+                f"panos, extractor={r_index['extractor']}, topk="
+                f"{retrieval['topk']}, min_coverage="
+                f"{config.retrieval_min_coverage}")
+
         matcher = make_pair_matcher(
             model_config, params,
             do_softmax=config.softmax,
@@ -797,18 +843,90 @@ def run_inloc_eval(
                 for idx in range(n_panos)
             ]
 
+        def retrieval_plan(q, raw_q, src):
+            """Score query ``q``'s FULL precomputed candidate row by coarse
+            similarity (``retrieval/index.py::local_shortlist`` through the
+            verified store) and return ``(top-k pano names, coverage)`` —
+            or ``(None, coverage)`` when the row cannot be covered to
+            ``config.retrieval_min_coverage``, in which case the caller
+            matches the original .mat order (a reported fallback, never a
+            silent truncation)."""
+            row = [_as_str(pano_fns[q][i]) for i in range(len(pano_fns[q]))]
+            r_index = retrieval["index"]
+            sub = dict(r_index)
+            sub["panos"] = {n: r_index["panos"][n] for n in row
+                            if n in r_index["panos"]}
+            try:
+                if r_index["extractor"] == "raw":
+                    desc = pooled_descriptor(raw_coarse_volume(
+                        raw_q, r_index["factor"], grid=retrieval["grid"]))
+                else:
+                    desc = pooled_descriptor(coarse_volume_from_features(
+                        np.asarray(src.features, dtype=np.float32),
+                        r_index["factor"]))
+                res = local_shortlist(retrieval_store, sub, desc,
+                                      topk=retrieval["topk"])
+            except Exception as e:  # noqa: BLE001 — fail-open: retrieval
+                # trouble must never fail a query, only un-reorder it
+                log.warning(f"retrieval scoring failed for query {q + 1} "
+                            f"({e}); matching the precomputed .mat order",
+                            kind="retrieval")
+                obs_events.emit("retrieval_fallback", query=q + 1,
+                                reason="error", error=str(e)[:200])
+                return None, 0.0
+            # outcome-total coverage over the ROW: panos absent from the
+            # index count against it exactly like unreadable entries
+            coverage = res["consulted"] / max(1, len(row))
+            if coverage < config.retrieval_min_coverage:
+                log.warning(
+                    f"retrieval coverage {coverage:.3f} < "
+                    f"{config.retrieval_min_coverage} for query {q + 1} "
+                    f"({res['consulted']}/{len(row)} row panos scored); "
+                    "matching the precomputed .mat order", kind="retrieval")
+                obs_events.emit("retrieval_fallback", query=q + 1,
+                                reason="coverage",
+                                coverage=round(coverage, 6),
+                                consulted=res["consulted"], row=len(row))
+                return None, coverage
+            names = [p for p, _s in res["scores"]][:config.n_panos]
+            obs_events.emit("retrieval_shortlist", query=q + 1,
+                            coverage=round(coverage, 6),
+                            consulted=res["consulted"], row=len(row),
+                            topk=len(names),
+                            unavailable=len(res["unavailable"]))
+            return names, coverage
+
         def process_query(q, io_pool):
             out_path = os.path.join(out_dir, f"{q + 1}.mat")
             if progress:
                 log.info(str(q))
             matches = np.zeros((1, config.n_panos, n_cap, 5))
             jobs = pano_jobs(q)
-            # an empty shortlist row still writes its all-zeros table
-            pending = io_pool.submit(load_raw, jobs[0]) if jobs else None
-            # preprocess the query ONCE; it is reused across its ~10 pano pairs
-            src = matcher.preprocess(
-                load_raw(os.path.join(config.query_path, query_fns[q]))
-            )
+            shortlist_names = None
+            retrieval_coverage = None
+            if retrieval is None:
+                # an empty shortlist row still writes its all-zeros table
+                pending = io_pool.submit(load_raw, jobs[0]) if jobs else None
+                # preprocess the query ONCE; it is reused across its ~10
+                # pano pairs
+                src = matcher.preprocess(
+                    load_raw(os.path.join(config.query_path, query_fns[q]))
+                )
+            else:
+                # retrieval may reorder the jobs, so the decode-ahead
+                # submit has to wait for the plan; query load + preprocess
+                # come first either way (the descriptor needs them)
+                raw_q = load_raw(
+                    os.path.join(config.query_path, query_fns[q]))
+                src = matcher.preprocess(raw_q)
+                with span("retrieval_plan", query=q + 1):
+                    names, retrieval_coverage = retrieval_plan(
+                        q, raw_q, src)
+                if names is not None:
+                    shortlist_names = names
+                    jobs = [os.path.join(config.pano_path, n)
+                            for n in names]
+                pending = io_pool.submit(load_raw, jobs[0]) if jobs else None
             # pipelined dispatch: pair idx+1's upload + forward are dispatched
             # (async) before pair idx's result is pulled, so the tunnel's
             # dispatch/transfer latency hides behind the previous pair's device
@@ -889,11 +1007,16 @@ def run_inloc_eval(
                     first = False
             while in_flight:
                 drain_one(sample=False)
-            atomic_savemat(
-                out_path,
-                {"matches": matches, "query_fn": query_fns[q], "pano_fn": pano_fn_all},
-                do_compression=True,
-            )
+            payload = {"matches": matches, "query_fn": query_fns[q],
+                       "pano_fn": pano_fn_all}
+            if shortlist_names is not None:
+                # when retrieval reordered the row, `matches` rows follow
+                # THIS list (not pano_fn order) — record it, plus the
+                # coverage the reorder was made under, for consumers
+                payload["shortlist"] = np.asarray(
+                    [[n] for n in shortlist_names], dtype=object)
+                payload["retrieval_coverage"] = float(retrieval_coverage)
+            atomic_savemat(out_path, payload, do_compression=True)
 
         manifest = None
         if config.write_manifest:
@@ -918,6 +1041,8 @@ def run_inloc_eval(
         # a leaked store would hold its journal handle open)
         if store is not None:
             store.close()
+        if retrieval_store is not None:
+            retrieval_store.close()
         if own_sink is not None:
             obs_events.set_global_sink(prev_sink)
             own_sink.close()
@@ -1026,6 +1151,8 @@ def run_inloc_eval(
             summary_extra["store"] = store.health()
             summary_extra["feature_extractions"] = \
                 matcher.feature_extractions
+        if retrieval_store is not None:
+            summary_extra["retrieval_store"] = retrieval_store.health()
         quality_registry.flush(event="eval_summary", eval="inloc",
                                completed=n_done,
                                quarantined=(list(manifest.quarantined_ids)
@@ -1037,6 +1164,9 @@ def run_inloc_eval(
             # release the journal handle
             store.flush_stats(eval="inloc")
             store.close()
+        if retrieval_store is not None:
+            retrieval_store.flush_stats(eval="inloc_retrieval")
+            retrieval_store.close()
         if own_sink is not None:
             obs_events.set_global_sink(prev_sink)
             own_sink.close()

@@ -270,7 +270,12 @@ _HIGHER_TOKENS = ("pck", "pairs_per_s", "pairs_per_sec", "qps",
                   # effectiveness fraction from the bench's cached-
                   # localization scenario — a falling hit rate is the
                   # store silently losing its reason to exist
-                  "hit_pct")
+                  "hit_pct",
+                  # sharded retrieval (ncnet_tpu/retrieval/): coverage is
+                  # the fraction of the database a sweep consulted — a
+                  # falling coverage at fixed shard health is replication
+                  # or planning regressing
+                  "coverage_pct")
 _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  "_step_s", "_wall_s",
                  # diffuse match distributions are worse: entropy gates
@@ -286,7 +291,11 @@ _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  # temp/peak-HBM byte series (mem_*_temp_bytes,
                  # mem_peak_hbm_bytes) gate exactly like walls — a 2x
                  # footprint jump fails perf_regress --check
-                 "_bytes")
+                 "_bytes",
+                 # sharded retrieval: hedges are paid redundant work — a
+                 # rising hedge rate at fixed shard health means straggler
+                 # detection is firing where it should not
+                 "hedge_pct")
 
 
 def metric_direction(name: str) -> Optional[str]:

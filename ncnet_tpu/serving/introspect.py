@@ -406,7 +406,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/":
                 code, ctype = 200, "text/plain; charset=utf-8"
                 body = "endpoints: /metrics /healthz /statusz " \
-                    "(+ POST /match)\n"
+                    "(+ POST /match, POST /retrieve)\n"
             else:
                 code, ctype, body = 404, "text/plain; charset=utf-8", \
                     f"no such endpoint {path}; try /metrics /healthz " \
@@ -418,21 +418,27 @@ class _Handler(BaseHTTPRequestHandler):
         self._respond(code, ctype, body.encode("utf-8"))
 
     def do_POST(self) -> None:  # noqa: N802 — http.server contract
-        """The wire data plane (serving/wire.py): ``POST /match`` admits
+        """The wire data plane: ``POST /match`` (serving/wire.py) admits
         one framed request against the fronted service/router and blocks
         this connection's thread until its terminal outcome — the
-        multi-host twin of a local ``submit(...).result()``."""
+        multi-host twin of a local ``submit(...).result()``.  ``POST
+        /retrieve`` (retrieval/wire.py) is the same contract for the
+        scatter-gather shortlist plane; a host that fronts no retrieval
+        service answers 404 there, not 500."""
         intro = getattr(self.server, "introspect", None)
         path = self.path.split("?", 1)[0].rstrip("/")
-        if intro is None or path != "/match":
+        if intro is None or path not in ("/match", "/retrieve"):
             self._respond(503 if intro is None else 404,
                           "text/plain; charset=utf-8",
-                          b"POST accepts only /match\n")
+                          b"POST accepts only /match and /retrieve\n")
             return
         try:
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n) if n > 0 else b""
-            code, ctype, payload = intro.match_payload(body)
+            if path == "/retrieve":
+                code, ctype, payload = intro.retrieve_payload(body)
+            else:
+                code, ctype, payload = intro.match_payload(body)
         except Exception as e:  # noqa: BLE001 — same fail-open contract
             # as do_GET: a data-plane handler bug answers 500
             code, ctype = 500, "text/plain; charset=utf-8"
@@ -529,3 +535,17 @@ class IntrospectionServer:
         from ncnet_tpu.serving.wire import serve_match
 
         return serve_match(self._service.submit, body)
+
+    def retrieve_payload(self, body: bytes):
+        """``POST /retrieve`` body → ``(status, content_type, payload)``
+        — one framed retrieval request against the fronted service
+        (``retrieval/wire.py::serve_retrieve``).  Any service exposing a
+        ``retrieve(desc, ...)`` data plane (a shard host, the coordinator)
+        joins the wire automatically; everything else answers 404."""
+        retrieve = getattr(self._service, "retrieve", None)
+        if not callable(retrieve):
+            return (404, "text/plain; charset=utf-8",
+                    b"this host serves no /retrieve\n")
+        from ncnet_tpu.retrieval.wire import serve_retrieve
+
+        return serve_retrieve(retrieve, body)

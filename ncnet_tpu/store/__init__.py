@@ -13,6 +13,7 @@ from ncnet_tpu.store.feature_store import (  # noqa: F401
     STORE_OK,
     FeatureStore,
     backbone_fingerprint,
+    coarse_fingerprint,
     content_digest,
     weights_digest,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "STORE_OK",
     "FeatureStore",
     "backbone_fingerprint",
+    "coarse_fingerprint",
     "content_digest",
     "weights_digest",
 ]

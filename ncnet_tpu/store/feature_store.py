@@ -135,6 +135,21 @@ def backbone_fingerprint(params, *, image_size, k_size: int,
     return f"{weights_digest(params)}-s{image_size}-k{int(k_size)}-{dtype}"
 
 
+def coarse_fingerprint(base_fingerprint: str, factor: int) -> str:
+    """The retrieval tier's coarse-volume generation:
+    ``<base fingerprint>-c<factor>`` — a DISTINCT store generation from
+    the dense features it was pooled from (a coarse entry must never
+    answer a dense read or vice versa), but sharing the leading weights
+    segment, so :meth:`FeatureStore.gc_superseded`'s keep-same-weights-
+    siblings rule protects dense and coarse generations of the same
+    weights together.  ``base_fingerprint`` is a
+    :func:`backbone_fingerprint` for backbone-pooled volumes, or a
+    synthetic model-free token (e.g. ``raw-s16-k0-f32``) for the
+    ``raw`` extractor — the builder and every reader derive it the same
+    way, so a mismatch is a MISS, never a wrong shortlist."""
+    return f"{base_fingerprint}-c{int(factor)}"
+
+
 def _weights_segment(fingerprint: str) -> str:
     return fingerprint.split("-", 1)[0]
 

@@ -203,6 +203,28 @@ class FaultPlan:
     # classify DeadlineExceeded, never a zombie success
     hang_backend_urls: Tuple[str, ...] = ()
     hang_backend_seconds: float = 0.5
+    # --- retrieval-tier faults (ncnet_tpu/retrieval/ layer) ---
+    # shard base-url substrings whose retrieval wire sends raise
+    # ConnectionError — the shard-death shape without a process to kill:
+    # the coordinator must fail the pano group over to replica shards and
+    # keep coverage, then resurrect the shard via probe once cleared
+    dead_shard_urls: Tuple[str, ...] = ()
+    # shard base-url substrings whose retrieval wire sends sleep
+    # hang_shard_seconds then DIE — the stalled-then-lost peer: hedged
+    # re-dispatch must already have covered its panos elsewhere
+    hang_shard_urls: Tuple[str, ...] = ()
+    hang_shard_seconds: float = 0.5
+    # shard base-url substrings whose retrieval wire sends sleep
+    # slow_shard_seconds then PROCEED — the pure-straggler shape the
+    # coordinator's hedging exists for: the hedge must beat the straggler
+    # without ever marking the slow shard dead
+    slow_shard_urls: Tuple[str, ...] = ()
+    slow_shard_seconds: float = 0.25
+    # shard base-url substrings whose retrieval wire RESPONSES get one bit
+    # flipped before decode — in-flight corruption: the response checksum
+    # must refuse the payload (classified transport error, pano group
+    # retried on replicas), never a silently-wrong shortlist
+    shard_bitflip_urls: Tuple[str, ...] = ()
     # --- feature-store faults (ncnet_tpu/store/ layer) ---
     # entry paths containing any of these substrings get ONE payload bit
     # flipped immediately AFTER their commit rename — the media-corruption
@@ -457,6 +479,49 @@ def backend_fault_hook(base_url: str, phase: str) -> None:
     if any(s and s in base_url for s in p.dead_backend_urls):
         raise ConnectionError(
             f"injected backend death ({base_url}, {phase})")
+
+
+def shard_fault_hook(base_url: str, phase: str) -> None:
+    """The retrieval-tier chaos seam (retrieval/wire.py
+    RetrieveClient.retrieve).
+
+    ``slow_shard_urls`` sleep then proceed — the pure straggler the
+    coordinator must HEDGE around (the shard stays healthy and its late
+    answer still counts).  ``hang_shard_urls`` sleep then die — the
+    stalled-then-lost peer.  ``dead_shard_urls`` raise ``ConnectionError``
+    — shard death without a process: the coordinator re-routes the pano
+    group to replicas and a probe resurrects the shard once the plan
+    clears."""
+    p = _active()
+    if p is None:
+        return
+    if any(s and s in base_url for s in p.slow_shard_urls):
+        time.sleep(p.slow_shard_seconds)
+    if any(s and s in base_url for s in p.hang_shard_urls):
+        time.sleep(p.hang_shard_seconds)
+        raise ConnectionError(
+            f"injected shard hang-death ({base_url}, {phase})")
+    if any(s and s in base_url for s in p.dead_shard_urls):
+        raise ConnectionError(
+            f"injected shard death ({base_url}, {phase})")
+
+
+def shard_payload_hook(base_url: str, data: bytes) -> bytes:
+    """Flip one bit of a retrieval wire RESPONSE for matching shard urls
+    (the in-flight corruption shape): the client-side response checksum
+    must refuse the payload and the coordinator must re-cover the pano
+    group from replicas — a silently-wrong shortlist is the one failure
+    this tier may never produce.  Returns ``data`` unchanged when the
+    fault is not armed."""
+    p = _active()
+    if p is None or not p.shard_bitflip_urls:
+        return data
+    if not any(s and s in base_url for s in p.shard_bitflip_urls) \
+            or not data:
+        return data
+    flipped = bytearray(data)
+    flipped[-1] ^= 0x01
+    return bytes(flipped)
 
 
 def queue_overflow_burst(submit: Callable[[], object], n: int):

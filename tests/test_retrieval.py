@@ -1,0 +1,634 @@
+"""Chaos suite for shard-replicated coarse-volume retrieval (ISSUE 16).
+
+Layers under test:
+
+  * **Assignment** (``retrieval/assignment.py``): rendezvous placement is
+    deterministic, balanced, R-replicated, and minimal-movement under
+    shard removal — the property that makes failover a re-dispatch, not a
+    reshuffle.
+  * **Scoring + index** (``retrieval/scoring.py`` / ``index.py``): the
+    raw extractor discriminates structured panos, top-k is deterministic
+    under ties, and ``local_shortlist`` rides the store's verified-read /
+    quarantine / recompute ladder (a bit-flipped entry recomputes to an
+    IDENTICAL shortlist).
+  * **Wire** (``retrieval/wire.py`` + ``POST /retrieve``): framed round
+    trips, checksum-sealed answers (corrupt scores are refused, never
+    served), classified terminal errors.
+  * **Coordinator** (``retrieval/coordinator.py``): replication turns
+    shard death into lost capacity at full coverage; R=1 loss is reported
+    DEGRADED with honest coverage, never silent; stragglers are hedged;
+    probes resurrect a restarted shard.
+  * **Tools**: ``run_report --retrieval`` (the outcome-total identity
+    replayed from the log), ``stall_watchdog --url`` on a coordinator
+    document, ``serve_probe``'s fixture/spawn helpers.
+
+THE acceptance chain (test_acceptance_sigkill_full_coverage): a 4-shard
+R=2 CPU pod of REAL ``serve_shard.py`` processes under a query stream
+survives SIGKILL of one shard with every query still terminating
+classified at coverage 1.0, marks it DEAD, re-admits a restarted process
+at the same address, and the event log replays the identity with zero
+lost queries.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ncnet_tpu.observability import EventLog
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability.events import replay_events
+from ncnet_tpu.observability.export import parse_prometheus
+from ncnet_tpu.observability.perfstore import metric_direction
+from ncnet_tpu.retrieval import (
+    RetrievalConfig,
+    RetrievalCoordinator,
+    RetrieveClient,
+    ShardService,
+    assignment_table,
+    coarse_volume_from_features,
+    decode_retrieve_request,
+    decode_retrieve_response,
+    encode_retrieve_request,
+    encode_retrieve_response,
+    load_index_manifests,
+    local_shortlist,
+    pooled_descriptor,
+    raw_coarse_volume,
+    replica_shards,
+    score_coarse_volume,
+    top_k,
+    write_index_manifest,
+)
+from ncnet_tpu.serving import DeadlineExceeded
+from ncnet_tpu.serving.wire import WireError
+from ncnet_tpu.store import FeatureStore, coarse_fingerprint
+from ncnet_tpu.store.feature_store import _weights_segment
+from ncnet_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import run_report  # noqa: E402
+import serve_probe  # noqa: E402
+import stall_watchdog  # noqa: E402
+
+FACTOR = 4
+GRID = 16
+FP = coarse_fingerprint(f"raw-s{GRID}-k0-f32", FACTOR)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    obs_events.set_global_sink(None)
+    yield
+    faults.clear()
+    obs_events.set_global_sink(None)
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def make_img(i, hw=(96, 128)):
+    """STRUCTURED test pano (distinct hue levels + stripe cadence).
+    Random noise is useless here: the raw statistics extractor scores
+    noise panos all ~identical (cosine ~0.9999), so a noise fixture could
+    never prove the shortlist ranks correctly."""
+    img = np.zeros((*hw, 3), np.uint8)
+    img[..., 0] = (37 * i) % 256
+    img[..., 1] = (91 * i + 13) % 256
+    img[:: (i % 5) + 2, :, 2] = 255
+    return img
+
+
+def descriptor(img):
+    return pooled_descriptor(raw_coarse_volume(img, FACTOR, grid=GRID))
+
+
+def build_fixture(root, n_panos=12):
+    """Coarse store + index under ``root`` via the serve_probe helper (the
+    probe's fixture IS this suite's fixture — one builder, no drift)."""
+    return serve_probe.build_coarse_fixture(str(root), n_panos,
+                                            factor=FACTOR, grid=GRID)
+
+
+def start_inproc_pod(root, n_shards, replication, n_panos=12):
+    """In-process shard pod: N ``ShardService``s over one store + index,
+    each behind its own introspection plane.  Returns
+    ``(services, {sid: url}, index)``."""
+    index_path, images = build_fixture(root, n_panos)
+    index = load_index_manifests(index_path)
+    shard_ids = [f"s{i}" for i in range(n_shards)]
+    services, urls = [], {}
+    for sid in shard_ids:
+        store = FeatureStore(str(root), index["fingerprint"],
+                             scope=f"test_{sid}")
+        svc = ShardService(sid, shard_ids, index, store,
+                           replication=replication, introspect_port=0)
+        svc.start()
+        assert svc.introspect_url is not None
+        services.append(svc)
+        urls[sid] = svc.introspect_url
+    return services, urls, index, images
+
+
+# ---------------------------------------------------------------------------
+# assignment: deterministic, balanced, replicated, minimal movement
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_assignment_properties():
+    shards = [f"s{i}" for i in range(4)]
+    panos = [f"p{i:03d}" for i in range(200)]
+    t1 = assignment_table(panos, shards, 2)
+    t2 = assignment_table(panos, shards, 2)
+    assert t1 == t2  # pure function of (pano, shard) ids
+    # R-way replication: every pano on exactly R distinct shards
+    owners = {p: [s for s in shards if p in set(t1[s])] for p in panos}
+    assert all(len(o) == 2 for o in owners.values())
+    assert all(set(o) == set(replica_shards(p, shards, 2))
+               for p, o in owners.items())
+    # balance: expected 100 panos/shard; rendezvous keeps it in a band
+    counts = [len(t1[s]) for s in shards]
+    assert sum(counts) == 400
+    assert min(counts) > 50 and max(counts) < 150
+    # minimal movement: removing s3 only re-homes panos that LIVED on s3
+    survivors = shards[:-1]
+    for p in panos:
+        old = replica_shards(p, shards, 2)
+        new = replica_shards(p, survivors, 2)
+        if "s3" not in old:
+            assert new == old  # untouched panos do not move
+        else:
+            assert set(old) & set(new)  # the surviving replica stays
+    with pytest.raises(ValueError):
+        replica_shards("p0", shards, 0)
+
+
+# ---------------------------------------------------------------------------
+# scoring / fingerprints / perf-gate directions
+# ---------------------------------------------------------------------------
+
+
+def test_raw_extractor_discriminates_and_topk_deterministic():
+    vols = {f"p{i}": raw_coarse_volume(make_img(i), FACTOR, grid=GRID)
+            for i in range(6)}
+    desc = descriptor(make_img(3))
+    scores = {n: score_coarse_volume(desc, v) for n, v in vols.items()}
+    ranked = top_k(scores, 3)
+    assert ranked[0][0] == "p3"  # the query's own pano wins
+    assert ranked == top_k(scores, 3)
+    # tie-break is the pano id, not dict/iteration order
+    assert top_k([("b", 1.0), ("a", 1.0), ("c", 0.5)], 2) == \
+        (("a", 1.0), ("b", 1.0))
+    # channel mismatch is a refusal, never a silently-wrong ranking
+    with pytest.raises(ValueError):
+        score_coarse_volume(np.ones(5, np.float32), vols["p0"])
+    # both extractors produce the shared formats
+    feat = np.random.default_rng(0).normal(size=(1, 16, 16, 8))
+    vol = coarse_volume_from_features(feat, FACTOR)
+    assert vol.shape == (4, 4, 8)
+    assert np.allclose(np.linalg.norm(vol, axis=-1), 1.0, atol=1e-5)
+
+
+def test_coarse_fingerprint_is_own_generation_same_weights_segment():
+    base = "abc123-s3200-k2-bf16"
+    fp = coarse_fingerprint(base, 4)
+    assert fp == "abc123-s3200-k2-bf16-c4"
+    assert fp != coarse_fingerprint(base, 2)  # factor rides the generation
+    # same weights segment: checkpoint-scoped GC covers coarse entries too
+    assert _weights_segment(fp) == _weights_segment(base)
+
+
+def test_retrieval_metrics_gate_directions():
+    assert metric_direction("retrieve_coverage_pct") == "higher"
+    assert metric_direction("retrieve_hedge_pct") == "lower"
+    assert metric_direction("retrieve_p95_ms") == "lower"
+
+
+# ---------------------------------------------------------------------------
+# wire: framed round trips, checksum seal, classified errors
+# ---------------------------------------------------------------------------
+
+
+def test_wire_roundtrip_and_checksum_refusal():
+    desc = descriptor(make_img(0))
+    data = encode_retrieve_request(desc, panos=["a", "b"], topk=3,
+                                   client="t", budget_s=1.5,
+                                   request_id="q1")
+    got, meta = decode_retrieve_request(data)
+    np.testing.assert_allclose(got, desc, rtol=1e-6)
+    assert meta["panos"] == ["a", "b"] and meta["topk"] == 3
+    assert meta["budget_s"] == 1.5 and meta["request"] == "q1"
+
+    answer = {"shard": "s0", "scores": [["p1", 0.9]], "coverage": 1.0}
+    status, payload = encode_retrieve_response(answer)
+    assert status == 200
+    assert decode_retrieve_response(payload) == answer
+    # one flipped payload byte breaks the sha256 seal: corrupt scores are
+    # a WireError (shard failure -> replica re-route), never served
+    corrupt = bytearray(payload)
+    corrupt[-2] ^= 0x01
+    with pytest.raises(WireError):
+        decode_retrieve_response(bytes(corrupt))
+
+
+def test_fault_plan_shard_hooks():
+    url = "http://127.0.0.1:45678"
+    # unarmed: no-ops
+    faults.shard_fault_hook(url, "send")
+    assert faults.shard_payload_hook(url, b"abc") == b"abc"
+    faults.install(faults.FaultPlan(dead_shard_urls=("127.0.0.1:45678",),
+                                    shard_bitflip_urls=("127.0.0.1:9",)))
+    with pytest.raises(ConnectionError):
+        faults.shard_fault_hook(url, "send")
+    faults.shard_fault_hook("http://127.0.0.1:1", "send")  # others pass
+    assert faults.shard_payload_hook(url, b"abc") == b"abc"  # not armed
+    assert faults.shard_payload_hook("http://127.0.0.1:9", b"abc") != b"abc"
+    faults.clear()
+    faults.shard_fault_hook(url, "send")  # disarmed again
+
+
+# ---------------------------------------------------------------------------
+# local shortlist: the store ladder under a bit flip
+# ---------------------------------------------------------------------------
+
+
+def test_local_shortlist_bitflip_quarantines_recomputes_identical(tmp_path):
+    """A bit-flipped coarse entry is caught by the store checksum,
+    quarantined, recomputed — and the shortlist comes out IDENTICAL to
+    the uncorrupted pass (the headline: corruption costs latency, never
+    ranking)."""
+    index_path, images = build_fixture(tmp_path, n_panos=6)
+    index = load_index_manifests(index_path)
+
+    def compute(name):
+        return raw_coarse_volume(images[name], FACTOR, grid=GRID)
+
+    store = FeatureStore(str(tmp_path), index["fingerprint"], scope="t")
+    try:
+        desc = descriptor(images[sorted(images)[2]])
+        baseline = local_shortlist(store, index, desc, topk=4,
+                                   compute=compute)
+        assert baseline["coverage"] == 1.0
+        assert baseline["scores"][0][0] == sorted(images)[2]
+
+        # corrupt one committed entry post-commit, then re-sweep
+        victim = sorted(images)[2]
+        digest = index["panos"][victim]
+        arr = compute(victim)
+        with faults.injected(faults.FaultPlan(
+                store_bitflip_paths=(digest,))):
+            store.put(digest, arr)  # committed, then bit-flipped
+        again = local_shortlist(store, index, desc, topk=4,
+                                compute=compute)
+        assert store.counters["corrupt"] == 1  # caught, not served
+        assert again["scores"] == baseline["scores"]  # identical shortlist
+        assert again["coverage"] == 1.0
+        # without compute, an unreadable entry lowers coverage instead
+        with faults.injected(faults.FaultPlan(
+                store_bitflip_paths=(digest,))):
+            store.put(digest, arr)
+        partial = local_shortlist(store, index, desc, topk=4)
+        assert partial["coverage"] < 1.0
+        assert victim in partial["unavailable"]
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process pod: R=1 honesty, hedging, wire bitflip failover
+# ---------------------------------------------------------------------------
+
+
+def test_r1_dead_shard_reports_degraded_coverage_never_silent(tmp_path):
+    """At R=1 a dead shard's panos are simply GONE from the sweep: the
+    answer must say so — coverage < 1.0 and DEGRADED — rather than
+    silently serving a truncated shortlist as if it were total."""
+    services, urls, index, images = start_inproc_pod(tmp_path, 2, 1)
+    coord = None
+    try:
+        cfg = RetrievalConfig(replication=1, topk=5, max_failures=2,
+                              probe_period_s=5.0)
+        coord = RetrievalCoordinator(urls, list(index["panos"]), cfg)
+        coord.start()
+        dead = urls["s1"].replace("http://", "")
+        faults.install(faults.FaultPlan(dead_shard_urls=(dead,)))
+        ans = coord.retrieve(descriptor(make_img(1)), budget_s=10.0,
+                             request_id="r1-q0")
+        assert ans["degraded"] is True
+        assert 0.0 < ans["coverage"] < 1.0
+        assert ans["consulted"] < ans["total"]
+        # the living half still ranks correctly within its coverage
+        assert all(p in index["panos"] for p, _ in ans["scores"])
+    finally:
+        faults.clear()
+        if coord is not None:
+            coord.stop()
+        for s in services:
+            s.stop()
+
+
+def test_hedging_beats_slow_straggler(tmp_path):
+    """A shard that is merely SLOW is hedged, not killed: its panos
+    re-dispatch to replicas after ``hedge_after_s`` and the query answers
+    at full coverage well under the straggler's wall."""
+    services, urls, index, images = start_inproc_pod(tmp_path, 4, 2)
+    coord = None
+    try:
+        cfg = RetrievalConfig(replication=2, topk=5, hedge_after_s=0.12,
+                              probe_period_s=5.0)
+        coord = RetrievalCoordinator(urls, list(index["panos"]), cfg)
+        coord.start()
+        slow = urls["s2"].replace("http://", "")
+        faults.install(faults.FaultPlan(slow_shard_urls=(slow,),
+                                        slow_shard_seconds=1.5))
+        t0 = time.perf_counter()
+        ans = coord.retrieve(descriptor(make_img(2)), budget_s=10.0,
+                             request_id="hedge-q0")
+        wall = time.perf_counter() - t0
+        assert ans["coverage"] == 1.0  # replicas covered the straggler
+        assert ans["hedges"] >= 1
+        assert wall < 1.2  # beat the 1.5 s straggler
+        assert ans["scores"][0][0] == sorted(images)[2]
+        b = coord._backends["s2"]
+        assert b.state == "READY"  # slow is hedged, never punished dead
+    finally:
+        faults.clear()
+        if coord is not None:
+            coord.stop()
+        for s in services:
+            s.stop()
+
+
+def test_wire_bitflip_refused_replica_covers(tmp_path):
+    """A shard answering with corrupt bytes fails its checksum seal: the
+    coordinator refuses the scores, re-routes to replicas (coverage stays
+    1.0, identical top-1), and the repeat offender goes DEAD."""
+    services, urls, index, images = start_inproc_pod(tmp_path, 4, 2)
+    coord = None
+    try:
+        cfg = RetrievalConfig(replication=2, topk=5, max_failures=2,
+                              probe_period_s=5.0)
+        coord = RetrievalCoordinator(urls, list(index["panos"]), cfg)
+        coord.start()
+        clean = coord.retrieve(descriptor(make_img(4)), budget_s=10.0,
+                               request_id="bf-base")
+        assert clean["coverage"] == 1.0
+        flip = urls["s0"].replace("http://", "")
+        faults.install(faults.FaultPlan(shard_bitflip_urls=(flip,)))
+        for i in range(3):
+            ans = coord.retrieve(descriptor(make_img(4)), budget_s=10.0,
+                                 request_id=f"bf-q{i}")
+            assert ans["coverage"] == 1.0
+            assert ans["scores"][0][0] == clean["scores"][0][0]
+        assert coord._backends["s0"].state == "DEAD"  # streak caught it
+    finally:
+        faults.clear()
+        if coord is not None:
+            coord.stop()
+        for s in services:
+            s.stop()
+
+
+def test_zero_budget_classifies_deadline(tmp_path):
+    services, urls, index, _ = start_inproc_pod(tmp_path, 2, 2, n_panos=4)
+    coord = None
+    try:
+        coord = RetrievalCoordinator(urls, list(index["panos"]),
+                                     RetrievalConfig(probe_period_s=5.0))
+        coord.start()
+        with pytest.raises(DeadlineExceeded):
+            coord.retrieve(descriptor(make_img(0)), budget_s=0.0,
+                           request_id="dl-q0")
+    finally:
+        if coord is not None:
+            coord.stop()
+        for s in services:
+            s.stop()
+
+
+def test_shard_wire_plane_and_metrics(tmp_path):
+    """``POST /retrieve`` on the shard's introspection server answers a
+    framed client; ``POST /match`` there is a 404 (this host serves the
+    retrieval plane); ``/metrics`` exports the ncnet_retrieve_* family."""
+    import urllib.error
+    import urllib.request
+
+    services, urls, index, images = start_inproc_pod(tmp_path, 2, 2,
+                                                     n_panos=6)
+    try:
+        url = urls["s0"]
+        client = RetrieveClient(url)
+        ans = client.retrieve(descriptor(make_img(1)), budget_s=5.0,
+                              request_id="wire-q0")
+        client.close()
+        assert ans["shard"] == "s0"
+        assert ans["consulted"]  # it scored its assigned panos
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                urllib.request.Request(f"{url}/match", data=b"x",
+                                       method="POST"), timeout=5)
+        assert ei.value.code == 404
+        body = urllib.request.urlopen(f"{url}/metrics",
+                                      timeout=5).read().decode()
+        families = parse_prometheus(body)
+        up = [v for _n, _l, v in
+              families["ncnet_retrieve_shard_up"]["samples"]]
+        assert up == [1.0]
+        assert "ncnet_retrieve_shard_requests_total" in families
+    finally:
+        for s in services:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chain: real processes, SIGKILL at R=2, restart-in-place
+# ---------------------------------------------------------------------------
+
+
+def _spawn_shard(sid, shard_ids, store_root, index_path, port=0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve_shard.py"),
+         "--shard-id", sid, "--shards", ",".join(shard_ids),
+         "--store", str(store_root), "--index", str(index_path),
+         "--replication", "2", "--port", str(port)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    doc = json.loads(proc.stdout.readline())
+    assert "url" in doc, f"shard failed to start: {doc}"
+    return proc, doc["url"]
+
+
+def test_acceptance_sigkill_full_coverage(tmp_path):
+    """ISSUE 16 acceptance: 4 real shard processes at R=2 — SIGKILL one
+    mid-stream and every query still terminates classified at coverage
+    1.0 with the correct top-1; the victim goes DEAD, the pod DEGRADED
+    (capacity, not coverage); a restarted process at the SAME address is
+    re-admitted by the wire probe; the event log replays the outcome
+    identity with zero lost queries; stall_watchdog reads the coordinator
+    document with the per-shard breakdown."""
+    index_path, images = build_fixture(tmp_path, n_panos=12)
+    index = load_index_manifests(index_path)
+    names = sorted(images)
+    shard_ids = [f"s{i}" for i in range(4)]
+    log_path = str(tmp_path / "retrieval_events.jsonl")
+    procs = {}
+    with obs_events.bound(EventLog(log_path)):
+        for sid in shard_ids:
+            procs[sid] = _spawn_shard(sid, shard_ids, tmp_path, index_path)
+        coord = RetrievalCoordinator(
+            {sid: url for sid, (_, url) in procs.items()},
+            list(index["panos"]),
+            RetrievalConfig(replication=2, topk=5, probe_period_s=0.2,
+                            resurrect_after_s=0.3, max_failures=2,
+                            introspect_port=0))
+        coord.start()
+        try:
+            def query(i, tag):
+                return coord.retrieve(descriptor(images[names[i]]),
+                                      budget_s=15.0,
+                                      request_id=f"{tag}-{i}")
+
+            # phase 1: healthy stream — full coverage, correct top-1
+            for i in range(len(names)):
+                ans = query(i, "steady")
+                assert ans["coverage"] == 1.0
+                assert ans["degraded"] is False
+                assert ans["scores"][0][0] == names[i]
+
+            # phase 2: SIGKILL s1 — capacity lost, coverage kept
+            p1, url1 = procs["s1"]
+            p1.kill()  # SIGKILL: no drain, no goodbye
+            for i in range(len(names)):
+                ans = query(i, "killed")
+                assert ans["coverage"] == 1.0  # replication's headline
+                assert ans["scores"][0][0] == names[i]
+            victim = coord._backends["s1"]
+            assert wait_until(lambda: victim.state == "DEAD", 15)
+            assert coord.state == "DEGRADED"  # shards:3/4
+            assert victim.deaths >= 1
+
+            # phase 3: restart-in-place at the same port; the healthz +
+            # wire probe re-admits it and capacity recovers
+            port = int(url1.rsplit(":", 1)[1])
+            p1.wait(timeout=10)
+            procs["s1"] = _spawn_shard("s1", shard_ids, tmp_path,
+                                       index_path, port=port)
+            assert wait_until(lambda: victim.state == "READY", 15)
+            assert wait_until(lambda: coord.state == "READY", 5)
+            ans = query(0, "revived")
+            assert ans["coverage"] == 1.0
+
+            # stall_watchdog reads the coordinator document directly
+            v = stall_watchdog.judge_url(coord.introspect_url, factor=5,
+                                         min_age=30.0)
+            assert v["status"] == "alive" and v["role"] == "retrieval"
+            assert v["retrieval"]["shards_total"] == 4
+            assert set(v["backends"]) == set(shard_ids)
+        finally:
+            coord.stop()  # emits the final retrieve_health_doc
+            for p, _ in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+            for p, _ in procs.values():
+                try:
+                    p.wait(timeout=20)
+                except Exception:  # noqa: BLE001 — wedged child
+                    p.kill()
+
+    # the event log replays the whole story: outcome-total identity,
+    # zero lost queries, the death + resurrection on s1
+    report = run_report.build_report([log_path])
+    r = report["retrieval"]
+    o = r["outcomes"]
+    assert o["admitted"] == 25  # 12 + 12 + 1
+    assert o["results"] == o["admitted"]
+    assert o["deadline_exceeded"] == 0 and o["shed"] == 0
+    assert o["unresolved"] == 0 and not r["lost_requests"]
+    assert r["coverage"]["min"] == 1.0 and r["coverage"]["below_full"] == 0
+    assert r["shards"]["s1"]["deaths"] >= 1
+    assert r["shards"]["s1"]["resurrections"] >= 1
+    assert r["final_health_doc"] is not None
+    assert run_report.main([log_path, "--retrieval"]) == 0
+
+    _, events = replay_events(log_path)
+    deaths = [e for e in events if e.get("event") == "retrieve_backend"
+              and e.get("state") == "DEAD"]
+    assert any(e.get("shard") == "s1" for e in deaths)
+
+
+def test_run_report_retrieval_identity_flags_lost(tmp_path):
+    """The replayed identity must actually bite: an admit with no
+    terminal outcome reads as unresolved/lost, and a degraded result is
+    split out of the full-coverage count."""
+    log_path = str(tmp_path / "ev.jsonl")
+    sink = EventLog(log_path)
+    with obs_events.bound(sink):
+        obs_events.emit("retrieve_admit", request="q1", client="t",
+                        panos=4, budget_s=1.0)
+        obs_events.emit("retrieve_result", request="q1", client="t",
+                        coverage=0.5, degraded=True, hedges=0,
+                        attempts=2, consulted=2, total=4, wall_ms=3.0)
+        obs_events.emit("retrieve_admit", request="q2", client="t",
+                        panos=4, budget_s=1.0)  # ... and then silence
+    r = run_report.build_report([log_path])["retrieval"]
+    assert r["outcomes"]["admitted"] == 2
+    assert r["outcomes"]["results"] == 1
+    assert r["outcomes"]["results_degraded"] == 1
+    assert r["outcomes"]["unresolved"] == 1
+    assert len(r["lost_requests"]) == 1
+    assert r["coverage"]["below_full"] == 1
+    out = run_report.render_retrieval({"retrieval": r})
+    assert "VIOLATED" in out
+
+
+def test_stall_watchdog_retrieval_advisory_unit():
+    doc = {"role": "retrieval", "state": "DEGRADED",
+           "activity": {"age_s": 0.1},
+           "retrieval": {"coverage_p50": 0.9, "coverage_min": 0.5,
+                         "min_coverage": 1.0, "replication": 2},
+           "pod": {"ready": 3, "total": 4, "backends": []}}
+    verdict = {"status": "alive"}
+    stall_watchdog._apply_retrieval_advisory(verdict, doc)
+    rt = verdict["retrieval"]
+    assert rt["shards_ready"] == 3 and rt["shards_total"] == 4
+    assert rt["coverage_min"] == 0.5
+    # non-retrieval documents are untouched
+    verdict2 = {"status": "alive"}
+    stall_watchdog._apply_retrieval_advisory(verdict2, {"role": "router"})
+    assert "retrieval" not in verdict2
+
+
+# ---------------------------------------------------------------------------
+# index manifests: merge refusal + builder contract
+# ---------------------------------------------------------------------------
+
+
+def test_index_manifests_refuse_mixed_generations(tmp_path):
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    write_index_manifest(a, fingerprint=FP, factor=4, extractor="raw",
+                         panos={"p0": "d0"})
+    write_index_manifest(b, fingerprint=FP, factor=4, extractor="raw",
+                         panos={"p1": "d1"})
+    merged = load_index_manifests([a, b])
+    assert set(merged["panos"]) == {"p0", "p1"}
+    write_index_manifest(b, fingerprint=FP, factor=2, extractor="raw",
+                         panos={"p1": "d1"})
+    with pytest.raises(ValueError):
+        load_index_manifests([a, b])  # factor disagreement
+    with pytest.raises(ValueError):
+        load_index_manifests(str(tmp_path / "nothing*.json"))

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Resumable sharded builder for the retrieval tier's coarse-volume index.
+
+The scatter-gather retrieval tier (``ncnet_tpu/retrieval/``) serves cached
+COARSE volumes — per-pano pooled descriptive grids 1/factor^4 the size of
+dense features — out of the PR 14 feature store.  This tool walks a
+shortlist's unique pano set, computes each pano's coarse volume, commits
+it under the coarse generation (``coarse_fingerprint``), and writes the
+durable index manifest (``coarse_index.shard<i>_of_<n>.json``) mapping
+pano names to content digests that shard hosts and the coordinator load.
+
+Same robustness contract as ``build_feature_store.py``:
+
+  * each pano builds under ``run_isolated`` — bounded retry + backoff,
+    quarantine into the per-shard run manifest instead of aborting;
+    exit 2 while quarantined panos remain;
+  * resumable two ways: a pano already in this stripe's index manifest is
+    skipped without decoding, and a recomputed pano whose entry already
+    sits in the store is a verified HIT (two-phase commits mean a
+    SIGKILLed rerun can never be fooled by a torn entry);
+  * striping: ``--shard_index/--shard_count`` split the pano set across
+    builder hosts; shard hosts later merge the per-stripe manifests
+    (``load_index_manifests`` refuses mixed generations).
+
+Extractors: ``--raw`` builds model-free color/gradient-statistics volumes
+(numpy only, no jax import — the CPU chaos path); the default pools real
+backbone features by ``--factor`` (pays compiles, matches serving).
+
+Usage::
+
+    python tools/build_coarse_index.py --store_dir /data/cstore \
+        --inloc_shortlist .../densePE_top100_shortlist_cvpr18.mat \
+        --pano_path datasets/inloc/pano/ --factor 4 --raw \
+        [--checkpoint <ckpt> | --backbone tiny] [--n_panos 10] \
+        [--shard_index 0 --shard_count 4] [--telemetry_dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Build the coarse-volume retrieval index from an InLoc "
+                    "shortlist (resumable, per-shard manifests)")
+    p.add_argument("--store_dir", required=True,
+                   help="feature store root for the coarse generation "
+                        "(shared across builder shards)")
+    p.add_argument("--inloc_shortlist", type=str,
+                   default="datasets/inloc/densePE_top100_shortlist_cvpr18"
+                           ".mat")
+    p.add_argument("--pano_path", type=str, default="datasets/inloc/pano/")
+    p.add_argument("--factor", type=int, default=4,
+                   help="coarse pooling factor (rides the fingerprint and "
+                        "the index manifest)")
+    p.add_argument("--raw", action="store_true",
+                   help="model-free extractor (numpy only, no compiles) — "
+                        "the CPU chaos-suite path")
+    p.add_argument("--raw_grid", type=int, default=16,
+                   help="raw extractor's fine grid the factor pools from")
+    p.add_argument("--checkpoint", type=str, default="")
+    p.add_argument("--backbone", type=str, default="",
+                   help="trunk override when building without a checkpoint "
+                        "(e.g. 'tiny' for the CPU smoke test)")
+    p.add_argument("--image_size", type=int, default=3200)
+    p.add_argument("--k_size", type=int, default=2)
+    p.add_argument("--n_panos", type=int, default=10)
+    p.add_argument("--shard_index", type=int, default=0)
+    p.add_argument("--shard_count", type=int, default=1)
+    p.add_argument("--retries", type=int, default=2)
+    p.add_argument("--retry_backoff_s", type=float, default=0.5)
+    p.add_argument("--telemetry_dir", type=str, default="",
+                   help="open a structured event log here (replay with "
+                        "run_report --store)")
+    return p
+
+
+def raw_base_fingerprint(grid: int) -> str:
+    """The model-free extractor's synthetic base fingerprint — same
+    ``<weights>-s<size>-k<k>-<dtype>`` shape as a backbone fingerprint so
+    the store's weights-segment GC semantics apply unchanged."""
+    return f"raw-s{int(grid)}-k0-f32"
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    out = sys.stdout.write
+
+    if not 0 <= args.shard_index < max(1, args.shard_count):
+        raise SystemExit(f"shard_index {args.shard_index} out of range for "
+                         f"shard_count {args.shard_count}")
+
+    from ncnet_tpu.data.datasets import load_image
+    from ncnet_tpu.evaluation.resilience import (
+        FaultPolicy,
+        RunManifest,
+        run_isolated,
+    )
+    from ncnet_tpu.observability import events as obs_events
+    from ncnet_tpu.retrieval.index import (
+        load_index_manifests,
+        write_index_manifest,
+    )
+    from ncnet_tpu.retrieval.scoring import (
+        coarse_volume_from_features,
+        raw_coarse_volume,
+    )
+    from ncnet_tpu.store import (
+        FeatureStore,
+        coarse_fingerprint,
+        content_digest,
+    )
+    _TOOLS = os.path.dirname(os.path.abspath(__file__))
+    if _TOOLS not in sys.path:
+        sys.path.insert(0, _TOOLS)
+    from build_feature_store import unique_panos
+
+    own_sink = None
+    if args.telemetry_dir:
+        from ncnet_tpu.observability.events import EventLog
+
+        log_name = ("events.jsonl" if args.shard_count <= 1 else
+                    f"events.shard{args.shard_index}.jsonl")
+        own_sink = EventLog(
+            os.path.join(args.telemetry_dir, log_name),
+            run_meta={"tool": "build_coarse_index",
+                      "shard_index": args.shard_index,
+                      "shard_count": args.shard_count})
+        obs_events.set_global_sink(own_sink)
+
+    if args.raw:
+        extractor = "raw"
+        base_fp = raw_base_fingerprint(args.raw_grid)
+
+        def volume_of(raw):
+            return raw_coarse_volume(raw, args.factor, grid=args.raw_grid)
+    else:
+        # deferred so --raw (and --help) never pay jax startup
+        import jax
+
+        from ncnet_tpu.config import ModelConfig
+        from ncnet_tpu.evaluation.inloc import make_pair_matcher
+        from ncnet_tpu.store import backbone_fingerprint
+
+        extractor = "backbone"
+        base = ModelConfig(checkpoint=args.checkpoint, half_precision=True,
+                           relocalization_k_size=args.k_size,
+                           **({"backbone": args.backbone} if args.backbone
+                              else {}))
+        if args.checkpoint:
+            from ncnet_tpu.models.checkpoint import load_params
+
+            model_config, params = load_params(args.checkpoint, base)
+            model_config = model_config.replace(
+                half_precision=True, relocalization_k_size=args.k_size)
+        else:
+            from ncnet_tpu.models.ncnet import init_ncnet
+
+            model_config = base
+            params = init_ncnet(model_config, jax.random.key(1))
+        base_fp = backbone_fingerprint(
+            params, image_size=args.image_size, k_size=args.k_size,
+            dtype="bf16")
+        matcher = make_pair_matcher(
+            model_config, params, do_softmax=True, both_directions=True,
+            flip_direction=False, preprocess_image_size=args.image_size)
+
+        def volume_of(raw):
+            import numpy as np
+
+            prepared = matcher.preprocess(raw[None])
+            return coarse_volume_from_features(
+                np.asarray(prepared.features, dtype=np.float32),
+                args.factor)
+
+    fingerprint = coarse_fingerprint(base_fp, args.factor)
+    store = FeatureStore(args.store_dir, fingerprint, scope="coarse_build")
+    shard_tag = f"shard{args.shard_index}_of_{max(1, args.shard_count)}"
+    index_path = os.path.join(args.store_dir,
+                              f"coarse_index.{shard_tag}.json")
+    # fast-forward: panos already in this stripe's index manifest carry
+    # their digest and are skipped without even decoding
+    index_panos = {}
+    if os.path.exists(index_path):
+        try:
+            prior = load_index_manifests(index_path)
+            if prior["fingerprint"] == fingerprint \
+                    and prior["factor"] == args.factor \
+                    and prior["extractor"] == extractor:
+                index_panos = dict(prior["panos"])
+        except (OSError, ValueError):
+            pass  # a foreign/torn manifest restarts the stripe, not the run
+
+    panos = unique_panos(args.inloc_shortlist, args.n_panos)
+    stripe = panos[args.shard_index::max(1, args.shard_count)]
+    manifest = RunManifest(
+        os.path.join(args.store_dir, f"coarse_manifest.{shard_tag}.json"),
+        meta={"tool": "build_coarse_index", "fingerprint": fingerprint,
+              "factor": args.factor, "extractor": extractor,
+              "shortlist": os.path.basename(args.inloc_shortlist),
+              "n_panos": args.n_panos,
+              "shard_index": args.shard_index,
+              "shard_count": max(1, args.shard_count)})
+    policy = FaultPolicy(retries=args.retries,
+                         backoff_s=args.retry_backoff_s, quarantine=True)
+
+    t0 = time.perf_counter()
+    built = skipped = 0
+    for name in stripe:
+        if name in index_panos:
+            skipped += 1
+            if not manifest.is_completed(name):
+                manifest.complete(name)
+            continue
+
+        def work(name=name):
+            raw = load_image(os.path.join(args.pano_path, name))
+            digest = content_digest(raw)
+            store.resolve(digest, lambda raw=raw: volume_of(raw))
+            return digest
+
+        ok, digest = run_isolated(name, work, policy=policy,
+                                  manifest=manifest,
+                                  label=f"pano {name}")
+        if ok:
+            built += 1
+            index_panos[name] = digest
+            write_index_manifest(
+                index_path, fingerprint=fingerprint, factor=args.factor,
+                extractor=extractor, panos=index_panos,
+                meta={"shard_index": args.shard_index,
+                      "shard_count": max(1, args.shard_count)})
+
+    doc = {
+        "tool": "build_coarse_index",
+        "fingerprint": fingerprint,
+        "extractor": extractor,
+        "factor": args.factor,
+        "shard": f"{args.shard_index}/{max(1, args.shard_count)}",
+        "index": index_path,
+        "stripe_panos": len(stripe),
+        "built": built,
+        "skipped_indexed": skipped,
+        "quarantined": list(manifest.quarantined_ids),
+        "store": store.flush_stats(tool="build_coarse_index"),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    out(json.dumps(doc, sort_keys=True) + "\n")
+    store.close()
+    if own_sink is not None:
+        obs_events.set_global_sink(None)
+        own_sink.close()
+    return 2 if manifest.quarantined_ids else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

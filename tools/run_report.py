@@ -652,6 +652,146 @@ def build_router_section(events: List[dict]) -> Dict[str, Any]:
     }
 
 
+def build_retrieval_section(events: List[dict]) -> Dict[str, Any]:
+    """The retrieval-tier postmortem (ncnet_tpu/retrieval/): the
+    outcome-total identity at the COORDINATOR level (``retrieve_admit ==
+    retrieve_result + retrieve_deadline + retrieve_shed``; results split
+    into full-coverage and degraded), the coverage distribution with its
+    never-silent floor, the hedge rate, per-shard outcome accounting, and
+    the shard death/resurrection timeline — all replayed from the log."""
+    admits = [e for e in events if e.get("event") == "retrieve_admit"]
+    results = [e for e in events if e.get("event") == "retrieve_result"]
+    deadlines = [e for e in events
+                 if e.get("event") == "retrieve_deadline"]
+    sheds = [e for e in events if e.get("event") == "retrieve_shed"]
+    hedges = [e for e in events if e.get("event") == "retrieve_hedge"]
+    degraded = [e for e in results if e.get("degraded")]
+    terminals = len(results) + len(deadlines) + len(sheds)
+
+    def _key(e: dict):
+        return (e.get("run"), e.get("request"))
+
+    settled = {_key(e) for e in results + deadlines + sheds}
+    lost = [f"{e.get('request')} (run {e.get('run')})" for e in admits
+            if _key(e) not in settled]
+
+    covs = [e["coverage"] for e in results
+            if isinstance(e.get("coverage"), (int, float))]
+    walls = [e["wall_ms"] for e in results
+             if isinstance(e.get("wall_ms"), (int, float))]
+    hedged_queries = sum(
+        1 for e in results if (e.get("hedges") or 0) > 0)
+
+    # per-shard accounting: results/walls from retrieve_shard_result,
+    # error kinds from retrieve_shard_error, lifecycle from
+    # retrieve_backend, hedges targeted at the shard from retrieve_hedge
+    shards: Dict[str, Dict[str, Any]] = {}
+
+    def _sh(sid) -> Dict[str, Any]:
+        return shards.setdefault(str(sid), {
+            "results": 0, "walls": [], "consulted": 0, "unavailable": 0,
+            "errors": {}, "deaths": 0, "resurrections": 0, "draining": 0,
+            "hedges": 0,
+        })
+
+    for e in events:
+        ev, sid = e.get("event"), e.get("shard")
+        if sid is None:
+            continue
+        if ev == "retrieve_shard_result":
+            s = _sh(sid)
+            s["results"] += 1
+            s["consulted"] += e.get("consulted") or 0
+            s["unavailable"] += e.get("unavailable") or 0
+            if isinstance(e.get("wall_ms"), (int, float)):
+                s["walls"].append(e["wall_ms"])
+        elif ev == "retrieve_shard_error":
+            k = str(e.get("kind", "other"))
+            s = _sh(sid)
+            s["errors"][k] = s["errors"].get(k, 0) + 1
+        elif ev == "retrieve_backend":
+            st = e.get("state")
+            if st == "DEAD":
+                _sh(sid)["deaths"] += 1
+            elif st == "READY":
+                _sh(sid)["resurrections"] += 1
+            elif st == "DRAINING":
+                _sh(sid)["draining"] += 1
+        elif ev == "retrieve_hedge":
+            _sh(sid)["hedges"] += 1
+    shard_table = {}
+    for sid, s in sorted(shards.items()):
+        shard_table[sid] = {
+            "results": s["results"],
+            "wall_ms": _percentiles(s["walls"]),
+            "consulted": s["consulted"],
+            "unavailable": s["unavailable"],
+            "errors": s["errors"],
+            "deaths": s["deaths"],
+            "resurrections": s["resurrections"],
+            "draining": s["draining"],
+            "hedges_absorbed": s["hedges"],
+        }
+
+    out: Dict[str, Any] = {
+        "outcomes": {
+            "admitted": len(admits),
+            "results": len(results),
+            "results_degraded": len(degraded),
+            "deadline_exceeded": len(deadlines),
+            "shed": len(sheds),
+            "terminals": terminals,
+            "unresolved": max(0, len(admits) - terminals),
+        },
+        "lost_requests": lost,
+        "coverage": {
+            **_percentiles(covs),
+            "min": round(min(covs), 6) if covs else None,
+            "below_full": sum(1 for c in covs if c < 1.0),
+        },
+        "latency_ms": _percentiles(walls),
+        "hedging": {
+            "hedge_dispatches": len(hedges),
+            "hedged_queries": hedged_queries,
+            "hedge_rate_pct": round(
+                100.0 * hedged_queries / max(1, len(results)), 2),
+        },
+        "shards": shard_table,
+        "timeline": [
+            {"t": e.get("t"), "state": e.get("state"),
+             "reason": e.get("reason"),
+             **({"shard": e["shard"]} if e.get("shard") is not None
+                else {})}
+            for e in events
+            if e.get("event") in ("retrieve_health", "retrieve_backend")
+        ],
+        "final_health_doc": next(
+            (e.get("doc") for e in reversed(events)
+             if e.get("event") == "retrieve_health_doc"
+             and isinstance(e.get("doc"), dict)), None),
+    }
+    # the InLoc in-system shortlist's events ride the same section: how
+    # often retrieval actually reordered a query vs fell back, and why
+    shortlists = [e for e in events
+                  if e.get("event") == "retrieval_shortlist"]
+    fallbacks = [e for e in events
+                 if e.get("event") == "retrieval_fallback"]
+    if shortlists or fallbacks:
+        reasons: Dict[str, int] = {}
+        for e in fallbacks:
+            r = str(e.get("reason", "unknown"))
+            reasons[r] = reasons.get(r, 0) + 1
+        out["inloc_shortlist"] = {
+            "reordered": len(shortlists),
+            "fallbacks": len(fallbacks),
+            "fallback_reasons": reasons,
+            "coverage": _percentiles(
+                [e["coverage"] for e in shortlists
+                 if isinstance(e.get("coverage"), (int, float))]),
+        }
+    return out
+
+
 def build_report(paths: List[str],
                  quality_ref: Optional[str] = None) -> Dict[str, Any]:
     """Aggregate one report dict over every given event log."""
@@ -775,6 +915,9 @@ def build_report(paths: List[str],
         report["slo"] = build_slo_section(events)
     if any(str(e.get("event", "")).startswith("route_") for e in events):
         report["router"] = build_router_section(events)
+    if any(str(e.get("event", "")).startswith(("retrieve_", "retrieval_"))
+           for e in events):
+        report["retrieval"] = build_retrieval_section(events)
     if any(e.get("event") in ("memory_ledger", "memory_leak_suspect",
                               "memory_postmortem", "device_snapshot")
            for e in events):
@@ -1140,6 +1283,72 @@ def render_store(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_retrieval(report: Dict[str, Any]) -> str:
+    r = report.get("retrieval")
+    if not r:
+        return "(no retrieval events in the log)"
+    lines = ["retrieval tier (replayed from the event log):"]
+    o = r["outcomes"]
+    ident = "HOLDS" if o["unresolved"] == 0 and not r["lost_requests"] \
+        else "VIOLATED"
+    lines.append(
+        f"  outcomes: admitted={o['admitted']}  results={o['results']} "
+        f"(degraded={o['results_degraded']})  "
+        f"deadline={o['deadline_exceeded']}  shed={o['shed']}  "
+        f"unresolved={o['unresolved']}  [identity {ident}]")
+    if r["lost_requests"]:
+        lines.append("  LOST requests (admitted, no terminal outcome): "
+                     + ", ".join(r["lost_requests"][:10]))
+    cov = r["coverage"]
+    if cov.get("n"):
+        lines.append(
+            f"  coverage: p50={cov.get('p50')} p90={cov.get('p90')} "
+            f"min={cov.get('min')}  below-full={cov['below_full']} "
+            f"of {cov['n']} (degraded or shed, never silent)")
+    if r["latency_ms"]:
+        lines.append("  sweep wall: "
+                     + _fmt_stats(r["latency_ms"], "ms"))
+    h = r["hedging"]
+    lines.append(
+        f"  hedging: {h['hedge_dispatches']} dispatch(es) over "
+        f"{h['hedged_queries']} query(ies) "
+        f"({h['hedge_rate_pct']}% of results)")
+    if r["shards"]:
+        lines.append("  per-shard:")
+        for sid, s in r["shards"].items():
+            err = (" errors=" + ",".join(
+                f"{k}:{v}" for k, v in sorted(s["errors"].items()))
+                if s["errors"] else "")
+            lines.append(
+                f"    {sid}: results={s['results']} "
+                f"consulted={s['consulted']} "
+                f"unavailable={s['unavailable']} deaths={s['deaths']} "
+                f"resurrections={s['resurrections']} "
+                f"hedges_absorbed={s['hedges_absorbed']}{err}")
+    deaths = [t for t in r["timeline"] if t.get("state") == "DEAD"]
+    if deaths or any(t.get("state") == "READY" and t.get("shard")
+                     for t in r["timeline"]):
+        lines.append("  shard lifecycle timeline:")
+        for t in r["timeline"]:
+            if t.get("shard") is None:
+                continue
+            lines.append(f"    t={t.get('t')}: {t['shard']} -> "
+                         f"{t.get('state')} ({t.get('reason')})")
+    il = r.get("inloc_shortlist")
+    if il:
+        lines.append(
+            f"  inloc shortlist: reordered={il['reordered']} "
+            f"fallbacks={il['fallbacks']} "
+            f"reasons={il['fallback_reasons']}")
+    fin = r.get("final_health_doc")
+    if fin:
+        pod = fin.get("pod", {})
+        lines.append(
+            f"  final health: {fin.get('state')} "
+            f"(shards {pod.get('ready')}/{pod.get('total')})")
+    return "\n".join(lines)
+
+
 def render_slo(report: Dict[str, Any]) -> str:
     s = report.get("slo")
     if not s or not s["admitted"]:
@@ -1282,6 +1491,12 @@ def main(argv=None) -> int:
                          "recomputed from the log (objectives from "
                          "serve_start), burn %%, and the consistency "
                          "verdict against the service's final slo event")
+    ap.add_argument("--retrieval", action="store_true",
+                    help="append the retrieval-tier section: the "
+                         "coordinator outcome-total identity, the coverage "
+                         "distribution, hedge rate, per-shard outcome "
+                         "accounting, and the shard death/resurrection "
+                         "timeline replayed from retrieve_* events")
     ap.add_argument("--store", action="store_true",
                     help="append the feature-store section: hit/miss/"
                          "corrupt/evict counters, the DEGRADED->recovered "
@@ -1313,6 +1528,9 @@ def main(argv=None) -> int:
         if args.memory:
             print()
             print(render_memory(report))
+        if args.retrieval:
+            print()
+            print(render_retrieval(report))
         if args.slo:
             print()
             print(render_slo(report))

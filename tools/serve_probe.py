@@ -415,6 +415,176 @@ def probe_router(n_backends: int, side: int, n_pairs: int,
     return out
 
 
+def _structured_pano(i: int, hw=(96, 128)):
+    """Deterministic STRUCTURED test image: distinct per-pano hue levels +
+    a stripe pattern.  Random-noise images are useless here — the raw
+    statistics extractor scores them all ~identical (cosine ~0.9999), so a
+    noise-built fixture cannot prove the shortlist ranks correctly."""
+    import numpy as np
+
+    img = np.zeros((*hw, 3), np.uint8)
+    img[..., 0] = (37 * i) % 256
+    img[..., 1] = (91 * i + 13) % 256
+    img[:: (i % 5) + 2, :, 2] = 255
+    return img
+
+
+def build_coarse_fixture(root: str, n_panos: int, factor: int = 4,
+                         grid: int = 16):
+    """Synthetic raw-extractor coarse store + index under ``root`` (the
+    retrieval analog of the router phase's FakeEngine backends: numpy only,
+    zero compiles).  Returns ``(index_path, {name: image})``."""
+    from ncnet_tpu.retrieval.index import write_index_manifest
+    from ncnet_tpu.retrieval.scoring import raw_coarse_volume
+    from ncnet_tpu.store import (
+        FeatureStore,
+        coarse_fingerprint,
+        content_digest,
+    )
+
+    fp = coarse_fingerprint(f"raw-s{grid}-k0-f32", factor)
+    store = FeatureStore(root, fp, scope="probe_fixture")
+    panos, images = {}, {}
+    try:
+        for i in range(n_panos):
+            img = _structured_pano(i)
+            name = f"pano{i:03d}.jpg"
+            digest = content_digest(img)
+            store.resolve(
+                digest,
+                lambda img=img: raw_coarse_volume(img, factor, grid=grid))
+            panos[name] = digest
+            images[name] = img
+    finally:
+        store.close()
+    index_path = os.path.join(root, "coarse_index.shard0_of_1.json")
+    write_index_manifest(index_path, fingerprint=fp, factor=factor,
+                         extractor="raw", panos=panos)
+    return index_path, images
+
+
+def spawn_shards(n: int, store_root: str, index_path: str,
+                 replication: int):
+    """Spawn ``n`` serve_shard subprocesses over one shared coarse store +
+    index and block for their startup lines.  Returns ``[(Popen, url)]``;
+    caller owns teardown (:func:`stop_backends` works unchanged)."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "serve_shard.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off")
+    shard_ids = ",".join(f"s{i}" for i in range(n))
+    procs = []
+    for i in range(n):
+        procs.append(subprocess.Popen(
+            [sys.executable, script, "--shard-id", f"s{i}",
+             "--shards", shard_ids, "--store", store_root,
+             "--index", index_path, "--replication", str(replication)],
+            stdout=subprocess.PIPE, text=True, env=env))
+    out = []
+    try:
+        for p in procs:
+            line = p.stdout.readline()
+            doc = json.loads(line) if line.strip() else {}
+            if "url" not in doc:
+                raise RuntimeError(f"shard failed to start: {doc}")
+            out.append((p, doc["url"]))
+    except Exception:
+        for p in procs:
+            p.kill()
+        raise
+    return out
+
+
+def probe_shards(n_shards: int, n_panos: int, n_queries: int,
+                 replication: int = 2) -> Dict[str, Any]:
+    """The retrieval-tier sweep: scatter-gather walls + coverage through a
+    real ``RetrievalCoordinator`` over ``n_shards`` spawned shard hosts —
+    steady state, then a SIGKILLed shard mid-sweep (replication turning
+    shard death into lost capacity, not lost coverage)."""
+    import tempfile
+
+    import numpy as np
+
+    from ncnet_tpu.retrieval import RetrievalConfig, RetrievalCoordinator
+    from ncnet_tpu.retrieval.index import load_index_manifests
+    from ncnet_tpu.retrieval.scoring import (
+        pooled_descriptor,
+        raw_coarse_volume,
+    )
+
+    out: Dict[str, Any] = {"shards": n_shards, "panos": n_panos,
+                           "replication": replication,
+                           "n_queries": n_queries}
+    with tempfile.TemporaryDirectory() as root:
+        index_path, images = build_coarse_fixture(root, n_panos)
+        index = load_index_manifests(index_path)
+        names = list(images)
+        procs = spawn_shards(n_shards, root, index_path, replication)
+        coord = None
+        try:
+            coord = RetrievalCoordinator(
+                {f"s{i}": url for i, (_, url) in enumerate(procs)},
+                list(index["panos"]),
+                RetrievalConfig(replication=replication, topk=5,
+                                probe_period_s=0.3, resurrect_after_s=0.3))
+            coord.start()
+
+            def query(i):
+                img = images[names[i % len(names)]]
+                desc = pooled_descriptor(
+                    raw_coarse_volume(img, index["factor"], grid=16))
+                return coord.retrieve(desc, budget_s=10.0,
+                                      request_id=f"probe-{i}")
+
+            def sweep(n):
+                walls, covs, hedges = [], [], 0
+                outcomes = {"result": 0, "degraded": 0, "deadline": 0,
+                            "shed": 0}
+                t0 = time.perf_counter()
+                for i in range(n):
+                    try:
+                        ans = query(i)
+                    except Exception as e:  # noqa: BLE001 — classified
+                        kind = type(e).__name__
+                        outcomes["deadline" if "Deadline" in kind
+                                 else "shed"] += 1
+                        continue
+                    outcomes["degraded" if ans["degraded"]
+                             else "result"] += 1
+                    walls.append(ans["wall_ms"])
+                    covs.append(ans["coverage"])
+                    hedges += ans["hedges"]
+                span = time.perf_counter() - t0
+                return {
+                    "outcomes": outcomes,
+                    "qps": round(n / span, 2),
+                    "latency_ms": _percentiles(walls),
+                    "coverage_pct": round(
+                        100.0 * float(np.mean(covs)), 2) if covs else 0.0,
+                    "coverage_min": round(
+                        float(np.min(covs)), 6) if covs else 0.0,
+                    "hedge_pct": round(100.0 * hedges / max(1, n), 2),
+                }
+
+            # 1. steady-state scatter-gather walls
+            out["steady"] = sweep(n_queries)
+
+            # 2. SIGKILL one shard mid-sweep: with R-way replication every
+            # query must still terminate classified at full coverage
+            victim_proc, victim_url = procs[0]
+            victim_proc.kill()  # SIGKILL: no drain, no goodbye
+            out["failover"] = sweep(n_queries)
+            out["failover"]["killed"] = victim_url
+            out["health"] = coord.health()
+        finally:
+            if coord is not None:
+                coord.stop()
+            stop_backends(procs)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Probe the resident match service on the attached "
@@ -441,6 +611,19 @@ def main(argv=None) -> int:
                          "the local service: capacity through the router, "
                          "the SIGKILL failover pause + zero-lost "
                          "accounting, and the shed wall")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="spawn N retrieval shard subprocesses over a "
+                         "synthetic coarse index and sweep the RETRIEVAL "
+                         "tier instead: scatter-gather walls + coverage, "
+                         "then the SIGKILL failover sweep; records "
+                         "retrieve_p95_ms / retrieve_coverage_pct / "
+                         "retrieve_hedge_pct to the perf store")
+    ap.add_argument("--shard-panos", type=int, default=24,
+                    help="panos in the synthetic retrieval fixture")
+    ap.add_argument("--shard-queries", type=int, default=24,
+                    help="queries per retrieval sweep phase")
+    ap.add_argument("--replication", type=int, default=2,
+                    help="replica count for the --shards sweep")
     ap.add_argument("--json", default=None, help="also write the JSON here")
     args = ap.parse_args(argv)
 
@@ -455,7 +638,27 @@ def main(argv=None) -> int:
     try:
         sides = [int(s) for s in args.sides.split(",") if s]
         replicas = [int(r) for r in args.replicas.split(",") if r] or [1]
-        if args.router > 0:
+        if args.shards > 0:
+            ret = probe_shards(args.shards, args.shard_panos,
+                               args.shard_queries,
+                               replication=args.replication)
+            out = {"retrieval": ret}
+            # the perf-store families perf_regress --check gates: the p95
+            # scatter-gather wall (lower), mean steady coverage (higher —
+            # see perfstore._HIGHER_TOKENS), and the steady hedge rate
+            # (lower: hedges firing with no straggler is paid redundancy)
+            from ncnet_tpu.observability.perfstore import maybe_record
+
+            steady = ret.get("steady", {})
+            lat = steady.get("latency_ms") or {}
+            metrics = {}
+            if lat.get("p95") is not None:
+                metrics["retrieve_p95_ms"] = lat["p95"]
+            if steady:
+                metrics["retrieve_coverage_pct"] = steady["coverage_pct"]
+                metrics["retrieve_hedge_pct"] = steady["hedge_pct"]
+            maybe_record(metrics, source="serve_probe_shards")
+        elif args.router > 0:
             out = {"router": probe_router(
                 args.router, sides[0], args.pairs, args.burst_factor,
                 args.tiny)}

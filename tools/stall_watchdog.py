@@ -222,6 +222,29 @@ def _apply_store_advisory(verdict: Dict[str, Any],
     }
 
 
+def _apply_retrieval_advisory(verdict: Dict[str, Any],
+                              doc: Dict[str, Any]) -> None:
+    """Retrieval-tier advisory (ncnet_tpu/retrieval/): a coordinator's
+    document carries shard capacity and the served coverage distribution.
+    Like the store advisory this never touches liveness — a DEGRADED
+    coordinator is still answering every query with an honest ``coverage``
+    field; what the operator needs surfaced is HOW MUCH of the database
+    those answers consulted, and which shards the per-row breakdown
+    (``verdict["backends"]``, the shard rows here) says are dead."""
+    if doc.get("role") != "retrieval":
+        return
+    r = doc.get("retrieval") or {}
+    pod = doc.get("pod") or {}
+    verdict["retrieval"] = {
+        "shards_ready": pod.get("ready"),
+        "shards_total": pod.get("total"),
+        "replication": r.get("replication"),
+        "coverage_p50": r.get("coverage_p50"),
+        "coverage_min": r.get("coverage_min"),
+        "min_coverage": r.get("min_coverage"),
+    }
+
+
 def _apply_hbm_warning(verdict: Dict[str, Any], doc: Dict[str, Any],
                        warn_pct: float) -> None:
     """HBM-pressure advisory from the health document's memory section
@@ -300,6 +323,7 @@ def judge_url(url: str, events_path: Optional[str] = None,
     if events_path:
         _apply_replica_backstop(verdict, events_path, factor, min_age)
     _apply_backend_backstop(verdict, doc, factor, min_age)
+    _apply_retrieval_advisory(verdict, doc)
     _apply_hbm_warning(verdict, doc, hbm_warn_pct)
     _apply_store_advisory(verdict, doc)
     return verdict
@@ -423,6 +447,14 @@ def main(argv=None) -> int:
             print(f"  backend {bid} [{b.get('state')}]: last result "
                   f"{b['last_result_age_s']}s ago vs {b['threshold_s']}s "
                   f"({tag})")
+        rt = verdict.get("retrieval")
+        if rt:
+            print(f"  retrieval pod: {rt.get('shards_ready')}/"
+                  f"{rt.get('shards_total')} shards ready (R="
+                  f"{rt.get('replication')}); coverage p50 "
+                  f"{rt.get('coverage_p50')}, min {rt.get('coverage_min')} "
+                  f"vs floor {rt.get('min_coverage')} — answers below the "
+                  "floor arrive DEGRADED, never silent")
         hw = verdict.get("hbm_warning")
         if hw:
             for rid, s in hw["replicas"].items():
