@@ -310,8 +310,7 @@ def bench_jax(res=None):
         nv = 2 * BATCH  # symmetric batch-fold: 2 volumes per pair
         k = KERNELS[0]
         params16 = [
-            {"w": layer["w"].astype(jnp.bfloat16),
-             "b": layer["b"].astype(jnp.bfloat16)}
+            jax.tree.map(lambda a: a.astype(jnp.bfloat16), layer)
             for layer in params["nc"]
         ]
 
@@ -326,6 +325,37 @@ def bench_jax(res=None):
                 return (x + (jnp.sum(out.astype(jnp.float32)) * 1e-12
                              ).astype(x.dtype),)
             return step
+
+        # the chooser's own decision for this stack shape first: when an
+        # ARITHMETIC tier (cp/fft, ISSUE 17) wins, decompose ITS layer
+        # prefixes — the stage attribution must describe the implementation
+        # production actually runs, not only the fused-lane tiers.  The
+        # arithmetic chains consume the plain channels-last volume (no
+        # layout conversion), so layer1 IS prefix1 there.
+        from ncnet_tpu.ops import choose_fused_stack, cp_stack_ranks
+        from ncnet_tpu.ops.conv4d_cp import nc_stack_cp
+        from ncnet_tpu.ops.conv4d_fft import nc_stack_fft
+
+        selected = choose_fused_stack(
+            s, s, s, s, tuple(KERNELS), tuple(CHANNELS),
+            cp_ranks=cp_stack_ranks(params16))
+        if selected in ("cp", "fft"):
+            afn = nc_stack_cp if selected == "cp" else nc_stack_fft
+            stages = {"tier": selected}
+            prev = None
+            for n in range(1, len(params16) + 1):
+                t = _with_retries(
+                    lambda n=n: _timeit_scan(
+                        eps_step(lambda x, n=n: afn(params16[:n], x)),
+                        vol_input, per=BATCH, n_long=8),
+                    label=f"filter_stage_prefix{n}",
+                )
+                if t is None:
+                    return stages
+                stages[f"stack_prefix{n}"] = t
+                stages[f"layer{n}"] = t - (prev if prev is not None else 0.0)
+                prev = t
+            return stages
 
         stages = {}
         # layout-in and layout-out in isolation (cheap scalar-volume ops)
@@ -663,6 +693,110 @@ def bench_jax(res=None):
                 "bench_filter_highres", "bf16"),
             label="mem_dense_filter_highres",
         )
+
+    # ------------------------------------------------------------------
+    # arithmetic-tier scenario (ISSUE 17): the CP / FFT conv4d tiers at the
+    # production stack shape — forced-tier filter walls, the CP chain's
+    # ledger temp bytes, and the default rank's label-free PCK-recovery
+    # proxy (argmax-match agreement vs the dense filter).  Name tokens
+    # perf-store-gate them (`_ms`/`_bytes` lower, `recovery_pct` higher).
+    # TPU-gated like the sparse scenario; NCNET_BENCH_ARITH=1 forces,
+    # =0 skips.
+    # ------------------------------------------------------------------
+    def _arith_gate():
+        import os as _os
+
+        flag = _os.environ.get("NCNET_BENCH_ARITH")
+        on_tpu_ = "TPU" in jax.devices()[0].device_kind
+        return flag not in ("0", "") if flag is not None else on_tpu_
+
+    if _arith_gate():
+        from ncnet_tpu.models.ncnet import ncnet_filter as _ncf
+        from ncnet_tpu.ops import correlation_4d as _c4
+        from ncnet_tpu.ops.conv4d_cp import DEFAULT_CP_RANK as _CP_R
+        from ncnet_tpu.ops.cp_als import decompose_stack as _cp_dec
+
+        feat_shape = jax.eval_shape(
+            lambda p, x: extract_features(cfg16, p, x),
+            params,
+            jax.ShapeDtypeStruct((BATCH, IMAGE, IMAGE, 3), jnp.float32),
+        ).shape
+        params_cp = dict(params)
+        params_cp["nc"], _cp_errs = _cp_dec(params["nc"], _CP_R)
+
+        def _arith_input(key):
+            k1, k2 = jax.random.split(key)
+            return (
+                jax.random.normal(k1, feat_shape, jnp.float32) * 0.03,
+                jax.random.normal(k2, feat_shape, jnp.float32) * 0.03,
+            )
+
+        def _tier_wall(cfg_t, p):
+            def step(carry):
+                fa, fb = carry
+                corr = _c4(fa.astype(jnp.bfloat16), fb.astype(jnp.bfloat16))
+                out = _ncf(cfg_t, p, corr).corr
+                return (fa + (jnp.sum(out.astype(jnp.float32)) * 1e-12
+                              ).astype(fa.dtype), fb)
+
+            return _timeit_scan(step, _arith_input, per=BATCH, n_long=8)
+
+        put(
+            f"filter_wall_ms_cp_r{_CP_R}",
+            lambda: _tier_wall(cfg16.replace(nc_tier="cp"), params_cp),
+            label="filter_cp",
+        )
+        put(
+            "filter_wall_ms_fft",
+            lambda: _tier_wall(cfg16.replace(nc_tier="fft"), params),
+            label="filter_fft",
+        )
+
+        def _cp_memory():
+            from ncnet_tpu.observability import memory as obs_memory
+
+            cfg_cp = cfg16.replace(nc_tier="cp")
+
+            def filt(p, fa, fb):
+                corr = _c4(fa.astype(jnp.bfloat16),
+                           fb.astype(jnp.bfloat16))
+                return _ncf(cfg_cp, p, corr).corr
+
+            sds = jax.ShapeDtypeStruct(feat_shape, jnp.float32)
+            compiled = jax.jit(filt).lower(params_cp, sds, sds).compile()
+            mem = obs_memory.analysis_dict(compiled)
+            if not mem or mem.get("temp_bytes") is None:
+                return None
+            obs_memory.record_program(
+                "bench_filter_cp",
+                f"{feat_shape[1]}x{feat_shape[2]}xb{BATCH}|r={_CP_R}",
+                analysis=compiled, tier="cp", source="bench")
+            return mem["temp_bytes"]
+
+        put("mem_filter_temp_bytes_cp", _cp_memory, label="mem_filter_cp")
+
+        def _cp_recovery():
+            # label-free PCK proxy: the fraction of target cells whose
+            # argmax source match survives the rank-R factorization —
+            # computed fp32 on one deterministic synthetic pair, the same
+            # quantity fine-tuning is asked to recover (ISSUE 17)
+            k1, k2 = jax.random.split(jax.random.key(7))
+            fa = jax.random.normal(k1, feat_shape, jnp.float32) * 0.03
+            fb = jax.random.normal(k2, feat_shape, jnp.float32) * 0.03
+            vd = jax.jit(
+                lambda p, a, b_: _ncf(cfg, p, _c4(a, b_)).corr
+            )(params, fa, fb)
+            vc = jax.jit(
+                lambda p, a, b_: _ncf(
+                    cfg.replace(nc_tier="cp"), p, _c4(a, b_)).corr
+            )(params_cp, fa, fb)
+            b, ha, wa, hb, wb = vd.shape
+            bd = jnp.argmax(vd.reshape(b, ha * wa, hb * wb), axis=1)
+            bc = jnp.argmax(vc.reshape(b, ha * wa, hb * wb), axis=1)
+            return round(
+                float(jnp.mean((bd == bc).astype(jnp.float32))) * 100, 2)
+
+        put("cp_rank_pck_recovery_pct", _cp_recovery, label="cp_recovery")
 
     # correlation-only (BASELINE north-star: ms/pair 4D-corr fwd) — feature
     # shape derived from the configured backbone via eval_shape (free), so a

@@ -30,6 +30,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default="trained_models")
     p.add_argument("--fe_finetune_params", type=int, default=0,
                    help="number of backbone blocks to finetune")
+    p.add_argument("--finetune_cp_rank", type=int, default=0,
+                   help="decompose the (loaded) NC kernels to rank-R CP "
+                        "factors and fine-tune the FACTORS with the trunk "
+                        "frozen (tools/cp_decompose.py recipe); 0 = dense "
+                        "training")
     p.add_argument("--backbone", type=str, default="resnet101")
     p.add_argument("--backbone_weights", type=str, default="",
                    help="torchvision state_dict (.pth) to initialize the trunk "
@@ -125,6 +130,7 @@ def main(argv=None) -> int:
         result_model_fn=args.result_model_fn,
         result_model_dir=args.result_model_dir,
         fe_finetune_params=args.fe_finetune_params,
+        finetune_cp_rank=args.finetune_cp_rank,
         seed=args.seed,
         num_workers=args.num_workers,
         remat_nc_layers=args.remat_nc_layers,

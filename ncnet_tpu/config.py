@@ -46,6 +46,12 @@ class ModelConfig:
     sparse_halo: int = -1                # fine-cell patch halo around each
                                          # candidate block; -1 = auto (one
                                          # coarse ring = factor cells)
+    # force a named ARITHMETIC filter tier ('cp' | 'fft'; ops/conv4d_cp.py,
+    # ops/conv4d_fft.py) through the NC stack, bypassing choose_fused_stack's
+    # FLOP gates.  '' (default) lets the chooser pick.  'cp' requires CP
+    # factors on every NC layer (tools/cp_decompose.py); the fine-tune path
+    # (TrainConfig.finetune_cp_rank) sets this so factor gradients flow.
+    nc_tier: str = ""
     half_precision: bool = False         # bf16 volume + NC weights (TPU-native fp16 analog)
     backbone_bf16: bool = False          # run the (frozen) trunk in bfloat16 —
                                          # TPU-native fast path with no reference
@@ -76,6 +82,13 @@ class TrainConfig:
     result_model_fn: str = "checkpoint_adam"
     result_model_dir: str = "trained_models"
     fe_finetune_params: int = 0
+    # CP fine-tune (ISSUE 17; Lebedev et al.): > 0 decomposes every NC
+    # kernel of the (loaded) dense params to rank-R CP factors
+    # (tools/cp_decompose.py) and trains the FACTORS through the 'cp' tier
+    # with the trunk frozen — the paper's PCK-recovery recipe.  The model
+    # config is forced to nc_tier='cp' for the run so the gradient path
+    # matches serving.  0 = dense training, the unchanged default.
+    finetune_cp_rank: int = 0
     seed: int = 1
     num_workers: int = 0
     eval_num_workers: int = 4

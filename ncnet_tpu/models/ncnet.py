@@ -381,6 +381,7 @@ def neigh_consensus(
     custom_grad: "bool | Sequence[Dict[str, str]]" = False,
     allow_pallas: bool = True,
     require_vjp: bool = False,
+    force_tier: Optional[str] = None,
 ) -> jnp.ndarray:
     """Neighbourhood-consensus filtering of the 4D volume.
 
@@ -424,6 +425,19 @@ def neigh_consensus(
     ``nc_pallas=False``), so the forward must not outrun its backward.
     Where the VJP tier is unavailable the call keeps the plain XLA stack,
     exactly the pre-r7 training path.
+
+    ``force_tier``: route the stack through a named ARITHMETIC tier
+    unconditionally — ``'cp'`` (rank-R separable chain; every layer must
+    carry factors, see tools/cp_decompose.py) or ``'fft'`` (spectral
+    conv) — bypassing the chooser's gates.  The explicit seam for
+    ``ModelConfig.nc_tier`` and the CP fine-tune path, which must train
+    the factors even where the arithmetic gate would keep the dense
+    tiers; the forced tier is still announced to the tier machinery
+    (``note_forced_tier``) so quality events carry the honest label.
+    Round 17: without a force, ``choose_fused_stack`` considers the
+    arithmetic tiers wherever the layer structure permits — any backend,
+    any dtype — and they outrank the Pallas ladder when their FLOP gates
+    clear (training's ``require_vjp`` path never auto-selects them).
     """
     if custom_grad is True:
         convs = [conv4d_same] * len(nc_params)
@@ -455,23 +469,97 @@ def neigh_consensus(
 
     x = corr[..., None]  # (B, hA, wA, hB, wB, 1)
 
-    # params must already be bf16 (ncnet_filter casts them): mixed
-    # fp32-params/bf16-volume calls keep the XLA path, where XLA's own
-    # promotion rules apply, instead of a silent bf16 downcast
-    pallas_eligible = (
-        allow_pallas and not remat_layers and custom_grad is False
-        and x.dtype == jnp.bfloat16
+    # params must already be bf16 (ncnet_filter casts them) for the Pallas
+    # tiers: mixed fp32-params/bf16-volume calls keep them off, where XLA's
+    # own promotion rules apply, instead of a silent bf16 downcast.  The
+    # ARITHMETIC tiers (cp/fft, round 17) are plain XLA with no dtype or
+    # backend requirement, so eligibility splits: the chooser is consulted
+    # whenever the layer STRUCTURE permits, and ``pallas_ok`` tells it
+    # whether the Pallas ladder is additionally on the table.
+    bf16_ok = (
+        x.dtype == jnp.bfloat16
         and all(layer["w"].dtype == jnp.bfloat16 for layer in nc_params)
+    )
+    tier_eligible = (
+        allow_pallas and not remat_layers and custom_grad is False
     )
     use_fused = False
     fused_tap_swap = False
-    if pallas_eligible:
-        from ncnet_tpu.ops import choose_fused_stack, choose_fused_vjp
+    arith_tier = None
+    b, ha, wa, hb, wb = corr.shape
+    kernels = tuple(layer["w"].shape[0] for layer in nc_params)
+    channels = tuple(layer["w"].shape[5] for layer in nc_params)
+    if force_tier:
+        from ncnet_tpu.ops import cp_stack_ranks, note_forced_tier
 
-        b, ha, wa, hb, wb = corr.shape
-        kernels = tuple(layer["w"].shape[0] for layer in nc_params)
-        channels = tuple(layer["w"].shape[5] for layer in nc_params)
-        if symmetric and (ha, wa) != (hb, wb) and tap_swap_fusable(nc_params):
+        if force_tier not in ("cp", "fft"):
+            raise ValueError(
+                f"force_tier must be 'cp' or 'fft', got {force_tier!r}")
+        if force_tier == "cp" and cp_stack_ranks(nc_params) is None:
+            raise ValueError(
+                "force_tier='cp' needs CP factors on every NC layer "
+                "(tools/cp_decompose.py attaches them)")
+        arith_tier = force_tier
+        note_forced_tier(ha, wa, hb, wb, kernels, channels, force_tier)
+    elif tier_eligible and require_vjp:
+        # the require_vjp (TRAINING) gate fuses only where the resident
+        # BACKWARD engages — a fused forward whose VJP replays XLA is a net
+        # loss under value_and_grad; its forward side needs no extra check
+        # (nc_stack_fused's impl dispatcher falls back per shape anyway).
+        # The arithmetic tiers are never auto-selected here: training
+        # defaults keep the proven resident-VJP path, and the CP fine-tune
+        # path opts in explicitly via ``force_tier``.
+        if bf16_ok:
+            from ncnet_tpu.ops import choose_fused_stack, choose_fused_vjp
+
+            if symmetric and (ha, wa) != (hb, wb) \
+                    and tap_swap_fusable(nc_params):
+                # the tap-swapped symmetric pass is itself a 2-layer chain
+                # (see below); training on this class additionally needs
+                # the Pallas backward of the block-diagonal chain
+                c = nc_params[0]["w"].shape[5]
+                fused_tap_swap = choose_fused_stack(
+                    ha, wa, hb, wb, kernels, (2 * c, 2)
+                ) == "resident" and choose_fused_vjp(
+                    ha, wa, hb, wb, kernels, (2 * c, 2)
+                ) is not None
+            shapes = {(ha, wa, hb, wb)}
+            if symmetric and (ha, wa) != (hb, wb) \
+                    and not tap_swap_fusable(nc_params):
+                shapes.add((hb, wb, ha, wa))
+            use_fused = all(
+                choose_fused_vjp(*s, kernels, channels) is not None
+                for s in shapes
+            )
+    elif tier_eligible:
+        from ncnet_tpu.ops import choose_fused_stack, cp_stack_ranks
+
+        cp_ranks = cp_stack_ranks(nc_params)
+        shapes = [(ha, wa, hb, wb)]
+        if symmetric and (ha, wa) != (hb, wb) \
+                and not tap_swap_fusable(nc_params):
+            # only the rectangular two-pass fallback runs stack() on the
+            # A<->B transposed volume — gate that orientation only when it
+            # will actually execute (a square volume batch-folds and the
+            # tap-swap class never transposes)
+            shapes.append((hb, wb, ha, wa))
+        decisions = [
+            choose_fused_stack(*s, kernels, channels,
+                               cp_ranks=cp_ranks, pallas_ok=bf16_ok)
+            for s in shapes
+        ]
+        if decisions[0] in ("cp", "fft") \
+                and all(d == decisions[0] for d in decisions):
+            # an arithmetic tier won every orientation: route stack()
+            # straight through its differentiable XLA body (both gates are
+            # symmetric under the A<->B swap, so a split can only mean a
+            # demotion landed mid-consult — then the generic dispatch below
+            # re-asks per shape)
+            arith_tier = decisions[0]
+        else:
+            use_fused = bf16_ok and all(d is not None for d in decisions)
+        if bf16_ok and arith_tier is None and symmetric \
+                and (ha, wa) != (hb, wb) and tap_swap_fusable(nc_params):
             # the tap-swapped symmetric pass is itself a 2-layer chain
             # (1 → 2C fused first layer, then a BLOCK-DIAGONAL 2C → 2 final
             # layer whose two output channels are the two stacks' outputs,
@@ -481,35 +569,22 @@ def neigh_consensus(
             fused_tap_swap = choose_fused_stack(
                 ha, wa, hb, wb, kernels, (2 * c, 2)
             ) == "resident"
-            if require_vjp:
-                # training on this class additionally needs the Pallas
-                # backward of the block-diagonal chain
-                fused_tap_swap = fused_tap_swap and choose_fused_vjp(
-                    ha, wa, hb, wb, kernels, (2 * c, 2)
-                ) is not None
-        shapes = {(ha, wa, hb, wb)}
-        if symmetric and (ha, wa) != (hb, wb) \
-                and not tap_swap_fusable(nc_params):
-            # only the rectangular two-pass fallback runs stack() on the
-            # A<->B transposed volume — gate that orientation only when it
-            # will actually execute (a square volume batch-folds and the
-            # tap-swap class never transposes)
-            shapes.add((hb, wb, ha, wa))
-        # the require_vjp (TRAINING) gate fuses only where the resident
-        # BACKWARD engages — a fused forward whose VJP replays XLA is a net
-        # loss under value_and_grad; its forward side needs no extra check
-        # (nc_stack_fused's impl dispatcher falls back per shape anyway)
-        chooser = choose_fused_vjp if require_vjp else choose_fused_stack
-        use_fused = all(
-            chooser(*s, kernels, channels) is not None for s in shapes
-        )
 
     def stack(x: jnp.ndarray) -> jnp.ndarray:
-        # every layer takes and emits the plain channels-last volume.  The
-        # fused-lane Pallas chain replaces the whole stack when the shape
-        # class fits (see ``allow_pallas`` above); otherwise conv4d's
-        # 'auto' chooser (ops/conv4d.py) remains the single authority for
-        # the per-layer MXU formulation
+        # every layer takes and emits the plain channels-last volume.  An
+        # arithmetic tier (chosen or forced) replaces the whole stack with
+        # its differentiable XLA chain; the fused-lane Pallas chain does so
+        # when the shape class fits (see ``allow_pallas`` above); otherwise
+        # conv4d's 'auto' chooser (ops/conv4d.py) remains the single
+        # authority for the per-layer MXU formulation
+        if arith_tier == "cp":
+            from ncnet_tpu.ops import nc_stack_cp
+
+            return nc_stack_cp(nc_params, x)
+        if arith_tier == "fft":
+            from ncnet_tpu.ops import nc_stack_fft
+
+            return nc_stack_fft(nc_params, x)
         if use_fused:
             from ncnet_tpu.ops.nc_fused_lane import nc_stack_fused
 
@@ -525,11 +600,11 @@ def neigh_consensus(
         # the variant chooser itself, whether every layer keeps a channel-
         # folding formulation at the doubled batch; otherwise run the two
         # passes sequentially (their buffer lifetimes then barely overlap)
-        b, ha, wa, hb, wb = corr.shape
         # the fused Pallas tiers stream one row at a time (per-volume VMEM
         # working set, batch only widens the grid), so the XLA chooser's
-        # fold-memory demotion does not apply to them
-        fold_ok = use_fused or all(
+        # fold-memory demotion does not apply to them; the arithmetic tiers
+        # never materialize a k⁴-folded patch matrix at all
+        fold_ok = use_fused or arith_tier is not None or all(
             choose_conv4d_variant(
                 layer["w"].shape[4], layer["w"].shape[5], hb, wb,
                 shape_a=(ha, wa), kernel=tuple(layer["w"].shape[:4]),
@@ -547,7 +622,7 @@ def neigh_consensus(
             xt = jnp.transpose(x, (0, 3, 4, 1, 2, 5))  # swap (hA,wA)↔(hB,wB)
             y = stack(jnp.concatenate([x, xt], axis=0))
             out = y[:b] + jnp.transpose(y[b:], (0, 3, 4, 1, 2, 5))
-        elif tap_swap_fusable(nc_params):
+        elif tap_swap_fusable(nc_params) and arith_tier is None:
             # rectangular volumes cannot batch-fold, but the transpose pass
             # is avoidable algebraically: transposition commutes with ReLU
             # and swaps a cubic kernel's A/B tap groups, so
@@ -761,8 +836,14 @@ def coarse2fine_filter(config: ModelConfig, params, fa: jnp.ndarray,
         jnp.transpose(coarse.corr, (0, 3, 4, 1, 2)), config.sparse_topk)
 
     def stack_fn(vol: jnp.ndarray) -> jnp.ndarray:
+        # the folded-tile batch consults the SAME tier chooser as the dense
+        # volume — the arithmetic tiers (cp/fft) and the Pallas ladder all
+        # apply per tile shape, so a CP win compounds on the coarse pass
+        # and again on every fine tile (ISSUE 17); config.nc_tier forces
+        # the arithmetic tier here exactly like the dense path
         return neigh_consensus(nc_params, vol,
-                               symmetric=config.symmetric_mode)
+                               symmetric=config.symmetric_mode,
+                               force_tier=config.nc_tier or None)
 
     def stack_fn_t(vol: jnp.ndarray) -> jnp.ndarray:
         # the role-swapped tile family's stack: the symmetric stack commutes
@@ -798,7 +879,10 @@ def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
     stack on the forward.  ``nc_pallas_vjp``: the TRAINING form of that
     permission — fuse only where the resident Pallas BACKWARD also engages
     (``require_vjp`` in :func:`neigh_consensus`); training/loss.py passes
-    both True since round 7."""
+    both True since round 7.  ``config.nc_tier`` (round 17) forces the
+    named arithmetic tier ('cp'/'fft') through :func:`neigh_consensus`'s
+    ``force_tier`` seam — the CP fine-tune path sets it so factor
+    gradients flow regardless of the chooser's FLOP gate."""
     nc_params = params["nc"]
     if config.half_precision:
         nc_params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), nc_params)
@@ -811,7 +895,8 @@ def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,
                            remat_layers=remat_nc_layers,
                            custom_grad=nc_custom_grad,
                            allow_pallas=nc_pallas,
-                           require_vjp=nc_pallas_vjp)
+                           require_vjp=nc_pallas_vjp,
+                           force_tier=config.nc_tier or None)
     corr = mutual_matching(corr)
     return NCNetOutput(corr, delta4d)
 

@@ -275,7 +275,11 @@ _HIGHER_TOKENS = ("pck", "pairs_per_s", "pairs_per_sec", "qps",
                   # the fraction of the database a sweep consulted — a
                   # falling coverage at fixed shard health is replication
                   # or planning regressing
-                  "coverage_pct")
+                  "coverage_pct",
+                  # CP tier (ops/conv4d_cp.py): argmax-match agreement of
+                  # the rank-R filtered volume vs the dense filter — the
+                  # label-free PCK-recovery proxy the bench tracks per rank
+                  "recovery_pct")
 _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  "_step_s", "_wall_s",
                  # diffuse match distributions are worse: entropy gates

@@ -235,14 +235,25 @@ def active_tier(eligible: bool = True, stage: str = "forward") -> str:
     coarse-to-fine sparse path, the signals describe THAT pipeline's
     volume — regardless of which fused-stack tier the coarse/tile stacks
     used inside it, and regardless of precision eligibility (the sparse
-    pipeline runs in fp32 too)."""
+    pipeline runs in fp32 too).
+
+    The ARITHMETIC forward tiers ('cp'/'fft', round 17) likewise pass
+    through regardless of ``eligible``: they are precision-agnostic, fp32
+    programs consult the chooser for them (and can force them via
+    ``ModelConfig.nc_tier``), so when the stage's latest decision is one
+    of them the signals really did flow through that arithmetic — the
+    ``eligible`` guard exists only to keep Pallas-tier labels off
+    programs that could not run Pallas."""
     from ncnet_tpu.ops import last_selected_tier
 
     if stage == "forward" and last_selected_tier("pipeline") == "coarse2fine":
         return "coarse2fine"
+    selected = last_selected_tier(stage)
+    if stage == "forward" and selected in ("cp", "fft"):
+        return selected
     if not eligible:
         return "xla"
-    return last_selected_tier(stage) or "xla"
+    return selected or "xla"
 
 
 def emit_quality(scope: str, signals: Dict[str, Any], *,

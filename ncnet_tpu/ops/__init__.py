@@ -30,7 +30,21 @@ from ncnet_tpu.ops.nc_fused_lane import (  # noqa: F401
     fused_lane_feasible,
     nc_stack_fused,
     nc_stack_fused_lane,
+    note_forced_tier,
     reset_fused_tier_demotions,
+)
+from ncnet_tpu.ops.conv4d_cp import (  # noqa: F401
+    cp_apply_layer,
+    cp_feasible,
+    cp_reconstruct,
+    cp_stack_ranks,
+    exact_cp_factors,
+    nc_stack_cp,
+)
+from ncnet_tpu.ops.conv4d_fft import (  # noqa: F401
+    conv4d_fft,
+    fft_feasible,
+    nc_stack_fft,
 )
 from ncnet_tpu.ops.nc_fused_lane_vjp import (  # noqa: F401
     choose_fused_vjp,
@@ -85,6 +99,16 @@ __all__ = [
     "conv4d_transpose_weights",
     "choose_fused_stack",
     "choose_fused_vjp",
+    "conv4d_fft",
+    "cp_apply_layer",
+    "cp_feasible",
+    "cp_reconstruct",
+    "cp_stack_ranks",
+    "exact_cp_factors",
+    "fft_feasible",
+    "nc_stack_cp",
+    "nc_stack_fft",
+    "note_forced_tier",
     "demote_fused_tier",
     "last_selected_tier",
     "demoted_fused_tiers",

@@ -1,0 +1,229 @@
+"""CP-decomposed conv4d: the rank-R separable tier of the NC filter.
+
+A dense NC layer contracts a ``(k, k, k, k, C_in, C_out)`` kernel against
+every volume cell — ``2·cells·k⁴·C_in·C_out`` FLOPs, the k⁴ wall ROADMAP
+item 2 names.  Following *Speeding-up Convolutional Neural Networks Using
+Fine-tuned CP-Decomposition* (Lebedev et al., PAPERS.md), the kernel is
+factorized as a rank-R canonical polyadic (CP) sum of separable terms::
+
+    w[p,q,r,s,c,o] = Σ_ρ  ka[p,ρ]·kwa[q,ρ]·kb[r,ρ]·kwb[s,ρ]·cin[c,ρ]·cout[ρ,o]
+
+and the layer becomes a chain of cheap contractions — a ``C_in→R``
+pointwise map, four 1-D "same" cross-correlations (one per spatial dim,
+each a k-tap depthwise filter over the R rank channels), and an ``R→C_out``
+pointwise map + bias::
+
+    FLOPs ≈ 2·cells·R·(C_in + C_out + 4k)    vs    2·cells·k⁴·C_in·C_out
+
+At the PF-Pascal/InLoc k=5 16→16 layer and the default rank 16 that is a
+~190× algebraic cut.  The rank is an accuracy knob: factors come from
+``tools/cp_decompose.py`` (HOSVD init + ALS refinement of a trained dense
+checkpoint) and PCK is recovered by fine-tuning them with the frozen trunk
+(``train.py --finetune_cp_rank R`` — the paper's recipe).
+
+Tier contract (ops/nc_fused_lane.py): a layer OPTS IN by carrying a
+``"cp"`` factor dict beside its dense ``"w"``/``"b"`` — the chooser
+considers the ``"cp"`` tier only when every layer has factors
+(:func:`cp_stack_ranks`) AND the arithmetic gate (:func:`cp_feasible`)
+predicts a FLOP win over the dense stack, and gates it behind a real
+compile probe (:func:`cp_compiles`, with a memory-ledger row).  The chain
+is plain differentiable XLA — no Pallas, no custom VJP — so it runs on any
+backend and any dtype, and the fine-tune path trains the factors directly
+through it.
+
+Exactness seam for tests: :func:`exact_cp_factors` builds a rank-
+``k⁴·C_in`` factorization that reconstructs ANY kernel exactly (one-hot
+spatial/input factors; the kernel's fibers as ``cout``), so the rank-full
+chain must match dense ``conv4d`` to fp32 tolerance on every shape class.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# the checkpoint-conversion default (tools/cp_decompose.py, bench.py): at
+# the k=5 16→16 InLoc layer R=16 keeps the rank channels as wide as the
+# dense channels (HOSVD captures the kernel's leading subspace exactly at
+# C=16) while cutting layer FLOPs ~190×
+DEFAULT_CP_RANK = 16
+
+# the arithmetic gate's win margin: predicted CP FLOPs must undercut the
+# dense stack by at least this factor before the tier engages — the chain
+# is 6 XLA ops per layer vs 1, so a marginal FLOP tie loses to launch and
+# layout overhead
+_CP_GATE_MARGIN = 0.75
+
+_FACTOR_KEYS = ("ka", "kwa", "kb", "kwb", "cin", "cout")
+
+
+def cp_stack_ranks(nc_params: Sequence[dict]) -> Optional[Tuple[int, ...]]:
+    """Per-layer CP ranks when EVERY layer carries factors, else None (the
+    chooser's opt-in signal: a stack without full factor coverage cannot
+    route through the CP tier)."""
+    ranks = []
+    for layer in nc_params:
+        cp = layer.get("cp") if isinstance(layer, dict) else None
+        if not cp or any(k not in cp for k in _FACTOR_KEYS):
+            return None
+        ranks.append(int(cp["cout"].shape[0]))
+    return tuple(ranks) if ranks else None
+
+
+def _corr1d_same(y: jnp.ndarray, taps: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Per-rank-channel 1-D "same" cross-correlation along ``axis``:
+    ``out[i] = Σ_p y[i + p - k//2] · taps[p]`` with zero padding — the
+    one-dimensional factor of conv4d's cross-correlation semantics.
+    ``y``: ``(..., R)`` with the rank dim last; ``taps``: ``(k, R)``."""
+    k = taps.shape[0]
+    d = k // 2
+    n = y.shape[axis]
+    pad = [(0, 0)] * y.ndim
+    pad[axis] = (d, d)
+    yp = jnp.pad(y, pad)
+    out = None
+    for p in range(k):
+        term = lax.slice_in_dim(yp, p, p + n, axis=axis) * taps[p]
+        out = term if out is None else out + term
+    return out
+
+
+def cp_apply_layer(x: jnp.ndarray, cp: Dict[str, jnp.ndarray],
+                   bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """One CP-decomposed conv4d layer ("same", stride 1) on the volume
+    ``x`` ``(B, hA, wA, hB, wB, C_in)`` → ``(..., C_out)``.
+
+    The spatial taps separate because the CP term is an outer product: the
+    four 1-D passes compose to exactly the rank's 4-D tap tensor, and the
+    rank sum rides the R channel dim through all four."""
+    dtype = x.dtype
+    fac = {k: cp[k].astype(dtype) for k in _FACTOR_KEYS}
+    y = jnp.einsum("...c,cr->...r", x, fac["cin"])
+    for axis, key in ((1, "ka"), (2, "kwa"), (3, "kb"), (4, "kwb")):
+        y = _corr1d_same(y, fac[key], axis)
+    y = jnp.einsum("...r,ro->...o", y, fac["cout"])
+    if bias is not None:
+        y = y + bias.astype(dtype)
+    return y
+
+
+def nc_stack_cp(nc_params: List[dict], x: jnp.ndarray) -> jnp.ndarray:
+    """The full [conv4d_same + bias + ReLU]×N stack through each layer's CP
+    factors — the "cp" tier's stack body (differentiable plain XLA; the
+    fine-tune path takes gradients w.r.t. the factors through this)."""
+    for layer in nc_params:
+        x = jax.nn.relu(cp_apply_layer(x, layer["cp"], layer["b"]))
+    return x
+
+
+def cp_reconstruct(cp: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Materialize the dense ``(kA, kWA, kB, kWB, C_in, C_out)`` kernel a
+    factor dict represents (tests / conversion-error reporting)."""
+    return jnp.einsum("pr,qr,sr,tr,cr,ro->pqstco",
+                      cp["ka"], cp["kwa"], cp["kb"], cp["kwb"],
+                      cp["cin"], cp["cout"])
+
+
+def exact_cp_factors(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """A rank-``k⁴·C_in`` CP factorization that is EXACT for any kernel:
+    component ``ρ = (p,q,r,s,c)`` gets one-hot spatial/input factors and
+    ``cout[ρ] = w[p,q,r,s,c,:]``.  The parity fixture for the tier tests —
+    rank-full CP must equal dense conv4d to float tolerance."""
+    dims = tuple(w.shape[:5])  # (kA, kWA, kB, kWB, C_in)
+    c_out = w.shape[5]
+    rank = 1
+    for n in dims:
+        rank *= n
+
+    def mode_factor(mode: int) -> jnp.ndarray:
+        # (dim_mode, rank): e_{idx_mode(ρ)} per component ρ = (p,q,r,s,c)
+        # row-major — the mode's identity broadcast over the other modes
+        n = dims[mode]
+        shape = [1] * 5
+        shape[mode] = n
+        t = jnp.broadcast_to(
+            jnp.eye(n, dtype=w.dtype).reshape((n,) + tuple(shape)),
+            (n,) + dims)
+        return t.reshape(n, rank)
+
+    factors = {key: mode_factor(m)
+               for m, key in enumerate(("ka", "kwa", "kb", "kwb", "cin"))}
+    factors["cout"] = w.reshape(rank, c_out)
+    return factors
+
+
+# ---------------------------------------------------------------------------
+# arithmetic gate + compile probe (the chooser's two checks)
+# ---------------------------------------------------------------------------
+
+
+def cp_layer_flops(cells: int, k: int, c_in: int, c_out: int,
+                   rank: int) -> int:
+    """Predicted FLOPs of one CP layer on a ``cells``-cell volume: the
+    C_in→R map, four k-tap 1-D passes over R channels, and the R→C_out
+    map (multiply-adds counted as 2)."""
+    return 2 * cells * rank * (c_in + c_out + 4 * k)
+
+
+def dense_layer_flops(cells: int, k: int, c_in: int, c_out: int) -> int:
+    """Direct-k⁴ FLOPs of one dense conv4d layer (the baseline both
+    arithmetic tiers' gates compare against)."""
+    return 2 * cells * (k ** 4) * c_in * c_out
+
+
+def cp_feasible(ha: int, wa: int, hb: int, wb: int,
+                kernels: Sequence[int], channels: Sequence[int],
+                ranks: Sequence[int]) -> bool:
+    """The CP tier's arithmetic gate: odd kernels (the "same"-pad shape
+    class conv4d serves) and a predicted whole-stack FLOP win of at least
+    ``_CP_GATE_MARGIN`` over the dense stack.  A rank high enough to lose
+    the arithmetic (rank-full parity factors on a tiny kernel) keeps the
+    dense tiers — exactness is the test fixture's job, not the chooser's."""
+    if len(ranks) != len(kernels) or any(k % 2 == 0 for k in kernels):
+        return False
+    cells = ha * wa * hb * wb
+    cp = dense = 0
+    c_in = 1
+    for k, c_out, r in zip(kernels, channels, ranks):
+        cp += cp_layer_flops(cells, k, c_in, c_out, r)
+        dense += dense_layer_flops(cells, k, c_in, c_out)
+        c_in = c_out
+    return cp <= _CP_GATE_MARGIN * dense
+
+
+@functools.lru_cache(maxsize=16)
+def cp_compiles(ha, wa, hb, wb, kernels, channels, ranks) -> bool:
+    """Real-compile probe for the CP chain (cached per shape class) — the
+    chain is plain XLA so failures are rare, but the tier discipline
+    (ops/nc_fused_lane.py) is uniform: every tier proves an actual compile
+    before the chooser routes traffic, and the probe's AOT memory analysis
+    lands in the ledger as the tier's temp-bytes evidence."""
+    try:
+        x = jax.ShapeDtypeStruct((1, ha, wa, hb, wb, 1), jnp.float32)
+        params = []
+        c_in = 1
+        for k, c_out, r in zip(kernels, channels, ranks):
+            params.append({
+                "cp": {
+                    "ka": jax.ShapeDtypeStruct((k, r), jnp.float32),
+                    "kwa": jax.ShapeDtypeStruct((k, r), jnp.float32),
+                    "kb": jax.ShapeDtypeStruct((k, r), jnp.float32),
+                    "kwb": jax.ShapeDtypeStruct((k, r), jnp.float32),
+                    "cin": jax.ShapeDtypeStruct((c_in, r), jnp.float32),
+                    "cout": jax.ShapeDtypeStruct((r, c_out), jnp.float32),
+                },
+                "b": jax.ShapeDtypeStruct((c_out,), jnp.float32),
+            })
+            c_in = c_out
+        compiled = jax.jit(nc_stack_cp).lower(params, x).compile()
+        from ncnet_tpu.ops.nc_fused_lane import _record_probe_memory
+
+        _record_probe_memory("nc_cp_probe", "cp", ha, wa, hb, wb,
+                             kernels, channels, compiled)
+        return True
+    except Exception:  # noqa: BLE001 — any compile failure demotes, never raises
+        return False

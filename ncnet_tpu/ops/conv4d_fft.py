@@ -1,0 +1,198 @@
+"""FFT-domain conv4d: the spectral tier of the NC filter.
+
+Direct "same" conv4d pays ``2·cells·k⁴·C_in·C_out`` FLOPs; following *Fast
+Training of Convolutional Networks through FFTs* (Mathieu, Henaff & LeCun,
+PAPERS.md) the convolution is evaluated in the frequency domain instead —
+``rfftn`` over the four spatial dims (each zero-padded to ``n+k−1``, so the
+circular theorem computes the LINEAR correlation exactly), a per-frequency
+complex contraction over C_in, ``irfftn``, and an exact crop back to the
+"same" output window.  Transform cost grows like ``S·log S`` of the padded
+volume while the spectral multiply is k-independent, so the win grows with
+k — at k=3 the gate below rejects it, at the k=5 InLoc arch it clears.
+
+Semantics: bit-exact in exact arithmetic to ``ops/conv4d.py``'s "same"
+cross-correlation (zero pad ``k//2``, stride/dilation 1).  The correlation
+theorem gives ``c[n] = Σ_j x[(n+j) mod S]·w[j] = IFFT(FFT(x)·conj(FFT(w)))``;
+with both operands zero-padded to ``S = n+k−1`` no wraparound term touches a
+nonzero product, and the "same" window is ``out[i] = c[(i − k//2) mod S]`` —
+a roll by ``k//2`` and a leading slice per dim (the negative indices wrap
+into the tail positions the zero padding vacated).  Everything is computed
+in f32 (complex64 spectra) and cast back to the input dtype, so the bf16
+path gets an f32-accumulated result like the MXU tiers.
+
+Tier contract (ops/nc_fused_lane.py): shape-only opt-in — no per-layer
+state, so the chooser consults :func:`fft_feasible` (an arithmetic gate
+with a VPU-vs-MXU penalty on the spectral FLOPs, plus a spectrum-bytes
+budget: the weight spectrum is ``C_in·C_out`` padded volumes and is the
+known FFT-conv memory blowup at large spatial dims) and a real compile
+probe (:func:`fft_compiles`, memory-ledger row).  Plain differentiable
+XLA — any backend, any dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# real-FFT cost model: ~``coeff·S·log2 S`` real FLOPs per S-cell 4-D
+# transform (split-radix ballpark; a heuristic constant for the gate, not a
+# measurement)
+_FFT_COST_COEFF = 2.5
+# spectral work runs on the VPU (complex mul/add) while the dense baseline
+# rides the MXU at far higher FLOP throughput — penalize spectral FLOPs by
+# this factor before comparing.  With it the k=3 NC arches keep the dense
+# tiers and the k=5 arch clears the gate (the paper's crossover direction).
+_FFT_VPU_PENALTY = 4.0
+# weight-spectrum budget: Cin·Cout complex64 padded volumes must fit this
+# many bytes or the tier is rejected (e.g. the 56M-cell InLoc volume's
+# 16→16 layer would need ~59 GB).  Env-overridable for probes.
+_FFT_TEMP_BUDGET = int(os.environ.get(
+    "NCNET_FFT_TEMP_BUDGET", str(2 * 1024 ** 3)))
+
+_SPATIAL_AXES = (1, 2, 3, 4)
+
+
+def _rfft4(x: jnp.ndarray, sizes, axes) -> jnp.ndarray:
+    """Real 4-D FFT as rfft(last axis) ∘ fftn(first three): XLA's FFT op
+    tops out at 3 contiguous dims, so the fourth runs as its own pass —
+    the transforms commute, the composition is the exact 4-D transform."""
+    y = jnp.fft.rfft(x, n=sizes[3], axis=axes[3])
+    return jnp.fft.fftn(y, s=sizes[:3], axes=axes[:3])
+
+
+def _irfft4(y: jnp.ndarray, sizes, axes) -> jnp.ndarray:
+    y = jnp.fft.ifftn(y, s=sizes[:3], axes=axes[:3])
+    return jnp.fft.irfft(y, n=sizes[3], axis=axes[3])
+
+
+def conv4d_fft(x: jnp.ndarray, weight: jnp.ndarray,
+               bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """"Same" 4D cross-correlation + bias via the frequency domain.
+
+    Args:
+      x:      ``(B, hA, wA, hB, wB, C_in)`` channels-last volume.
+      weight: ``(kA, kWA, kB, kWB, C_in, C_out)`` (odd taps).
+      bias:   ``(C_out,)`` or None.
+    Returns:
+      ``(B, hA, wA, hB, wB, C_out)`` in ``x.dtype`` (f32 compute inside).
+    """
+    dtype = x.dtype
+    spatial = tuple(x.shape[a] for a in _SPATIAL_AXES)
+    taps = tuple(weight.shape[:4])
+    assert all(k % 2 == 1 for k in taps), (
+        f"conv4d_fft serves the same-pad odd-tap shape class, got {taps}")
+    sizes = tuple(n + k - 1 for n, k in zip(spatial, taps))
+    xf = _rfft4(x.astype(jnp.float32), sizes, _SPATIAL_AXES)
+    wf = _rfft4(weight.astype(jnp.float32), sizes, (0, 1, 2, 3))
+    # correlation theorem: FFT(x)·conj(FFT(w)), contracting C_in per bin
+    yf = jnp.einsum("bpqrsc,pqrsco->bpqrso", xf, jnp.conj(wf))
+    c = _irfft4(yf, sizes, _SPATIAL_AXES)
+    # exact "same" crop: out[i] = c[(i − k//2) mod S] per dim — the wrapped
+    # entries c[S−t] hold the left-edge rows (only zero-padding positions
+    # contribute to their circular sum, see module docstring)
+    c = jnp.roll(c, shift=tuple(k // 2 for k in taps), axis=_SPATIAL_AXES)
+    out = c[:, :spatial[0], :spatial[1], :spatial[2], :spatial[3], :]
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dtype)
+
+
+def nc_stack_fft(nc_params: List[dict], x: jnp.ndarray) -> jnp.ndarray:
+    """The full [conv4d_same + bias + ReLU]×N stack through
+    :func:`conv4d_fft` — the "fft" tier's stack body."""
+    for layer in nc_params:
+        x = jax.nn.relu(conv4d_fft(x, layer["w"], layer["b"]))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# arithmetic gate + compile probe (the chooser's two checks)
+# ---------------------------------------------------------------------------
+
+
+def _fft_cost(cells: int) -> float:
+    return _FFT_COST_COEFF * cells * math.log2(max(cells, 2))
+
+
+def fft_layer_flops(spatial: Sequence[int], k: int, c_in: int,
+                    c_out: int) -> float:
+    """Predicted real FLOPs of one spectral layer: forward transforms of
+    the C_in input channels, the weight's C_in·C_out transforms (recomputed
+    per call — the weights are not spectrum-cached across steps), C_out
+    inverse transforms, and the per-bin complex contraction (~8 real FLOPs
+    per multiply-add over the Hermitian half-spectrum)."""
+    padded = 1
+    for n in spatial:
+        padded *= n + k - 1
+    transforms = (c_in + c_out + c_in * c_out) * _fft_cost(padded)
+    multiply = 8.0 * (padded / 2) * c_in * c_out
+    return transforms + multiply
+
+
+def fft_spectrum_bytes(spatial: Sequence[int], kernels: Sequence[int],
+                       channels: Sequence[int]) -> int:
+    """Peak weight-spectrum footprint across the stack: ``C_in·C_out``
+    complex64 half-spectra of the padded volume (the dominant FFT-conv
+    temp at volume scale; activations are a C-fold smaller)."""
+    peak = 0
+    c_in = 1
+    for k, c_out in zip(kernels, channels):
+        padded = 1
+        for n in spatial:
+            padded *= n + k - 1
+        peak = max(peak, int(c_in * c_out * (padded // 2 + 1) * 8))
+        c_in = c_out
+    return peak
+
+
+def fft_feasible(ha: int, wa: int, hb: int, wb: int,
+                 kernels: Sequence[int], channels: Sequence[int]) -> bool:
+    """The FFT tier's arithmetic gate: odd kernels, the weight spectrum
+    inside ``_FFT_TEMP_BUDGET``, and VPU-penalized spectral FLOPs beating
+    the dense stack's direct-k⁴ FLOPs over the whole stack."""
+    if any(k % 2 == 0 for k in kernels):
+        return False
+    spatial = (ha, wa, hb, wb)
+    if fft_spectrum_bytes(spatial, kernels, channels) > _FFT_TEMP_BUDGET:
+        return False
+    from ncnet_tpu.ops.conv4d_cp import dense_layer_flops
+
+    cells = ha * wa * hb * wb
+    spectral = dense = 0.0
+    c_in = 1
+    for k, c_out in zip(kernels, channels):
+        spectral += fft_layer_flops(spatial, k, c_in, c_out)
+        dense += dense_layer_flops(cells, k, c_in, c_out)
+        c_in = c_out
+    return _FFT_VPU_PENALTY * spectral < dense
+
+
+@functools.lru_cache(maxsize=16)
+def fft_compiles(ha, wa, hb, wb, kernels, channels) -> bool:
+    """Real-compile probe for the spectral stack (cached per shape class);
+    records the tier's AOT memory analysis in the ledger like every other
+    tier probe (ops/nc_fused_lane.py::_record_probe_memory)."""
+    try:
+        x = jax.ShapeDtypeStruct((1, ha, wa, hb, wb, 1), jnp.float32)
+        params = []
+        c_in = 1
+        for k, c_out in zip(kernels, channels):
+            params.append({
+                "w": jax.ShapeDtypeStruct(
+                    (k,) * 4 + (c_in, c_out), jnp.float32),
+                "b": jax.ShapeDtypeStruct((c_out,), jnp.float32),
+            })
+            c_in = c_out
+        compiled = jax.jit(nc_stack_fft).lower(params, x).compile()
+        from ncnet_tpu.ops.nc_fused_lane import _record_probe_memory
+
+        _record_probe_memory("nc_fft_probe", "fft", ha, wa, hb, wb,
+                             kernels, channels, compiled)
+        return True
+    except Exception:  # noqa: BLE001 — any compile failure demotes, never raises
+        return False

@@ -1,0 +1,163 @@
+"""HOSVD + ALS canonical-polyadic factorization of conv4d kernels.
+
+The numerical core of the dense→CP checkpoint conversion (ISSUE 17;
+*Speeding-up Convolutional Neural Networks Using Fine-tuned
+CP-Decomposition*, Lebedev et al., PAPERS.md) for the 6-way
+``(kA, kWA, kB, kWB, C_in, C_out)`` kernels the NC filter stacks: HOSVD
+initialization (leading left singular vectors of each mode unfolding — a
+deterministic, nested-subspace start) refined by alternating least
+squares, each mode solved exactly per sweep so the Frobenius
+reconstruction error is monotonically non-increasing over sweeps.
+
+Plain numpy on purpose — conversion is offline host work (seconds for the
+InLoc arch), and keeping it out of jax means the tool runs identically
+with no accelerator.  The factor layout matches ``ops/conv4d_cp.py``::
+
+    w[p,q,r,s,c,o] ≈ Σ_ρ ka[p,ρ]·kwa[q,ρ]·kb[r,ρ]·kwb[s,ρ]·cin[c,ρ]·cout[ρ,o]
+
+:func:`nested_decompose` warm-starts each rank from the previous rank's
+solved factors with the new components' ``cout`` rows ZEROED, so the
+starting error at rank R+1 equals the final error at rank R; combined
+with ALS's monotone sweeps this makes reconstruction error provably
+non-increasing in rank — the property tests/test_conv4d_tiers.py pins.
+
+CLI wrapper: ``tools/cp_decompose.py``; fine-tune consumer:
+``training/train.py`` (``TrainConfig.finetune_cp_rank``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_FACTOR_KEYS = ("ka", "kwa", "kb", "kwb", "cin", "cout")
+DEFAULT_ALS_ITERS = 60
+
+
+def _unfold(t: np.ndarray, mode: int) -> np.ndarray:
+    """Mode-``mode`` unfolding: ``(d_mode, prod(other dims))``, remaining
+    modes flattened row-major in original order (the khatri-rao column
+    order below matches this)."""
+    return np.moveaxis(t, mode, 0).reshape(t.shape[mode], -1)
+
+
+def _khatri_rao(mats: Sequence[np.ndarray]) -> np.ndarray:
+    """Column-wise Kronecker of ``(d_j, R)`` factors, first factor slowest
+    — the column order of a row-major unfolding's remaining modes."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+def _hosvd_init(w: np.ndarray, rank: int, seed: int = 0) -> List[np.ndarray]:
+    """Per-mode leading left singular vectors, padded with small seeded
+    Gaussian columns where a mode is thinner than the rank."""
+    rng = np.random.RandomState(seed)
+    factors = []
+    for mode in range(w.ndim):
+        u, _, _ = np.linalg.svd(_unfold(w, mode), full_matrices=False)
+        u = u[:, :rank]
+        if u.shape[1] < rank:
+            pad = rng.standard_normal((u.shape[0], rank - u.shape[1]))
+            u = np.concatenate([u, 0.01 * pad], axis=1)
+        factors.append(u)
+    return factors
+
+
+def _reconstruct(factors: Sequence[np.ndarray]) -> np.ndarray:
+    return np.einsum("pr,qr,sr,tr,cr,or->pqstco", *factors)
+
+
+def _rel_err(w: np.ndarray, factors: Sequence[np.ndarray]) -> float:
+    denom = float(np.linalg.norm(w)) or 1.0
+    return float(np.linalg.norm(w - _reconstruct(factors))) / denom
+
+
+def _als(w: np.ndarray, factors: List[np.ndarray],
+         iters: int) -> List[np.ndarray]:
+    """Exact per-mode least-squares sweeps: each update solves its mode's
+    normal equations against the current others, so the Frobenius error
+    never increases across sweeps."""
+    for _ in range(iters):
+        for mode in range(w.ndim):
+            others = [factors[j] for j in range(w.ndim) if j != mode]
+            gram = np.ones((factors[0].shape[1],) * 2)
+            for u in others:
+                gram *= u.T @ u
+            kr = _khatri_rao(others)
+            factors[mode] = _unfold(w, mode) @ kr @ np.linalg.pinv(gram)
+    return factors
+
+
+def decompose_kernel(
+    w: np.ndarray, rank: int, iters: int = DEFAULT_ALS_ITERS,
+    init: Optional[List[np.ndarray]] = None,
+) -> Tuple[Dict[str, np.ndarray], float]:
+    """Rank-``rank`` CP factors of one dense conv4d kernel.
+
+    Returns ``(cp_dict, relative_error)`` with the ``ops/conv4d_cp.py``
+    factor layout in float32.  ``init``: optional warm-start factor list
+    (6 mode matrices, ``cout`` transposed to ``(C_out, R')`` like the
+    internal layout); thinner inits are zero-padded on the ``cout`` mode so
+    the warm start reproduces its source solution exactly."""
+    w64 = np.asarray(w, dtype=np.float64)
+    if init is None:
+        factors = _hosvd_init(w64, rank)
+    else:
+        rng = np.random.RandomState(1)
+        factors = []
+        for mode, u in enumerate(init):
+            u = np.asarray(u, dtype=np.float64)
+            if u.shape[1] < rank:
+                extra = rank - u.shape[1]
+                if mode == w64.ndim - 1:
+                    # zero cout rows: the new components start invisible,
+                    # so the initial error equals the warm start's
+                    pad = np.zeros((u.shape[0], extra))
+                else:
+                    pad = 0.01 * rng.standard_normal((u.shape[0], extra))
+                u = np.concatenate([u, pad], axis=1)
+            factors.append(u[:, :rank])
+    factors = _als(w64, factors, iters)
+    cp = {key: factors[m].astype(np.float32)
+          for m, key in enumerate(_FACTOR_KEYS[:5])}
+    cp["cout"] = factors[5].T.astype(np.float32)
+    return cp, _rel_err(w64, factors)
+
+
+def nested_decompose(
+    w: np.ndarray, ranks: Sequence[int], iters: int = DEFAULT_ALS_ITERS,
+) -> List[Tuple[Dict[str, np.ndarray], float]]:
+    """Decompose at each rank (ascending), warm-starting every rank from
+    the previous one — the construction that makes reconstruction error
+    non-increasing in rank (module docstring)."""
+    if list(ranks) != sorted(ranks):
+        raise ValueError(f"ranks must ascend, got {list(ranks)}")
+    results = []
+    init = None
+    for rank in ranks:
+        cp, err = decompose_kernel(w, rank, iters=iters, init=init)
+        results.append((cp, err))
+        init = [cp[k].astype(np.float64) for k in _FACTOR_KEYS[:5]]
+        init.append(cp["cout"].T.astype(np.float64))
+    return results
+
+
+def decompose_stack(nc_params: Sequence[dict], rank: int,
+                    iters: int = DEFAULT_ALS_ITERS):
+    """Attach ``"cp"`` factors to every layer of an NC stack at the
+    requested rank (used verbatim per layer so the tier's FLOP model stays
+    predictable).  Returns ``(new_params, per_layer_rel_errs)``; dense
+    ``"w"``/``"b"`` ride along untouched so every non-CP tier keeps
+    working and the chooser can fall back freely."""
+    out, errs = [], []
+    for layer in nc_params:
+        cp, err = decompose_kernel(np.asarray(layer["w"], np.float32),
+                                   rank, iters=iters)
+        new_layer = dict(layer)
+        new_layer["cp"] = cp
+        out.append(new_layer)
+        errs.append(err)
+    return out, errs

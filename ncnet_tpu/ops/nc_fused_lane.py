@@ -715,7 +715,12 @@ _runtime_demoted: set = set()
 # walk skips it unless it IS the active pipeline (see demote_fused_tier):
 # demoting a tier no traffic runs would burn the recovery's free retry on
 # a bit-identical program.
-_TIER_ORDER = ("coarse2fine", "resident", "perlayer")
+# "cp" / "fft" — the ARITHMETIC tiers (ops/conv4d_cp.py, ops/conv4d_fft.py:
+# rank-R separable and spectral conv4d) — outrank the Pallas tiers because
+# their gates only pass where the ALGORITHM beats the dense k⁴ FLOP count
+# the Pallas tiers merely schedule well; like coarse2fine, the ladder walk
+# only treats them as failure suspects while they are routing traffic.
+_TIER_ORDER = ("coarse2fine", "cp", "fft", "resident", "perlayer")
 _ALL_TIERS = ("resident_vjp",) + _TIER_ORDER
 
 
@@ -743,6 +748,11 @@ def demote_fused_tier(tier: Optional[str] = None) -> Optional[str]:
                 # the sparse pipeline is only a failure suspect when it is
                 # actually routing traffic (sparse_topk off, or already on
                 # dense fallback: demoting it changes no program)
+                continue
+            if t in ("cp", "fft") and _last_selected.get("forward") != t:
+                # same rule for the arithmetic tiers: most programs never
+                # select them (no factors attached / gate predicts a loss),
+                # and demoting an inactive tier changes no program
                 continue
             if t not in dead:
                 tier = t
@@ -815,7 +825,9 @@ def _emit_tier_selected(stage: str, sig, tier, cached: bool = False,
     _emitted_choices[(stage, sig)] = tier
     from ncnet_tpu.observability import events as _obs_events
 
-    ha, wa, hb, wb, kernels, channels = sig
+    # sig may carry a 7th element (the CP ranks context / a "forced" tag —
+    # see choose_fused_stack): it keys the decision but is not a wire field
+    ha, wa, hb, wb, kernels, channels = sig[:6]
     _obs_events.emit(
         "tier_selected", stage=stage, tier=tier or none_label,
         shape=[ha, wa, hb, wb], kernels=list(kernels),
@@ -823,28 +835,56 @@ def _emit_tier_selected(stage: str, sig, tier, cached: bool = False,
     )
 
 
-def choose_fused_stack(ha, wa, hb, wb, kernels, channels):
+def choose_fused_stack(ha, wa, hb, wb, kernels, channels,
+                       cp_ranks=None, pallas_ok: bool = True):
     """The one authority for the fused-stack tier at a shape class:
-    ``'resident'`` (whole-stack kernel), ``'perlayer'`` (r5 chain), or
-    ``None`` (XLA formulations).  Both Pallas tiers require a real TPU
-    backend and a green compile probe — and no runtime demotion: a tier
-    that failed MID-RUN (``demote_fused_tier``) is skipped even where its
-    compile probe stays green, because the failure mode (OOM under
-    eval-loop memory pressure, Mosaic runtime faults) is invisible to the
-    probe.
+    ``'cp'`` (rank-R separable chain, ops/conv4d_cp.py), ``'fft'``
+    (spectral conv, ops/conv4d_fft.py), ``'resident'`` (whole-stack
+    Pallas kernel), ``'perlayer'`` (r5 chain), or ``None`` (XLA
+    formulations).  Every tier is gated by a cheap arithmetic feasibility
+    gate plus a real compile probe, and skipped when runtime-demoted: a
+    tier that failed MID-RUN (``demote_fused_tier``) stays off even where
+    its probe is green, because the failure mode (OOM under eval-loop
+    memory pressure, Mosaic runtime faults) is invisible to the probe.
+
+    Round 17 adds the two ARITHMETIC tiers above the Pallas ladder — they
+    cut the k⁴ FLOPs themselves rather than scheduling them, run as plain
+    XLA on any backend/dtype, and engage only where their gates predict a
+    FLOP win.  ``cp_ranks``: the per-layer CP ranks when every layer of
+    the caller's stack carries factors (``conv4d_cp.cp_stack_ranks``) —
+    the CP tier's opt-in context, part of the decision's cache signature.
+    ``pallas_ok``: whether the caller's program can run the Pallas tiers
+    at all (bf16 volume + weights); the arithmetic tiers are considered
+    either way, which is what lets fp32/CPU programs route through them.
 
     Round 9: the persistent tier cache (``ops/tier_cache.py``) is consulted
     before the compile probes — a warm process replays a previous process's
     probed decision (the cheap feasibility gates still run) and skips the
-    Mosaic compile entirely; demotions persisted there apply like runtime
+    compile entirely; demotions persisted there apply like runtime
     ones.  A miss probes as before and records the outcome."""
+    cp_ranks = tuple(cp_ranks) if cp_ranks else None
     sig = (ha, wa, hb, wb, tuple(kernels), tuple(channels))
-    tier, cached = _choose_fused_stack(*sig)
-    _emit_tier_selected("forward", sig, tier, cached=cached)
+    tier, cached = _choose_fused_stack(
+        *sig, cp_ranks=cp_ranks, pallas_ok=pallas_ok)
+    sig_ext = sig if cp_ranks is None else sig + (cp_ranks,)
+    _emit_tier_selected("forward", sig_ext, tier, cached=cached)
     return tier
 
 
-def _forward_tier_usable(tier, ha, wa, hb, wb, kernels, channels) -> bool:
+def note_forced_tier(ha, wa, hb, wb, kernels, channels, tier) -> None:
+    """Record an explicitly FORCED forward tier (``ModelConfig.nc_tier`` /
+    the CP fine-tune path) as the stage's active decision, bypassing the
+    chooser — so quality events are tagged with the tier that actually ran
+    and the demotion ladder sees it as routing traffic.  The "forced" tag
+    keys the telemetry separately from chooser decisions at the same
+    shape (a forced run must not suppress — or be suppressed by — the
+    chooser's own tier_selected event)."""
+    sig = (ha, wa, hb, wb, tuple(kernels), tuple(channels), "forced")
+    _emit_tier_selected("forward", sig, tier)
+
+
+def _forward_tier_usable(tier, ha, wa, hb, wb, kernels, channels,
+                         cp_ranks=None, pallas_ok: bool = True) -> bool:
     """Whether a CACHED forward decision is still admissible without a
     probe: the tier is not demoted and passes its (cheap, arithmetic)
     feasibility gate — so a cache written under different VMEM budget
@@ -859,6 +899,17 @@ def _forward_tier_usable(tier, ha, wa, hb, wb, kernels, channels) -> bool:
 
     if tier in _runtime_demoted or tier in tier_cache.persistent_demotions():
         return False
+    if tier == "cp":
+        from ncnet_tpu.ops.conv4d_cp import cp_feasible
+
+        return cp_ranks is not None and cp_feasible(
+            ha, wa, hb, wb, kernels, channels, cp_ranks)
+    if tier == "fft":
+        from ncnet_tpu.ops.conv4d_fft import fft_feasible
+
+        return fft_feasible(ha, wa, hb, wb, kernels, channels)
+    if not pallas_ok:
+        return False
     if tier == "resident":
         return fused_resident_feasible(ha, wa, hb, wb, kernels, channels)
     if tier == "perlayer":
@@ -867,17 +918,19 @@ def _forward_tier_usable(tier, ha, wa, hb, wb, kernels, channels) -> bool:
     return False
 
 
-def _choose_fused_stack(ha, wa, hb, wb, kernels, channels):
+def _choose_fused_stack(ha, wa, hb, wb, kernels, channels,
+                        cp_ranks=None, pallas_ok: bool = True):
     """Returns ``(tier, from_cache)``."""
-    from ncnet_tpu.ops.conv4d import _pallas_available
-
-    if not _pallas_available():
-        return None, False
     from ncnet_tpu.ops import tier_cache
+    from ncnet_tpu.ops.conv4d import _pallas_available
+    from ncnet_tpu.ops.conv4d_cp import cp_compiles, cp_feasible
+    from ncnet_tpu.ops.conv4d_fft import fft_compiles, fft_feasible
 
     sig = (ha, wa, hb, wb, kernels, channels)
-    hit = tier_cache.lookup("forward", sig)
-    if hit is not None and _forward_tier_usable(hit[0], *sig):
+    sig_ext = sig if cp_ranks is None else sig + (cp_ranks,)
+    hit = tier_cache.lookup("forward", sig_ext)
+    if hit is not None and _forward_tier_usable(
+            hit[0], *sig, cp_ranks=cp_ranks, pallas_ok=pallas_ok):
         return hit[0], True
     demoted = _runtime_demoted | tier_cache.persistent_demotions()
     # a failed compile probe may be TRANSIENT (device busy, tunnel
@@ -888,30 +941,60 @@ def _choose_fused_stack(ha, wa, hb, wb, kernels, channels):
     # behavior).
     probe_failed = False
     tier = None
-    if "resident" not in demoted \
-            and fused_resident_feasible(ha, wa, hb, wb, kernels, channels):
-        if fused_resident_compiles(ha, wa, hb, wb, kernels, channels):
-            tier = "resident"
+    # arithmetic tiers first (backend/dtype-agnostic): they only pass their
+    # gates where the ALGORITHM undercuts the dense FLOPs the Pallas tiers
+    # schedule, so when one engages it outranks the whole Pallas ladder
+    if cp_ranks is not None and "cp" not in demoted \
+            and cp_feasible(ha, wa, hb, wb, kernels, channels, cp_ranks):
+        if cp_compiles(ha, wa, hb, wb, kernels, channels, cp_ranks):
+            tier = "cp"
         else:
             probe_failed = True
-    if tier is None and "perlayer" not in demoted \
-            and channels[-1] == 1 \
-            and fused_lane_feasible(ha, wa, hb, wb, kernels, channels):
-        if fused_lane_compiles(ha, wa, hb, wb, kernels, channels):
-            tier = "perlayer"
+    if tier is None and "fft" not in demoted \
+            and fft_feasible(ha, wa, hb, wb, kernels, channels):
+        if fft_compiles(ha, wa, hb, wb, kernels, channels):
+            tier = "fft"
         else:
             probe_failed = True
+    if tier is None and pallas_ok and _pallas_available():
+        if "resident" not in demoted \
+                and fused_resident_feasible(ha, wa, hb, wb, kernels,
+                                            channels):
+            if fused_resident_compiles(ha, wa, hb, wb, kernels, channels):
+                tier = "resident"
+            else:
+                probe_failed = True
+        if tier is None and "perlayer" not in demoted \
+                and channels[-1] == 1 \
+                and fused_lane_feasible(ha, wa, hb, wb, kernels, channels):
+            if fused_lane_compiles(ha, wa, hb, wb, kernels, channels):
+                tier = "perlayer"
+            else:
+                probe_failed = True
     if tier is not None and not probe_failed:
-        tier_cache.record("forward", sig, tier)
+        tier_cache.record("forward", sig_ext, tier)
     return tier, False
 
 
 def _fused_stack_impl(nc_params, x):
     """Dispatch the forward to the best available tier for this shape."""
+    from ncnet_tpu.ops.conv4d_cp import cp_stack_ranks
+
     b, ha, wa, hb, wb, _ = x.shape
     kernels = tuple(layer["w"].shape[0] for layer in nc_params)
     channels = tuple(layer["w"].shape[5] for layer in nc_params)
-    tier = choose_fused_stack(ha, wa, hb, wb, kernels, channels)
+    tier = choose_fused_stack(
+        ha, wa, hb, wb, kernels, channels,
+        cp_ranks=cp_stack_ranks(nc_params),
+        pallas_ok=x.dtype == jnp.bfloat16)
+    if tier == "cp":
+        from ncnet_tpu.ops.conv4d_cp import nc_stack_cp
+
+        return nc_stack_cp(nc_params, x)
+    if tier == "fft":
+        from ncnet_tpu.ops.conv4d_fft import nc_stack_fft
+
+        return nc_stack_fft(nc_params, x)
     if tier == "resident":
         return nc_stack_resident(nc_params, x)
     if tier == "perlayer":

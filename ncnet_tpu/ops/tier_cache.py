@@ -81,11 +81,16 @@ def signature_key(stage: str,
                   sig: Tuple[int, int, int, int,
                              Sequence[int], Sequence[int]]) -> str:
     """Stable string key for one (stage, shape-class): the same tuple the
-    choosers and ``tier_selected`` events use."""
-    ha, wa, hb, wb, kernels, channels = sig
-    return (f"{stage}|{ha}x{wa}x{hb}x{wb}"
-            f"|k={','.join(str(k) for k in kernels)}"
-            f"|c={','.join(str(c) for c in channels)}")
+    choosers and ``tier_selected`` events use.  An optional 7th element
+    (the CP tier's per-layer rank context) extends the key — a stack that
+    gains or loses factors is a DIFFERENT decision, not a cache hit."""
+    ha, wa, hb, wb, kernels, channels = sig[:6]
+    key = (f"{stage}|{ha}x{wa}x{hb}x{wb}"
+           f"|k={','.join(str(k) for k in kernels)}"
+           f"|c={','.join(str(c) for c in channels)}")
+    if len(sig) > 6 and sig[6] is not None:
+        key += f"|r={','.join(str(r) for r in sig[6])}"
+    return key
 
 
 def _empty_doc() -> dict:

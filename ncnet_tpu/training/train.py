@@ -173,6 +173,35 @@ def create_train_state(
         )
     else:
         params = init_ncnet(model_config, key or jax.random.key(config.seed))
+    if config.finetune_cp_rank > 0:
+        # CP fine-tune (ISSUE 17, the Lebedev et al. recovery recipe):
+        # decompose every NC kernel to rank-R factors and train THEM with
+        # the trunk frozen.  nc_tier='cp' forces the forward/backward
+        # through the CP chain regardless of the chooser's FLOP gate —
+        # gate-dependent routing would silently zero the factor gradients
+        # wherever the dense tiers win.  The dense kernels ride along
+        # (zero grads → Adam no-op) so checkpoints stay dense-servable.
+        if config.fe_finetune_params > 0:
+            raise ValueError(
+                "finetune_cp_rank fine-tunes CP factors with the trunk "
+                "frozen (the paper's recipe); it is incompatible with "
+                "fe_finetune_params > 0"
+            )
+        from ncnet_tpu.ops.cp_als import decompose_stack
+
+        params = dict(params)
+        params["nc"], cp_errs = decompose_stack(
+            params["nc"], config.finetune_cp_rank)
+        model_config = model_config.replace(nc_tier="cp")
+        log.info(
+            f"CP fine-tune: rank {config.finetune_cp_rank}, per-layer "
+            f"reconstruction error {[round(e, 4) for e in cp_errs]}"
+        )
+        if not config.model.checkpoint:
+            log.warning(
+                "finetune_cp_rank without model.checkpoint decomposes a "
+                "RANDOM init — sensible only for smoke tests"
+            )
     labels = trainable_labels(model_config, params, config.fe_finetune_params)
     optimizer = make_optimizer(labels)(config.lr)
     state = TrainState(params, optimizer.init(params), jnp.asarray(0, jnp.int32))

@@ -1,0 +1,488 @@
+"""Arithmetic conv4d tiers (round 17): CP-decomposed and FFT stacks.
+
+Three claims are locked here.  EXACTNESS: a rank-full CP factorization and
+the spectral conv both equal dense conv4d to pinned fp32 tolerance on every
+shape class the NC filter serves (square, rectangular, k=1, k=5).
+CONVERSION: the HOSVD+ALS solver's error is monotone non-increasing in
+rank, and recovers an exactly-low-rank kernel to float precision.
+ROUTING: ``choose_fused_stack`` selects the tiers only where their
+arithmetic gates predict a FLOP win (spy-counted compile probes), the
+decisions persist in the tier cache keyed by CP rank, demotion walks
+cp → fft → XLA, the forced path (``ModelConfig.nc_tier``) bypasses the
+gates on both the dense and the folded-tile sparse pipelines, and quality
+events carry the tier names.
+"""
+
+import importlib
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ncnet_tpu.ops.nc_fused_lane as lane
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.models.ncnet import ncnet_filter, neigh_consensus
+from ncnet_tpu.ops import tier_cache
+from ncnet_tpu.ops.conv4d import conv4d
+from ncnet_tpu.ops.cp_als import decompose_kernel, decompose_stack, \
+    nested_decompose
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability.events import EventLog, replay_events
+
+cp_mod = importlib.import_module("ncnet_tpu.ops.conv4d_cp")
+fft_mod = importlib.import_module("ncnet_tpu.ops.conv4d_fft")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+def xla_stack(params, x):
+    for layer in params:
+        x = jax.nn.relu(conv4d(x, layer["w"], layer["b"]))
+    return x
+
+
+def make_params(key, kernels, channels, dtype=jnp.float32):
+    params, c_in = [], 1
+    for k, c_out in zip(kernels, channels):
+        k1, k2, key = jax.random.split(key, 3)
+        params.append({
+            "w": jax.random.normal(k1, (k,) * 4 + (c_in, c_out), dtype) * 0.1,
+            "b": jax.random.normal(k2, (c_out,), dtype) * 0.1,
+        })
+        c_in = c_out
+    return params
+
+
+def rank1_params(key, kernels, channels):
+    """NC params whose kernels are EXACT rank-1 CP tensors (built from the
+    factors, so the attached "cp" entries reconstruct them to float
+    precision) — the fixture for natural CP routing and sparse parity."""
+    params, c_in = [], 1
+    for k, c_out in zip(kernels, channels):
+        keys = jax.random.split(key, 8)
+        key = keys[7]
+        cp = {
+            "ka": jax.random.normal(keys[0], (k, 1)),
+            "kwa": jax.random.normal(keys[1], (k, 1)),
+            "kb": jax.random.normal(keys[2], (k, 1)),
+            "kwb": jax.random.normal(keys[3], (k, 1)),
+            "cin": jax.random.normal(keys[4], (c_in, 1)),
+            "cout": jax.random.normal(keys[5], (1, c_out)) * 0.5,
+        }
+        params.append({
+            "w": cp_mod.cp_reconstruct(cp),
+            "b": jax.random.normal(keys[6], (c_out,)) * 0.1,
+            "cp": cp,
+        })
+        c_in = c_out
+    return params
+
+
+# the four shape classes of the parity claim: square, rectangular, k=1, k=5
+PARITY_SHAPES = [
+    ((2, 6, 6, 6, 6), (3, 3), (3, 1)),
+    ((1, 5, 6, 4, 7), (3,), (2,)),
+    ((1, 5, 5, 5, 5), (1, 1), (3, 1)),
+    ((1, 6, 6, 6, 6), (5,), (2,)),
+]
+
+
+def _normed_close(got, ref, atol):
+    got = np.asarray(got, np.float32)
+    ref = np.asarray(ref, np.float32)
+    scale = max(1e-6, float(np.max(np.abs(ref))))
+    np.testing.assert_allclose(got / scale, ref / scale, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# exactness: rank-full CP and FFT == dense conv4d (pinned fp32 tolerance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape,kernels,channels", PARITY_SHAPES)
+def test_rank_full_cp_stack_matches_dense(shape, kernels, channels):
+    params = make_params(jax.random.key(0), kernels, channels)
+    for layer in params:
+        layer["cp"] = cp_mod.exact_cp_factors(layer["w"])
+    x = jax.random.normal(jax.random.key(7), shape + (1,)) * 0.5
+    _normed_close(cp_mod.nc_stack_cp(params, x), xla_stack(params, x),
+                  atol=1e-5)
+
+
+@pytest.mark.parametrize("shape,kernels,channels", PARITY_SHAPES)
+def test_fft_stack_matches_dense(shape, kernels, channels):
+    params = make_params(jax.random.key(1), kernels, channels)
+    x = jax.random.normal(jax.random.key(8), shape + (1,)) * 0.5
+    _normed_close(fft_mod.nc_stack_fft(params, x), xla_stack(params, x),
+                  atol=1e-4)
+
+
+def test_fft_single_layer_matches_conv4d_rectangular():
+    """conv4d_fft alone (no ReLU chain) on a rectangular multi-channel
+    volume: the crop arithmetic must hold per dim independently."""
+    w = jax.random.normal(jax.random.key(2), (5, 3, 3, 5, 2, 3)) * 0.2
+    b = jax.random.normal(jax.random.key(3), (3,)) * 0.1
+    x = jax.random.normal(jax.random.key(4), (1, 7, 6, 5, 8, 2))
+    _normed_close(fft_mod.conv4d_fft(x, w, b), conv4d(x, w, b), atol=1e-5)
+
+
+def test_fft_rejects_even_kernels():
+    w = jnp.zeros((2, 2, 2, 2, 1, 1))
+    x = jnp.zeros((1, 4, 4, 4, 4, 1))
+    with pytest.raises(AssertionError, match="odd-tap"):
+        fft_mod.conv4d_fft(x, w)
+
+
+def test_cp_reconstruct_inverts_exact_factors():
+    w = jax.random.normal(jax.random.key(5), (3, 3, 3, 3, 2, 4))
+    cp = cp_mod.exact_cp_factors(w)
+    np.testing.assert_allclose(np.asarray(cp_mod.cp_reconstruct(cp)),
+                               np.asarray(w), atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# conversion: HOSVD+ALS error monotone in rank; exact recovery
+# ---------------------------------------------------------------------------
+
+
+def test_cp_als_error_monotone_in_rank():
+    w = np.asarray(jax.random.normal(jax.random.key(6), (3, 3, 3, 3, 2, 2)))
+    ranks = (1, 2, 4, 8)
+    errs = [err for _, err in nested_decompose(w, ranks, iters=20)]
+    assert all(b <= a + 1e-9 for a, b in zip(errs, errs[1:])), errs
+    assert errs[-1] < errs[0]
+
+
+def test_cp_als_recovers_low_rank_kernel_exactly():
+    cp = {k: np.asarray(jax.random.normal(jax.random.key(i), (3, 2)))
+          for i, k in enumerate(("ka", "kwa", "kb", "kwb"))}
+    cp["cin"] = np.asarray(jax.random.normal(jax.random.key(4), (2, 2)))
+    cp["cout"] = np.asarray(jax.random.normal(jax.random.key(5), (2, 2)))
+    w = np.asarray(cp_mod.cp_reconstruct(
+        {k: jnp.asarray(v) for k, v in cp.items()}))
+    _, err = decompose_kernel(w, rank=2, iters=60)
+    assert err < 1e-5, err
+
+
+def test_decompose_stack_attaches_factors_and_preserves_dense():
+    params = make_params(jax.random.key(9), (3, 3), (2, 1))
+    dense_w = [np.asarray(layer["w"]) for layer in params]
+    out, errs = decompose_stack(params, rank=4, iters=10)
+    assert cp_mod.cp_stack_ranks(out) == (4, 4)
+    assert len(errs) == 2 and all(0 <= e < 1.0 for e in errs)
+    for layer, w0 in zip(out, dense_w):
+        # the dense kernel stays beside the factors (checkpoint-compatible)
+        np.testing.assert_array_equal(np.asarray(layer["w"]), w0)
+        assert layer["cp"]["cout"].dtype == jnp.float32
+    # a stack with partial factor coverage is NOT CP-routable
+    partial = [out[0], {k: v for k, v in out[1].items() if k != "cp"}]
+    assert cp_mod.cp_stack_ranks(partial) is None
+
+
+# ---------------------------------------------------------------------------
+# the arithmetic gates: pass exactly where the FLOP model predicts a win
+# ---------------------------------------------------------------------------
+
+
+def test_cp_gate_directions():
+    # rank 16 at the PF-Pascal arch: a predicted ~42x FLOP cut — passes
+    assert cp_mod.cp_feasible(25, 25, 25, 25, (5, 5, 5), (16, 16, 1),
+                              (16, 16, 16))
+    # rank-full parity factors lose the arithmetic — the gate keeps dense
+    assert not cp_mod.cp_feasible(6, 6, 6, 6, (3,), (1,), (81,))
+    # low rank at k=3 still clears (28 vs 0.75*162 FLOPs/cell)
+    assert cp_mod.cp_feasible(7, 7, 7, 7, (3,), (1,), (2,))
+    assert not cp_mod.cp_feasible(7, 7, 7, 7, (3,), (1,), (8,))
+    # even kernels and rank/kernel arity mismatches are out of class
+    assert not cp_mod.cp_feasible(8, 8, 8, 8, (4,), (1,), (2,))
+    assert not cp_mod.cp_feasible(8, 8, 8, 8, (3, 3), (4, 1), (2,))
+
+
+def test_fft_gate_directions(monkeypatch):
+    # k=5 arch: spectral beats direct k^4 even under the VPU penalty
+    assert fft_mod.fft_feasible(25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+    assert fft_mod.fft_feasible(8, 8, 8, 8, (5, 5, 5), (16, 16, 1))
+    # k=3 arches keep the dense tiers (the paper's crossover direction)
+    assert not fft_mod.fft_feasible(13, 13, 13, 13, (3, 3), (16, 1))
+    assert not fft_mod.fft_feasible(6, 6, 6, 6, (3,), (1,))
+    assert not fft_mod.fft_feasible(8, 8, 8, 8, (4,), (1,))  # even taps
+    # the weight-spectrum budget rejects volume-scale blowups
+    monkeypatch.setattr(fft_mod, "_FFT_TEMP_BUDGET", 1024)
+    assert not fft_mod.fft_feasible(25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+
+
+# ---------------------------------------------------------------------------
+# chooser routing: spy-counted probes, demotion, tier-cache persistence
+# ---------------------------------------------------------------------------
+
+K5_ARGS = (25, 25, 25, 25, (5, 5, 5), (16, 16, 1))
+K5_RANKS = (16, 16, 16)
+
+
+@pytest.fixture
+def fresh_chooser():
+    lane.reset_fused_tier_demotions()
+    lane._emitted_choices.clear()
+    lane._last_selected.clear()
+    yield
+    lane.reset_fused_tier_demotions()
+    lane._emitted_choices.clear()
+    lane._last_selected.clear()
+
+
+def _arm_arith_probes(monkeypatch, results=None):
+    """Spy-counted compile probes for both arithmetic tiers: the gate's job
+    is proven by which probes RUN, not just by the returned tier."""
+    results = results or {}
+    counts = {"cp": 0, "fft": 0}
+
+    def cp_probe(*a):
+        counts["cp"] += 1
+        return results.get("cp", True)
+
+    def fft_probe(*a):
+        counts["fft"] += 1
+        return results.get("fft", True)
+
+    monkeypatch.setattr(cp_mod, "cp_compiles", cp_probe)
+    monkeypatch.setattr(fft_mod, "fft_compiles", fft_probe)
+    return counts
+
+
+def test_chooser_selects_arith_tiers_only_where_gates_pass(
+        fresh_chooser, monkeypatch):
+    counts = _arm_arith_probes(monkeypatch)
+    # with factors attached the CP tier wins (and fft is never probed)
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "cp"
+    assert counts == {"cp": 1, "fft": 0}
+    # without factors the spectral tier takes the k=5 arch
+    assert lane.choose_fused_stack(*K5_ARGS) == "fft"
+    assert counts == {"cp": 1, "fft": 1}
+    # a k=3 arch fails both gates: no probe runs, XLA keeps the shape
+    assert lane.choose_fused_stack(13, 13, 13, 13, (3, 3), (16, 1)) is None
+    assert lane.choose_fused_stack(
+        7, 7, 7, 7, (3,), (1,), cp_ranks=(8,)) is None
+    assert counts == {"cp": 1, "fft": 1}
+    assert lane.last_selected_tier("forward") == "xla"
+
+
+def test_arith_tier_outranks_pallas_ladder(fresh_chooser, monkeypatch):
+    conv4d_base = importlib.import_module("ncnet_tpu.ops.conv4d")
+    monkeypatch.setattr(conv4d_base, "_pallas_available", lambda: True)
+    monkeypatch.setattr(lane, "fused_resident_feasible", lambda *a: True)
+    resident = {"n": 0}
+
+    def resident_probe(*a):
+        resident["n"] += 1
+        return True
+
+    monkeypatch.setattr(lane, "fused_resident_compiles", resident_probe)
+    counts = _arm_arith_probes(monkeypatch)
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "cp"
+    assert counts["cp"] == 1 and resident["n"] == 0
+    # ... but a failed arithmetic probe falls through to the Pallas ladder
+    lane._emitted_choices.clear()
+    counts = _arm_arith_probes(monkeypatch, results={"cp": False,
+                                                     "fft": False})
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "resident"
+    assert counts == {"cp": 1, "fft": 1} and resident["n"] == 1
+
+
+def test_demotion_walks_cp_then_fft(fresh_chooser, monkeypatch):
+    _arm_arith_probes(monkeypatch)
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "cp"
+    assert lane.demote_fused_tier() == "cp"
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "fft"
+    assert lane.demote_fused_tier() == "fft"
+    # both arithmetic tiers dead, no Pallas backend on CPU: XLA
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) is None
+    assert lane.demoted_fused_tiers() == {"cp", "fft"}
+    lane.reset_fused_tier_demotions()
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "cp"
+
+
+def test_inactive_arith_tiers_are_skipped_by_the_demotion_walk(
+        fresh_chooser, monkeypatch):
+    """A process whose chooser never routed cp/fft must not burn its
+    demotion cycle on them: the walk lands on the Pallas ladder."""
+    assert lane.demote_fused_tier() == "resident"
+
+
+def test_tier_cache_persists_cp_decision_keyed_by_rank(
+        fresh_chooser, monkeypatch, tmp_path):
+    path = str(tmp_path / "tier_cache.json")
+    monkeypatch.setenv(tier_cache.CACHE_ENV, path)
+    tier_cache._reset_state()
+    counts = _arm_arith_probes(monkeypatch)
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "cp"
+    assert counts["cp"] == 1
+    sig_ext = K5_ARGS + (K5_RANKS,)
+    assert tier_cache.lookup("forward", sig_ext) == ("cp",)
+    assert "|r=" in tier_cache.signature_key("forward", sig_ext)
+    # "fresh process": the cached decision replays without a probe
+    tier_cache._reset_state()
+    lane._emitted_choices.clear()
+    counts["cp"] = counts["fft"] = 0
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "cp"
+    assert counts == {"cp": 0, "fft": 0}
+    # a DIFFERENT rank is a different decision: cache miss, fresh probe
+    assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=(8, 8, 8)) == "cp"
+    assert counts["cp"] == 1
+    tier_cache._reset_state()
+
+
+# ---------------------------------------------------------------------------
+# model routing: natural selection, forced tiers, sparse folded tiles
+# ---------------------------------------------------------------------------
+
+
+def test_neigh_consensus_selects_cp_naturally(fresh_chooser):
+    """Factors attached + gate green: the fp32 CPU volume routes through
+    the CP chain with no force, and matches the dense stack (the rank-1
+    kernels are exactly their factors)."""
+    params = rank1_params(jax.random.key(10), (3,), (1,))
+    corr = jax.random.normal(jax.random.key(11), (1, 7, 7, 7, 7)) * 0.5
+    out = neigh_consensus(params, corr, symmetric=False)
+    assert lane.last_selected_tier("forward") == "cp"
+    ref = neigh_consensus(
+        [{"w": p["w"], "b": p["b"]} for p in params], corr, symmetric=False)
+    _normed_close(out, ref, atol=1e-5)
+
+
+def test_neigh_consensus_selects_fft_naturally(fresh_chooser):
+    """The k=5 16-channel arch clears the spectral gate on the fp32 CPU
+    path: the chooser (real compile probe) routes fft, and the output
+    matches the XLA stack."""
+    params = make_params(jax.random.key(12), (5, 5, 5), (16, 16, 1))
+    corr = jax.random.normal(jax.random.key(13), (1, 8, 8, 8, 8)) * 0.5
+    out = neigh_consensus(params, corr, symmetric=False)
+    assert lane.last_selected_tier("forward") == "fft"
+    ref = neigh_consensus(params, corr, symmetric=False, allow_pallas=False)
+    _normed_close(out, ref, atol=1e-4)
+
+
+def test_force_tier_fft_overrides_gate(fresh_chooser):
+    """k=3 fails the spectral gate, but the forced path must run it anyway
+    (exactness fixture / ModelConfig.nc_tier seam) and tag the decision."""
+    params = make_params(jax.random.key(14), (3, 3), (4, 1))
+    corr = jax.random.normal(jax.random.key(15), (2, 6, 6, 6, 6)) * 0.5
+    out = neigh_consensus(params, corr, symmetric=True, force_tier="fft")
+    assert lane.last_selected_tier("forward") == "fft"
+    ref = neigh_consensus(params, corr, symmetric=True, allow_pallas=False)
+    _normed_close(out, ref, atol=1e-4)
+
+
+def test_force_tier_cp_requires_factors(fresh_chooser):
+    params = make_params(jax.random.key(16), (3,), (1,))
+    corr = jnp.zeros((1, 6, 6, 6, 6))
+    with pytest.raises(ValueError, match="CP factors"):
+        neigh_consensus(params, corr, force_tier="cp")
+    with pytest.raises(ValueError, match="force_tier"):
+        neigh_consensus(params, corr, force_tier="resident")
+    # with rank-full factors attached the forced CP run is exact
+    for layer in params:
+        layer["cp"] = cp_mod.exact_cp_factors(layer["w"])
+    corr = jax.random.normal(jax.random.key(17), (1, 6, 6, 6, 6)) * 0.5
+    out = neigh_consensus(params, corr, symmetric=True, force_tier="cp")
+    assert lane.last_selected_tier("forward") == "cp"
+    ref = neigh_consensus(params, corr, symmetric=True, allow_pallas=False)
+    _normed_close(out, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("tier", ["cp", "fft"])
+def test_sparse_folded_tiles_match_dense_through_each_tier(tier,
+                                                          fresh_chooser):
+    """The PR 15 coarse-to-fine pipeline's folded-tile stacks route through
+    the same forced tier as the dense volume, and at full top-k coverage
+    the sparse output still equals the dense filter — through CP factors
+    and through the spectral conv alike."""
+    from ncnet_tpu.models.ncnet import ncnet_match_volume
+    from ncnet_tpu.ops import correlation_4d
+
+    rng = np.random.default_rng(18)
+    fa = jnp.asarray(rng.standard_normal((1, 8, 8, 12)).astype(np.float32))
+    fb = jnp.asarray(rng.standard_normal((1, 8, 8, 12)).astype(np.float32))
+    params = {"nc": rank1_params(jax.random.key(19), (3, 3), (4, 1))}
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3, 3),
+                      ncons_channels=(4, 1), nc_tier=tier)
+    dense = ncnet_filter(cfg, params, correlation_4d(fa, fb)).corr
+    assert lane.last_selected_tier("forward") == tier
+    sp = ncnet_match_volume(
+        cfg.replace(sparse_topk=16, sparse_factor=2, sparse_halo=2),
+        params, fa, fb)
+    np.testing.assert_allclose(np.asarray(sp.corr), np.asarray(dense),
+                               atol=1e-4, rtol=1e-3)
+    # (tier-vs-unforced-dense exactness is owned by the parity and
+    # natural-selection tests above — not re-run here.)
+
+
+# ---------------------------------------------------------------------------
+# observability: quality tags, tier_selected events
+# ---------------------------------------------------------------------------
+
+
+def test_active_tier_reports_arithmetic_tiers(fresh_chooser):
+    from ncnet_tpu.observability.quality import active_tier
+
+    lane._last_selected["forward"] = "cp"
+    # precision-agnostic: the label holds whether or not bf16 was eligible
+    assert active_tier(False) == "cp"
+    assert active_tier(True) == "cp"
+    lane._last_selected["forward"] = "fft"
+    assert active_tier(False) == "fft"
+    lane._last_selected["forward"] = "xla"
+    assert active_tier(False) == "xla"
+
+
+def test_tier_selected_events_for_chosen_and_forced(fresh_chooser,
+                                                    monkeypatch, tmp_path):
+    _arm_arith_probes(monkeypatch)
+    events_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(events_path)):
+        assert lane.choose_fused_stack(*K5_ARGS, cp_ranks=K5_RANKS) == "cp"
+        lane.note_forced_tier(6, 6, 6, 6, (3,), (1,), "fft")
+    _, events = replay_events(events_path)
+    selected = [e for e in events if e["event"] == "tier_selected"]
+    assert [e["tier"] for e in selected] == ["cp", "fft"]
+    # sig[6] (ranks / forced tag) keys the decision but is not a wire field
+    assert all("shape" in e and len(e["shape"]) == 4 for e in selected)
+
+
+# ---------------------------------------------------------------------------
+# training entry + probe tool smoke
+# ---------------------------------------------------------------------------
+
+
+def test_finetune_cp_rank_decomposes_and_forces_cp():
+    import warnings
+
+    from ncnet_tpu import training
+    from ncnet_tpu.config import TrainConfig
+
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,))
+    tcfg = TrainConfig(model=cfg, batch_size=2, data_parallel=False,
+                       finetune_cp_rank=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # random-trunk warning expected
+        state, _, mcfg, _ = training.create_train_state(tcfg)
+    assert mcfg.nc_tier == "cp"
+    assert cp_mod.cp_stack_ranks(state.params["nc"]) == (2,)
+    # the two fine-tune-the-adapter modes are mutually exclusive
+    import dataclasses
+
+    with pytest.raises(ValueError, match="fe_finetune_params"):
+        training.create_train_state(
+            dataclasses.replace(tcfg, fe_finetune_params=1))
+
+
+def test_cp_fft_probe_tiny_smoke(capsys):
+    import cp_fft_probe
+
+    assert cp_fft_probe.main(["--tiny"]) == 0
+    outp = capsys.readouterr().out
+    assert "tiny smoke: OK" in outp

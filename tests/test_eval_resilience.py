@@ -356,6 +356,11 @@ def test_demotion_registry_and_choose_fused_stack(monkeypatch):
     # attribute — resolve the module through importlib
     conv4d_mod = importlib.import_module("ncnet_tpu.ops.conv4d")
     monkeypatch.setattr(conv4d_mod, "_pallas_available", lambda: True)
+    # the fft tier clears its gate at this k=5 shape; this test is about
+    # the Pallas demotion walk, so keep it out (test_conv4d_tiers.py owns
+    # the arithmetic tiers' demotion coverage)
+    fft_mod = importlib.import_module("ncnet_tpu.ops.conv4d_fft")
+    monkeypatch.setattr(fft_mod, "fft_feasible", lambda *a: False)
     for name in ("fused_resident_feasible", "fused_resident_compiles",
                  "fused_lane_feasible", "fused_lane_compiles"):
         monkeypatch.setattr(lane, name, lambda *a, **k: True)

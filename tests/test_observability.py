@@ -685,7 +685,16 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # runs inside the eval/serving dispatch hot paths and its tool's
     # stdout is ONE parseable summary JSON line — a bare print in either
     # corrupts the tool's output or reopens the side channel mid-query)
+    # (the ISSUE 17 arithmetic conv4d tiers are pinned for the same
+    # reason: the cp/fft ops and the ALS solver run inside the filter's
+    # dispatch hot path, and both tools emit parseable probe/conversion
+    # reports on stdout)
     for target in ("ncnet_tpu/observability/quality.py",
+                   "ncnet_tpu/ops/conv4d_cp.py",
+                   "ncnet_tpu/ops/conv4d_fft.py",
+                   "ncnet_tpu/ops/cp_als.py",
+                   "tools/cp_decompose.py",
+                   "tools/cp_fft_probe.py",
                    "ncnet_tpu/observability/export.py",
                    "ncnet_tpu/observability/memory.py",
                    "ncnet_tpu/serving",

@@ -450,6 +450,11 @@ def _arm_forward_probes(monkeypatch, results=None):
     results = results or {}
     conv4d_mod = importlib.import_module("ncnet_tpu.ops.conv4d")
     monkeypatch.setattr(conv4d_mod, "_pallas_available", lambda: True)
+    # the arithmetic fft tier legitimately clears its gate at ARGS (k=5);
+    # these tests are about the PALLAS ladder's cache discipline, so keep
+    # it out of the way (its own routing lives in test_conv4d_tiers.py)
+    fft_mod = importlib.import_module("ncnet_tpu.ops.conv4d_fft")
+    monkeypatch.setattr(fft_mod, "fft_feasible", lambda *a: False)
     counts = {"resident": 0, "perlayer": 0}
     monkeypatch.setattr(lane, "fused_resident_feasible", lambda *a: True)
     monkeypatch.setattr(lane, "fused_lane_feasible", lambda *a: True)
@@ -605,6 +610,8 @@ import ncnet_tpu.ops.nc_fused_lane as lane
 
 conv4d_mod = importlib.import_module("ncnet_tpu.ops.conv4d")
 conv4d_mod._pallas_available = lambda: True
+fft_mod = importlib.import_module("ncnet_tpu.ops.conv4d_fft")
+fft_mod.fft_feasible = lambda *a: False   # Pallas-ladder test, not fft's
 lane.fused_resident_feasible = lambda *a: True
 lane.fused_lane_feasible = lambda *a: True
 counts = {{"resident": 0, "perlayer": 0}}

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Convert a trained dense checkpoint to rank-R CP factors (ISSUE 17).
+
+The CLI wrapper over ``ncnet_tpu/ops/cp_als.py`` (HOSVD init + ALS
+refinement, per-mode exact least squares): loads a checkpoint written by
+``models/checkpoint.py``, attaches a ``"cp"`` factor dict beside every NC
+layer's dense ``"w"``/``"b"`` (the ``"cp"`` tier's opt-in signal —
+ops/conv4d_cp.py), and writes a new checkpoint.  The dense kernels stay,
+so the converted checkpoint still serves every non-CP tier and the
+chooser falls back freely where the CP gate loses.
+
+Accuracy lost at low rank is recovered by fine-tuning the factors with
+the trunk frozen — ``train.py --finetune_cp_rank R`` (the Lebedev et al.
+recipe) — which performs this conversion in-memory on its own loaded
+checkpoint; this tool exists for offline conversion and for inspecting
+per-layer reconstruction error vs rank before committing to one.
+
+Usage::
+
+    python tools/cp_decompose.py --checkpoint trained_models/ckpt \
+        --out trained_models/ckpt_cp --rank 16 [--iters 60] [--json]
+
+Exit codes: 0 = converted (per-layer relative errors reported), 2 =
+usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ncnet_tpu.ops.cp_als import (  # noqa: E402
+    DEFAULT_ALS_ITERS,
+    decompose_stack,
+)
+
+_out = sys.stdout.write
+_err = sys.stderr.write
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--checkpoint", required=True,
+                    help="trained checkpoint dir (models/checkpoint.py)")
+    ap.add_argument("--out", required=True,
+                    help="output checkpoint dir (config + params with CP "
+                         "factors attached)")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="CP rank (default: ops/conv4d_cp.DEFAULT_CP_RANK)")
+    ap.add_argument("--iters", type=int, default=DEFAULT_ALS_ITERS,
+                    help="ALS refinement sweeps")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a machine-readable summary line")
+    args = ap.parse_args(argv)
+
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models.checkpoint import load_params, save_params
+    from ncnet_tpu.ops.conv4d_cp import DEFAULT_CP_RANK
+
+    rank = args.rank if args.rank is not None else DEFAULT_CP_RANK
+    if rank < 1:
+        _err(f"--rank must be >= 1, got {rank}\n")
+        return 2
+    try:
+        config, params = load_params(args.checkpoint, ModelConfig())
+    except (OSError, ValueError) as e:
+        _err(f"cannot load checkpoint {args.checkpoint!r}: {e}\n")
+        return 2
+    params = dict(params)
+    params["nc"], errs = decompose_stack(params["nc"], rank,
+                                         iters=args.iters)
+    save_params(args.out, config, params)
+    if args.json:
+        _out(json.dumps({"rank": rank, "iters": args.iters,
+                         "rel_errs": errs, "out": args.out}) + "\n")
+    else:
+        _out(f"rank={rank} iters={args.iters}\n")
+        for i, err in enumerate(errs):
+            _out(f"  nc layer {i}: relative reconstruction error "
+                 f"{err:.4f}\n")
+        _out(f"wrote {args.out}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""CP / FFT arithmetic-tier probe: per-rank, per-shape AOT compile,
+memory-ledger and wall characterization against the dense filter tiers.
+
+ISSUE 17's acceptance rides on two measured claims: the CP chain's AOT
+temp bytes undercut the dense stack at the production 25⁴/k=5 shape, and
+the walls of both arithmetic tiers land where their FLOP gates predict.
+This probe produces the evidence:
+
+  * for each requested CP rank: decompose the probe params
+    (``ops/cp_als.py``), AOT-compile the forced-CP stack at the given
+    volume shape, record its ``memory_analysis()`` into the compiled-
+    program memory ledger (program ``cp_fft_probe``, keyed per rank), and
+    report temp/peak bytes beside the dense stack program's at the same
+    shape — plus the arithmetic gate's verdict (``cp_feasible``) so a
+    reader sees where the chooser would actually engage the tier;
+  * the FFT tier likewise (``fft_feasible`` + forced-FFT program row);
+  * with ``--time`` (TPU session): steady-state walls, each tier vs dense.
+
+``--tiny`` is the CPU smoke kept tier-1 (tests/test_conv4d_tiers.py):
+rank-full CP and FFT parity against dense conv4d at miniature shapes,
+gate-direction sanity at the production arch, and the 25⁴/k=5 CPU AOT
+ledger comparison (CP temp bytes < dense at the default rank) — the
+acceptance row itself, runnable with no accelerator.
+
+Usage::
+
+    python tools/cp_fft_probe.py --ranks 4,8,16,32 --size 25 [--time]
+    python tools/cp_fft_probe.py --tiny
+
+Exit codes: 0 = OK, 1 = tiny-smoke parity/acceptance failure, 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+_out = sys.stdout.write
+_err = sys.stderr.write
+
+
+def _params_for(kernels, channels, key_seed=1):
+    import jax
+
+    from ncnet_tpu.ops import conv4d_init
+
+    key = jax.random.key(key_seed)
+    nc = []
+    c_in = 1
+    for k, c_out in zip(kernels, channels):
+        key, sub = jax.random.split(key)
+        w, b = conv4d_init(sub, k, c_in, c_out)
+        nc.append({"w": w, "b": b})
+        c_in = c_out
+    return nc
+
+
+def _aot_memory(fn, *sds):
+    """(compiled, analysis-dict|None) — fail-open where the backend lacks
+    ``memory_analysis`` (CPU wheels differ)."""
+    import jax
+
+    from ncnet_tpu.observability import memory as obs_memory
+
+    compiled = jax.jit(fn).lower(*sds).compile()
+    return compiled, (obs_memory.analysis_dict(compiled) or None)
+
+
+def _stack_fn(nc_params, tier):
+    """A (corr-volume → filtered) single-pass stack through one tier —
+    the same ``neigh_consensus`` seam production dispatches through, so
+    the compiled program is the production formulation, not a hand-built
+    approximation."""
+    from ncnet_tpu.models.ncnet import neigh_consensus
+
+    if tier == "dense":
+        return lambda p, corr: neigh_consensus(
+            p, corr, symmetric=False, allow_pallas=False)
+    return lambda p, corr: neigh_consensus(
+        p, corr, symmetric=False, force_tier=tier)
+
+
+def probe(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ncnet_tpu.observability import memory as obs_memory
+    from ncnet_tpu.ops.conv4d_cp import cp_feasible
+    from ncnet_tpu.ops.conv4d_fft import fft_feasible
+    from ncnet_tpu.ops.cp_als import decompose_stack
+
+    kernels = tuple(int(v) for v in args.kernels.split(","))
+    channels = tuple(int(v) for v in args.channels.split(","))
+    ranks = [int(v) for v in args.ranks.split(",")]
+    s, b = args.size, args.batch
+    dt = jnp.bfloat16 if args.bf16 else jnp.float32
+    params = _params_for(kernels, channels)
+    if args.bf16:
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    sds = jax.ShapeDtypeStruct((b, s, s, s, s), dt)
+    report = {
+        "size": s, "batch": b, "kernels": list(kernels),
+        "channels": list(channels), "dtype": jnp.dtype(dt).name,
+        "device_kind": jax.devices()[0].device_kind,
+        "fft_feasible": fft_feasible(s, s, s, s, kernels, channels),
+        "ranks": {},
+    }
+
+    try:
+        _, dense_mem = _aot_memory(_stack_fn(params, "dense"), params, sds)
+        report["dense"] = dense_mem
+    except Exception as e:  # the dense volume may simply not compile/fit
+        report["dense"] = {"error": str(e)[:200]}
+        dense_mem = None
+
+    def vs_dense(mem):
+        if dense_mem and mem and mem.get("temp_bytes") \
+                and dense_mem.get("temp_bytes"):
+            return round(mem["temp_bytes"] / dense_mem["temp_bytes"], 4)
+        return None
+
+    for rank in ranks:
+        row = {"cp_feasible": cp_feasible(
+            s, s, s, s, kernels, channels, (rank,) * len(kernels))}
+        try:
+            params_cp, errs = decompose_stack(params, rank,
+                                              iters=args.iters)
+            params_cp = jax.tree.map(
+                lambda x: jnp.asarray(x, dt), params_cp)
+            row["rel_errs"] = [round(e, 4) for e in errs]
+            compiled, mem = _aot_memory(
+                _stack_fn(params_cp, "cp"), params_cp, sds)
+            row["memory"] = mem
+            obs_memory.record_program(
+                "cp_fft_probe", f"{s}^4xb{b}|cp|r={rank}",
+                analysis=compiled, tier="cp", source="probe")
+            row["temp_vs_dense"] = vs_dense(mem)
+        except Exception as e:
+            row["error"] = str(e)[:300]
+        report["ranks"][rank] = row
+
+    try:
+        compiled, mem = _aot_memory(_stack_fn(params, "fft"), params, sds)
+        report["fft"] = {"memory": mem, "temp_vs_dense": vs_dense(mem)}
+        obs_memory.record_program(
+            "cp_fft_probe", f"{s}^4xb{b}|fft",
+            analysis=compiled, tier="fft", source="probe")
+    except Exception as e:
+        report["fft"] = {"error": str(e)[:300]}
+
+    if args.time:
+        import time as _time
+
+        import numpy as np
+
+        def wall(p, tier):
+            rng = np.random.default_rng(0)
+            corr = jnp.asarray(
+                rng.normal(size=(b, s, s, s, s)) * 0.05, dt)
+            jitted = jax.jit(_stack_fn(p, tier))
+            jax.block_until_ready(jitted(p, corr))  # compile
+            walls = []
+            for _ in range(args.reps):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(jitted(p, corr))
+                walls.append((_time.perf_counter() - t0) * 1e3)
+            return round(float(np.median(walls)), 3)
+
+        try:
+            report["dense_wall_ms"] = wall(params, "dense")
+        except Exception as e:
+            _err(f"dense wall failed: {str(e)[:200]}\n")
+        for rank in ranks:
+            try:
+                params_cp, _ = decompose_stack(params, rank,
+                                               iters=args.iters)
+                params_cp = jax.tree.map(
+                    lambda x: jnp.asarray(x, dt), params_cp)
+                report["ranks"][rank]["wall_ms"] = wall(params_cp, "cp")
+            except Exception as e:
+                _err(f"cp wall r={rank} failed: {str(e)[:200]}\n")
+        try:
+            report["fft"]["wall_ms"] = wall(params, "fft")
+        except Exception as e:
+            _err(f"fft wall failed: {str(e)[:200]}\n")
+
+    _out(json.dumps(report, indent=2, sort_keys=True, default=str) + "\n")
+    return 0
+
+
+def tiny(args) -> int:
+    """CPU smoke: parity, gate direction, and the 25⁴/k=5 AOT ledger
+    acceptance row, all with no accelerator.  Exit nonzero on any
+    failure — the tier-1 guard that keeps the probe runnable for the
+    TPU session."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ncnet_tpu.observability import memory as obs_memory
+    from ncnet_tpu.ops import conv4d, exact_cp_factors
+    from ncnet_tpu.ops.conv4d_cp import (
+        DEFAULT_CP_RANK,
+        cp_apply_layer,
+        cp_feasible,
+    )
+    from ncnet_tpu.ops.conv4d_fft import conv4d_fft, fft_feasible
+    from ncnet_tpu.ops.cp_als import decompose_stack
+
+    rng = np.random.default_rng(7)
+
+    # 1) rank-full CP and FFT parity vs dense conv4d (square k=3 + small
+    #    k=5 — the exhaustive shape matrix lives in the tier tests)
+    for (ha, wa, hb, wb), k, c_in, c_out in (
+            ((6, 6, 6, 6), 3, 2, 3), ((5, 5, 5, 5), 5, 1, 2)):
+        x = jnp.asarray(
+            rng.normal(size=(1, ha, wa, hb, wb, c_in)).astype(np.float32))
+        w = jnp.asarray(rng.normal(
+            size=(k, k, k, k, c_in, c_out)).astype(np.float32) * 0.2)
+        b_ = jnp.asarray(rng.normal(size=(c_out,)).astype(np.float32))
+        ref = conv4d(x, w, b_)
+        d_cp = float(jnp.max(jnp.abs(
+            cp_apply_layer(x, exact_cp_factors(w), b_) - ref)))
+        d_fft = float(jnp.max(jnp.abs(conv4d_fft(x, w, b_) - ref)))
+        _out(f"k={k} parity: rank-full CP {d_cp:.2e}, FFT {d_fft:.2e}\n")
+        if d_cp > 1e-4 or d_fft > 1e-4:
+            _err("FAIL: arithmetic tier parity vs dense conv4d\n")
+            return 1
+
+    # 2) gate direction at the production archs: k=5 InLoc arch clears the
+    #    FFT gate at 25⁴, the k=3 arch must not; CP clears at the default
+    #    rank and refuses at rank-full arithmetic
+    k5, c5 = (5, 5, 5), (16, 16, 1)
+    checks = (
+        fft_feasible(25, 25, 25, 25, k5, c5),
+        not fft_feasible(25, 25, 25, 25, (3, 3, 3), (10, 10, 1)),
+        cp_feasible(25, 25, 25, 25, k5, c5, (DEFAULT_CP_RANK,) * 3),
+        not cp_feasible(6, 6, 6, 6, (3,), (2,), (3 ** 4 * 2,)),
+    )
+    _out(f"gate direction (fft k5, !fft k3, cp r16, !cp rank-full): "
+         f"{list(checks)}\n")
+    if not all(checks):
+        _err("FAIL: a gate verdict points the wrong way\n")
+        return 1
+
+    # 3) the acceptance row: CPU AOT memory ledger, CP at the default rank
+    #    vs dense, 25⁴/k=5 stack shape (compile-only — nothing executes)
+    params = _params_for(k5, c5)
+    params_cp, _ = decompose_stack(params, DEFAULT_CP_RANK, iters=2)
+    params_cp = jax.tree.map(jnp.asarray, params_cp)
+    sds = jax.ShapeDtypeStruct((1, 25, 25, 25, 25), jnp.float32)
+    cd, dense_mem = _aot_memory(_stack_fn(params, "dense"), params, sds)
+    cc, cp_mem = _aot_memory(_stack_fn(params_cp, "cp"), params_cp, sds)
+    if dense_mem is None or cp_mem is None:
+        _out("AOT memory analysis unavailable on this backend — "
+             "acceptance row skipped (fail-open)\n")
+    else:
+        obs_memory.record_program(
+            "cp_fft_probe", "25^4xb1|dense", analysis=cd,
+            tier="xla", source="probe")
+        obs_memory.record_program(
+            "cp_fft_probe", f"25^4xb1|cp|r={DEFAULT_CP_RANK}",
+            analysis=cc, tier="cp", source="probe")
+        _out(f"25^4/k=5 temp bytes: dense {dense_mem['temp_bytes']:,} "
+             f"vs cp r{DEFAULT_CP_RANK} {cp_mem['temp_bytes']:,}\n")
+        if cp_mem["temp_bytes"] >= dense_mem["temp_bytes"]:
+            _err("FAIL: CP temp bytes not below dense at 25^4/k=5\n")
+            return 1
+    _out("tiny smoke: OK\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-rank/per-shape AOT + memory + wall probe of the "
+                    "CP and FFT conv4d tiers vs the dense filter")
+    ap.add_argument("--ranks", default="4,8,16,32",
+                    help="comma-separated CP ranks to probe")
+    ap.add_argument("--size", type=int, default=25,
+                    help="volume side (25 = the PF-Pascal bench grid)")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--kernels", default="5,5,5")
+    ap.add_argument("--channels", default="16,16,1")
+    ap.add_argument("--iters", type=int, default=20,
+                    help="ALS sweeps per decomposition")
+    ap.add_argument("--bf16", action="store_true", default=True)
+    ap.add_argument("--no-bf16", dest="bf16", action="store_false")
+    ap.add_argument("--time", action="store_true",
+                    help="measure steady-state walls (TPU session)")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU smoke: parity/gates/AOT-ledger acceptance "
+                         "(tier-1)")
+    args = ap.parse_args(argv)
+    if args.tiny:
+        return tiny(args)
+    return probe(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -82,7 +82,7 @@ SYNTH_SPARSE_SHIFT = (32, 32)
 
 
 def synthetic_reference_run(workdir: str, perturb: bool = False,
-                            sparse: bool = False):
+                            sparse: bool = False, tier: str = ""):
     """Run the pinned deterministic synthetic PF-Pascal eval on this
     backend; returns ``(stats, events_path)``.
 
@@ -104,6 +104,15 @@ def synthetic_reference_run(workdir: str, perturb: bool = False,
     SYNTH_SPARSE_K``): its quality events are tier-tagged ``coarse2fine``,
     which seeds — and then gates — that tier's own reference series (the
     label-free proof the sparse tier loses no accuracy, ISSUE 15).
+
+    ``tier="cp"`` attaches rank-1 CP factors to the NC params (the delta
+    kernel is exactly rank 1, and rank 1 clears the arithmetic gate at
+    this 6x6/k=3 fixture, so ``choose_fused_stack`` selects "cp"
+    NATURALLY); ``tier="fft"`` forces the FFT tier via
+    ``ModelConfig.nc_tier`` (the spectral gate rightly rejects k=3 on
+    cost grounds — exactness, not speed, is what the reference series
+    certifies).  Either way the quality events are tagged with the tier
+    name, seeding that tier's own reference series.
     """
     import jax.numpy as jnp
     import numpy as np
@@ -130,10 +139,16 @@ def synthetic_reference_run(workdir: str, perturb: bool = False,
                       ncons_channels=(1,))
     if sparse:
         cfg = cfg.replace(sparse_topk=SYNTH_SPARSE_K)
+    if tier == "fft":
+        cfg = cfg.replace(nc_tier="fft")
     net = models.NCNet(cfg, seed=0)
     iw = np.zeros((3, 3, 3, 3, 1, 1), np.float32)
     iw[1, 1, 1, 1, 0, 0] = 1.0
     net.params["nc"] = [{"w": jnp.asarray(iw), "b": jnp.zeros((1,))}]
+    if tier == "cp":
+        from ncnet_tpu.ops.cp_als import decompose_stack
+
+        net.params["nc"], _ = decompose_stack(net.params["nc"], 1)
     if perturb:
         orig = net.forward_fn
 
@@ -253,7 +268,18 @@ def main(argv=None) -> int:
         _err("running the sparse (coarse2fine) synthetic reference eval "
              f"under {work_sp}\n")
         _, sparse_events = synthetic_reference_run(work_sp, sparse=True)
-        logs = [events_path, sparse_events] + logs
+        # the arithmetic conv4d tiers (CP factors chosen naturally by the
+        # gate; FFT forced — see synthetic_reference_run) seed their own
+        # series so quality_drift --check gates them like any kernel tier
+        work_cp = tempfile.mkdtemp(prefix="quality_ref_cp_")
+        _err(f"running the CP-tier synthetic reference eval under "
+             f"{work_cp}\n")
+        _, cp_events = synthetic_reference_run(work_cp, tier="cp")
+        work_fft = tempfile.mkdtemp(prefix="quality_ref_fft_")
+        _err(f"running the FFT-tier synthetic reference eval under "
+             f"{work_fft}\n")
+        _, fft_events = synthetic_reference_run(work_fft, tier="fft")
+        logs = [events_path, sparse_events, cp_events, fft_events] + logs
 
     if not logs:
         _err("quality_drift: no event logs given\n")
