@@ -31,11 +31,12 @@ retraining.  Mirrors the reference's own load-time quirks: the ``'vgg'→
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import re
 import time
-from typing import Any, Callable, Dict, List, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -272,18 +273,84 @@ def with_io_retries(
 
 
 # ---------------------------------------------------------------------------
+# payload integrity (the commit-metadata sha256 the live rollout trusts)
+# ---------------------------------------------------------------------------
+
+# config.json key carrying the params-payload digest.  Underscore-prefixed
+# like the _train/_epoch metadata keys: load_params picks _ARCH_FIELDS only,
+# so every existing reader skips it.
+PAYLOAD_SHA_KEY = "_payload_sha256"
+
+
+class CheckpointPayloadError(RuntimeError):
+    """Loaded checkpoint params do not match the payload sha256 recorded in
+    the commit metadata — bit rot, a torn copy, or tampering.  Raised by
+    :func:`verify_checkpoint_payload` so consumers (the live rollout's
+    staging gate, ``fit --resume``) can refuse the checkpoint instead of
+    serving or training on silently-wrong weights."""
+
+
+def params_payload_sha256(params) -> str:
+    """Full sha256 over every param leaf's dtype/shape/bytes in pytree
+    order — the payload identity recorded at commit and re-derived at load.
+    Same hashing discipline as the feature store's ``weights_digest`` but
+    over the WHOLE tree (NC filter included: a rollout candidate is the
+    complete model) and untruncated (this digest gates trust, not cache
+    addressing)."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.dtype.str).encode())
+        h.update(str(tuple(a.shape)).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def verify_checkpoint_payload(path: str, params) -> Optional[str]:
+    """Check loaded ``params`` against the sha256 the checkpoint's commit
+    metadata recorded.  Returns the verified digest; ``None`` when the
+    checkpoint predates payload metadata (legacy: nothing to verify
+    against, the caller decides whether that is acceptable).  Raises
+    :class:`CheckpointPayloadError` on mismatch — deserialization that
+    *succeeds* on rotten bytes is exactly the failure this closes."""
+    cfg_path = os.path.join(resolve_checkpoint_dir(path), "config.json")
+    try:
+        with open(cfg_path) as f:
+            expect = json.load(f).get(PAYLOAD_SHA_KEY)
+    except (OSError, ValueError):
+        return None
+    if not expect:
+        return None
+    got = params_payload_sha256(params)
+    if got != expect:
+        raise CheckpointPayloadError(
+            f"checkpoint {path!r} payload sha256 mismatch: config.json "
+            f"records {expect[:16]}..., loaded params hash to "
+            f"{got[:16]}... — refusing the corrupt/torn payload")
+    return got
+
+
+# ---------------------------------------------------------------------------
 # native (orbax) checkpoints
 # ---------------------------------------------------------------------------
 
 
 def save_params(path: str, config: ModelConfig, params) -> None:
-    """Save ``{config.json, params/}`` under ``path`` (orbax pytree)."""
+    """Save ``{config.json, params/}`` under ``path`` (orbax pytree).  The
+    commit metadata records the payload sha256 so later loaders
+    (:func:`verify_checkpoint_payload` — the rollout staging gate) can
+    refuse a bit-rotted directory instead of trusting whatever orbax
+    happens to deserialize."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
+    doc = dataclasses.asdict(config)
+    doc[PAYLOAD_SHA_KEY] = params_payload_sha256(params)
     with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(dataclasses.asdict(config), f, indent=2, default=list)
+        json.dump(doc, f, indent=2, default=list)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(path, "params"), params, force=True)
     ckptr.wait_until_finished()

@@ -37,9 +37,25 @@ from ncnet_tpu.serving.health import (  # noqa: F401
 from ncnet_tpu.serving.introspect import IntrospectionServer  # noqa: F401
 from ncnet_tpu.serving.replica import (  # noqa: F401
     REPLICA_DEAD,
+    REPLICA_DRAINING,
     REPLICA_READY,
     Replica,
     ReplicaPool,
+)
+from ncnet_tpu.serving.rollout import (  # noqa: F401
+    ROLLOUT_CANARY,
+    ROLLOUT_COMPLETE,
+    ROLLOUT_IDLE,
+    ROLLOUT_PROMOTING,
+    ROLLOUT_ROLLED_BACK,
+    ROLLOUT_ROLLING_BACK,
+    ROLLOUT_STAGING,
+    RolloutConfig,
+    RolloutController,
+    RolloutRefused,
+    read_rollout_state,
+    resolve_serving_checkpoint,
+    write_rollout_state,
 )
 from ncnet_tpu.serving.router import (  # noqa: F401
     BACKEND_DEAD,
@@ -92,11 +108,22 @@ __all__ = [
     "Overloaded",
     "READY",
     "REPLICA_DEAD",
+    "REPLICA_DRAINING",
     "REPLICA_READY",
+    "ROLLOUT_CANARY",
+    "ROLLOUT_COMPLETE",
+    "ROLLOUT_IDLE",
+    "ROLLOUT_PROMOTING",
+    "ROLLOUT_ROLLED_BACK",
+    "ROLLOUT_ROLLING_BACK",
+    "ROLLOUT_STAGING",
     "ROUTER_DOC_SCHEMA",
     "Replica",
     "ReplicaPool",
     "RequestQuarantined",
+    "RolloutConfig",
+    "RolloutController",
+    "RolloutRefused",
     "RouterConfig",
     "SLOTracker",
     "STARTING",
@@ -110,4 +137,7 @@ __all__ = [
     "build_health_document",
     "build_router_document",
     "pad_to_bucket",
+    "read_rollout_state",
+    "resolve_serving_checkpoint",
+    "write_rollout_state",
 ]

@@ -187,6 +187,31 @@ class BatchMatchEngine:
         self._jitted_cached.retrace()
         self._feat.retrace()
 
+    def swap_params(self, params) -> None:
+        """Live weight swap (the rollout controller's per-replica seam):
+        re-stage ``params`` on this engine's device and drop every compiled
+        program — the new tree may differ structurally (a CP-rank
+        fine-tune changes the NC-filter leaves), so the old executables
+        are invalid, and the rollout's bucket-ladder warmup recompiles
+        them off the dispatch path (fresh memory-ledger rows included).
+        Must only be called on a DRAINED replica: a fetcher racing the
+        re-staging would mix old handles with new params."""
+        import jax
+
+        self._params = (jax.device_put(params, self.device)
+                        if self.device is not None
+                        else jax.device_put(params))
+        self.retrace()
+
+    def attach_store(self, store) -> None:
+        """Attach (or detach with ``None``) the persistent feature store.
+        The rollout controller detaches the store from a replica swapped
+        to DIFFERENT backbone weights — letting it resolve through the old
+        generation would commit features computed under the new weights
+        into the old fingerprint's directory (silent cache poisoning);
+        recompute-only until the pod converges is the safe degradation."""
+        self._store = store
+
     @property
     def half_precision(self) -> bool:
         return bool(self.config.half_precision)

@@ -120,6 +120,8 @@ def build_health_document(machine: HealthMachine,
                           activity: Optional[Dict[str, Any]] = None,
                           memory: Optional[Dict[str, Any]] = None,
                           store: Optional[Dict[str, Any]] = None,
+                          model_version: Optional[str] = None,
+                          rollout: Optional[Dict[str, Any]] = None,
                           ) -> Dict[str, Any]:
     """THE one health document (``HEALTH_DOC_SCHEMA``-versioned) — the
     ``/healthz`` body, ``MatchService.health()`` return value, the final
@@ -154,6 +156,14 @@ def build_health_document(machine: HealthMachine,
         store is an operator signal, NOT a serving outage — the store
         fails open to recompute, so ``stall_watchdog --url`` must (and
         does) treat store-DEGRADED as degraded-but-serving, never stalled.
+      * ``model_version`` — the pod's converged model identity (live
+        rollout, serving/rollout.py); per-replica versions live in the
+        pool rows, so a mid-rollout mixed pod is visible to the router.
+      * ``rollout`` — the rollout controller's status while one is
+        attached (phase, versions, canary verdict inputs).
+
+    ``model_version``/``rollout`` are ADDITIVE optional fields — schema 1
+    consumers that predate them simply never read the keys.
     """
     ready = sum(1 for r in replicas if r.get("state") == "READY")
     doc: Dict[str, Any] = {
@@ -173,4 +183,8 @@ def build_health_document(machine: HealthMachine,
         doc["memory"] = memory
     if store is not None:
         doc["store"] = store
+    if model_version is not None:
+        doc["model_version"] = model_version
+    if rollout is not None:
+        doc["rollout"] = rollout
     return doc

@@ -54,6 +54,7 @@ from ncnet_tpu.observability.metrics import Counter, Histogram
 _BUCKET_HIST_PREFIX = "serve_wall_ms_"
 _REPLICA_HIST_PREFIX = "replica_wall_ms_"
 _QUALITY_HIST_PREFIX = "q_"
+_VERSION_HIST_PREFIX = "version_wall_ms_"
 
 
 def metrics_families(service) -> List[Family]:
@@ -67,12 +68,19 @@ def metrics_families(service) -> List[Family]:
     quality = Family("ncnet_serve_quality", "histogram",
                      "per-pair match-quality signal digests "
                      "(observability/quality.py)")
+    ver_lat = Family("ncnet_serve_version_latency_ms", "histogram",
+                     "end-to-end request latency per model version "
+                     "(live rollout: canary vs baseline)")
     with service._cond:
         doc = service.health()
         reg_items = dict(service._registry._metrics)
         replica_counters = [
             (name, m.value) for name, m in sorted(reg_items.items())
             if isinstance(m, Counter) and name.startswith("replica_")
+        ]
+        version_counters = [
+            (name, m.value) for name, m in sorted(reg_items.items())
+            if isinstance(m, Counter) and name.startswith("version_")
         ]
         # histogram families render INSIDE the lock: counts and sum must
         # be one cut, or a fetcher landing mid-scrape could put a value in
@@ -89,6 +97,9 @@ def metrics_families(service) -> List[Family]:
             elif name.startswith(_QUALITY_HIST_PREFIX):
                 quality.add_histogram(
                     h, signal=name[len(_QUALITY_HIST_PREFIX):])
+            elif name.startswith(_VERSION_HIST_PREFIX):
+                ver_lat.add_histogram(
+                    h, model_version=name[len(_VERSION_HIST_PREFIX):])
     fams: List[Family] = []
 
     up = Family("ncnet_serve_up", "gauge",
@@ -157,6 +168,29 @@ def metrics_families(service) -> List[Family]:
     fams.extend([rep_batches, rep_failures])
 
     fams.extend([lat, rep_hist, quality])
+
+    # live-rollout version families (serving/rollout.py): the pod's
+    # converged identity as an info-style gauge, plus per-version terminal
+    # counts and latency digests — the canary judge's evidence, scrapable
+    if doc.get("model_version"):
+        fams.append(Family(
+            "ncnet_serve_model_version", "gauge",
+            "1 on the pod's converged model version's series")
+            .add(1, model_version=doc["model_version"]))
+    ver_req = Family(
+        "ncnet_serve_version_requests_total", "counter",
+        "terminal outcomes per model version (live rollout)")
+    for name, value in version_counters:
+        if name.startswith("version_results_"):
+            ver_req.add(value, outcome="result",
+                        model_version=name[len("version_results_"):])
+        elif name.startswith("version_failures_"):
+            ver_req.add(value, outcome="failure",
+                        model_version=name[len("version_failures_"):])
+    if ver_req.samples:
+        fams.append(ver_req)
+    if ver_lat.samples:
+        fams.append(ver_lat)
 
     slo = doc.get("slo")
     if slo is not None:
@@ -286,6 +320,14 @@ def render_statusz(service) -> str:
     add("ncnet_tpu match service — statusz")
     add(f"state: {doc['state']}  (for {svc['age_s']}s"
         + (f", reason: {svc['reason']}" if svc.get("reason") else "") + ")")
+    if doc.get("model_version"):
+        line = f"model version: {doc['model_version']}"
+        ro = doc.get("rollout")
+        if ro is not None and ro.get("phase") not in (None, "IDLE"):
+            line += (f"  rollout: {ro['phase']}"
+                     + (f" -> {ro['new_version']}"
+                        if ro.get("new_version") else ""))
+        add(line)
     q = doc["queue"]
     add(f"queue: depth={q['depth']}/{q['effective_max_queue']}  "
         f"inflight_batches={q['inflight_batches']}  "
@@ -302,13 +344,14 @@ def render_statusz(service) -> str:
     pool = doc["pool"]
     hbm = (doc.get("memory") or {}).get("hbm") or {}
     add(f"replicas ({pool['ready']}/{pool['total']} ready):")
-    add(f"  {'id':<8} {'state':<6} {'score':>10} {'ewma_ms':>9} "
-        f"{'load':>4} {'batches':>8} {'failures':>8} {'deaths':>6} "
-        f"{'hbm%':>6}")
+    add(f"  {'id':<8} {'state':<8} {'version':<10} {'score':>10} "
+        f"{'ewma_ms':>9} {'load':>4} {'batches':>8} {'failures':>8} "
+        f"{'deaths':>6} {'hbm%':>6}")
     for r in pool["replicas"]:
         ewma = r.get("ewma_wall_ms")
         fill = (hbm.get(r["id"]) or {}).get("fill_pct")
-        add(f"  {r['id']:<8} {r['state']:<6} {r['score']:>10.4f} "
+        add(f"  {r['id']:<8} {r['state']:<8} "
+            f"{(r.get('model_version') or '-'):<10} {r['score']:>10.4f} "
             f"{(f'{ewma:.2f}' if ewma is not None else '-'):>9} "
             f"{r['load']:>4} {r['batches']:>8} {r['failures']:>8} "
             f"{r['deaths']:>6} "
@@ -403,10 +446,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/statusz":
                 code, ctype = 200, "text/plain; charset=utf-8"
                 body = intro.statusz_text()
+            elif path == "/rollout":
+                code, ctype = 200, "application/json; charset=utf-8"
+                body = json.dumps(intro.rollout_doc(),
+                                  sort_keys=True) + "\n"
             elif path == "/":
                 code, ctype = 200, "text/plain; charset=utf-8"
-                body = "endpoints: /metrics /healthz /statusz " \
-                    "(+ POST /match, POST /retrieve)\n"
+                body = "endpoints: /metrics /healthz /statusz /rollout " \
+                    "(+ POST /match, POST /retrieve, POST /rollout)\n"
             else:
                 code, ctype, body = 404, "text/plain; charset=utf-8", \
                     f"no such endpoint {path}; try /metrics /healthz " \
@@ -427,16 +474,19 @@ class _Handler(BaseHTTPRequestHandler):
         service answers 404 there, not 500."""
         intro = getattr(self.server, "introspect", None)
         path = self.path.split("?", 1)[0].rstrip("/")
-        if intro is None or path not in ("/match", "/retrieve"):
+        if intro is None or path not in ("/match", "/retrieve", "/rollout"):
             self._respond(503 if intro is None else 404,
                           "text/plain; charset=utf-8",
-                          b"POST accepts only /match and /retrieve\n")
+                          b"POST accepts only /match, /retrieve and "
+                          b"/rollout\n")
             return
         try:
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n) if n > 0 else b""
             if path == "/retrieve":
                 code, ctype, payload = intro.retrieve_payload(body)
+            elif path == "/rollout":
+                code, ctype, payload = intro.rollout_payload(body)
             else:
                 code, ctype, payload = intro.match_payload(body)
         except Exception as e:  # noqa: BLE001 — same fail-open contract
@@ -535,6 +585,49 @@ class IntrospectionServer:
         from ncnet_tpu.serving.wire import serve_match
 
         return serve_match(self._service.submit, body)
+
+    def rollout_doc(self) -> Dict[str, Any]:
+        """``GET /rollout``: the live rollout status (phase, versions,
+        verdict inputs) — IDLE with the pod's version when no controller
+        was ever attached."""
+        ctl = getattr(self._service, "_rollout", None)
+        if ctl is not None:
+            return ctl.status()
+        return {"phase": "IDLE",
+                "model_version": getattr(self._service, "model_version",
+                                         None)}
+
+    def rollout_payload(self, body: bytes):
+        """``POST /rollout`` (control plane, ``tools/rollout.py``): JSON
+        ``{"checkpoint": ..., knobs...}`` kicks a background rollout on
+        the fronted service.  A host that fronts no rollout-capable
+        service (a router) answers 404 — same pattern as /retrieve."""
+        start = getattr(self._service, "start_rollout", None)
+        if not callable(start):
+            return (404, "text/plain; charset=utf-8",
+                    b"this host serves no rollout control plane\n")
+        from ncnet_tpu.serving.rollout import RolloutConfig
+
+        try:
+            req = json.loads(body.decode("utf-8") or "{}")
+            candidate = req["checkpoint"]
+        except (ValueError, KeyError) as e:
+            return (400, "text/plain; charset=utf-8",
+                    f"bad rollout request: {type(e).__name__}: {e}\n"
+                    .encode("utf-8"))
+        knobs = {k: req[k] for k in (
+            "canary_fraction", "canary_min_results", "canary_timeout_s",
+            "drain_timeout_s", "psi_threshold", "error_rate_margin",
+            "latency_factor", "min_latency_samples", "state_path",
+            "gc_keep_generations") if k in req}
+        try:
+            ctl = start(candidate, RolloutConfig(**knobs))
+        except RuntimeError as e:  # a rollout is already in progress
+            return (409, "text/plain; charset=utf-8",
+                    f"{e}\n".encode("utf-8"))
+        payload = json.dumps(ctl.status(), sort_keys=True) + "\n"
+        return (202, "application/json; charset=utf-8",
+                payload.encode("utf-8"))
 
     def retrieve_payload(self, body: bytes):
         """``POST /retrieve`` body → ``(status, content_type, payload)``

@@ -49,9 +49,14 @@ from ncnet_tpu.observability import events as obs_events
 from ncnet_tpu.serving.request import Bucket
 
 # replica lifecycle states (distinct from the service-level health machine:
-# replicas cycle READY <-> DEAD, the service machine is monotone)
+# replicas cycle READY <-> DEAD, the service machine is monotone).
+# DRAINING is the live-rollout holding state: the router treats it like
+# DEAD (no new traffic) but resurrection probes leave it alone — the
+# rollout controller owns the replica until it re-admits it via
+# ``resurrect``.
 REPLICA_READY = "READY"
 REPLICA_DEAD = "DEAD"
+REPLICA_DRAINING = "DRAINING"
 
 # routing prior for a replica with no measured wall yet (fresh or just
 # resurrected): small enough that an idle unknown replica wins against a
@@ -89,6 +94,10 @@ class Replica:
         self.last_probe_t: Optional[float] = None
         self.probing = False   # a probe thread is out on this replica
         self.last_bucket: Optional[Bucket] = None
+        # which model generation this replica's engine is serving; stamped
+        # by the service at construction and advanced by the rollout
+        # controller at each drained swap (version-tags results + /metrics)
+        self.model_version: str = ""
 
     # -- device-facing (no service lock; the chaos seams live here) ---------
 
@@ -142,6 +151,7 @@ class Replica:
         return {
             "id": self.id,
             "state": self.state,
+            "model_version": self.model_version or None,
             "device": str(self.device) if self.device is not None else None,
             "score": round(self.health_score(), 6),
             "ewma_wall_ms": (round(self.ewma_wall_s * 1e3, 3)
@@ -176,6 +186,13 @@ class ReplicaPool:
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate replica ids: {ids}")
         self.on_change = on_change
+        # canary routing (rollout-controller owned, service-lock guarded):
+        # while set, ``canary_id`` receives ``canary_fraction`` of routing
+        # decisions via a deterministic credit accumulator and is excluded
+        # from the general health-scored scan
+        self.canary_id: Optional[str] = None
+        self.canary_fraction: float = 0.0
+        self._canary_credit: float = 0.0
 
     @classmethod
     def from_model(cls, model_config, params, n_replicas: int = 0,
@@ -248,6 +265,34 @@ class ReplicaPool:
                         state=REPLICA_READY, reason=reason)
         self._notify_change()
 
+    def drain_for_swap(self, replica: Replica, reason: str) -> None:
+        """Pull a READY replica out of rotation for a live weight swap:
+        DRAINING gets no new traffic (the router only considers READY) but
+        — unlike DEAD — resurrection probes skip it, so the rollout
+        controller alone decides when it rejoins (via :meth:`resurrect`).
+        In-flight batches it already owns finish normally; the caller
+        waits for ``load == 0`` before touching the engine."""
+        if replica.state != REPLICA_READY:
+            return
+        replica.state = REPLICA_DRAINING
+        obs_events.emit("serve_health", replica=replica.id,
+                        state=REPLICA_DRAINING, reason=reason)
+        self._notify_change()
+
+    # -- canary routing (rollout controller seam) ---------------------------
+
+    def set_canary(self, replica: Replica, fraction: float) -> None:
+        """Route ``fraction`` of decisions to ``replica`` (the freshly
+        swapped version) and everything else away from it."""
+        self.canary_id = replica.id
+        self.canary_fraction = max(0.0, min(1.0, float(fraction)))
+        self._canary_credit = 0.0
+
+    def clear_canary(self) -> None:
+        self.canary_id = None
+        self.canary_fraction = 0.0
+        self._canary_credit = 0.0
+
     def due_probes(self, now: float, period_s: float) -> List[Replica]:
         """DEAD replicas whose next resurrection probe is due (and whose
         backlog has fully failed over — probing a replica that still owns
@@ -277,10 +322,28 @@ class ReplicaPool:
         preferring replicas the batch has NOT already failed on
         (``exclude``); when every candidate is excluded the least-cost
         READY one is returned anyway — retrying a replica beats stranding
-        the batch.  None = no READY replica has spare depth."""
+        the batch.  None = no READY replica has spare depth.
+
+        While a canary is set, it is carved OUT of the general scan and
+        receives exactly ``canary_fraction`` of decisions through a
+        deterministic credit accumulator (no RNG: every ``1/fraction``-th
+        routable decision goes to the canary) — except when the rest of
+        the pool has no spare depth, where the canary takes the batch
+        anyway: availability beats holding the fraction exact."""
+        canary = self.get(self.canary_id) if self.canary_id else None
+        canary_ok = (canary is not None
+                     and canary.state == REPLICA_READY
+                     and canary.load < max_load)
+        if canary_ok and canary.id not in exclude:
+            self._canary_credit += self.canary_fraction
+            if self._canary_credit >= 1.0:
+                self._canary_credit -= 1.0
+                return canary
         best = fallback = None
         best_s = fb_s = float("inf")
         for r in self.replicas:
+            if r is canary:
+                continue
             if r.state != REPLICA_READY or r.load >= max_load:
                 continue
             s = r.health_score()
@@ -289,4 +352,7 @@ class ReplicaPool:
                     fallback, fb_s = r, s
             elif s < best_s:
                 best, best_s = r, s
-        return best if best is not None else fallback
+        chosen = best if best is not None else fallback
+        if chosen is None and canary_ok:
+            return canary
+        return chosen

@@ -189,6 +189,7 @@ class Backend:
         self.inflight = 0            # wire attempts currently out
         # probe-document signals (refreshed every probe_period_s)
         self.doc_state: Optional[str] = None
+        self.model_version: Optional[str] = None  # live-rollout visibility
         self.ready_replicas = 1
         self.total_replicas = 1
         self.queue_fill = 0.0        # backend queue depth / its live bound
@@ -258,6 +259,12 @@ class Backend:
         (replica units across its backends) — tiers chain, so a backend
         may itself be a router fronting a sub-pod."""
         self.doc_state = str(doc.get("state"))
+        # which model generation this backend serves (live rollout): the
+        # doc refresh every probe_period_s makes a mid-rollout version
+        # change visible at the router without any new wire machinery
+        mv = doc.get("model_version")
+        if isinstance(mv, str) and mv:
+            self.model_version = mv
         if doc.get("role") == "router":
             pod = doc.get("pod") or {}
             ready, total = pod.get("replicas_ready"), \
@@ -285,6 +292,7 @@ class Backend:
             "id": self.id,
             "url": self.url,
             "state": self.state,
+            "model_version": self.model_version,
             "score": round(self.health_score(), 6),
             "ewma_wall_ms": (round(self.ewma_wall_s * 1e3, 3)
                              if self.ewma_wall_s else None),
@@ -347,6 +355,11 @@ def build_router_document(machine: HealthMachine,
     ``pod`` in place of ``pool``: backend rows instead of replica rows,
     plus the pod's aggregate replica capacity (the admission units)."""
     ready = sum(1 for b in backends if b.get("state") == BACKEND_READY)
+    # the distinct model versions the pod's backends report (live
+    # rollout): >1 entry = a mixed-version pod mid-rollout — an operator
+    # signal, not an error (the router keeps routing across versions)
+    versions = sorted({b["model_version"] for b in backends
+                       if b.get("model_version")})
     return {
         "schema": ROUTER_DOC_SCHEMA,
         "role": "router",
@@ -360,6 +373,7 @@ def build_router_document(machine: HealthMachine,
                 if b.get("state") == BACKEND_READY),
             "replicas_total": sum(
                 b.get("replicas_total") or 1 for b in backends),
+            "model_versions": versions,
             "backends": list(backends),
         },
         "queue": dict(queue),

@@ -166,6 +166,11 @@ class ServingConfig:
     # match extraction
     do_softmax: bool = True
     scale: str = "centered"
+    # live rollout (serving/rollout.py): the version label stamped on every
+    # replica at construction — serve_result/quality events and /metrics
+    # families carry it, and the rollout controller advances it per
+    # drained swap
+    model_version: str = "v0"
 
 
 @dataclasses.dataclass
@@ -222,6 +227,16 @@ class MatchService:
                 scope="serving")
             store.gc_superseded()
         self._store = store
+        # live-rollout state (serving/rollout.py): the model identity the
+        # pod currently serves, the resident params a rollback swaps back
+        # to, and the attached controller (None = no rollout in progress)
+        self._model_config = model_config
+        self._model_params = params
+        self._model_version = serving.model_version
+        self._rollout = None
+        self._rollout_thread: Optional[threading.Thread] = None
+        # test seam: replaces the controller's default checkpoint loader
+        self.rollout_loader = None
         if engine is not None:
             engines = list(engine) if isinstance(engine, (list, tuple)) \
                 else [engine]
@@ -236,6 +251,8 @@ class MatchService:
                 do_softmax=serving.do_softmax, scale=serving.scale,
                 store=self._store,
             )
+        for rep in self._pool.replicas:
+            rep.model_version = self._model_version
         self._registry = registry or MetricsRegistry(scope="serving")
         self._bucketer = ShapeBucketer(
             multiple=serving.bucket_multiple,
@@ -593,6 +610,9 @@ class MatchService:
                 memory=self._memory_doc_locked(),
                 store=(self._store.health()
                        if self._store is not None else None),
+                model_version=self._model_version,
+                rollout=(self._rollout.status()
+                         if self._rollout is not None else None),
             )
 
     def _memory_doc_locked(self) -> Dict[str, Any]:
@@ -976,6 +996,16 @@ class MatchService:
         self._leak.observe(step=inf.seq)
         tables, quality = self._split_table(inf.replica, table)
         tier = self._active_tier(inf.replica)
+        # which model generation produced this batch — stamped on every
+        # result/quality event and per-version metric so the canary judge
+        # (and run_report --rollout) can split old from new
+        ver = inf.replica.model_version
+        if quality:
+            from ncnet_tpu.utils import faults
+
+            # chaos seam: shift the NEW version's quality signals so the
+            # canary judge's PSI gate has a real regression to catch
+            quality = faults.canary_quality_shift_hook(ver, quality)
         for i, req in enumerate(inf.batch):
             if req.expired(now):
                 # deadline check at FETCH: the caller's budget is gone —
@@ -993,8 +1023,13 @@ class MatchService:
             with self._cond:
                 self._n["results"] += 1
                 self._registry.counter("results").inc()
+                self._registry.counter(f"version_results_{ver}").inc()
                 self._registry.histogram(
                     f"serve_wall_ms_{bucket_label(inf.bucket)}",
+                    0.0, self.cfg.latency_hist_ms,
+                ).add(req_wall * 1e3)
+                self._registry.histogram(
+                    f"version_wall_ms_{ver}",
                     0.0, self.cfg.latency_hist_ms,
                 ).add(req_wall * 1e3)
             wall_ms = round(req_wall * 1e3, 3)
@@ -1002,7 +1037,7 @@ class MatchService:
                 "serve_result", request=req.id, client=req.client,
                 bucket=bucket_label(inf.bucket),
                 wall_ms=wall_ms, batch_size=len(inf.batch),
-                replica=rid,
+                replica=rid, model_version=ver,
             )
             # SLO judged on the SAME rounded wall the event records, so
             # run_report --slo replaying the log reclassifies identically
@@ -1013,7 +1048,13 @@ class MatchService:
 
                 emit_quality("serving", quality[i], tier=tier,
                              registry=self._registry, request=req.id,
-                             replica=rid)
+                             replica=rid, model_version=ver)
+            rollout = self._rollout
+            if rollout is not None:
+                # feed the canary judge (controller takes its OWN lock;
+                # never called under self._cond — see rollout.py)
+                rollout.observe_result(
+                    ver, wall_ms, quality[i] if quality else None)
             self._terminal(req)
 
     @staticmethod
@@ -1069,6 +1110,8 @@ class MatchService:
             self._controller.note_failure()
             replica.note_failure()
             self._registry.counter(f"replica_failures_{replica.id}").inc()
+            self._registry.counter(
+                f"version_failures_{replica.model_version}").inc()
             if replica.state == REPLICA_READY and \
                     replica.consecutive_failures >= \
                     self.cfg.replica_max_failures:
@@ -1084,6 +1127,9 @@ class MatchService:
             survivors = [r for r in self._pool.ready() if r is not replica]
             any_ready = bool(self._pool.ready())
             recovery_gen = self._recovery_gen
+        rollout = self._rollout
+        if rollout is not None:
+            rollout.observe_failure(replica.model_version)
         requeue: List[MatchRequest] = []
         quarantine: List[MatchRequest] = []
         tier: Optional[str] = None
@@ -1281,6 +1327,186 @@ class MatchService:
             # drain phase (tests prove the event log still accounts for
             # everything that had no outcome yet)
             faults.serve_drain_kill_hook(n)
+
+    # ------------------------------------------------------------------
+    # live rollout seam (serving/rollout.py drives these; each method
+    # takes the service lock itself — the controller NEVER holds its own
+    # lock while calling in, and the service never calls controller
+    # methods under self._cond except status()/observe_* which take only
+    # the controller's lock: one consistent lock order, no deadlock)
+    # ------------------------------------------------------------------
+
+    def attach_rollout(self, controller) -> None:
+        self._rollout = controller
+
+    def detach_rollout(self) -> None:
+        self._rollout = None
+
+    def start_rollout(self, candidate: str, config=None):
+        """Kick a rollout to ``candidate`` (a checkpoint dir or versioned
+        root) on a background thread — the POST /rollout entry point.
+        Returns the attached controller; raises if one is already live."""
+        from ncnet_tpu.serving.rollout import RolloutConfig, RolloutController
+
+        with self._cond:
+            if self._rollout_thread is not None \
+                    and self._rollout_thread.is_alive():
+                raise RuntimeError("a rollout is already in progress")
+        ctl = RolloutController(self, config or RolloutConfig(),
+                                loader=self.rollout_loader)
+        t = threading.Thread(target=ctl.run, args=(candidate,),
+                             name="match-rollout", daemon=True)
+        self._rollout_thread = t
+        t.start()
+        return ctl
+
+    @property
+    def model_version(self) -> str:
+        return self._model_version
+
+    def rollout_pick_canary(self) -> Replica:
+        """The replica staging borrows: READY with the lowest load.  A
+        pool with fewer than two READY replicas refuses — draining the
+        sole survivor would trade a model update for an outage."""
+        with self._cond:
+            ready = self._pool.ready()
+            if len(ready) < 2:
+                raise RuntimeError(
+                    f"rollout needs >= 2 READY replicas to keep serving "
+                    f"during the swap (have {len(ready)})")
+            return min(ready, key=lambda r: r.load)
+
+    def rollout_drain(self, rep: Replica, timeout_s: float) -> bool:
+        """DRAINING + wait for the replica's in-flight batches to finish.
+        Returns False on timeout (the replica is left DRAINING for the
+        caller to re-admit or roll back)."""
+        with self._cond:
+            self._pool.drain_for_swap(rep, "rollout_swap")
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while rep.load > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(0.2, remaining))
+        return True
+
+    def rollout_swap(self, rep: Replica, params, version: str, *,
+                     detach_store: bool = False) -> None:
+        """Swap one DRAINED replica's weights and warm the new programs
+        off the dispatch path: re-stage params (engine.swap_params drops
+        the old executables), then compile the registered bucket ladder at
+        every batch size — memory-ledger rows re-record through the
+        engine's ResilientJit exactly like startup warmup.  The
+        ``kill_at_weight_swap`` chaos seam fires between the re-stage and
+        the version stamp: a SIGKILL there leaves the pod restartable on
+        the OLD version (the state file's pointer only advances at
+        COMPLETE)."""
+        from ncnet_tpu.utils import faults
+
+        engine = rep.engine
+        swap = getattr(engine, "swap_params", None)
+        if swap is None:
+            raise RuntimeError(
+                f"replica {rep.id} engine cannot swap params")
+        swap(params)
+        faults.weight_swap_kill_hook()
+        if detach_store and hasattr(engine, "attach_store"):
+            # new weights must not commit features into the old
+            # fingerprint's generation (cache poisoning); recompute-only
+            # until the pod converges and the store generation advances
+            engine.attach_store(None)
+        with self._cond:
+            rep.model_version = version
+            buckets = list(self._bucketer.buckets)
+        warmed = []
+        try:
+            for bucket in buckets:
+                for b in self._batch_ladder():
+                    zeros = np.zeros((b, *bucket[0], 3), np.uint8)
+                    zt = np.zeros((b, *bucket[1], 3), np.uint8)
+                    rep.fetch(rep.dispatch(zeros, zt))
+                warmed.append(bucket_label(bucket))
+            obs_memory.flush_pending(timeout=120.0)
+        except Exception:
+            obs_events.emit("rollout_swap", replica=rep.id, version=version,
+                            warmed=warmed, ok=False)
+            raise
+        obs_events.emit("rollout_swap", replica=rep.id, version=version,
+                        warmed=warmed, ok=True)
+
+    def rollout_readmit(self, rep: Replica, reason: str) -> None:
+        with self._cond:
+            self._pool.resurrect(rep, reason=reason)
+            self._cond.notify_all()
+
+    def rollout_set_canary(self, rep: Replica, fraction: float) -> None:
+        with self._cond:
+            self._pool.set_canary(rep, fraction)
+            self._cond.notify_all()
+
+    def rollout_clear_canary(self) -> None:
+        with self._cond:
+            self._pool.clear_canary()
+            self._cond.notify_all()
+
+    def rollout_replicas(self) -> List[Replica]:
+        with self._cond:
+            return list(self._pool.replicas)
+
+    def rollout_set_version(self, version: str, params) -> None:
+        """The pod's converged identity: health docs and future replicas
+        report ``version``; ``params`` become what a later rollback (or
+        the next rollout's old side) swaps back to."""
+        with self._cond:
+            self._model_version = version
+            self._model_params = params
+
+    def rollout_switch_store(self, params) -> None:
+        """Advance the shared feature store to the new weights' fingerprint
+        generation and re-attach it to every engine (promotion committed),
+        GC'ing superseded generations with the configured grace so the
+        rollback target's cache survives.  No store configured = no-op."""
+        old = self._store
+        if old is None:
+            return
+        from ncnet_tpu.store import FeatureStore, backbone_fingerprint
+
+        mc = self._model_config
+        fp = backbone_fingerprint(
+            params, image_size="serve",
+            k_size=max(mc.relocalization_k_size, 1) if mc is not None else 1,
+            dtype="bf16" if mc is not None and mc.half_precision else "f32")
+        if fp == old.fingerprint:
+            # same backbone (an NC-filter-only fine-tune): the generation
+            # is still valid everywhere — just re-attach where detached
+            new = old
+        else:
+            new = FeatureStore(old.root, fp, budget_bytes=old.budget_bytes,
+                               scope="serving")
+        with self._cond:
+            self._store = new
+            for rep in self._pool.replicas:
+                if hasattr(rep.engine, "attach_store"):
+                    rep.engine.attach_store(new)
+        if new is not old:
+            old.flush_stats()
+            old.close()
+
+    def rollout_reattach_store(self) -> None:
+        """Rollback path: the store generation never advanced — re-attach
+        the existing store to any engine the canary swap detached."""
+        if self._store is None:
+            return
+        with self._cond:
+            for rep in self._pool.replicas:
+                if hasattr(rep.engine, "attach_store"):
+                    rep.engine.attach_store(self._store)
+
+    def rollout_gc_store(self, keep_generations: int) -> None:
+        if self._store is not None:
+            self._store.gc_superseded(keep_generations=keep_generations)
 
     # ------------------------------------------------------------------
     # resurrection probes

@@ -629,13 +629,20 @@ class FeatureStore:
 
     # -- generations --------------------------------------------------------
 
-    def gc_superseded(self) -> int:
+    def gc_superseded(self, keep_generations: int = 0) -> int:
         """Remove sibling fingerprint directories whose WEIGHTS digest
         differs from this generation's (features computed under superseded
         weights are dead: they can never be read again — fingerprint
         mismatch is already a miss — so they only waste the budget).
         Same-weights siblings (another image_size/k/dtype consumer, e.g.
         the serving engine beside the InLoc eval) are live and kept.
+
+        ``keep_generations`` is the live-rollout grace (serving/
+        rollout.py): the N most-recently-touched superseded WEIGHTS
+        generations survive — a rollback target's cache stays warm through
+        promotion instead of cold-recomputing every pano.  0 (the default)
+        is the old immediate-removal behavior.
+
         Returns the number of entries removed."""
         keep = _weights_segment(self.fingerprint)
         removed = 0
@@ -645,6 +652,28 @@ class FeatureStore:
         except OSError as e:
             self._fail("gc", e)
             return 0
+        spared: set = set()
+        if keep_generations > 0:
+            # rank superseded WEIGHTS segments by the newest mtime among
+            # their dirs (a generation the pod served until the swap is
+            # the freshest) and spare the top N whole
+            newest: Dict[str, float] = {}
+            for name in names:
+                path = os.path.join(self.root, name)
+                if name in (self.fingerprint, "quarantine") \
+                        or not os.path.isdir(path):
+                    continue
+                seg = _weights_segment(name)
+                if seg == keep:
+                    continue
+                try:
+                    t = os.stat(path).st_mtime
+                except OSError:
+                    continue
+                newest[seg] = max(newest.get(seg, 0.0), t)
+            spared = {seg for seg, _ in sorted(
+                newest.items(), key=lambda kv: kv[1],
+                reverse=True)[:keep_generations]}
         for name in names:
             path = os.path.join(self.root, name)
             if name in (self.fingerprint, "quarantine") \
@@ -652,6 +681,8 @@ class FeatureStore:
                 continue
             if _weights_segment(name) == keep:
                 continue  # same weights, different consumer: live
+            if _weights_segment(name) in spared:
+                continue  # rollback grace: recent generation kept warm
             try:
                 faults.store_io_hook("evict", path)
                 n = sum(1 for f in os.listdir(path)

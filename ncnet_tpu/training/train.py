@@ -663,6 +663,11 @@ def save_train_checkpoint(
                         float(v) if math.isfinite(v) else None
                         for v in test_loss
                     ],
+                    # payload identity: lets resume/rollout refuse a
+                    # bit-rotted or torn copy instead of training/serving
+                    # on silently-wrong weights
+                    ckpt_io.PAYLOAD_SHA_KEY:
+                        ckpt_io.params_payload_sha256(state.params),
                 },
                 f,
                 indent=2,
@@ -754,6 +759,13 @@ def load_train_checkpoint(
     )
     with open(os.path.join(path, "config.json")) as f:
         meta = json.load(f)
+    # resume refuses a checkpoint whose params no longer hash to the sha
+    # recorded at commit (legacy checkpoints without the key pass through)
+    expect = meta.get(ckpt_io.PAYLOAD_SHA_KEY)
+    if expect and ckpt_io.params_payload_sha256(params) != expect:
+        raise ckpt_io.CheckpointPayloadError(
+            f"training checkpoint {path!r} payload sha256 mismatch — "
+            "refusing to resume from a corrupt/torn params payload")
     state = TrainState(params, opt["opt_state"], opt["step"])
     position = meta.get("_position") or {
         "epoch": meta["_epoch"] + 1, "next_batch": 0

@@ -241,6 +241,25 @@ class FaultPlan:
     # two-phase-commit crash window: a rerun must see NO visible entry
     # (only a .tmp carcass) and rebuild it
     kill_at_store_commit: int = -1
+    # --- live-rollout faults (ncnet_tpu/serving/rollout.py layer) ---
+    # SIGKILL self during the Nth rollout weight swap (1-based, process-
+    # global counter over rollout_swap calls), AFTER the new params are
+    # staged on the drained replica but BEFORE its warmup/readmission —
+    # the mid-swap crash window: the serving-version pointer has not
+    # advanced, so a restart must come back on ONE consistent (old) version
+    kill_at_weight_swap: int = -1
+    # candidate checkpoint paths containing this substring get one param
+    # leaf bit-flipped AFTER a successful load — the silently-corrupt-
+    # candidate shape the commit-metadata payload sha256 exists for: the
+    # rollout's staging verification must refuse the candidate before any
+    # replica is touched
+    corrupt_candidate_checkpoint: str = ""
+    # additive shift applied to every quality signal of batches served by
+    # replicas whose model_version contains canary_shift_version — the
+    # injected canary regression: the PSI drift gate must breach and the
+    # rollout must auto-rollback.  0.0 = disarmed.
+    canary_quality_shift: float = 0.0
+    canary_shift_version: str = ""
 
 
 _plan: Optional[FaultPlan] = None
@@ -250,16 +269,18 @@ _savemat_attempts: Dict[str, int] = {}
 _device_calls = 0
 _watchdog_calls = 0
 _store_commits = 0
+_weight_swaps = 0
 _lock = threading.Lock()
 
 
 def _reset_counters_locked() -> None:
-    global _device_calls, _watchdog_calls, _store_commits
+    global _device_calls, _watchdog_calls, _store_commits, _weight_swaps
     _decode_attempts.clear()
     _savemat_attempts.clear()
     _device_calls = 0
     _watchdog_calls = 0
     _store_commits = 0
+    _weight_swaps = 0
 
 
 def install(plan: FaultPlan) -> None:
@@ -626,3 +647,76 @@ def store_bitflip_hook(path: str) -> None:
         byte = f.read(1)
         f.seek(-1, os.SEEK_END)
         f.write(bytes([byte[0] ^ 0x01]))
+
+
+# ---------------------------------------------------------------------------
+# live-rollout hooks (ncnet_tpu/serving/rollout.py layer)
+# ---------------------------------------------------------------------------
+
+
+def weight_swap_kill_hook() -> None:
+    """SIGKILL self during the Nth rollout weight swap (1-based, if armed)
+    — fired after the candidate params are staged on the drained replica
+    but before warmup/readmission.  The crash window the two-phase serving-
+    version pointer exists for: the pointer only advances at COMPLETE, so
+    the restarted process must come back serving ONE consistent (old)
+    version."""
+    p = _active()
+    if p is None or p.kill_at_weight_swap < 0:
+        return
+    global _weight_swaps
+    with _lock:
+        _weight_swaps += 1
+        n = _weight_swaps
+    if n == p.kill_at_weight_swap:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def corrupt_candidate_hook(path: str, params):
+    """Flip one bit of one param leaf of a just-loaded rollout candidate
+    for matching checkpoint paths — the bit-rotted-checkpoint shape that
+    deserialization alone does NOT catch: the commit-metadata payload
+    sha256 verification must refuse the candidate before any replica is
+    touched.  Returns ``params`` unchanged when not armed."""
+    p = _active()
+    if p is None or not p.corrupt_candidate_checkpoint:
+        return params
+    if p.corrupt_candidate_checkpoint not in path:
+        return params
+
+    flipped = [False]
+
+    def flip(leaf):
+        arr = np.array(leaf, copy=True)
+        if not flipped[0] and arr.size:
+            raw = arr.view(np.uint8).reshape(-1)
+            raw[0] ^= 0x01
+            flipped[0] = True
+        return arr
+
+    try:
+        import jax
+
+        return jax.tree.map(flip, params)
+    except ImportError:  # fake-engine chaos paths carry no real pytree
+        return params
+
+
+def canary_quality_shift_hook(model_version: str, quality):
+    """Additively shift every quality signal of a batch served by a
+    matching ``model_version`` — the injected canary regression (a new
+    checkpoint whose match quality silently degraded): the rollout's PSI
+    drift gate must breach and auto-rollback.  ``quality`` is the per-pair
+    signal-dict list from ``BatchMatchEngine.split`` (or None for narrow
+    grids); returned unchanged when not armed or not matching."""
+    p = _active()
+    if p is None or not p.canary_quality_shift or not quality:
+        return quality
+    if not p.canary_shift_version \
+            or p.canary_shift_version not in (model_version or ""):
+        return quality
+    return [
+        {k: min(1.0, max(0.0, float(v) + p.canary_quality_shift))
+         for k, v in row.items()} if row else row
+        for row in quality
+    ]

@@ -689,7 +689,13 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # reason: the cp/fft ops and the ALS solver run inside the filter's
     # dispatch hot path, and both tools emit parseable probe/conversion
     # reports on stdout)
+    # (the PR 18 rollout plane is pinned for the same reason: the
+    # controller runs against a LIVE service — a bare print there reopens
+    # the side channel mid-serving — and tools/rollout.py's stdout is its
+    # machine-scriptable phase timeline)
     for target in ("ncnet_tpu/observability/quality.py",
+                   "ncnet_tpu/serving/rollout.py",
+                   "tools/rollout.py",
                    "ncnet_tpu/ops/conv4d_cp.py",
                    "ncnet_tpu/ops/conv4d_fft.py",
                    "ncnet_tpu/ops/cp_als.py",

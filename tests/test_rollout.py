@@ -1,0 +1,619 @@
+"""Chaos suite for the live model rollout (ncnet_tpu/serving/rollout.py).
+
+The ISSUE 18 acceptance bars, executed deterministically through the
+utils/faults.py rollout hooks against FakeEngine pools (the replica-level
+seams are real — serving/replica.py, serving/service.py — so the fake
+engine exercises the REAL drain/swap/readmit/judge paths):
+
+  (a) sustained stream against a 4-replica pool → canaried old->new
+      rollout COMPLETEs with ZERO lost requests, ready capacity never
+      observed below N-1, every phase/swap/verdict replayable via
+      ``run_report --rollout``, and the durable pointer advanced;
+  (b) an injected canary quality regression (``canary_quality_shift``)
+      breaches the PSI drift gate → automatic ROLLED_BACK, the pod back
+      on the old params AND version, pointer never advanced;
+  (c) a bit-rotted candidate (``corrupt_candidate_checkpoint`` through
+      the REAL versioned-checkpoint loader) is refused at staging by the
+      payload-sha256 gate BEFORE any replica is touched;
+  (d) SIGKILL mid-swap (``kill_at_weight_swap`` in a subprocess) leaves
+      the two-phase pointer un-advanced → the restart resolves the OLD
+      checkpoint: one consistent version, never a mix;
+  (e) the multi-host router keeps routing a mixed-version pod mid-rollout
+      and says so (``pod.model_versions``);
+  (f) the wire control plane (POST/GET /rollout) + tools/rollout.py exit
+      codes 0 (COMPLETE) / 2 (ROLLED_BACK) / 1 (refused), 409 on a
+      concurrent rollout, 400 on a bad request.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import ops
+from ncnet_tpu.observability import EventLog
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.serving import (
+    READY,
+    REPLICA_READY,
+    BatchMatchEngine,
+    MatchRouter,
+    MatchService,
+    Overloaded,
+    RouterConfig,
+    ServingConfig,
+)
+from ncnet_tpu.serving.rollout import (
+    ROLLOUT_CANARY,
+    ROLLOUT_COMPLETE,
+    ROLLOUT_IDLE,
+    ROLLOUT_PROMOTING,
+    ROLLOUT_ROLLED_BACK,
+    ROLLOUT_STAGING,
+    RolloutConfig,
+    RolloutController,
+    read_rollout_state,
+    resolve_serving_checkpoint,
+    write_rollout_state,
+)
+from ncnet_tpu.store import FeatureStore, content_digest
+from ncnet_tpu.utils import faults
+from ncnet_tpu.utils.faults import FaultPlan
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import rollout as rollout_tool  # noqa: E402
+import run_report  # noqa: E402
+import stall_watchdog  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No armed faults, no demoted tiers, no leaked event sink."""
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+
+
+def u8(side=32, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+class FakeEngine:
+    """Device stand-in (same protocol as tests/test_serving_pool.py) plus
+    the rollout's ``swap_params`` seam — the drain/swap/warmup/readmit
+    ladder runs through the REAL Replica/MatchService code either way."""
+
+    split = staticmethod(BatchMatchEngine.split)
+    half_precision = False
+
+    def __init__(self, latency_s: float = 0.0):
+        self.latency_s = latency_s
+        self.swapped = []  # every params object this engine was given
+
+    def dispatch(self, src, tgt):
+        faults.device_error_hook("fake_serve")
+        return (src.shape[0], time.monotonic())
+
+    def fetch(self, handle):
+        b, t0 = handle
+        while time.monotonic() - t0 < self.latency_s:
+            time.sleep(0.005)
+        table = np.zeros((b, 6, 16), np.float32)
+        table[:, 4, :] = 1.0
+        table[:, 5, :5] = [0.5, 0.1, 0.4, 0.9, 0.8]
+        return table
+
+    def retrace(self):
+        pass
+
+    def swap_params(self, params):
+        self.swapped.append(params)
+
+
+def pool_service(n=4, latency_s=0.02, **over):
+    cfg = dict(bucket_multiple=32, max_image_side=64, max_batch=2,
+               replica_max_failures=1, resurrect_after_s=0.2,
+               model_version="v0",
+               # a single-client chaos stream: the fairness cap must
+               # exceed the stream depth or the tests shed themselves
+               max_queue=128, max_in_flight_per_client=128)
+    cfg.update(over)
+    engines = [FakeEngine(latency_s=latency_s) for _ in range(n)]
+    svc = MatchService(engine=engines,
+                       serving=ServingConfig(**cfg)).start()
+    # injected-engine services carry no real params; give rollback a
+    # recognizable old-params object to restore
+    svc._model_params = "params-v0"
+    return svc, engines
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def drive_stream(svc, ctl, *, max_wall_s=60.0):
+    """Submit one repeated pair against ``svc`` until ``ctl`` reaches a
+    terminal phase; returns (futures, shed_at_submit, min_ready_seen).
+    Overloaded at submit is ELASTIC admission doing its job while a
+    replica is drained — classified, never a crash."""
+    pair = (u8(seed=1), u8(seed=2))
+    futs, shed, min_ready = [], 0, 10 ** 9
+    deadline = time.monotonic() + max_wall_s
+    while ctl.status()["phase"] not in (ROLLOUT_COMPLETE,
+                                        ROLLOUT_ROLLED_BACK, ROLLOUT_IDLE):
+        assert time.monotonic() < deadline, \
+            f"rollout stuck in {ctl.status()['phase']}"
+        try:
+            futs.append(svc.submit(*pair))
+        except Overloaded as e:
+            shed += 1
+            time.sleep(min(e.retry_after_s or 0.05, 0.2))
+        min_ready = min(min_ready, svc.health()["pool"]["ready"])
+        time.sleep(0.005)
+    return futs, shed, min_ready
+
+
+def settle(futs, timeout=60.0):
+    """Resolve every admitted future; returns (results, failures)."""
+    results = failures = 0
+    for f in futs:
+        try:
+            f.result(timeout=timeout)
+            results += 1
+        except Exception:  # noqa: BLE001 — classified failure, not lost
+            failures += 1
+    return results, failures
+
+
+# ---------------------------------------------------------------------------
+# units: durable pointer, state machine edges, watchdog advisory
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_state_pointer_two_phase(tmp_path):
+    """``current`` only advances at COMPLETE: a candidate-only state file
+    (the SIGKILL-mid-swap residue) still resolves to the default."""
+    path = str(tmp_path / "rollout_state.json")
+    assert resolve_serving_checkpoint(path, "/ckpt/old") == "/ckpt/old"
+    assert resolve_serving_checkpoint(None, "/ckpt/old") == "/ckpt/old"
+    write_rollout_state(path, {"current": None, "candidate": "/ckpt/new",
+                               "phase": "STAGING"})
+    st = read_rollout_state(path)
+    assert st["candidate"] == "/ckpt/new" and st["schema"] == 1
+    assert resolve_serving_checkpoint(path, "/ckpt/old") == "/ckpt/old"
+    write_rollout_state(path, {"current": "/ckpt/new",
+                               "candidate": "/ckpt/new",
+                               "phase": "COMPLETE"})
+    assert resolve_serving_checkpoint(path, "/ckpt/old") == "/ckpt/new"
+    # a truncated/garbage state file degrades to the default, never raises
+    with open(path, "w") as f:
+        f.write("{nope")
+    assert resolve_serving_checkpoint(path, "/ckpt/old") == "/ckpt/old"
+
+
+def test_rollout_illegal_transition_raises():
+    svc, _ = pool_service(n=2, latency_s=0.0)
+    try:
+        ctl = RolloutController(svc, RolloutConfig())
+        with pytest.raises(RuntimeError, match="illegal rollout transition"):
+            ctl._to(ROLLOUT_PROMOTING)  # IDLE -> PROMOTING is not an edge
+    finally:
+        svc.stop(drain=False)
+
+
+def test_stall_watchdog_rollout_advisory_is_not_liveness():
+    """The watchdog's model section: rollout phase + mixed versions are
+    surfaced as advisory context, never a liveness verdict."""
+    verdict = {"ok": True}
+    doc = {
+        "model_version": "v0",
+        "rollout": {"phase": "CANARY", "old_version": "v0",
+                    "new_version": "v1", "reason": None},
+        "pool": {"ready": 3, "total": 4, "replicas": [
+            {"id": "rep0", "model_version": "v1"},
+            {"id": "rep1", "model_version": "v0"},
+        ]},
+    }
+    stall_watchdog._apply_rollout_advisory(verdict, doc)
+    m = verdict["model"]
+    assert m["rollout"]["phase"] == "CANARY"
+    assert m["rollout"]["new_version"] == "v1"
+    assert m["mixed_versions"] == ["v0", "v1"]
+    assert verdict["ok"] is True  # advisory only — liveness untouched
+
+
+def test_feature_store_gc_keeps_rollback_generation(tmp_path):
+    """``gc_superseded(keep_generations=1)`` spares the most-recently-
+    touched superseded WEIGHTS generation — the rollback target's cache
+    stays warm through promotion."""
+    arr = u8(seed=3).astype(np.float32)
+    d = content_digest(arr)
+    root = str(tmp_path / "fstore")
+    now = time.time()
+    for i, fp in enumerate(["aaaa1111-s64-k2-f32", "bbbb2222-s64-k2-f32",
+                            "cccc3333-s64-k2-f32"]):
+        s = FeatureStore(root, fp)
+        s.put(d, arr)
+        s.close()
+        # stagger mtimes so "newest superseded" is unambiguous
+        os.utime(os.path.join(root, fp), (now - 100 + i, now - 100 + i))
+    cur = FeatureStore(root, "dddd4444-s64-k2-f32")
+    cur.put(d, arr)
+    assert cur.gc_superseded(keep_generations=1) == 2
+    left = sorted(n for n in os.listdir(root) if not n.startswith("quar"))
+    assert left == ["cccc3333-s64-k2-f32", "dddd4444-s64-k2-f32"]
+    # grace spent: the next swap's GC with 0 removes the survivor too
+    assert cur.gc_superseded(keep_generations=0) == 1
+    cur.close()
+
+
+# ---------------------------------------------------------------------------
+# (a) the promote chaos chain: stream -> canary -> rolling swaps -> COMPLETE
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_promotes_under_stream_zero_lost(tmp_path, capsys):
+    log_path = str(tmp_path / "events.jsonl")
+    state_path = str(tmp_path / "rollout_state.json")
+    with obs_events.bound(EventLog(log_path)):
+        svc, engines = pool_service(n=4)
+        svc.rollout_loader = lambda cand: (cand, "v1", None, "params-v1")
+        try:
+            ctl = svc.start_rollout("/ckpt/v1", RolloutConfig(
+                canary_fraction=0.5, canary_min_results=4,
+                canary_timeout_s=30.0, drain_timeout_s=10.0,
+                state_path=state_path))
+            futs, shed, min_ready = drive_stream(svc, ctl)
+            wait_until(lambda: not svc._rollout_thread.is_alive())
+            st = ctl.status()
+            assert st["phase"] == ROLLOUT_COMPLETE
+            # pod identity advanced; every replica converged on v1
+            assert svc.model_version == "v1"
+            assert all(r.model_version == "v1"
+                       for r in svc.rollout_replicas())
+            assert all(e.swapped == ["params-v1"] for e in engines)
+            # ZERO lost: every admitted request resolves as a result
+            results, failures = settle(futs)
+            assert results == len(futs) and failures == 0
+            assert results > 0  # the stream actually exercised the pod
+            # capacity: ready never observed below N-1 (one drained swap
+            # at a time)
+            assert min_ready >= 3
+            # the judge saw both versions and passed
+            assert st["verdict"]["breach"] is None
+            assert st["verdict"]["results"]["old"] >= 4
+            assert st["verdict"]["results"]["new"] >= 4
+            # per-version metric families split by construction
+            metrics = svc.metrics()
+            assert metrics.get("version_results_v1", 0) > 0
+            assert metrics.get("version_results_v0", 0) > 0
+            # the durable pointer advanced at COMPLETE (phase 2)
+            assert resolve_serving_checkpoint(state_path, "(old)") \
+                == "/ckpt/v1"
+        finally:
+            svc.stop()
+
+    # -- replay: the event log alone reconstructs the whole rollout ------
+    _, events = obs_events.replay_events(log_path)
+    phases = [e["phase"] for e in events
+              if e.get("event") == "rollout_phase"]
+    assert phases == [ROLLOUT_STAGING, ROLLOUT_CANARY,
+                      ROLLOUT_PROMOTING, ROLLOUT_COMPLETE]
+    sec = run_report.build_rollout_section(events)
+    assert sec["terminal_phase"] == "COMPLETE"
+    assert len(sec["swaps"]) == 4 and sec["swaps_failed"] == 0
+    assert all(s["ok"] and s["version"] == "v1" for s in sec["swaps"])
+    assert not sec["refusals"] and not sec["rollbacks"]
+    assert sec["canary_verdicts"][0]["breach"] is None
+    # version-tagged accounting: both versions served during the window
+    assert sec["versions"]["v0"]["results"] > 0
+    assert sec["versions"]["v1"]["results"] > 0
+    assert sec["versions"]["v0"]["failures"] == 0
+    assert sec["versions"]["v1"]["failures"] == 0
+    # the serving section agrees on the mixed-version window
+    serving = run_report.build_serving_section(events)
+    assert sorted(serving["results_by_version"]) == ["v0", "v1"]
+
+    # -- the CLI rendering (run_report --rollout) ------------------------
+    assert run_report.main([log_path, "--rollout"]) == 0
+    out = capsys.readouterr().out
+    assert "-> COMPLETE" in out and "[v0 -> v1]" in out
+    assert "weight swaps (4, 0 failed)" in out
+    assert "canary verdict [pass]" in out
+
+
+# ---------------------------------------------------------------------------
+# (b) injected canary regression -> automatic rollback
+# ---------------------------------------------------------------------------
+
+
+def test_canary_quality_shift_triggers_auto_rollback(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    state_path = str(tmp_path / "rollout_state.json")
+    with obs_events.bound(EventLog(log_path)):
+        svc, engines = pool_service(n=4)
+        svc.rollout_loader = lambda cand: (cand, "v1", None, "params-v1")
+        try:
+            with faults.injected(FaultPlan(canary_quality_shift=0.4,
+                                           canary_shift_version="v1")):
+                ctl = svc.start_rollout("/ckpt/v1", RolloutConfig(
+                    canary_fraction=0.5, canary_min_results=4,
+                    canary_timeout_s=30.0, drain_timeout_s=10.0,
+                    state_path=state_path))
+                futs, _, min_ready = drive_stream(svc, ctl)
+                wait_until(lambda: not svc._rollout_thread.is_alive())
+            st = ctl.status()
+            assert st["phase"] == ROLLOUT_ROLLED_BACK
+            assert st["verdict"]["breach"].startswith("quality_drift:")
+            # the pod is back on the OLD version and the OLD params
+            assert svc.model_version == "v0"
+            assert all(r.model_version == "v0"
+                       for r in svc.rollout_replicas())
+            # exactly one engine saw the canary swap, then swapped back
+            touched = [e for e in engines if e.swapped]
+            assert len(touched) == 1
+            assert touched[0].swapped == ["params-v1", "params-v0"]
+            # rollback lost nothing either
+            results, failures = settle(futs)
+            assert results == len(futs) and failures == 0
+            assert min_ready >= 3
+            # the pointer NEVER advanced: a restart lands on the old ckpt
+            assert resolve_serving_checkpoint(state_path, "(old)") \
+                == "(old)"
+            st_file = read_rollout_state(state_path)
+            assert st_file["current"] is None
+            assert st_file["phase"] == ROLLOUT_ROLLED_BACK
+        finally:
+            svc.stop()
+
+    _, events = obs_events.replay_events(log_path)
+    sec = run_report.build_rollout_section(events)
+    assert sec["terminal_phase"] == "ROLLED_BACK"
+    assert sec["rollbacks"][0]["reason"].startswith("quality_drift:")
+    assert not sec["rollbacks"][0].get("stuck_replicas")
+    verdict = sec["canary_verdicts"][0]
+    # the PSI evidence is in the replayed verdict, not just a summary
+    drifted = [s for s, v in verdict["psi"].items()
+               if v > verdict["psi_threshold"]]
+    assert drifted
+
+
+# ---------------------------------------------------------------------------
+# (c) corrupt candidate refused at staging by the REAL checkpoint loader
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_candidate_refused_before_any_replica(tmp_path):
+    jax = pytest.importorskip("jax")
+    from ncnet_tpu import models
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models.checkpoint import save_params
+
+    cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                      ncons_channels=(1,))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        params = models.init_ncnet(cfg, jax.random.key(0))
+    root = tmp_path / "ckpts"
+    save_params(str(root / "step_000050"), cfg, params)
+
+    log_path = str(tmp_path / "events.jsonl")
+    svc, engines = pool_service(n=2, latency_s=0.0)
+    try:
+        with obs_events.bound(EventLog(log_path)), \
+                faults.injected(FaultPlan(
+                    corrupt_candidate_checkpoint="step_000050")):
+            ctl = RolloutController(svc, RolloutConfig(
+                state_path=str(tmp_path / "state.json")))
+            # the DEFAULT loader: newest-complete resolution + sha gate
+            assert ctl.run(str(root)) == ROLLOUT_IDLE
+        st = ctl.status()
+        assert st["reason"] == "refused:payload_sha_mismatch"
+        # no replica was touched: no swaps, everything still READY v0
+        assert all(e.swapped == [] for e in engines)
+        assert all(r.state == REPLICA_READY and r.model_version == "v0"
+                   for r in svc.rollout_replicas())
+        assert svc.health()["state"] == READY
+        # refusal leaves no durable residue at all
+        assert resolve_serving_checkpoint(
+            str(tmp_path / "state.json"), "(old)") == "(old)"
+    finally:
+        svc.stop(drain=False)
+    _, events = obs_events.replay_events(log_path)
+    ref = [e for e in events if e.get("event") == "rollout_refused"]
+    assert len(ref) == 1 and ref[0]["reason"] == "payload_sha_mismatch"
+
+
+# ---------------------------------------------------------------------------
+# (d) SIGKILL mid-swap: the restart resolves ONE consistent (old) version
+# ---------------------------------------------------------------------------
+
+
+_KILL_CHILD = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from ncnet_tpu.serving import BatchMatchEngine, MatchService, ServingConfig
+from ncnet_tpu.serving.rollout import RolloutConfig, RolloutController
+
+class FakeEngine:
+    split = staticmethod(BatchMatchEngine.split)
+    half_precision = False
+    def dispatch(self, src, tgt):
+        return (src.shape[0], time.monotonic())
+    def fetch(self, handle):
+        b, _ = handle
+        t = np.zeros((b, 6, 16), np.float32)
+        t[:, 4, :] = 1.0
+        return t
+    def retrace(self):
+        pass
+    def swap_params(self, params):
+        pass
+
+svc = MatchService(
+    engine=[FakeEngine(), FakeEngine()],
+    serving=ServingConfig(bucket_multiple=32, max_image_side=32,
+                          max_batch=1, model_version="v0")).start()
+ctl = RolloutController(
+    svc, RolloutConfig(state_path=sys.argv[1], canary_min_results=0),
+    loader=lambda cand: (cand, "v1", None, "params-v1"))
+ctl.run("/ckpt/new")  # NCNET_TPU_FAULTS kills us inside the first swap
+sys.stdout.write("SURVIVED\\n")  # must never be reached
+"""
+
+
+def test_sigkill_mid_swap_recovers_on_old_version(tmp_path):
+    state_path = str(tmp_path / "rollout_state.json")
+    child = tmp_path / "child.py"
+    child.write_text(_KILL_CHILD.format(repo=_REPO))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off",
+               NCNET_TPU_FAULTS='{"kill_at_weight_swap": 1}')
+    proc = subprocess.run(
+        [sys.executable, str(child), state_path],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert proc.returncode == -signal.SIGKILL, \
+        (proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "SURVIVED" not in proc.stdout
+    # phase 1 ran (the candidate is recorded) but phase 2 never did:
+    # the restart resolves the OLD checkpoint — one consistent version
+    st = read_rollout_state(state_path)
+    assert st is not None and st["candidate"] == "/ckpt/new"
+    assert st.get("current") is None
+    assert resolve_serving_checkpoint(state_path, "/ckpt/old") \
+        == "/ckpt/old"
+
+
+# ---------------------------------------------------------------------------
+# (e) the router keeps routing a mixed-version pod and says so
+# ---------------------------------------------------------------------------
+
+
+def test_router_reports_mixed_version_pod(tmp_path):
+    svc_a, _ = pool_service(n=2, latency_s=0.0, introspect_port=0)
+    svc_b, _ = pool_service(n=2, latency_s=0.0, introspect_port=0)
+    router = None
+    try:
+        assert svc_a.introspect_url and svc_b.introspect_url
+        ctl = RolloutController(
+            svc_b, RolloutConfig(canary_min_results=0),
+            loader=lambda cand: (cand, "v1", None, "params-v1"))
+        assert ctl.run("/ckpt/v1") == ROLLOUT_COMPLETE  # promote blind
+        assert svc_b.model_version == "v1"
+        router = MatchRouter(
+            [svc_a.introspect_url, svc_b.introspect_url],
+            RouterConfig(probe_period_s=0.1, resurrect_after_s=0.3,
+                         backend_max_failures=2)).start()
+        assert wait_until(
+            lambda: router.health()["pod"]["model_versions"] == ["v0", "v1"])
+        # a mixed-version pod still serves through the router
+        fut = router.submit(u8(seed=4), u8(seed=5))
+        assert fut.result(timeout=60).table is not None
+    finally:
+        if router is not None:
+            router.stop()
+        svc_a.stop(drain=False)
+        svc_b.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# (f) wire control plane + tools/rollout.py exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_wire_control_plane_and_cli_exit_codes(tmp_path, capsys):
+    svc, _ = pool_service(n=2, latency_s=0.0, introspect_port=0)
+    try:
+        base = svc.introspect_url
+        assert base
+
+        # GET /rollout before any rollout: the IDLE doc
+        with urllib.request.urlopen(base + "/rollout", timeout=10) as r:
+            doc = json.loads(r.read().decode("utf-8"))
+        assert doc == {"phase": "IDLE", "model_version": "v0"}
+
+        # POST with no checkpoint key -> 400
+        req = urllib.request.Request(
+            base + "/rollout", data=b'{"not_checkpoint": 1}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+
+        # exit 0: a full POST+watch to COMPLETE through the CLI
+        svc.rollout_loader = lambda cand: (cand, "v1", None, "params-v1")
+        rc = rollout_tool.main([base, "/ckpt/v1", "--canary-min-results",
+                                "0", "--poll", "0.05", "--timeout", "60"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "-> COMPLETE" in out
+        assert wait_until(lambda: svc.model_version == "v1")
+
+        # 409: a second rollout while one is in flight; then exit 2 via
+        # --watch on the starved canary's automatic rollback
+        svc.rollout_loader = lambda cand: (cand, "v2", None, "params-v2")
+        code, doc = rollout_tool.post_rollout(
+            base, "/ckpt/v2",
+            {"canary_min_results": 4, "canary_timeout_s": 1.0})
+        assert code == 202 and doc["phase"] in ("IDLE", "STAGING", "CANARY")
+        code2, doc2 = rollout_tool.post_rollout(base, "/ckpt/v2", {})
+        assert code2 == 409 and "in progress" in doc2["error"]
+        rc = rollout_tool.main([base, "--watch", "--poll", "0.05",
+                                "--timeout", "60", "--json"])
+        out = capsys.readouterr().out
+        assert rc == 2, out
+        assert "ROLLED_BACK (canary_starved)" in out
+        assert wait_until(lambda: svc.model_version == "v1")  # restored
+
+        # exit 1: a refused candidate (same version) terminates IDLE
+        wait_until(lambda: not svc._rollout_thread.is_alive())
+        svc.rollout_loader = lambda cand: (cand, "v1", None, "params-v1")
+        rc = rollout_tool.main([base, "/ckpt/v1", "--poll", "0.05",
+                                "--timeout", "60"])
+        out = capsys.readouterr().out
+        assert rc == 1, out
+        assert "IDLE" in out and "refused:same_version" in out
+    finally:
+        svc.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# tools: the real-model probe smoke (the full checkpoint path on CPU)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_probe_rollout_tiny_smoke(capsys):
+    import serve_probe
+
+    rc = serve_probe.main(["--rollout", "--tiny", "--sides", "48",
+                           "--pairs", "4"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)["rollout"]
+    assert doc["phase"] == "COMPLETE"
+    assert doc["lost"] == 0
+    assert doc["min_ready_replicas"] >= 1  # N-1 for the 2-replica pool
+    assert doc["pod_version"] == doc["new_version"]
+    assert doc["resolved_checkpoint"].endswith(doc["new_version"])

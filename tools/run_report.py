@@ -344,6 +344,15 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
             "probes": r["probes"],
         }
 
+    # per-model-version result counts (the PR 18 rollout stamps every
+    # serve_result with the serving replica's model_version): a pod that
+    # served under more than one version mid-log renders as mixed-version
+    by_version: Dict[str, int] = {}
+    for e in results:
+        if e.get("model_version") is not None:
+            v = str(e["model_version"])
+            by_version[v] = by_version.get(v, 0) + 1
+
     return {
         "outcomes": {
             "admitted": len(admits),
@@ -358,6 +367,7 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
             # render as "-1 requests died"
             "unresolved": max(0, len(admits) - terminals),
         },
+        "results_by_version": by_version,
         "lost_requests": lost,
         "latency_ms": _percentiles(lat_all),
         "latency_ms_by_bucket": {
@@ -393,6 +403,98 @@ def build_serving_section(events: List[dict]) -> Dict[str, Any]:
              if k.startswith("n_") or k in ("t", "drained", "leftover")}
             for e in events if e.get("event") == "serve_drain"
         ],
+    }
+
+
+def build_rollout_section(events: List[dict]) -> Dict[str, Any]:
+    """The rollout postmortem (ncnet_tpu/serving/rollout.py): the phase
+    timeline (STAGING -> CANARY -> PROMOTING -> COMPLETE, or the rollback
+    branch), every per-replica weight swap with its warmup verdict, the
+    canary judgement (PSI per signal, error rate, latency EWMA for old vs
+    new), refusals with their classified reasons, and per-model-version
+    request accounting recomputed from the version-tagged ``serve_result``/
+    ``serve_failure`` stream — the replayable proof that a rollout (or its
+    automatic rollback) lost nothing."""
+    phases = [
+        {k: e.get(k) for k in ("t", "phase", "reason", "old_version",
+                               "new_version") if k in e}
+        for e in events if e.get("event") == "rollout_phase"
+    ]
+    swaps = [
+        {k: e.get(k) for k in ("t", "replica", "version", "warmed", "ok",
+                               "error") if k in e}
+        for e in events if e.get("event") == "rollout_swap"
+    ]
+    refusals = [
+        {k: e.get(k) for k in ("t", "candidate", "reason", "error")
+         if k in e}
+        for e in events if e.get("event") == "rollout_refused"
+    ]
+    verdicts = [
+        {k: e.get(k) for k in
+         ("t", "old_version", "new_version", "breach", "psi",
+          "psi_threshold", "error_rate", "latency_ewma_ms", "results")
+         if k in e}
+        for e in events if e.get("event") == "rollout_canary_verdict"
+    ]
+    rollbacks = [
+        {k: e.get(k) for k in ("t", "reason", "old_version", "new_version",
+                               "stuck_replicas") if k in e}
+        for e in events if e.get("event") == "rollout_rolled_back"
+    ]
+
+    # per-version request accounting from the version-tagged result stream:
+    # every serve_result/serve_failure carries the model_version of the
+    # replica that served it, so the canary's share and the mixed-version
+    # window are auditable after the fact
+    versions: Dict[str, Dict[str, Any]] = {}
+
+    def _ver(v) -> Dict[str, Any]:
+        return versions.setdefault(str(v), {
+            "results": 0, "failures": 0, "latencies": [],
+        })
+
+    for e in events:
+        ev = e.get("event")
+        if ev == "serve_result" and e.get("model_version") is not None:
+            v = _ver(e["model_version"])
+            v["results"] += 1
+            if isinstance(e.get("wall_ms"), (int, float)):
+                v["latencies"].append(e["wall_ms"])
+        elif ev == "serve_failure" and e.get("model_version") is not None:
+            _ver(e["model_version"])["failures"] += 1
+    version_table = {}
+    for vid, v in sorted(versions.items()):
+        version_table[vid] = {
+            "results": v["results"],
+            "failures": v["failures"],
+            "latency_ms": _percentiles(v["latencies"]),
+        }
+
+    # the DRAINING edges in the health timeline are the capacity evidence:
+    # rolling swaps drain exactly one replica at a time
+    draining = [
+        {"t": e.get("t"), "replica": e.get("replica"),
+         "reason": e.get("reason")}
+        for e in events
+        if e.get("event") == "serve_health"
+        and e.get("state") == "DRAINING" and e.get("replica") is not None
+    ]
+
+    terminal = None
+    for p in phases:
+        if p.get("phase") in ("COMPLETE", "ROLLED_BACK", "IDLE"):
+            terminal = p["phase"]
+    return {
+        "phases": phases,
+        "terminal_phase": terminal,
+        "swaps": swaps,
+        "swaps_failed": sum(1 for s in swaps if not s.get("ok")),
+        "refusals": refusals,
+        "canary_verdicts": verdicts,
+        "rollbacks": rollbacks,
+        "versions": version_table,
+        "replica_drains": draining,
     }
 
 
@@ -913,6 +1015,8 @@ def build_report(paths: List[str],
     if any(str(e.get("event", "")).startswith("serve_") for e in events):
         report["serving"] = build_serving_section(events)
         report["slo"] = build_slo_section(events)
+    if any(str(e.get("event", "")).startswith("rollout_") for e in events):
+        report["rollout"] = build_rollout_section(events)
     if any(str(e.get("event", "")).startswith("route_") for e in events):
         report["router"] = build_router_section(events)
     if any(str(e.get("event", "")).startswith(("retrieve_", "retrieval_"))
@@ -1026,6 +1130,11 @@ def render_serving(report: Dict[str, Any]) -> str:
     else:
         lines.append("  outcome-total: every admitted request reached "
                      "exactly one terminal outcome")
+    if sv.get("results_by_version"):
+        vs = sv["results_by_version"]
+        tag = "MIXED-VERSION pod" if len(vs) > 1 else "single version"
+        lines.append("  results by model version (" + tag + "): "
+                     + ", ".join(f"{k}={v}" for k, v in sorted(vs.items())))
     if sv["latency_ms"]:
         lines.append(f"  latency:  {_fmt_stats(sv['latency_ms'], 'ms')}")
     for b, stats in sv["latency_ms_by_bucket"].items():
@@ -1349,6 +1458,71 @@ def render_retrieval(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_rollout(report: Dict[str, Any]) -> str:
+    r = report.get("rollout")
+    if not r:
+        return "(no rollout events in the log)"
+    lines = ["rollout (serving/rollout.py, replayed from the event log):"]
+    add = lines.append
+    if r["phases"]:
+        add("  phase timeline:")
+        for p in r["phases"]:
+            vers = ""
+            if p.get("old_version") or p.get("new_version"):
+                vers = (f"  [{p.get('old_version')} -> "
+                        f"{p.get('new_version')}]")
+            add(f"    -> {p.get('phase')}{vers}"
+                + (f"  ({p.get('reason')})" if p.get("reason") else ""))
+        term = r.get("terminal_phase") or "(none — log ends mid-rollout)"
+        add(f"  terminal phase: {term}")
+    for f in r["refusals"]:
+        add(f"  REFUSED {f.get('candidate')}: {f.get('reason')}"
+            + (f"  ({str(f.get('error'))[:120]})" if f.get("error")
+               else ""))
+    if r["swaps"]:
+        add(f"  weight swaps ({len(r['swaps'])}, "
+            f"{r['swaps_failed']} failed):")
+        for s in r["swaps"]:
+            ok = "ok" if s.get("ok") else f"FAILED ({s.get('error')})"
+            add(f"    {s.get('replica')} -> {s.get('version')}  "
+                f"warmed={s.get('warmed')}  {ok}")
+    for v in r["canary_verdicts"]:
+        breach = v.get("breach")
+        tag = f"BREACH {breach}" if breach else "pass"
+        add(f"  canary verdict [{tag}]: {v.get('old_version')} vs "
+            f"{v.get('new_version')}  results={v.get('results')}")
+        psi = v.get("psi") or {}
+        if psi:
+            add("    psi: " + ", ".join(
+                f"{k}={psi[k]:.4f}" if isinstance(psi[k], (int, float))
+                else f"{k}={psi[k]}" for k in sorted(psi))
+                + f"  (threshold {v.get('psi_threshold')})")
+        if v.get("error_rate"):
+            add(f"    error rate: {v['error_rate']}")
+        if v.get("latency_ewma_ms"):
+            add(f"    latency EWMA (ms): {v['latency_ewma_ms']}")
+    for rb in r["rollbacks"]:
+        stuck = rb.get("stuck_replicas") or []
+        add(f"  ROLLED BACK ({rb.get('reason')}): restored "
+            f"{rb.get('old_version')}"
+            + (f"  [stuck replicas: {', '.join(map(str, stuck))}]"
+               if stuck else ""))
+    if r["versions"]:
+        add("  per-version accounting (from version-tagged serve "
+            "results):")
+        for vid, v in r["versions"].items():
+            add(f"    {vid}: results={v['results']}  "
+                f"failures={v['failures']}"
+                + (f"  latency {_fmt_stats(v['latency_ms'], 'ms')}"
+                   if v["latency_ms"] else ""))
+    if r["replica_drains"]:
+        add(f"  replica drains: {len(r['replica_drains'])} "
+            "(one at a time is the capacity invariant)")
+        for d in r["replica_drains"]:
+            add(f"    {d.get('replica')}  ({d.get('reason')})")
+    return "\n".join(lines)
+
+
 def render_slo(report: Dict[str, Any]) -> str:
     s = report.get("slo")
     if not s or not s["admitted"]:
@@ -1481,6 +1655,12 @@ def main(argv=None) -> int:
                          "(backend-tagged accounting, the outcome-total "
                          "identity recomputed at the router level) when "
                          "the log holds route_* events")
+    ap.add_argument("--rollout", action="store_true",
+                    help="append the rollout section: phase timeline, "
+                         "per-replica weight swaps, canary verdicts (PSI/"
+                         "error-rate/latency), rollbacks, refusals, and "
+                         "per-model-version request accounting replayed "
+                         "from rollout_* and version-tagged serve events")
     ap.add_argument("--memory", action="store_true",
                     help="append the memory section: the compiled-program "
                          "ledger table, the HBM trajectory, leak-sentinel "
@@ -1525,6 +1705,9 @@ def main(argv=None) -> int:
             if report.get("router"):
                 print()
                 print(render_router(report))
+        if args.rollout:
+            print()
+            print(render_rollout(report))
         if args.memory:
             print()
             print(render_memory(report))

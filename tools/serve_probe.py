@@ -35,6 +35,14 @@ tier-1 suite cannot make honestly:
      ``--router`` replaces the local-service phases — it measures the pod
      tier, not this process's devices.
 
+  6. **Live-rollout walls** (``--rollout``) — a canaried old->new weight
+     swap promoted through a 2-replica pool under a sustained stream: the
+     rollout wall (drain + swap + bucket-ladder warmup per replica, off
+     the dispatch path), the admitted stream's latency through the
+     mixed-version window, per-version request accounting, and the
+     zero-lost verdict.  ``--rollout --tiny`` is the tier-1 smoke of the
+     PR 18 rollout plane.
+
 Usage::
 
     python tools/serve_probe.py [--sides 400,512] [--pairs 48] [--tiny]
@@ -415,6 +423,135 @@ def probe_router(n_backends: int, side: int, n_pairs: int,
     return out
 
 
+def probe_rollout(side: int, n_pairs: int, tiny: bool) -> Dict[str, Any]:
+    """The live-rollout sweep (PR 18): a canaried old->new weight swap
+    driven while a sustained stream runs against the pool — measuring the
+    thing the CPU tier cannot fake on a real device: the per-replica swap
+    +warmup wall off the dispatch path, the admitted stream's latency
+    through the mixed-version window, and the zero-lost outcome accounting
+    across the whole promotion.  ``--tiny`` runs the same sweep as the
+    tier-1 smoke of the rollout plumbing."""
+    import tempfile
+    import warnings
+
+    import jax
+    import numpy as np
+
+    from ncnet_tpu import models
+    from ncnet_tpu.config import ModelConfig
+    from ncnet_tpu.models import checkpoint as ckpt_io
+    from ncnet_tpu.serving import (
+        MatchService,
+        RolloutConfig,
+        ServingConfig,
+        resolve_serving_checkpoint,
+    )
+
+    side = min(side, 64) if tiny else side
+    if tiny:
+        cfg = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                          ncons_channels=(1,), half_precision=False)
+    else:
+        cfg = ModelConfig(ncons_kernel_sizes=(5, 5, 5),
+                          ncons_channels=(16, 16, 1),
+                          half_precision=True, backbone_bf16=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # random-trunk warning: timing only
+        params_old = models.init_ncnet(cfg, jax.random.key(0))
+    # the candidate is a near-identical "fine-tune" (epsilon-perturbed, so
+    # the weights digest differs and the store-detach path runs) rather
+    # than a fresh init: a genuinely different random model SHOULD fail
+    # the PSI gate and roll back — this probe measures the promotion walls
+    params_new = jax.tree.map(lambda x: x + 1e-6, params_old)
+
+    rng = np.random.default_rng(0)
+
+    def pair():
+        return (rng.integers(0, 255, (side, side, 3), dtype=np.uint8),
+                rng.integers(0, 255, (side, side, 3), dtype=np.uint8))
+
+    out: Dict[str, Any] = {"side": side, "tiny": tiny}
+    with tempfile.TemporaryDirectory() as root:
+        cand = os.path.join(root, "step_000100")
+        ckpt_io.save_params(cand, cfg, params_new)
+        state_path = os.path.join(root, "rollout_state.json")
+        scfg = ServingConfig(
+            max_queue=256, max_batch=4, max_in_flight_per_client=256,
+            buckets=((side, side),), max_buckets=2,
+            warm_buckets=((side, side),), replicas=2, model_version="v0")
+        service = MatchService(cfg, params_old, scfg).start()
+        futs = []
+        min_ready = None
+        try:
+            rcfg = RolloutConfig(
+                canary_fraction=0.5, canary_min_results=4,
+                canary_timeout_s=300.0, drain_timeout_s=120.0,
+                state_path=state_path)
+            from ncnet_tpu.serving import Overloaded
+
+            # ONE repeated pair: the canary judge compares old-vs-new
+            # quality distributions over the judge window, and with a
+            # handful of canary samples per-INPUT variation across
+            # distinct pairs reads as model drift — identical inputs make
+            # the PSI verdict measure the model delta alone
+            p0 = pair()
+            t0 = time.perf_counter()
+            ctl = service.start_rollout(cand, config=rcfg)
+            shed_at_submit = 0
+            while True:
+                st = ctl.status()
+                if st["phase"] in ("COMPLETE", "ROLLED_BACK", "IDLE"):
+                    break
+                if time.perf_counter() - t0 > 600:
+                    break
+                # the stream offers load faster than a tiny CPU engine
+                # absorbs it: elastic admission shedding the overflow IS
+                # the designed behavior — classify it, keep streaming
+                try:
+                    futs.append(service.submit(*p0))
+                except Overloaded as e:
+                    shed_at_submit += 1
+                    time.sleep(min(e.retry_after_s or 0.1, 0.5))
+                pool = (service.health().get("pool") or {})
+                if pool.get("ready") is not None:
+                    min_ready = pool["ready"] if min_ready is None \
+                        else min(min_ready, pool["ready"])
+                time.sleep(0.02)
+            rollout_wall = time.perf_counter() - t0
+            outcomes = {"result": 0, "other": 0,
+                        "shed_at_submit": shed_at_submit}
+            walls = []
+            for f in futs:
+                try:
+                    walls.append(f.result(timeout=600).wall_s * 1e3)
+                    outcomes["result"] += 1
+                except Exception:  # noqa: BLE001 — classified accounting
+                    outcomes["other"] += 1
+            snap = service.metrics()
+            out.update({
+                "phase": st["phase"],
+                "verdict": st.get("verdict"),
+                "old_version": st.get("old_version"),
+                "new_version": st.get("new_version"),
+                "rollout_wall_s": round(rollout_wall, 2),
+                "streamed": len(futs),
+                "outcomes": outcomes,
+                "lost": sum(1 for f in futs if f.outcome is None),
+                "min_ready_replicas": min_ready,
+                "stream_latency_ms": _percentiles(walls),
+                "results_by_version": {
+                    k[len("version_results_"):]: v
+                    for k, v in snap.items()
+                    if k.startswith("version_results_")},
+                "resolved_checkpoint": resolve_serving_checkpoint(
+                    state_path, "(old)"),
+                "pod_version": service.model_version,
+            })
+        finally:
+            service.stop()
+    return out
+
+
 def _structured_pano(i: int, hw=(96, 128)):
     """Deterministic STRUCTURED test image: distinct per-pano hue levels +
     a stripe pattern.  Random-noise images are useless here — the raw
@@ -611,6 +748,14 @@ def main(argv=None) -> int:
                          "the local service: capacity through the router, "
                          "the SIGKILL failover pause + zero-lost "
                          "accounting, and the shed wall")
+    ap.add_argument("--rollout", action="store_true",
+                    help="sweep the LIVE-ROLLOUT plane instead: save a "
+                         "candidate checkpoint, drive a sustained stream "
+                         "against a 2-replica pool while a canaried "
+                         "old->new weight swap promotes through it, and "
+                         "report the rollout wall, the mixed-version "
+                         "stream latency, per-version accounting, and the "
+                         "zero-lost verdict (--tiny = tier-1 smoke)")
     ap.add_argument("--shards", type=int, default=0,
                     help="spawn N retrieval shard subprocesses over a "
                          "synthetic coarse index and sweep the RETRIEVAL "
@@ -658,6 +803,9 @@ def main(argv=None) -> int:
                 metrics["retrieve_coverage_pct"] = steady["coverage_pct"]
                 metrics["retrieve_hedge_pct"] = steady["hedge_pct"]
             maybe_record(metrics, source="serve_probe_shards")
+        elif args.rollout:
+            out = {"rollout": probe_rollout(sides[0], args.pairs,
+                                            args.tiny)}
         elif args.router > 0:
             out = {"router": probe_router(
                 args.router, sides[0], args.pairs, args.burst_factor,

@@ -37,6 +37,12 @@ degrades to ``--min-age`` alone.  An unreachable endpoint maps to the
 ``missing`` verdict (exit 2) — "not started or already gone", the same
 supervisor semantics as a missing heartbeat file.
 
+A document carrying a ``model_version`` / ``rollout`` section
+(ncnet_tpu/serving/rollout.py) ships a model advisory: a pod mid-rollout
+is intentionally mixed-version with one replica drained at a time, so the
+verdict names the phase and the version split instead of letting either
+read as trouble — and, like the store advisory, never flags a stall.
+
 A health document carrying a feature-store section (ncnet_tpu/store/)
 ships a store advisory in the verdict: a DEGRADED store fails OPEN (every
 query still answered, via recompute), so store-DEGRADED is rendered as a
@@ -245,6 +251,43 @@ def _apply_retrieval_advisory(verdict: Dict[str, Any],
     }
 
 
+def _apply_rollout_advisory(verdict: Dict[str, Any],
+                            doc: Dict[str, Any]) -> None:
+    """Model-version / live-rollout advisory (PR 18,
+    ncnet_tpu/serving/rollout.py).  A pod mid-rollout is INTENTIONALLY
+    mixed-version — one canary or rolling-swap replica on the candidate
+    while the rest serve the incumbent — so this surfaces the phase and
+    the version split instead of letting an operator read the drained
+    replica or the version skew as trouble.  Strictly an advisory: a
+    rollout never touches the liveness status (the whole design point is
+    that the pod keeps serving through it)."""
+    out: Dict[str, Any] = {}
+    if doc.get("model_version"):
+        out["model_version"] = doc["model_version"]
+    ro = doc.get("rollout")
+    if isinstance(ro, dict) and ro.get("phase") not in (None, "IDLE"):
+        out["rollout"] = {
+            "phase": ro.get("phase"),
+            "old_version": ro.get("old_version"),
+            "new_version": ro.get("new_version"),
+            "reason": ro.get("reason"),
+        }
+    # per-replica version split (service doc) / per-pod version list
+    # (router doc): more than one distinct version = mixed-version window
+    versions: List[str] = []
+    for row in (doc.get("pool") or {}).get("replicas") or []:
+        if isinstance(row, dict) and row.get("model_version"):
+            versions.append(str(row["model_version"]))
+    pod_versions = (doc.get("pod") or {}).get("model_versions")
+    if isinstance(pod_versions, list):
+        versions.extend(str(v) for v in pod_versions)
+    distinct = sorted(set(versions))
+    if len(distinct) > 1:
+        out["mixed_versions"] = distinct
+    if out:
+        verdict["model"] = out
+
+
 def _apply_hbm_warning(verdict: Dict[str, Any], doc: Dict[str, Any],
                        warn_pct: float) -> None:
     """HBM-pressure advisory from the health document's memory section
@@ -323,6 +366,7 @@ def judge_url(url: str, events_path: Optional[str] = None,
     if events_path:
         _apply_replica_backstop(verdict, events_path, factor, min_age)
     _apply_backend_backstop(verdict, doc, factor, min_age)
+    _apply_rollout_advisory(verdict, doc)
     _apply_retrieval_advisory(verdict, doc)
     _apply_hbm_warning(verdict, doc, hbm_warn_pct)
     _apply_store_advisory(verdict, doc)
@@ -447,6 +491,24 @@ def main(argv=None) -> int:
             print(f"  backend {bid} [{b.get('state')}]: last result "
                   f"{b['last_result_age_s']}s ago vs {b['threshold_s']}s "
                   f"({tag})")
+        mv = verdict.get("model")
+        if mv:
+            ro = mv.get("rollout")
+            if ro:
+                phase = ro.get("phase")
+                vers = (f"({ro.get('old_version')} -> "
+                        f"{ro.get('new_version')})")
+                if phase in ("COMPLETE", "ROLLED_BACK"):
+                    print(f"  last rollout: {phase} {vers}")
+                else:
+                    print(f"  rollout in progress: {phase} {vers} — mixed "
+                          "versions and one DRAINING replica are expected "
+                          "here, not trouble")
+            if mv.get("mixed_versions"):
+                print("  MIXED-VERSION pod: "
+                      + ", ".join(mv["mixed_versions"]))
+            elif mv.get("model_version") and not ro:
+                print(f"  model version: {mv['model_version']}")
         rt = verdict.get("retrieval")
         if rt:
             print(f"  retrieval pod: {rt.get('shards_ready')}/"
