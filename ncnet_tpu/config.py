@@ -46,6 +46,20 @@ class ModelConfig:
     sparse_halo: int = -1                # fine-cell patch halo around each
                                          # candidate block; -1 = auto (one
                                          # coarse ring = factor cells)
+    # streaming tracked mode (ops/temporal.py; README "Streaming matching"):
+    # search-window radius, in coarse cells, used to dilate the previous
+    # frame's match table into candidate rows when a stream session skips
+    # the coarse pass.  The tracked fine pass evaluates (2r+1)² tiles per
+    # source cell, so the radius scales its cost the way sparse_topk
+    # scales the coarse-to-fine tier's — radius 0 (one tile: the prior's
+    # cell, with the sparse_halo ring already granting ±halo fine cells
+    # of motion) is the steady-frame configuration that undercuts the
+    # k-candidate coarse-to-fine wall; radius 1 costs 9 tiles/cell and
+    # only pays off when frame-to-frame motion routinely crosses coarse
+    # cells (cut detection handles the rest by exact fallback).  Only
+    # consumed by the tracked filter — dense and coarse-to-fine queries
+    # ignore it.
+    track_radius: int = 0
     # force a named ARITHMETIC filter tier ('cp' | 'fft'; ops/conv4d_cp.py,
     # ops/conv4d_fft.py) through the NC stack, bypassing choose_fused_stack's
     # FLOP gates.  '' (default) lets the chooser pick.  'cp' requires CP

@@ -10,12 +10,14 @@ from ncnet_tpu.models.ncnet import (
     NCNet,
     NCNetOutput,
     coarse2fine_filter,
+    coarse2fine_tracked_filter,
     extract_features,
     init_ncnet,
     make_point_matcher,
     ncnet_filter,
     ncnet_forward,
     ncnet_forward_from_features,
+    ncnet_forward_tracked,
     ncnet_match_volume,
     neigh_consensus,
 )
@@ -38,9 +40,11 @@ __all__ = [
     "load_params",
     "make_point_matcher",
     "coarse2fine_filter",
+    "coarse2fine_tracked_filter",
     "ncnet_filter",
     "ncnet_forward",
     "ncnet_forward_from_features",
+    "ncnet_forward_tracked",
     "ncnet_match_volume",
     "neigh_consensus",
     "save_params",

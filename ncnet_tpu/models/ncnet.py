@@ -834,6 +834,24 @@ def coarse2fine_filter(config: ModelConfig, params, fa: jnp.ndarray,
     cand_ab = topk_candidates(coarse.corr, config.sparse_topk)
     cand_ba = topk_candidates(
         jnp.transpose(coarse.corr, (0, 3, 4, 1, 2)), config.sparse_topk)
+    return _sparse_dual_refine(config, nc_params, fa, fb, cand_ab, cand_ba,
+                               factor=factor, halo=halo)
+
+
+def _sparse_dual_refine(config: ModelConfig, nc_params, fa: jnp.ndarray,
+                        fb: jnp.ndarray, cand_ab: jnp.ndarray,
+                        cand_ba: jnp.ndarray, *, factor: int,
+                        halo: int) -> NCNetOutput:
+    """The candidate-agnostic fine pass shared by :func:`coarse2fine_filter`
+    and :func:`coarse2fine_tracked_filter`: refine BOTH candidate families
+    through the gathered-tile NC stack and merge on the dense frame.  One
+    code path for both tiers is what makes the tracked mode's full-coverage
+    / fallback equalities structural — each tile's filtered value depends
+    only on its (source cell, candidate cell) pair and the cross-tile
+    scatter-max gates, all order-independent, so any two candidate sets
+    with equal coverage scatter the identical dense volume.  Inputs are
+    already precision-cast by the caller."""
+    from ncnet_tpu.ops.sparse_corr import sparse_refine
 
     def stack_fn(vol: jnp.ndarray) -> jnp.ndarray:
         # the folded-tile batch consults the SAME tier chooser as the dense
@@ -864,6 +882,79 @@ def coarse2fine_filter(config: ModelConfig, params, fa: jnp.ndarray,
     # full coverage each family alone already equals the dense volume
     corr = jnp.maximum(vol_ab, jnp.transpose(vol_ba, (0, 3, 4, 1, 2)))
     return NCNetOutput(corr, None)
+
+
+def coarse2fine_tracked_filter(config: ModelConfig, params, fa: jnp.ndarray,
+                               fb: jnp.ndarray, prior_ab: jnp.ndarray,
+                               prior_ba: jnp.ndarray) -> NCNetOutput:
+    """The TRACKED match pipeline (README "Streaming matching"): the
+    coarse-to-fine fine pass with the coarse pass REPLACED by temporal
+    candidate seeding — frame ``t-1``'s match table, inverted to a
+    per-coarse-cell prior pair (``ops/temporal.prior_from_table``) and
+    dilated in-graph by the static ``(2·track_radius+1)²`` search window
+    (``ops/temporal.temporal_candidates``).  No coarse correlation, no
+    coarse NC filter: on a steady frame the only dense-resolution work is
+    the gathered tiles.  Both candidate families are seeded (A→B from
+    ``prior_ab``, B→A from ``prior_ba``) so both readout directions stay
+    covered exactly like the symmetric top-k selection.  The output is the
+    same dense-shaped wire volume; at full window coverage (radius ≥
+    coarse grid − 1) it is bitwise the sparse tier's at full k (shared
+    :func:`_sparse_dual_refine`).  Callers gate eligibility through
+    ``choose_tracked_pipeline`` and own cut/drift fallback — this function
+    trusts its prior."""
+    from ncnet_tpu.ops.sparse_topk import resolve_halo
+    from ncnet_tpu.ops.temporal import temporal_candidates
+
+    nc_params = params["nc"]
+    if config.half_precision:
+        nc_params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), nc_params)
+        fa = fa.astype(jnp.bfloat16)
+        fb = fb.astype(jnp.bfloat16)
+    factor = config.sparse_factor
+    halo = resolve_halo(config.sparse_halo, factor)
+    hac, wac = fa.shape[1] // factor, fa.shape[2] // factor
+    hbc, wbc = fb.shape[1] // factor, fb.shape[2] // factor
+    cand_ab = temporal_candidates(prior_ab, hbc, wbc, config.track_radius)
+    cand_ba = temporal_candidates(prior_ba, hac, wac, config.track_radius)
+    return _sparse_dual_refine(config, nc_params, fa, fb, cand_ab, cand_ba,
+                               factor=factor, halo=halo)
+
+
+def ncnet_forward_tracked(
+    config: ModelConfig,
+    params,
+    source_features: jnp.ndarray,
+    target_images: jnp.ndarray,
+    prior_ab: jnp.ndarray,
+    prior_ba: jnp.ndarray,
+) -> NCNetOutput:
+    """Streaming forward: source (reference) features precomputed — resolved
+    once per stream from the feature store — target frame extracted
+    in-program, and the match volume built by the tracked pipeline.  The
+    tier consult happens at trace time like every other dispatch, so a
+    demoted sparse tier retraces onto the ordinary
+    :func:`ncnet_match_volume` fallback instead of re-entering the crashed
+    fine pass through the streaming door."""
+    from ncnet_tpu.ops.sparse_corr import choose_tracked_pipeline
+    from ncnet_tpu.ops.sparse_topk import resolve_halo
+
+    fa = source_features
+    fb = extract_features(config, params, target_images)
+    if config.half_precision:
+        fa = fa.astype(jnp.bfloat16)
+        fb = fb.astype(jnp.bfloat16)
+    tier = choose_tracked_pipeline(
+        fa.shape[1], fa.shape[2], fb.shape[1], fb.shape[2],
+        factor=config.sparse_factor,
+        halo=resolve_halo(config.sparse_halo, config.sparse_factor),
+        radius=config.track_radius,
+        reloc_k=config.relocalization_k_size,
+    )
+    if tier == "tracked":
+        return coarse2fine_tracked_filter(config, params, fa, fb,
+                                          prior_ab, prior_ba)
+    return ncnet_match_volume(config, params, fa, fb)
 
 
 def ncnet_filter(config: ModelConfig, params, corr: jnp.ndarray,

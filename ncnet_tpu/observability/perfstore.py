@@ -279,7 +279,13 @@ _HIGHER_TOKENS = ("pck", "pairs_per_s", "pairs_per_sec", "qps",
                   # CP tier (ops/conv4d_cp.py): argmax-match agreement of
                   # the rank-R filtered volume vs the dense filter — the
                   # label-free PCK-recovery proxy the bench tracks per rank
-                  "recovery_pct")
+                  "recovery_pct",
+                  # streaming tracked mode (serving/stream.py): the
+                  # fraction of stream frames that skipped the coarse pass
+                  # — the steady-state win the bench scenario gates; a
+                  # falling skip rate means cut detection is over-firing
+                  # or tracking stopped engaging
+                  "skip_pct")
 _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  "_step_s", "_wall_s",
                  # diffuse match distributions are worse: entropy gates

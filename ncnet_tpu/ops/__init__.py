@@ -59,10 +59,20 @@ from ncnet_tpu.ops.sparse_topk import (  # noqa: F401
 )
 from ncnet_tpu.ops.sparse_corr import (  # noqa: F401
     choose_match_pipeline,
+    choose_tracked_pipeline,
     coarse2fine_feasible,
     sparse_fine_corr,
     sparse_mutual_matching,
     sparse_refine,
+    tracking_feasible,
+)
+from ncnet_tpu.ops.temporal import (  # noqa: F401
+    FEATURE_STRIDE,
+    identity_prior,
+    prior_from_table,
+    temporal_candidates,
+    tracking_recall_proxy,
+    window_size,
 )
 from ncnet_tpu.ops.matching import (
     Matches,
@@ -125,10 +135,18 @@ __all__ = [
     "pool_features",
     "topk_candidates",
     "choose_match_pipeline",
+    "choose_tracked_pipeline",
     "coarse2fine_feasible",
     "sparse_fine_corr",
     "sparse_mutual_matching",
     "sparse_refine",
+    "tracking_feasible",
+    "FEATURE_STRIDE",
+    "identity_prior",
+    "prior_from_table",
+    "temporal_candidates",
+    "tracking_recall_proxy",
+    "window_size",
     "scatter_sparse_scores",
     "mutual_argmax_agreement",
     "mutual_matching",

@@ -491,3 +491,44 @@ def choose_match_pipeline(ha: int, wa: int, hb: int, wb: int, *,
     sig = (ha, wa, hb, wb, (factor,), (sparse_topk,))
     _nfl._emit_tier_selected("pipeline", sig, tier, none_label="dense")
     return tier
+
+
+def tracking_feasible(ha: int, wa: int, hb: int, wb: int, *,
+                      factor: int, halo: int, radius: int,
+                      reloc_k: int = 0) -> bool:
+    """Whether the tracked (coarse-pass-skipping) pipeline applies to this
+    shape class: identical geometry constraints to
+    :func:`coarse2fine_feasible` — the tracked fine pass runs the SAME
+    gathered-tile refine, just with temporally-seeded candidates — except
+    the selection knob is the search radius instead of ``sparse_topk``."""
+    if radius < 0 or factor <= 1 or reloc_k > 1:
+        return False
+    if any(d % factor for d in (ha, wa, hb, wb)):
+        return False
+    patch = patch_side(factor, halo)
+    return min(ha, wa) >= patch and min(hb, wb) >= patch
+
+
+def choose_tracked_pipeline(ha: int, wa: int, hb: int, wb: int, *,
+                            factor: int, halo: int, radius: int,
+                            reloc_k: int = 0) -> Optional[str]:
+    """Tier authority for the tracked pipeline at a shape class:
+    ``"tracked"`` or ``None`` (fall back to whatever
+    :func:`choose_match_pipeline` picks).  The tracked tier shares the
+    sparse refine machinery with "coarse2fine", so a demotion of EITHER
+    name disables tracking — a crashed sparse fine pass must not keep
+    being re-entered through the streaming door.  Decisions are stamped
+    like every other tier consult."""
+    from ncnet_tpu.ops import nc_fused_lane as _nfl
+    from ncnet_tpu.ops import tier_cache
+
+    tier = None
+    if tracking_feasible(ha, wa, hb, wb, factor=factor, halo=halo,
+                         radius=radius, reloc_k=reloc_k):
+        dead = (_nfl.demoted_fused_tiers()
+                | tier_cache.persistent_demotions())
+        if not dead & {"tracked", "coarse2fine"}:
+            tier = "tracked"
+    sig = (ha, wa, hb, wb, (factor,), (radius,))
+    _nfl._emit_tier_selected("pipeline", sig, tier, none_label="dense")
+    return tier

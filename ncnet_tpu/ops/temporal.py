@@ -1,0 +1,191 @@
+"""Temporal candidate selection for streaming (tracked) matching.
+
+The coarse-to-fine tier (``ops/sparse_topk.py`` + ``ops/sparse_corr.py``)
+pays a full dense coarse filter per query just to pick each source cell's
+top-k candidate target neighbourhoods.  A video stream has a better prior
+for free: frame ``t-1``'s match table.  This module turns that table into
+candidate rows of the EXACT shape/contract ``topk_candidates`` produces —
+``(B, N, K)`` int32 flattened coarse target indices under the static-shape
+coverage-padding contract — so the gathered-tile fine pass, the scatter
+readout, and the wire format are reused unchanged and frame ``t`` skips the
+coarse pass entirely on steady frames:
+
+  * :func:`temporal_candidates` — in-graph dilation of a per-cell prior by
+    a static ``(2r+1)²`` search window, clamped into the coarse grid (edge
+    duplicates are harmless: the sparse scatter resolves by max, exactly
+    the ``topk_candidates`` padding rule);
+  * :func:`prior_from_table` — host-side inversion of a served ``(5|6, N)``
+    match table into the per-coarse-cell prior pair the next frame seeds
+    from (both families: A→B for ``cand_ab``, B→A for ``cand_ba``);
+  * :func:`tracking_recall_proxy` — the cut/drift detector's candidate-
+    containment proxy for ``sparse_topk.candidate_recall`` (the real recall
+    needs the dense volume the tracked frame deliberately never computed).
+
+Stream/session state (who owns which prior, cut fallback, eviction) lives
+in the serving layer (``serving/stream.py``); everything here is stateless.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# every trunk in models/backbone.py (resnet layer3, vgg pool4, densenet,
+# tiny) downsamples by 16: the serving layer maps image buckets to feature
+# grids with this constant
+FEATURE_STRIDE = 16
+
+
+def window_size(radius: int) -> int:
+    """Candidates per prior cell: the static ``(2r+1)²`` search window —
+    the tracked analog of ``sparse_topk``'s ``k``."""
+    r = int(radius)
+    if r < 0:
+        raise ValueError(f"track radius must be >= 0, got {radius}")
+    return (2 * r + 1) ** 2
+
+
+def temporal_candidates(prior: jnp.ndarray, hc: int, wc: int,
+                        radius: int) -> jnp.ndarray:
+    """Dilate a per-cell prior into candidate rows — the tracked
+    counterpart of :func:`~ncnet_tpu.ops.sparse_topk.topk_candidates`.
+
+    Args:
+      prior: ``(B, N)`` int32 — for every coarse SOURCE-side cell, the
+        flattened coarse TARGET-side index (row-major ``i·wc + j``) frame
+        ``t-1`` matched it to.
+      hc, wc: coarse target-side grid dims (``prior`` decodes against
+        ``wc``; values are clipped into the grid, so a stale or padded
+        prior can never index out of bounds).
+      radius: static search-window radius in coarse cells.
+
+    Returns:
+      ``(B, N, (2r+1)²)`` int32 candidate rows under the same coverage
+      contract as top-k selection: static shape for any (radius, grid)
+      combination, window cells clamped into the grid (edge windows shift
+      inward, producing duplicates the sparse scatter resolves by max),
+      and every row containing its prior cell's full block.
+    """
+    k = window_size(radius)  # validates radius
+    r = int(radius)
+    prior = jnp.clip(prior.astype(jnp.int32), 0, hc * wc - 1)
+    ic = prior // wc
+    jc = prior % wc
+    d = np.arange(-r, r + 1, dtype=np.int32)
+    di = np.repeat(d, 2 * r + 1)
+    dj = np.tile(d, 2 * r + 1)
+    wi = jnp.clip(ic[..., None] + di[None, None, :], 0, hc - 1)
+    wj = jnp.clip(jc[..., None] + dj[None, None, :], 0, wc - 1)
+    out = (wi * wc + wj).astype(jnp.int32)
+    assert out.shape[-1] == k
+    return out
+
+
+def _cells_from_coords(x: np.ndarray, y: np.ndarray, h: int, w: int,
+                       scale: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Invert ``corr_to_matches``' normalized coordinates back onto integer
+    grid cells (the ``linspace(lo, 1, n)`` convention, k_size=1 — the only
+    relocalization class the sparse tier admits)."""
+    lo = -1.0 if scale == "centered" else 0.0
+    span = 1.0 - lo
+    j = np.rint((np.asarray(x, np.float64) - lo) * (w - 1) / span) \
+        if w > 1 else np.zeros_like(x)
+    i = np.rint((np.asarray(y, np.float64) - lo) * (h - 1) / span) \
+        if h > 1 else np.zeros_like(y)
+    return (np.clip(i, 0, h - 1).astype(np.int64),
+            np.clip(j, 0, w - 1).astype(np.int64))
+
+
+def identity_prior(n_src_coarse: int, wc_src: int, hc_tgt: int,
+                   wc_tgt: int) -> np.ndarray:
+    """Zero-motion prior: every coarse source cell looks at the same
+    (row, col) on the target grid, clamped — the coverage-padding value for
+    cells frame ``t-1`` never claimed, and a valid cold seed for
+    same-scene streams."""
+    c = np.arange(n_src_coarse)
+    i = np.minimum(c // wc_src, hc_tgt - 1)
+    j = np.minimum(c % wc_src, wc_tgt - 1)
+    return (i * wc_tgt + j).astype(np.int32)
+
+
+def prior_from_table(table: np.ndarray, grid_a: Tuple[int, int],
+                     grid_b: Tuple[int, int], factor: int,
+                     scale: str = "centered"
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Invert one served match table into the next frame's prior pair.
+
+    Args:
+      table: ``(5|6, N)`` float32 — the serving wire rows (xA, yA, xB, yB,
+        score; row 5, when present, is the quality row and is ignored).
+        ``N == hB·wB``: one entry per fine TARGET cell, each naming its
+        best source cell (``corr_to_matches``' default direction).
+      grid_a, grid_b: fine source/target grids ``(h, w)``.
+      factor: coarse pooling factor (``config.sparse_factor``).
+      scale: the table's coordinate scale ('centered' | 'positive').
+
+    Returns:
+      ``(prior_ab, prior_ba)`` int32 —
+      ``prior_ab[c]``: per coarse SOURCE cell, the coarse target cell its
+      best-scoring claimant sat in (unclaimed cells fall back to the
+      zero-motion :func:`identity_prior`);
+      ``prior_ba[c]``: per coarse TARGET cell, the coarse source cell of
+      its best-scoring fine entry.  Both are coverage-total by
+      construction — every cell holds a valid in-grid index.
+    """
+    t = np.asarray(table, dtype=np.float32)
+    if t.ndim != 2 or t.shape[0] < 5:
+        raise ValueError(f"match table must be (5|6, N), got {t.shape}")
+    ha, wa = grid_a
+    hb, wb = grid_b
+    if t.shape[1] != hb * wb:
+        raise ValueError(
+            f"table has {t.shape[1]} rows, target grid {hb}x{wb} needs "
+            f"{hb * wb}")
+    xa, ya, xb, yb, score = t[0], t[1], t[2], t[3], t[4]
+    ia, ja = _cells_from_coords(xa, ya, ha, wa, scale)
+    ib, jb = _cells_from_coords(xb, yb, hb, wb, scale)
+    hac, wac = ha // factor, wa // factor
+    hbc, wbc = hb // factor, wb // factor
+    ca = (ia // factor) * wac + (ja // factor)
+    cb = (ib // factor) * wbc + (jb // factor)
+    # score-ascending order: the last write per cell below is the max-score
+    # entry — one vectorized pass instead of a python argmax per cell
+    order = np.argsort(score, kind="stable")
+    prior_ba = identity_prior(hbc * wbc, wbc, hac, wac)
+    prior_ba[cb[order]] = ca[order]
+    prior_ab = identity_prior(hac * wac, wac, hbc, wbc)
+    prior_ab[ca[order]] = cb[order]
+    return prior_ab.astype(np.int32), prior_ba.astype(np.int32)
+
+
+def tracking_recall_proxy(prior_ab: np.ndarray, table: np.ndarray,
+                          grid_a: Tuple[int, int], grid_b: Tuple[int, int],
+                          factor: int, radius: int,
+                          scale: str = "centered") -> float:
+    """Candidate-containment proxy for ``candidate_recall`` on a tracked
+    frame: the fraction of served entries whose (source → target) coarse
+    pairing falls inside the search window the frame was seeded with.
+
+    The true recall compares candidates against the DENSE volume's argmax
+    — exactly the volume a tracked frame skipped computing.  But the
+    merged two-family readout can land a row's match outside its source
+    cell's A→B window (the B→A tiles contribute their own support), and on
+    a scene cut it mostly does: the prior stops describing the scene, so
+    containment collapses along with the quality signals.  Steady frames
+    sit near 1.0.  Host-side numpy, like ``candidate_recall``."""
+    t = np.asarray(table, dtype=np.float32)
+    ha, wa = grid_a
+    hb, wb = grid_b
+    ia, ja = _cells_from_coords(t[0], t[1], ha, wa, scale)
+    ib, jb = _cells_from_coords(t[2], t[3], hb, wb, scale)
+    wac = wa // factor
+    wbc = wb // factor
+    ca = (ia // factor) * wac + (ja // factor)
+    cb_i, cb_j = (ib // factor), (jb // factor)
+    prior = np.asarray(prior_ab).reshape(-1)[ca]
+    di = np.abs(cb_i - prior // wbc)
+    dj = np.abs(cb_j - prior % wbc)
+    r = int(radius)
+    return float(np.mean((di <= r) & (dj <= r)))

@@ -84,6 +84,13 @@ from ncnet_tpu.serving.request import (  # noqa: F401
 )
 from ncnet_tpu.serving.service import MatchService, ServingConfig  # noqa: F401
 from ncnet_tpu.serving.slo import SLOTracker  # noqa: F401
+from ncnet_tpu.serving.stream import (  # noqa: F401
+    StreamFrameResult,
+    StreamSession,
+    StreamTable,
+    run_stream_load,
+    stream_schedule,
+)
 
 __all__ = [
     "ADMITTING",
@@ -130,6 +137,9 @@ __all__ = [
     "STOPPED",
     "ServingConfig",
     "ShapeBucketer",
+    "StreamFrameResult",
+    "StreamSession",
+    "StreamTable",
     "TERMINAL_OUTCOMES",
     "WIRE_SCHEMA",
     "WireError",
@@ -139,5 +149,7 @@ __all__ = [
     "pad_to_bucket",
     "read_rollout_state",
     "resolve_serving_checkpoint",
+    "run_stream_load",
+    "stream_schedule",
     "write_rollout_state",
 ]

@@ -31,11 +31,17 @@ leaves the engine bit-identical to the pre-store path.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ncnet_tpu.config import ModelConfig
+
+# bounded per-engine digest→features memo for the TRACKED path when no
+# persistent store is attached: a stream re-matches one reference image for
+# many frames, so a handful of entries covers every live stream on a replica
+_TRACKED_FEATURE_CACHE_ENTRIES = 16
 
 
 class BatchMatchEngine:
@@ -60,6 +66,7 @@ class BatchMatchEngine:
             extract_features,
             ncnet_forward,
             ncnet_forward_from_features,
+            ncnet_forward_tracked,
         )
         from ncnet_tpu.observability.quality import append_quality_rows
         from ncnet_tpu.ops import corr_to_matches
@@ -115,6 +122,15 @@ class BatchMatchEngine:
             return extract_features(
                 config, p, normalize_imagenet(src.astype(jnp.float32)))
 
+        def run_tracked(p, fa, tgt, prior_ab, prior_ba):
+            # the streaming frame program: reference features precomputed
+            # (resolved once per stream), target frame extracted in-program,
+            # match volume built from the previous frame's priors — NO
+            # coarse pass (models.ncnet_forward_tracked)
+            tgt = normalize_imagenet(tgt.astype(jnp.float32))
+            return tables_from(ncnet_forward_tracked(
+                config, p, fa, tgt, prior_ab, prior_ba))
+
         from ncnet_tpu.observability.quality import active_tier
 
         self._jitted = ResilientJit(
@@ -141,9 +157,28 @@ class BatchMatchEngine:
             ledger_tier=lambda: active_tier(self.half_precision),
         )
         self._feat = ResilientJit(run_feat, hook=False)
+        self._jitted_tracked = ResilientJit(
+            run_tracked, label="serve_batch",
+            ledger_program="serve_batch",
+            ledger_key_fn=lambda p, fa, t, pa, pb: (
+                f"trk{'x'.join(str(d) for d in fa.shape[1:])}"
+                f"-{t.shape[1]}x{t.shape[2]}xb{fa.shape[0]}"),
+            ledger_tier=lambda: active_tier(self.half_precision),
+        )
         self.feature_extractions = 0  # executed trunk dispatches (the spy)
+        # coarse-pass spy (streaming acceptance contract): counts batches
+        # dispatched through a program whose candidate selection pays the
+        # full coarse (or dense) filter — i.e. every non-tracked forward.
+        # A steady tracked stream must leave this flat.
+        self.coarse_passes = 0
+        self.tracked_dispatches = 0
+        self.swap_fastpath_hits = 0
+        # digest→features memo for tracked streams without a store
+        self._feat_cache: "collections.OrderedDict[str, np.ndarray]" = (
+            collections.OrderedDict())
 
-    def dispatch(self, src_u8: np.ndarray, tgt_u8: np.ndarray):
+    def dispatch(self, src_u8: np.ndarray, tgt_u8: np.ndarray,
+                 src_digests: Optional[Sequence[Optional[str]]] = None):
         """Enqueue upload + forward + match extraction; returns the
         on-device handle without blocking.  The fault-injection seam
         (``faults.device_fail_calls``) lives on the ResilientJit dispatch,
@@ -152,9 +187,14 @@ class BatchMatchEngine:
         With a feature store attached, each SOURCE row resolves through it
         first (verified hit / recompute + commit) and the batch runs the
         cached-pair program — the resolve is the one blocking step (a miss
-        pulls the computed features to host to commit them)."""
+        pulls the computed features to host to commit them).
+        ``src_digests`` lets a caller that already knows a row's content
+        digest (a stream session memoizes its reference image's — the
+        image is unchanged frame over frame) skip the per-request sha256
+        of that row; ``None`` entries hash as before."""
         import jax.numpy as jnp
 
+        self.coarse_passes += 1
         if self._store is None:
             return self._jitted(self._params, jnp.asarray(src_u8),
                                 jnp.asarray(tgt_u8))
@@ -163,6 +203,9 @@ class BatchMatchEngine:
         rows = []
         for i in range(src_u8.shape[0]):
             row = np.ascontiguousarray(src_u8[i])
+            digest = src_digests[i] if src_digests is not None else None
+            if digest is None:
+                digest = content_digest(row)
 
             def compute(row=row) -> np.ndarray:
                 self.feature_extractions += 1
@@ -170,10 +213,97 @@ class BatchMatchEngine:
                     self._feat(self._params, jnp.asarray(row[None])),
                     dtype=np.float32)[0]
 
-            arr, _status = self._store.resolve(content_digest(row), compute)
+            arr, _status = self._store.resolve(digest, compute)
             rows.append(arr)
         fa = jnp.asarray(np.stack(rows))
         return self._jitted_cached(self._params, fa, jnp.asarray(tgt_u8))
+
+    def _resolve_src_features(self, row: np.ndarray,
+                              digest: Optional[str]) -> np.ndarray:
+        """One source row's backbone features for the tracked path: the
+        persistent store when attached (same resolve ladder as the pair
+        path), else a small in-engine digest→features memo — either way a
+        steady stream extracts its reference trunk ONCE, not per frame."""
+        from ncnet_tpu.store import content_digest
+
+        import jax.numpy as jnp
+
+        row = np.ascontiguousarray(row)
+        if digest is None:
+            digest = content_digest(row)
+
+        def compute() -> np.ndarray:
+            self.feature_extractions += 1
+            return np.asarray(
+                self._feat(self._params, jnp.asarray(row[None])),
+                dtype=np.float32)[0]
+
+        if self._store is not None:
+            arr, _status = self._store.resolve(digest, compute)
+            return arr
+        hit = self._feat_cache.get(digest)
+        if hit is not None:
+            self._feat_cache.move_to_end(digest)
+            return hit
+        arr = compute()
+        self._feat_cache[digest] = arr
+        while len(self._feat_cache) > _TRACKED_FEATURE_CACHE_ENTRIES:
+            self._feat_cache.popitem(last=False)
+        return arr
+
+    def dispatch_tracked(self, src_u8: np.ndarray, tgt_u8: np.ndarray,
+                         prior_ab: np.ndarray, prior_ba: np.ndarray, *,
+                         src_digests: Optional[Sequence[Optional[str]]]
+                         = None):
+        """Enqueue a TRACKED batch: per-row reference features resolved
+        once per stream (store or in-engine memo), target frames extracted
+        in-program, candidates seeded from the rows' prior pairs — zero
+        coarse passes (``coarse_passes`` stays flat; ``tracked_dispatches``
+        counts these).  ``prior_ab``/``prior_ba`` are ``(B, Nc)`` int32
+        per-coarse-cell priors (``ops/temporal.prior_from_table``); padded
+        rows can carry any valid prior (their outputs are dropped).
+        Callers gate shape eligibility via :meth:`tracking_feasible`."""
+        import jax.numpy as jnp
+
+        self.tracked_dispatches += 1
+        rows = []
+        for i in range(src_u8.shape[0]):
+            digest = src_digests[i] if src_digests is not None else None
+            rows.append(self._resolve_src_features(src_u8[i], digest))
+        fa = jnp.asarray(np.stack(rows))
+        return self._jitted_tracked(
+            self._params, fa, jnp.asarray(tgt_u8),
+            jnp.asarray(prior_ab, dtype=np.int32),
+            jnp.asarray(prior_ba, dtype=np.int32))
+
+    def tracking_feasible(self, src_hw: Tuple[int, int],
+                          tgt_hw: Tuple[int, int]) -> bool:
+        """Host-side eligibility of the tracked pipeline for an IMAGE shape
+        bucket (the serving layer decides per stream before batch
+        assembly; the in-program tier consult re-checks at trace time).
+        Feature grids follow from the uniform stride-16 trunks."""
+        from ncnet_tpu.ops.sparse_corr import tracking_feasible
+        from ncnet_tpu.ops.sparse_topk import resolve_halo
+        from ncnet_tpu.ops.temporal import FEATURE_STRIDE
+
+        ha, wa = (d // FEATURE_STRIDE for d in src_hw)
+        hb, wb = (d // FEATURE_STRIDE for d in tgt_hw)
+        if min(ha, wa, hb, wb) <= 0:
+            return False
+        return tracking_feasible(
+            ha, wa, hb, wb,
+            factor=self.config.sparse_factor,
+            halo=resolve_halo(self.config.sparse_halo,
+                              self.config.sparse_factor),
+            radius=self.config.track_radius,
+            reloc_k=self.config.relocalization_k_size,
+        )
+
+    @property
+    def feature_stride(self) -> int:
+        from ncnet_tpu.ops.temporal import FEATURE_STRIDE
+
+        return FEATURE_STRIDE
 
     def fetch(self, handle) -> np.ndarray:
         """Block on the device result; one pull per batch."""
@@ -186,21 +316,46 @@ class BatchMatchEngine:
         self._jitted.retrace()
         self._jitted_cached.retrace()
         self._feat.retrace()
+        self._jitted_tracked.retrace()
+        self._feat_cache.clear()
 
     def swap_params(self, params) -> None:
         """Live weight swap (the rollout controller's per-replica seam):
-        re-stage ``params`` on this engine's device and drop every compiled
-        program — the new tree may differ structurally (a CP-rank
-        fine-tune changes the NC-filter leaves), so the old executables
-        are invalid, and the rollout's bucket-ladder warmup recompiles
-        them off the dispatch path (fresh memory-ledger rows included).
-        Must only be called on a DRAINED replica: a fetcher racing the
-        re-staging would mix old handles with new params."""
+        re-stage ``params`` on this engine's device.
+
+        **Same-structure fast path**: params enter every jitted program as
+        an ARGUMENT, so the compiled executables are keyed on the tree's
+        abstract values (structure + leaf shape/dtype), not its numbers.
+        When the incoming tree matches the staged one abstractly — the
+        common rollout shape: same architecture, new weights — the old
+        executables stay valid verbatim and the swap skips the retrace;
+        the rollout's bucket-ladder warmup then replays straight cache
+        hits (and the tier decisions they embody) instead of re-probing
+        and recompiling, which is what dominated the measured CPU
+        live-swap wall.  ``swap_fastpath_hits`` counts these.
+
+        A structurally DIFFERENT tree (a CP-rank fine-tune changes the
+        NC-filter leaves) still drops every compiled program, and the
+        warmup recompiles off the dispatch path (fresh memory-ledger rows
+        included).  Either way the digest→features memo is flushed —
+        cached features were computed under the old trunk.  Must only be
+        called on a DRAINED replica: a fetcher racing the re-staging
+        would mix old handles with new params."""
         import jax
 
+        def _abstract(tree):
+            leaves, treedef = jax.tree.flatten(tree)
+            return treedef, [(getattr(x, "shape", None),
+                              getattr(x, "dtype", None)) for x in leaves]
+
+        same = _abstract(self._params) == _abstract(params)
         self._params = (jax.device_put(params, self.device)
                         if self.device is not None
                         else jax.device_put(params))
+        if same:
+            self.swap_fastpath_hits += 1
+            self._feat_cache.clear()
+            return
         self.retrace()
 
     def attach_store(self, store) -> None:

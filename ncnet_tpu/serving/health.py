@@ -122,6 +122,7 @@ def build_health_document(machine: HealthMachine,
                           store: Optional[Dict[str, Any]] = None,
                           model_version: Optional[str] = None,
                           rollout: Optional[Dict[str, Any]] = None,
+                          streams: Optional[Dict[str, Any]] = None,
                           ) -> Dict[str, Any]:
     """THE one health document (``HEALTH_DOC_SCHEMA``-versioned) — the
     ``/healthz`` body, ``MatchService.health()`` return value, the final
@@ -161,9 +162,14 @@ def build_health_document(machine: HealthMachine,
         pool rows, so a mid-rollout mixed pod is visible to the router.
       * ``rollout`` — the rollout controller's status while one is
         attached (phase, versions, canary verdict inputs).
+      * ``streams`` — the streaming session table (``StreamTable.doc()``,
+        serving/stream.py): active/tracked/fallback/cold frame totals,
+        mean candidate recall, and per-session rows — the tracked-mode
+        counterpart of the request counters.
 
-    ``model_version``/``rollout`` are ADDITIVE optional fields — schema 1
-    consumers that predate them simply never read the keys.
+    ``model_version``/``rollout``/``streams`` are ADDITIVE optional
+    fields — schema 1 consumers that predate them simply never read the
+    keys.
     """
     ready = sum(1 for r in replicas if r.get("state") == "READY")
     doc: Dict[str, Any] = {
@@ -187,4 +193,6 @@ def build_health_document(machine: HealthMachine,
         doc["model_version"] = model_version
     if rollout is not None:
         doc["rollout"] = rollout
+    if streams is not None:
+        doc["streams"] = streams
     return doc

@@ -82,6 +82,15 @@ def metrics_families(service) -> List[Family]:
             (name, m.value) for name, m in sorted(reg_items.items())
             if isinstance(m, Counter) and name.startswith("version_")
         ]
+        stream_cuts = next(
+            (m.value for name, m in reg_items.items()
+             if name == "stream_cuts" and isinstance(m, Counter)), 0)
+        stream_tier = (
+            "tracked"
+            if getattr(service, "_tracking_capable", False)
+            and getattr(getattr(service, "cfg", None),
+                        "stream_tracking", False)
+            else "full")
         # histogram families render INSIDE the lock: counts and sum must
         # be one cut, or a fetcher landing mid-scrape could put a value in
         # _sum that _count does not yet count — exactly the consistency
@@ -271,6 +280,45 @@ def metrics_families(service) -> List[Family]:
     # (monotone within one store lifetime), and the footprint gauges.  A
     # DEGRADED store serves on via recompute (fail-open), so ncnet_store_up
     # going 0 is an operator page about the DISK, not about availability.
+    # streaming tracked mode (serving/stream.py): monotone frame totals by
+    # kind (tracked = coarse pass SKIPPED, fallback = cut-triggered exact
+    # re-seed, cold = first/unseeded frame), cut detections, live session
+    # gauge, the candidate-recall proxy, and the pipeline tier streaming
+    # frames currently dispatch through
+    sm = doc.get("streams")
+    if sm is not None:
+        frames = Family(
+            "ncnet_serve_stream_frames_total", "counter",
+            "stream frames served by kind (tracked = coarse pass skipped, "
+            "fallback = cut re-seed, cold = unseeded)")
+        frames.add(sm["tracked_frames"], kind="tracked")
+        frames.add(sm["fallback_frames"], kind="fallback")
+        frames.add(sm["cold_frames"], kind="cold")
+        fams.append(frames)
+        fams.append(Family("ncnet_serve_stream_cuts_total", "counter",
+                           "detected scene cuts / tracking drifts "
+                           "(recall collapse or quality collapse)")
+                    .add(stream_cuts))
+        fams.append(Family("ncnet_serve_stream_sessions", "gauge",
+                           "live stream sessions (bound under "
+                           "label=\"max\")")
+                    .add(sm["active"], bound="active")
+                    .add(sm["max_sessions"], bound="max"))
+        fams.append(Family("ncnet_serve_stream_evicted_total", "counter",
+                           "stream sessions evicted (idle/cap/drain)")
+                    .add(sm["evicted"]))
+        if sm.get("recall_mean") is not None:
+            fams.append(Family(
+                "ncnet_serve_stream_recall", "gauge",
+                "mean candidate-recall proxy over live sessions "
+                "(fraction of served matches inside the seeded windows)")
+                .add(sm["recall_mean"]))
+        fams.append(Family(
+            "ncnet_serve_stream_pipeline", "gauge",
+            "1 on the pipeline tier streaming frames dispatch through "
+            "(tracked = temporal-candidate fine pass, full = per-frame "
+            "coarse-to-fine)").add(1, tier=stream_tier))
+
     st = doc.get("store")
     if st is not None:
         fams.append(Family(
@@ -382,6 +430,15 @@ def render_statusz(service) -> str:
             + (f"  hit%={hp:.1f}" if hp is not None else "")
             + f"  corrupt={c.get('corrupt', 0)}"
             f"  evictions={c.get('evictions', 0)}")
+    sm = doc.get("streams")
+    if sm is not None and (sm["active"] or sm["frames"]):
+        add("")
+        rc = sm.get("recall_mean")
+        add(f"streams: active={sm['active']}/{sm['max_sessions']}  "
+            f"frames={sm['frames']}  tracked={sm['tracked_frames']}  "
+            f"fallback={sm['fallback_frames']}  cold={sm['cold_frames']}  "
+            f"evicted={sm['evicted']}"
+            + (f"  recall={rc:.3f}" if rc is not None else ""))
     slo = doc.get("slo")
     if slo is not None and slo["admitted"]:
         add("")
@@ -584,7 +641,9 @@ class IntrospectionServer:
         backend (tiers chain)."""
         from ncnet_tpu.serving.wire import serve_match
 
-        return serve_match(self._service.submit, body)
+        return serve_match(
+            self._service.submit, body,
+            stream_submit=getattr(self._service, "stream_submit", None))
 
     def rollout_doc(self) -> Dict[str, Any]:
         """``GET /rollout``: the live rollout status (phase, versions,

@@ -101,11 +101,31 @@ class Replica:
 
     # -- device-facing (no service lock; the chaos seams live here) ---------
 
-    def dispatch(self, src_u8, tgt_u8):
+    def dispatch(self, src_u8, tgt_u8, src_digests=None):
         from ncnet_tpu.utils import faults
 
         faults.replica_fault_hook(self.id, "dispatch")
+        if src_digests is not None:
+            # only engines that understand digest memoization get the
+            # keyword (injected fakes keep their two-arg signature)
+            return self.engine.dispatch(src_u8, tgt_u8,
+                                        src_digests=src_digests)
         return self.engine.dispatch(src_u8, tgt_u8)
+
+    def dispatch_tracked(self, src_u8, tgt_u8, prior_ab, prior_ba,
+                         src_digests=None):
+        """The streaming batch: same fault seam as :meth:`dispatch` (an
+        injected replica death kills tracked frames identically), routed
+        to the engine's coarse-pass-free tracked program."""
+        from ncnet_tpu.utils import faults
+
+        faults.replica_fault_hook(self.id, "dispatch")
+        return self.engine.dispatch_tracked(
+            src_u8, tgt_u8, prior_ab, prior_ba, src_digests=src_digests)
+
+    @property
+    def supports_tracking(self) -> bool:
+        return hasattr(self.engine, "dispatch_tracked")
 
     def fetch(self, handle):
         from ncnet_tpu.utils import faults

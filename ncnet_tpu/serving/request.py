@@ -215,6 +215,19 @@ class MatchRequest:
     # segment absorbs the earlier failed round trips)
     dispatched_t: Optional[float] = None
     fetch_begin_t: Optional[float] = None
+    # streaming tracked mode (serving/stream.py): requests carrying a
+    # session's temporal priors dispatch through the engine's coarse-pass-
+    # free tracked program.  The dispatcher keeps batches tracked-
+    # homogeneous (a tracked and a plain request cannot share a program),
+    # and ``src_digest`` lets the engine skip re-hashing a stream's
+    # unchanged reference image.  All None/False for ordinary requests —
+    # the plain path is untouched.
+    stream: Optional[str] = None
+    stream_seq: int = 0
+    tracked: bool = False
+    prior_ab: Optional[np.ndarray] = None
+    prior_ba: Optional[np.ndarray] = None
+    src_digest: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t

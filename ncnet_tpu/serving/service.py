@@ -109,6 +109,7 @@ from ncnet_tpu.serving.request import (
     MatchResult,
     Overloaded,
     RequestQuarantined,
+    ServeError,
     as_pair_image,
     bucket_label,
 )
@@ -163,6 +164,21 @@ class ServingConfig:
     # verified on read, shared by every replica's engine.  None = off.
     feature_store_dir: Optional[str] = None
     feature_store_budget_mb: int = 0    # LRU-evict above this (0 = unbounded)
+    # streaming tracked mode (serving/stream.py; README "Streaming
+    # matching"): per-stream sessions whose steady frames skip the coarse
+    # pass by seeding candidates from the previous frame's match table.
+    stream_tracking: bool = True        # False = every frame runs the full
+                                        # pipeline (sessions still track
+                                        # ordering/digests)
+    stream_cut_recall: float = 0.35     # tracked frame whose candidate-
+                                        # containment proxy falls below this
+                                        # → scene cut → exact fallback
+    stream_cut_quality_frac: float = 0.5  # ...or whose score/coherence
+                                        # falls below this fraction of the
+                                        # stream's EMA baseline
+    stream_idle_evict_s: float = 30.0   # session GC age (worker tick)
+    stream_max_sessions: int = 64       # live-session cap; admission sheds
+                                        # `stream_cap` beyond it
     # match extraction
     do_softmax: bool = True
     scale: str = "centered"
@@ -339,6 +355,18 @@ class MatchService:
         self._dev_monitor = DeviceMonitor(every_s=30.0)
         self._leak = obs_memory.LeakSentinel(
             window=4, min_interval_s=1.0, scope="serving")
+        # streaming sessions (serving/stream.py): per-stream FIFO + prior
+        # tables, idle-evicted from the worker tick, drained with the
+        # service.  Tracked dispatch engages only when EVERY replica's
+        # engine exposes the tracked program — a mixed pool would make a
+        # stream's path depend on routing
+        from ncnet_tpu.serving.stream import StreamTable
+
+        self._streams = StreamTable(
+            max_sessions=serving.stream_max_sessions,
+            idle_evict_s=serving.stream_idle_evict_s)
+        self._tracking_capable = all(
+            r.supports_tracking for r in self._pool.replicas)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -471,11 +499,16 @@ class MatchService:
     # ------------------------------------------------------------------
 
     def submit(self, src, tgt, *, deadline_s: Optional[float] = None,
-               client: str = "default") -> MatchFuture:
+               client: str = "default",
+               _stream_fields: Optional[Dict[str, Any]] = None
+               ) -> MatchFuture:
         """Admit one match query (raw uint8 pair).  Returns a
         :class:`MatchFuture`; raises :class:`Overloaded` (shed) or
         :class:`DeadlineExceeded` (budget already gone) synchronously —
         rejections are classified at the door, not discovered by timeout.
+        ``_stream_fields`` is the private streaming seam
+        (:meth:`stream_submit` passes the request's session/prior payload);
+        external callers leave it None.
         """
         src = as_pair_image(src, "src")
         tgt = as_pair_image(tgt, "tgt")
@@ -521,6 +554,7 @@ class MatchService:
                         submitted_t=now,
                         deadline_t=(now + deadline_s) if deadline_s
                         else None,
+                        **(_stream_fields or {}),
                     )
                     self._admission.note_admit(client)
                     self._n["admitted"] += 1
@@ -576,6 +610,188 @@ class MatchService:
         return req.future
 
     # ------------------------------------------------------------------
+    # streaming (serving/stream.py; README "Streaming matching")
+    # ------------------------------------------------------------------
+
+    def stream_submit(self, stream: str, src, tgt, *,
+                      deadline_s: Optional[float] = None,
+                      client: Optional[str] = None):
+        """Serve one frame of a video stream — BLOCKING (unlike
+        :meth:`submit`): frame ``t+1``'s candidates are seeded from this
+        frame's match table, so the data dependence forces one frame in
+        flight per stream; concurrent streams overlap freely, and the
+        session's FIFO lock extends the ordering guarantee to
+        multi-threaded callers of one stream id.
+
+        The fast path dispatches the engine's TRACKED program — zero
+        coarse passes — when the session has a prior, the bucket is
+        unchanged, and the shape class is eligible.  Cut/drift detection
+        runs on the result (candidate-containment proxy + quality-EMA
+        collapse); a detected cut re-runs the SAME frame through the full
+        pipeline (``submit`` — the identical executable a cold query uses,
+        so the fallback output is bitwise a cold query's), re-seeding the
+        tracker.  Returns a :class:`~ncnet_tpu.serving.stream.
+        StreamFrameResult`; raises the same classified errors as
+        :meth:`submit`."""
+        from ncnet_tpu.serving.stream import StreamFrameResult
+
+        client = client or f"stream:{stream}"
+        sess = self._streams.acquire(stream)
+        with sess.lock:
+            try:
+                out = self._stream_frame(sess, src, tgt, deadline_s, client)
+            except ServeError:
+                with self._cond:
+                    sess.errors += 1
+                raise
+            finally:
+                sess.last_activity = time.monotonic()
+        self._registry.gauge("active_streams").set(
+            self._streams.doc()["active"])
+        assert isinstance(out, StreamFrameResult)
+        return out
+
+    def _stream_geom(self, bucket: Bucket):
+        """(grid_a, grid_b, factor, radius) on the PADDED bucket, or None
+        when no model config is attached (injected fake engines): the
+        recall proxy and prior inversion are then skipped and the cut
+        detector rides quality collapse alone."""
+        mc = self._model_config
+        if mc is None:
+            return None
+        from ncnet_tpu.ops.temporal import FEATURE_STRIDE
+
+        ga = tuple(d // FEATURE_STRIDE for d in bucket[0])
+        gb = tuple(d // FEATURE_STRIDE for d in bucket[1])
+        if min(*ga, *gb) <= 0:
+            return None
+        return ga, gb, mc.sparse_factor, mc.track_radius
+
+    def _tracking_eligible(self, bucket: Bucket) -> bool:
+        if not (self.cfg.stream_tracking and self._tracking_capable):
+            return False
+        eng = self._pool.replicas[0].engine
+        feasible = getattr(eng, "tracking_feasible", None)
+        if feasible is None:
+            return True  # injected fakes: capability implies eligibility
+        return bool(feasible(bucket[0], bucket[1]))
+
+    def _stream_frame(self, sess, src, tgt, deadline_s, client):
+        from ncnet_tpu.serving.stream import StreamFrameResult
+
+        src = as_pair_image(src, "src")
+        tgt = as_pair_image(tgt, "tgt")
+        seq = sess.seq
+        sess.seq += 1
+        bucket = self._bucketer.peek(src.shape[:2], tgt.shape[:2])
+        if sess.bucket is not None and bucket != sess.bucket:
+            # resolution change: the prior's grids no longer describe the
+            # frames — cold restart for this stream, never a stale gather
+            sess.reset_tracking()
+        sess.bucket = bucket
+        geom = self._stream_geom(bucket)
+        digest = None
+        if self._tracking_capable:
+            digest = sess.src_digest(
+                src, bucket,
+                lambda: pad_to_bucket([src], bucket[0])[0])
+        tracked = (sess.prior_ab is not None
+                   and self._tracking_eligible(bucket))
+        fallback = False
+        recall = None
+        if tracked:
+            fut = self.submit(
+                src, tgt, deadline_s=deadline_s, client=client,
+                _stream_fields=dict(
+                    stream=sess.id, stream_seq=seq, tracked=True,
+                    prior_ab=sess.prior_ab, prior_ba=sess.prior_ba,
+                    src_digest=digest))
+            res = fut.result()
+            if geom is not None:
+                from ncnet_tpu.ops.temporal import tracking_recall_proxy
+
+                ga, gb, factor, radius = geom
+                recall = tracking_recall_proxy(
+                    sess.prior_ab, res.table, ga, gb, factor, radius,
+                    scale=self.cfg.scale)
+                sess.last_recall = recall
+            cut = (recall is not None
+                   and recall < self.cfg.stream_cut_recall) \
+                or sess.quality_collapsed(
+                    res.quality, self.cfg.stream_cut_quality_frac)
+            if cut:
+                obs_events.emit(
+                    "stream_cut", stream=sess.id, seq=seq,
+                    recall=(round(recall, 4) if recall is not None
+                            else None),
+                    quality=res.quality,
+                    bucket=bucket_label(bucket))
+                self._registry.counter("stream_cuts").inc()
+                # exact fallback: the SAME frame through the full
+                # pipeline — the identical program a cold query runs, so
+                # this output is bitwise a cold coarse-to-fine query's —
+                # and the tracker re-seeds from its table below
+                sess.reset_tracking()
+                fut = self.submit(src, tgt, deadline_s=deadline_s,
+                                  client=client,
+                                  _stream_fields=dict(
+                                      stream=sess.id, stream_seq=seq,
+                                      src_digest=digest))
+                res = fut.result()
+                tracked, fallback = False, True
+        else:
+            fut = self.submit(src, tgt, deadline_s=deadline_s,
+                              client=client,
+                              _stream_fields=dict(
+                                  stream=sess.id, stream_seq=seq,
+                                  src_digest=digest))
+            res = fut.result()
+        # re-seed / roll the prior from the served table, warm the quality
+        # baseline, and account the frame
+        if geom is not None:
+            from ncnet_tpu.ops.temporal import prior_from_table
+
+            ga, gb, factor, _radius = geom
+            try:
+                sess.prior_ab, sess.prior_ba = prior_from_table(
+                    res.table, ga, gb, factor, scale=self.cfg.scale)
+            except ValueError:
+                # a table that doesn't invert (foreign engine shape) just
+                # means the next frame runs the full pipeline
+                sess.reset_tracking()
+        sess.note_quality(res.quality)
+        kind = "tracked" if tracked else (
+            "fallback" if fallback else "cold")
+        sess.frames += 1
+        if tracked:
+            sess.tracked_frames += 1
+        elif fallback:
+            sess.fallback_frames += 1
+        else:
+            sess.cold_frames += 1
+        self._streams.note_frame(kind)
+        self._registry.counter("stream_frames").inc()
+        self._registry.counter(f"stream_frames_{kind}").inc()
+        if recall is not None:
+            self._registry.gauge("stream_recall").set(round(recall, 4))
+        obs_events.emit(
+            "stream_frame", stream=sess.id, seq=seq, kind=kind,
+            tracked=tracked, fallback=fallback,
+            recall=(round(recall, 4) if recall is not None else None),
+            wall_ms=round(res.wall_s * 1e3, 3),
+            bucket=bucket_label(bucket), client=client)
+        return StreamFrameResult(result=res, stream=sess.id, seq=seq,
+                                 tracked=tracked, fallback=fallback,
+                                 recall=recall)
+
+    def _evict_idle_streams(self) -> None:
+        for sess in self._streams.evict_idle():
+            obs_events.emit("stream_evict", stream=sess.id,
+                            frames=sess.frames,
+                            tracked=sess.tracked_frames,
+                            fallback=sess.fallback_frames, reason="idle")
+
+    # ------------------------------------------------------------------
     # probes
     # ------------------------------------------------------------------
 
@@ -613,6 +829,7 @@ class MatchService:
                 model_version=self._model_version,
                 rollout=(self._rollout.status()
                          if self._rollout is not None else None),
+                streams=self._streams.doc(now),
             )
 
     def _memory_doc_locked(self) -> Dict[str, Any]:
@@ -695,6 +912,7 @@ class MatchService:
                 self._dev_monitor.maybe_emit(step=self._batch_seq)
                 self._maybe_resurrect()
                 self._evict_expired()
+                self._evict_idle_streams()
                 self._fill_pipeline()
                 with self._cond:
                     if self._stop_now:
@@ -841,6 +1059,13 @@ class MatchService:
                     return
                 now = time.monotonic()
                 while q and len(batch) < self.cfg.max_batch:
+                    # tracked-homogeneous coalescing: a tracked and a plain
+                    # request cannot share a program, so peek BEFORE
+                    # popping and stop at the first flag flip — the
+                    # minority flavor leads the next batch instead of
+                    # bouncing
+                    if batch and q[0].tracked != batch[0].tracked:
+                        break
                     req = q.popleft()
                     # deadline check at DEQUEUE: an expired request is
                     # evicted before it can waste a device slot
@@ -882,13 +1107,40 @@ class MatchService:
         while b < len(batch):
             b *= 2
         b = min(b, self.cfg.max_batch)
-        pad = [None] * (b - len(batch))
-        src = pad_to_bucket(
-            [r.src for r in batch] + pad, bucket[0])
-        tgt = pad_to_bucket(
-            [r.tgt for r in batch] + pad, bucket[1])
+        npad = b - len(batch)
+        tracked = batch[0].tracked
+        if tracked:
+            # padding REPLICATES row 0 (not zeros): a tracked pad row must
+            # carry a valid prior, and repeating the head's image keeps
+            # the digest-memoized feature resolve a pure cache hit instead
+            # of hashing + extracting a zero image per dispatch
+            src = pad_to_bucket(
+                [r.src for r in batch] + [batch[0].src] * npad, bucket[0])
+            tgt = pad_to_bucket(
+                [r.tgt for r in batch] + [batch[0].tgt] * npad, bucket[1])
+            prior_ab = np.stack(
+                [r.prior_ab for r in batch]
+                + [batch[0].prior_ab] * npad).astype(np.int32)
+            prior_ba = np.stack(
+                [r.prior_ba for r in batch]
+                + [batch[0].prior_ba] * npad).astype(np.int32)
+            digests = ([r.src_digest for r in batch]
+                       + [batch[0].src_digest] * npad)
+        else:
+            pad = [None] * npad
+            src = pad_to_bucket(
+                [r.src for r in batch] + pad, bucket[0])
+            tgt = pad_to_bucket(
+                [r.tgt for r in batch] + pad, bucket[1])
+            digests = [r.src_digest for r in batch] + [None] * npad
         try:
-            handle = replica.dispatch(src, tgt)
+            if tracked:
+                handle = replica.dispatch_tracked(
+                    src, tgt, prior_ab, prior_ba, src_digests=digests)
+            elif any(d is not None for d in digests):
+                handle = replica.dispatch(src, tgt, src_digests=digests)
+            else:
+                handle = replica.dispatch(src, tgt)
         except Exception as e:
             self._on_batch_failure(batch, e, phase="dispatch",
                                    replica=replica)
@@ -1396,9 +1648,11 @@ class MatchService:
                      detach_store: bool = False) -> None:
         """Swap one DRAINED replica's weights and warm the new programs
         off the dispatch path: re-stage params (engine.swap_params drops
-        the old executables), then compile the registered bucket ladder at
-        every batch size — memory-ledger rows re-record through the
-        engine's ResilientJit exactly like startup warmup.  The
+        the old executables only for a structurally different tree — a
+        same-shape swap keeps them and the ladder replay below is pure
+        cache hits), then run the registered bucket ladder at every batch
+        size — memory-ledger rows re-record through the engine's
+        ResilientJit exactly like startup warmup.  The
         ``kill_at_weight_swap`` chaos seam fires between the re-stage and
         the version stamp: a SIGKILL there leaves the pod restartable on
         the OLD version (the state file's pointer only advances at
@@ -1410,7 +1664,12 @@ class MatchService:
         if swap is None:
             raise RuntimeError(
                 f"replica {rep.id} engine cannot swap params")
+        fast0 = getattr(engine, "swap_fastpath_hits", 0)
         swap(params)
+        # same-structure swap (engine.swap_params fast path): the ladder
+        # warmup below replays cached executables + their tier decisions
+        # instead of re-probing and recompiling
+        fastpath = getattr(engine, "swap_fastpath_hits", 0) > fast0
         faults.weight_swap_kill_hook()
         if detach_store and hasattr(engine, "attach_store"):
             # new weights must not commit features into the old
@@ -1431,10 +1690,10 @@ class MatchService:
             obs_memory.flush_pending(timeout=120.0)
         except Exception:
             obs_events.emit("rollout_swap", replica=rep.id, version=version,
-                            warmed=warmed, ok=False)
+                            warmed=warmed, fastpath=fastpath, ok=False)
             raise
         obs_events.emit("rollout_swap", replica=rep.id, version=version,
-                        warmed=warmed, ok=True)
+                        warmed=warmed, fastpath=fastpath, ok=True)
 
     def rollout_readmit(self, rep: Replica, reason: str) -> None:
         with self._cond:
@@ -1614,6 +1873,11 @@ class MatchService:
             self._observe_slo(req, "shed")
             self._emit_timeline(req, "overloaded")
             self._terminal(req)
+        for sess in self._streams.evict_all():
+            obs_events.emit("stream_evict", stream=sess.id,
+                            frames=sess.frames,
+                            tracked=sess.tracked_frames,
+                            fallback=sess.fallback_frames, reason="drain")
         obs_events.emit(
             "serve_drain", drained=self._draining and crashed is None,
             leftover=len(leftovers), **{f"n_{k}": v

@@ -128,10 +128,16 @@ def _unframe(data: bytes) -> Tuple[Dict[str, Any], bytes]:
 def encode_request(src: np.ndarray, tgt: np.ndarray, *,
                    client: str = "wire",
                    budget_s: Optional[float] = None,
-                   request_id: str = "") -> bytes:
+                   request_id: str = "",
+                   stream: Optional[str] = None) -> bytes:
     """One match query as wire bytes.  ``budget_s`` is the REMAINING
     deadline budget (None = no deadline); the receiving service admits
-    with exactly this budget, so edge and backend judge the same promise."""
+    with exactly this budget, so edge and backend judge the same promise.
+    ``stream`` (optional, ADDITIVE — schema-1 peers that predate it never
+    read the key) tags the request as one frame of a video stream: the
+    backend routes it through its per-stream FIFO session
+    (``MatchService.stream_submit``) so consecutive frames reuse temporal
+    candidate priors and skip the coarse pass on steady frames."""
     src = np.ascontiguousarray(src)
     tgt = np.ascontiguousarray(tgt)
     for name, a in (("src", src), ("tgt", tgt)):
@@ -147,6 +153,8 @@ def encode_request(src: np.ndarray, tgt: np.ndarray, *,
                      if budget_s is not None else None),
         "request": str(request_id),
     }
+    if stream is not None:
+        header["stream"] = str(stream)
     return _frame(header, src.tobytes() + tgt.tobytes())
 
 
@@ -177,6 +185,8 @@ def decode_request(data: bytes
                      if isinstance(header.get("budget_s"), (int, float))
                      else None),
         "request": str(header.get("request", "")),
+        "stream": (str(header["stream"])
+                   if header.get("stream") else None),
     }
     return src, tgt, meta
 
@@ -272,14 +282,23 @@ def decode_response(data: bytes) -> MatchResult:
 
 
 def serve_match(submit: Callable[..., Any], body: bytes, *,
-                max_wait_s: float = 600.0) -> Tuple[int, str, bytes]:
+                max_wait_s: float = 600.0,
+                stream_submit: Optional[Callable[..., Any]] = None
+                ) -> Tuple[int, str, bytes]:
     """Handle one wire request against ``submit`` (a ``MatchService.submit``
     or ``MatchRouter.submit`` — the wire cannot tell tiers apart): decode,
     admit with the propagated budget + client, BLOCK until the terminal
     outcome, encode it.  Returns ``(status, content_type, payload)`` for
     the HTTP handler.  ``max_wait_s`` bounds the wait for budget-less
     requests only — a budgeted request settles by its own deadline (plus a
-    small margin for the settle itself)."""
+    small margin for the settle itself).
+
+    A ``stream``-tagged request routes through ``stream_submit``
+    (``MatchService.stream_submit``) when the fronted service provides one
+    — the per-stream FIFO session that carries temporal priors across
+    frames.  A host without a streaming plane (a router) serves the frame
+    as an ordinary request: correct, just never coarse-skipped.
+    """
     try:
         src, tgt, meta = decode_request(body)
     except WireError as e:
@@ -290,10 +309,16 @@ def serve_match(submit: Callable[..., Any], body: bytes, *,
         return 400, WIRE_CONTENT_TYPE, payload
     budget = meta["budget_s"]
     try:
-        fut = submit(src, tgt, deadline_s=budget, client=meta["client"])
-        result = fut.result(
-            timeout=(budget + WIRE_SETTLE_MARGIN_S)
-            if budget is not None else max_wait_s)
+        if meta.get("stream") and stream_submit is not None:
+            result = stream_submit(
+                meta["stream"], src, tgt, deadline_s=budget,
+                client=meta["client"]).result
+        else:
+            fut = submit(src, tgt, deadline_s=budget,
+                         client=meta["client"])
+            result = fut.result(
+                timeout=(budget + WIRE_SETTLE_MARGIN_S)
+                if budget is not None else max_wait_s)
     except TimeoutError:
         # only reachable when the serving side failed to settle within its
         # own budget (or the budget-less cap): answer a classified timeout,
@@ -351,7 +376,7 @@ class MatchClient:
 
     def match(self, src: np.ndarray, tgt: np.ndarray, *,
               client: str = "wire", budget_s: Optional[float] = None,
-              request_id: str = "",
+              request_id: str = "", stream: Optional[str] = None,
               timeout_s: Optional[float] = None) -> MatchResult:
         """One wire round trip.  ``timeout_s`` bounds the WHOLE attempt at
         the socket level (send + the backend's serve + the response read) —
@@ -364,7 +389,7 @@ class MatchClient:
         # kills real processes; this hook covers the in-process tests)
         faults.backend_fault_hook(self.base_url, "send")
         body = encode_request(src, tgt, client=client, budget_s=budget_s,
-                              request_id=request_id)
+                              request_id=request_id, stream=stream)
         conn = self._connection(timeout_s if timeout_s is not None
                                 else self.timeout_s)
         try:

@@ -160,7 +160,8 @@ def test_wire_request_roundtrip_and_refusal():
                                request_id="r9")
     src2, tgt2, meta = wire.decode_request(blob)
     assert (src2 == src).all() and (tgt2 == tgt).all()
-    assert meta == {"client": "cam0", "budget_s": 0.25, "request": "r9"}
+    assert meta == {"client": "cam0", "budget_s": 0.25, "request": "r9",
+                    "stream": None}  # untagged request: no stream session
     # a peer speaking another wire schema is REFUSED, not misread: flip
     # the version byte and the decode must raise before trusting anything
     with pytest.raises(WireError, match="schema"):

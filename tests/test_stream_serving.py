@@ -1,0 +1,402 @@
+"""Streaming video matching through the serving plane (ISSUE 19).
+
+Service-level acceptance of the tracked (coarse-pass-skipping) mode:
+
+  (a) a steady tracked stream dispatches ZERO coarse-pass programs
+      (engine spy), resolves its reference features once, and reports
+      itself on /healthz, /metrics, and /statusz;
+  (b) a scene cut detected mid-stream falls back to the full pipeline and
+      the fallback frame's table is BITWISE a cold coarse-to-fine query's
+      (same executable), after which tracking re-seeds;
+  (c) chaos: a replica SIGKILLed mid-stream loses ZERO frames, and the
+      per-stream seq ordering + frame-outcome identity are recomputed
+      from the event log alone (run_report discipline);
+  (d) stream sessions are bounded (``stream_cap`` shedding), idle-evicted,
+      drained with the service, and their reference-digest memo hashes
+      once per (array, bucket);
+  (e) the wire's additive ``stream`` tag routes through the per-stream
+      session when the host has one and degrades to plain serving when it
+      does not;
+  (f) a same-structure rollout swap takes the engine fast path (the
+      ladder warmup replays cached executables) and says so on the
+      ``rollout_swap`` event;
+  (g) tools/stream_probe.py --tiny smokes end to end on CPU with the
+      steady-frame wall strictly below the per-frame coarse-to-fine wall.
+
+Ops/model/engine layers live in tests/test_temporal.py.
+"""
+
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+import jax
+
+from ncnet_tpu import models, ops
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.observability import EventLog
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.observability.export import parse_prometheus, render
+from ncnet_tpu.serving import (
+    BatchMatchEngine,
+    MatchService,
+    Overloaded,
+    ServingConfig,
+    StreamSession,
+    StreamTable,
+    run_stream_load,
+)
+from ncnet_tpu.serving.introspect import metrics_families, render_statusz
+from ncnet_tpu.serving.wire import (
+    decode_response,
+    encode_request,
+    serve_match,
+)
+from ncnet_tpu.utils import faults
+from ncnet_tpu.utils.faults import FaultPlan
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import stream_probe  # noqa: E402
+
+# tracked-capable tiny config: 96 px → 6x6 fine grid, factor 2 → 3x3 coarse
+TRACK = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                    ncons_channels=(1,), sparse_topk=4, sparse_factor=2)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No armed faults, no demoted tiers, no leaked event sink."""
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+
+
+@pytest.fixture(scope="module")
+def track_params():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return models.init_ncnet(TRACK, jax.random.key(0))
+
+
+def u8(side=96, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+def jittered(ref, seed):
+    """A steady frame: the reference plus small sensor noise."""
+    rng = np.random.default_rng(seed)
+    return np.clip(ref.astype(np.int16)
+                   + rng.integers(-3, 4, ref.shape), 0, 255).astype(np.uint8)
+
+
+def track_service(params, **over):
+    cfg = dict(bucket_multiple=32, max_image_side=96, max_batch=2)
+    cfg.update(over)
+    return MatchService(TRACK, params, ServingConfig(**cfg))
+
+
+# ---------------------------------------------------------------------------
+# (a) steady stream: zero coarse passes + observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_steady_stream_skips_coarse_pass_and_reports(track_params):
+    svc = track_service(track_params).start()
+    try:
+        eng = svc._pool.replicas[0].engine
+        src = u8(96, 1)
+        fr0 = svc.stream_submit("cam0", src, jittered(src, 2))
+        assert fr0.seq == 0 and not fr0.tracked and not fr0.fallback
+        cp, fe = eng.coarse_passes, eng.feature_extractions
+        frames = [svc.stream_submit("cam0", src, jittered(src, 10 + i))
+                  for i in range(4)]
+        # the acceptance spy: the steady segment dispatched ZERO programs
+        # that pay a coarse pass, and the reference features resolved once
+        assert eng.coarse_passes == cp
+        assert eng.feature_extractions == fe + 1
+        assert eng.tracked_dispatches == 4
+        assert [f.seq for f in frames] == [1, 2, 3, 4]
+        assert all(f.tracked and not f.fallback for f in frames)
+        assert all(f.recall is not None
+                   and f.recall >= svc.cfg.stream_cut_recall
+                   for f in frames)
+        assert all(np.isfinite(f.table).all() for f in frames)
+
+        sm = svc.health()["streams"]
+        assert sm["active"] == 1
+        assert sm["frames"] == 5
+        assert sm["tracked_frames"] == 4
+        assert sm["cold_frames"] == 1
+        assert sm["fallback_frames"] == 0
+        assert sm["sessions"][0]["stream"] == "cam0"
+        assert sm["sessions"][0]["seeded"] is True
+
+        fams = parse_prometheus(render(metrics_families(svc)))
+        samples = {lab.get("kind"): v for _n, lab, v in
+                   fams["ncnet_serve_stream_frames_total"]["samples"]}
+        assert samples["tracked"] == 4
+        assert samples["cold"] == 1
+        tier = fams["ncnet_serve_stream_pipeline"]["samples"][0]
+        assert tier[1]["tier"] == "tracked" and tier[2] == 1
+        sz = render_statusz(svc)
+        assert "streams: active=1" in sz and "tracked=4" in sz
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# (b) scene cut: exact fallback, bitwise a cold query, then re-seed
+# ---------------------------------------------------------------------------
+
+
+def test_cut_fallback_is_bitwise_cold_and_reseeds(track_params, tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc = track_service(track_params,
+                            stream_cut_quality_frac=0.8).start()
+        try:
+            src = u8(96, 1)
+            svc.stream_submit("cam0", src, jittered(src, 2))
+            for i in range(2):
+                fr = svc.stream_submit("cam0", src, jittered(src, 20 + i))
+                assert fr.tracked
+            # the cut: an unrelated scene — the tracker's prior stops
+            # describing the frame and the detector must fall back
+            cut_tgt = u8(96, 99)
+            fr_cut = svc.stream_submit("cam0", src, cut_tgt)
+            assert fr_cut.fallback and not fr_cut.tracked
+            # bitwise identity with a COLD query of the same pair: the
+            # fallback re-ran the frame through the identical executable
+            ref = svc.submit(src, cut_tgt).result(timeout=600)
+            assert np.array_equal(fr_cut.result.table, ref.table)
+            # the fallback's table re-seeded the tracker on the new scene
+            fr_next = svc.stream_submit("cam0", src, jittered(cut_tgt, 7))
+            assert fr_next.tracked and not fr_next.fallback
+            assert svc.health()["streams"]["fallback_frames"] == 1
+        finally:
+            svc.stop()
+
+    _, events = obs_events.replay_events(log_path)
+    cuts = [e for e in events if e.get("event") == "stream_cut"]
+    assert len(cuts) == 1 and cuts[0]["stream"] == "cam0" \
+        and cuts[0]["seq"] == 3
+    kinds = [e["kind"] for e in events
+             if e.get("event") == "stream_frame"]
+    assert kinds == ["cold", "tracked", "tracked", "fallback", "tracked"]
+    # drain evicted the session and said so
+    ev = [e for e in events if e.get("event") == "stream_evict"]
+    assert len(ev) == 1 and ev[0]["reason"] == "drain" \
+        and ev[0]["frames"] == 5
+
+
+# ---------------------------------------------------------------------------
+# (c) chaos: replica death mid-stream — ordering + zero lost from the log
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    """Device stand-in (tests/test_serving.py protocol): no tracked
+    capability, so every stream frame takes the cold path — the chaos bar
+    here is ordering + zero lost through REAL replica failover."""
+
+    split = staticmethod(BatchMatchEngine.split)
+    half_precision = False
+
+    def dispatch(self, src, tgt):
+        faults.device_error_hook("fake_serve")
+        return src.shape[0]
+
+    def fetch(self, handle):
+        table = np.zeros((handle, 6, 16), np.float32)
+        table[:, 4, :] = 1.0
+        table[:, 5, :5] = [0.5, 0.1, 0.4, 0.9, 0.8]
+        return table
+
+    def retrace(self):
+        pass
+
+
+def test_chaos_replica_death_mid_stream_zero_lost(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc = MatchService(
+            engine=[FakeEngine() for _ in range(4)],
+            serving=ServingConfig(
+                bucket_multiple=32, max_image_side=64, max_batch=2,
+                replica_max_failures=1, resurrect_after_s=0.2,
+                max_queue=128, max_in_flight_per_client=128)).start()
+        try:
+            frame = lambda si, fi: (u8(32, si), u8(32, 100 + fi))  # noqa
+            recs = run_stream_load(svc, frame, streams=3, frames=3,
+                                   rate_hz=200.0, seed=5)
+            # SIGKILL-style death of one replica MID-STREAM: the sessions
+            # continue (seq keeps rising) and failover serves every frame
+            faults.install(FaultPlan(dead_replica_ids=("rep1",)))
+            recs += run_stream_load(svc, frame, streams=3, frames=4,
+                                    rate_hz=200.0, seed=6)
+            assert all(r["outcome"] == "result" for r in recs)
+            sm = svc.health()["streams"]
+            assert sm["frames"] == 21 and sm["active"] == 3
+        finally:
+            faults.clear()
+            svc.stop()
+
+    # the replayed log alone proves ordering + the outcome identity
+    _, events = obs_events.replay_events(log_path)
+    frames_ev = [e for e in events if e.get("event") == "stream_frame"]
+    per = {}
+    for e in frames_ev:
+        per.setdefault(e["stream"], []).append(e["seq"])
+    assert set(per) == {"cam0", "cam1", "cam2"}
+    for seqs in per.values():
+        assert seqs == list(range(7))  # contiguous, in-order, none lost
+    kinds = [e["kind"] for e in frames_ev]
+    assert len(frames_ev) == 21 == len(recs)
+    assert (kinds.count("tracked") + kinds.count("fallback")
+            + kinds.count("cold")) == len(frames_ev)
+    assert [e for e in events if e.get("event") == "stream_evict"
+            and e["reason"] == "drain"]
+
+
+# ---------------------------------------------------------------------------
+# (d) session bounds, idle eviction, digest memo
+# ---------------------------------------------------------------------------
+
+
+def test_stream_table_cap_lru_and_idle_eviction():
+    tbl = StreamTable(max_sessions=2, idle_evict_s=5.0)
+    s1, s2 = tbl.acquire("a"), tbl.acquire("b")
+    with s1.lock, s2.lock:
+        # both ACTIVE (locks held): a third stream sheds, classified
+        with pytest.raises(Overloaded) as e:
+            tbl.acquire("c")
+        assert e.value.reason == "stream_cap"
+    # idle LRU makes room: the stalest unlocked session is evicted
+    s1.last_activity -= 100.0
+    tbl.acquire("c")
+    d = tbl.doc()
+    assert d["active"] == 2 and d["evicted"] == 1
+    assert {r["stream"] for r in d["sessions"]} == {"b", "c"}
+    # idle eviction skips a session whose FIFO lock is held (in flight)
+    s2.last_activity -= 100.0
+    s3 = tbl.acquire("c")
+    s3.last_activity -= 100.0
+    with s3.lock:
+        assert [s.id for s in tbl.evict_idle()] == ["b"]
+    # aggregate counters are monotone across evictions
+    tbl.note_frame("tracked")
+    tbl.note_frame("cold")
+    d = tbl.doc()
+    assert d["frames"] == 2 and d["tracked_frames"] == 1 \
+        and d["cold_frames"] == 1 and d["evicted"] == 2
+
+
+def test_stream_session_digest_memo_hashes_once():
+    sess = StreamSession("x")
+    bucket = ((32, 32), (32, 32))
+    src, hashes = u8(32, 1), []
+
+    def padded():
+        hashes.append(1)
+        return src
+
+    d1 = sess.src_digest(src, bucket, padded)
+    d2 = sess.src_digest(src, bucket, padded)
+    assert d1 == d2 and len(hashes) == 1  # same (array, bucket): memoized
+    # a different reference object re-hashes (and a changed bucket would)
+    other = u8(32, 2)
+    d3 = sess.src_digest(other, bucket, lambda: other)
+    assert d3 != d1
+    # the memo is one-deep by design (a stream has ONE reference): going
+    # back to the first array re-hashes, to the same digest
+    assert sess.src_digest(src, bucket, padded) == d1 and len(hashes) == 2
+
+
+# ---------------------------------------------------------------------------
+# (e) wire: the additive stream tag
+# ---------------------------------------------------------------------------
+
+
+def test_wire_stream_tag_routes_through_session():
+    svc = MatchService(engine=FakeEngine(),
+                       serving=ServingConfig(bucket_multiple=32,
+                                             max_image_side=64)).start()
+    try:
+        body = encode_request(u8(32, 1), u8(32, 2), client="edge",
+                              stream="camW")
+        status, _ctype, payload = serve_match(
+            svc.submit, body, stream_submit=svc.stream_submit)
+        assert status == 200
+        res = decode_response(payload)
+        assert res.table.size > 0
+        assert svc.health()["streams"]["frames"] == 1
+        # a host WITHOUT a streaming plane (router) serves the same bytes
+        # as an ordinary request: correct, just never session-routed
+        status2, _, payload2 = serve_match(svc.submit, body)
+        assert status2 == 200
+        assert decode_response(payload2).table.shape == res.table.shape
+        assert svc.health()["streams"]["frames"] == 1
+        # an untagged request never touches the stream table
+        status3, _, _ = serve_match(svc.submit,
+                                    encode_request(u8(32, 1), u8(32, 2)),
+                                    stream_submit=svc.stream_submit)
+        assert status3 == 200
+        assert svc.health()["streams"]["frames"] == 1
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# (f) rollout: same-structure swap rides the warm-executable fast path
+# ---------------------------------------------------------------------------
+
+
+def test_rollout_swap_fastpath_keeps_executables(track_params, tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    with obs_events.bound(EventLog(log_path)):
+        svc = track_service(track_params, replicas=2).start()
+        try:
+            svc.submit(u8(96, 1), u8(96, 2)).result(timeout=600)
+            rep = svc.rollout_pick_canary()
+            assert svc.rollout_drain(rep, 30.0)
+            new = jax.tree.map(lambda x: x * 1.0, track_params)
+            svc.rollout_swap(rep, new, "v1")
+            assert rep.engine.swap_fastpath_hits == 1
+            assert rep.model_version == "v1"
+        finally:
+            svc.stop()
+    _, events = obs_events.replay_events(log_path)
+    sw = [e for e in events if e.get("event") == "rollout_swap"]
+    assert sw and sw[-1]["ok"] is True
+    assert sw[-1]["fastpath"] is True  # ladder warmup replayed cache hits
+
+
+# ---------------------------------------------------------------------------
+# (g) stream_probe --tiny: the end-to-end CPU smoke
+# ---------------------------------------------------------------------------
+
+
+def test_stream_probe_tiny_smoke(tmp_path):
+    doc = stream_probe.probe(
+        tiny=True, streams=2, frames=8, rate_hz=30.0,
+        events_path=str(tmp_path / "events.jsonl"))
+    assert doc["tracking_feasible"]
+    # zero coarse passes on the steady segment, to the dispatch
+    assert doc["coarse_passes_steady_delta"] == doc["expected_coarse_passes"]
+    assert doc["coarse_skip_pct"] > 50.0
+    # the perf headline, at tiny scale: tracked steady frames beat the
+    # per-frame coarse-to-fine wall at the same shape
+    assert doc["steady_below_c2f"]
+    # replayability from the log alone
+    assert doc["replay_ordering_ok"]
+    assert doc["replay_outcome_identity_ok"]
+    assert doc["streams_doc"]["frames"] == 2 * 8

@@ -1,0 +1,302 @@
+"""Temporal candidate selection + the tracked match pipeline (ISSUE 19).
+
+The ops/model/engine layers of the streaming tentpole, CPU-verifiable:
+
+  (a) ``temporal_candidates`` rows obey the EXACT static-shape
+      coverage-padding contract ``topk_candidates`` established (in-grid,
+      clamped duplicates at edges, prior cell always contained);
+  (b) ``prior_from_table`` inverts a served match table into a
+      coverage-total prior pair (identity round trip, max-score wins);
+  (c) at FULL COVERAGE (radius spans the coarse grid) the tracked filter's
+      output is BITWISE the coarse-to-fine tier's — the acceptance-bar
+      equality that makes the steady-state fast path trustworthy;
+  (d) the engine's tracked dispatch pays ZERO coarse passes
+      (``coarse_passes`` spy flat), resolves reference features once per
+      stream (digest memo), and the same-structure weight-swap fast path
+      keeps its executables.
+
+Service-level streaming (sessions, cut fallback, chaos) lives in
+tests/test_stream_serving.py.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+import jax
+
+from ncnet_tpu import models, ops
+from ncnet_tpu.config import ModelConfig
+from ncnet_tpu.models.ncnet import (
+    coarse2fine_filter,
+    coarse2fine_tracked_filter,
+    extract_features,
+)
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.ops.image import normalize_imagenet
+from ncnet_tpu.ops.sparse_corr import choose_tracked_pipeline
+from ncnet_tpu.ops.temporal import (
+    identity_prior,
+    prior_from_table,
+    temporal_candidates,
+    tracking_recall_proxy,
+    window_size,
+)
+from ncnet_tpu.serving import BatchMatchEngine
+from ncnet_tpu.utils import faults
+
+# tracked-capable tiny config: 96 px → 6x6 fine grid, factor 2 → 3x3 coarse
+TRACK = ModelConfig(backbone="tiny", ncons_kernel_sizes=(3,),
+                    ncons_channels=(1,), sparse_topk=4, sparse_factor=2)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No armed faults, no demoted tiers, no leaked event sink."""
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+
+
+@pytest.fixture(scope="module")
+def track_params():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return models.init_ncnet(TRACK, jax.random.key(0))
+
+
+def u8(side=96, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+def feats(params, img):
+    x = normalize_imagenet(np.asarray(img[None], np.float32))
+    return extract_features(TRACK, params, x)
+
+
+# ---------------------------------------------------------------------------
+# ops/temporal.py units
+# ---------------------------------------------------------------------------
+
+
+def test_window_size_is_static_and_validates():
+    assert window_size(0) == 1
+    assert window_size(1) == 9
+    assert window_size(2) == 25
+    with pytest.raises(ValueError):
+        window_size(-1)
+
+
+def test_temporal_candidates_coverage_contract():
+    """Static (B, N, (2r+1)²) shape, every index in-grid, every row
+    containing its prior cell, and edge windows clamped into duplicates —
+    the exact ``topk_candidates`` padding rule."""
+    hc = wc = 4
+    prior = identity_prior(hc * wc, wc, hc, wc)[None]  # (1, 16)
+    out = np.asarray(temporal_candidates(prior, hc, wc, radius=1))
+    assert out.shape == (1, 16, 9)
+    assert out.dtype == np.int32
+    assert out.min() >= 0 and out.max() < hc * wc
+    for n in range(16):
+        assert prior[0, n] in out[0, n]
+    # interior cell: the full 3x3 block, no duplicates
+    assert len(set(out[0, 5].tolist())) == 9
+    # corner cell 0: the window shifts inward → only the 2x2 block survives
+    assert set(out[0, 0].tolist()) == {0, 1, 4, 5}
+    # a radius spanning the grid = full coverage from ANY prior
+    full = np.asarray(temporal_candidates(prior, hc, wc, radius=3))
+    for n in range(16):
+        assert set(full[0, n].tolist()) == set(range(16))
+
+
+def test_temporal_candidates_clips_stale_prior():
+    """An out-of-grid prior (stale session, padded row) can never index
+    out of bounds — it clips, it does not crash or wrap."""
+    prior = np.array([[999, -7]], np.int32)
+    out = np.asarray(temporal_candidates(prior, 3, 3, radius=1))
+    assert out.min() >= 0 and out.max() < 9
+
+
+def test_prior_from_table_identity_roundtrip():
+    """A table whose every fine target cell matches its own source cell
+    inverts to the zero-motion prior on both families."""
+    h = w = 6
+    factor = 2
+    n = h * w
+    jj, ii = np.meshgrid(np.arange(w), np.arange(h))
+    x = -1.0 + 2.0 * jj.reshape(-1) / (w - 1)
+    y = -1.0 + 2.0 * ii.reshape(-1) / (h - 1)
+    table = np.stack([x, y, x, y, np.ones(n)]).astype(np.float32)
+    pab, pba = prior_from_table(table, (h, w), (h, w), factor)
+    ident = identity_prior((h // factor) * (w // factor), w // factor,
+                           h // factor, w // factor)
+    assert np.array_equal(pab, ident)
+    assert np.array_equal(pba, ident)
+    assert pab.dtype == np.int32
+    # recall proxy: the seeding prior contains every served match → 1.0
+    assert tracking_recall_proxy(pab, table, (h, w), (h, w), factor,
+                                 radius=0) == 1.0
+
+
+def test_prior_from_table_max_score_wins_and_validates():
+    """Two fine entries claiming one coarse source cell: the higher-score
+    entry's target cell is the prior (the vectorized last-write argmax)."""
+    h = w = 4
+    factor = 2
+    n = h * w
+    jj, ii = np.meshgrid(np.arange(w), np.arange(h))
+    x = -1.0 + 2.0 * jj.reshape(-1) / (w - 1)
+    y = -1.0 + 2.0 * ii.reshape(-1) / (h - 1)
+    # every entry names SOURCE cell (0,0); entry 0 (low score) points at
+    # target fine cell 0 (coarse 0), entry n-1 (high score) at the last
+    # fine cell (coarse 3)
+    score = np.linspace(0.1, 1.0, n)
+    table = np.stack([np.full(n, -1.0), np.full(n, -1.0),
+                      x, y, score]).astype(np.float32)
+    pab, _ = prior_from_table(table, (h, w), (h, w), factor)
+    assert pab[0] == 3  # the max-score claimant's coarse target cell
+    # unclaimed source cells fall back to the zero-motion identity
+    ident = identity_prior(4, 2, 2, 2)
+    assert np.array_equal(pab[1:], ident[1:])
+    with pytest.raises(ValueError):
+        prior_from_table(table[:4], (h, w), (h, w), factor)  # not (5|6, N)
+    with pytest.raises(ValueError):
+        prior_from_table(table, (h, w), (8, 8), factor)  # N mismatch
+
+
+def test_tracking_recall_proxy_detects_displacement():
+    """Matches one coarse cell outside the radius-0 window collapse the
+    containment proxy to 0; within-radius matches keep it at 1."""
+    h = w = 4
+    factor = 2
+    n = h * w
+    jj, ii = np.meshgrid(np.arange(w), np.arange(h))
+    x = -1.0 + 2.0 * jj.reshape(-1) / (w - 1)
+    y = -1.0 + 2.0 * ii.reshape(-1) / (h - 1)
+    # every match displaced by one full coarse cell horizontally: flip x
+    table = np.stack([x, y, -x, y, np.ones(n)]).astype(np.float32)
+    ident = identity_prior(4, 2, 2, 2)
+    r0 = tracking_recall_proxy(ident, table, (h, w), (h, w), factor,
+                               radius=0)
+    r1 = tracking_recall_proxy(ident, table, (h, w), (h, w), factor,
+                               radius=1)
+    assert r0 < 1.0
+    assert r1 == 1.0  # the dilated window still contains the flip
+
+
+def test_choose_tracked_pipeline_geometry_and_demotion():
+    kw = dict(factor=2, halo=2, radius=0)
+    assert choose_tracked_pipeline(6, 6, 6, 6, **kw) == "tracked"
+    # odd grid: fine dims must pool by the factor
+    assert choose_tracked_pipeline(5, 6, 6, 6, **kw) is None
+    assert choose_tracked_pipeline(6, 6, 6, 6, factor=2, halo=2,
+                                   radius=-1) is None
+    # a demotion of the shared sparse refine machinery disables tracking
+    ops.demote_fused_tier("coarse2fine")
+    assert choose_tracked_pipeline(6, 6, 6, 6, **kw) is None
+    ops.reset_fused_tier_demotions()
+    assert choose_tracked_pipeline(6, 6, 6, 6, **kw) == "tracked"
+
+
+# ---------------------------------------------------------------------------
+# model: full-coverage bitwise equality (acceptance bar c)
+# ---------------------------------------------------------------------------
+
+
+def test_full_coverage_tracked_equals_coarse2fine_bitwise(track_params):
+    """On the 3x3 coarse grid, radius 2 dilates ANY prior to all 9 cells
+    and sparse_topk=9 selects all 9 — identical candidate sets through the
+    shared ``_sparse_dual_refine``, so the filtered volumes must be
+    BITWISE equal.  This is what makes the steady-state coarse-pass skip
+    an optimization rather than an approximation."""
+    cfg = TRACK.replace(sparse_topk=9, track_radius=2)
+    fa = feats(track_params, u8(96, 1))
+    fb = feats(track_params, u8(96, 2))
+    ident = identity_prior(9, 3, 3, 3)[None]
+    ref = coarse2fine_filter(cfg, track_params, fa, fb)
+    trk = coarse2fine_tracked_filter(cfg, track_params, fa, fb,
+                                     ident, ident)
+    assert np.array_equal(np.asarray(ref.corr), np.asarray(trk.corr))
+    # and an ARBITRARY prior reaches the same full coverage (the prior
+    # only positions the window; at full span position is irrelevant)
+    perm = np.roll(ident, 4, axis=1)
+    trk2 = coarse2fine_tracked_filter(cfg, track_params, fa, fb,
+                                      perm, perm)
+    assert np.array_equal(np.asarray(ref.corr), np.asarray(trk2.corr))
+
+
+# ---------------------------------------------------------------------------
+# engine: zero coarse passes, feature memo, swap fast path
+# ---------------------------------------------------------------------------
+
+
+def test_engine_tracked_dispatch_skips_coarse_pass(track_params):
+    """The streaming acceptance spy: a tracked dispatch leaves
+    ``coarse_passes`` FLAT, and the reference features are extracted once
+    per stream — the digest memo serves every later frame."""
+    eng = BatchMatchEngine(TRACK, track_params)
+    assert eng.tracking_feasible((96, 96), (96, 96))
+    # 48 px → 3x3 feature grid, not poolable by factor 2 → infeasible
+    assert not eng.tracking_feasible((48, 48), (48, 48))
+
+    src, tgt = u8(96, 1), u8(96, 2)
+    table = eng.fetch(eng.dispatch(src[None], tgt[None]))
+    assert eng.coarse_passes == 1
+    pab, pba = prior_from_table(table[0], (6, 6), (6, 6), 2)
+
+    cp, fe = eng.coarse_passes, eng.feature_extractions
+    t1 = eng.fetch(eng.dispatch_tracked(src[None], u8(96, 3)[None],
+                                        pab[None], pba[None]))
+    assert eng.coarse_passes == cp          # ZERO coarse passes
+    assert eng.tracked_dispatches == 1
+    assert eng.feature_extractions == fe + 1  # reference features, once
+    assert t1.shape == table.shape
+    assert np.isfinite(t1).all()
+    # frame 3: same reference object → the digest memo hits, no re-extract
+    eng.fetch(eng.dispatch_tracked(src[None], u8(96, 4)[None],
+                                   pab[None], pba[None]))
+    assert eng.feature_extractions == fe + 1
+    assert eng.coarse_passes == cp
+    assert eng.tracked_dispatches == 2
+
+
+def test_engine_tracked_fallback_is_bitwise_cold(track_params):
+    """A cut fallback re-runs the frame through ``dispatch`` — the SAME
+    executable a cold query uses, so its table is bitwise a cold query's."""
+    eng = BatchMatchEngine(TRACK, track_params)
+    src, tgt = u8(96, 5), u8(96, 6)
+    cold = eng.fetch(eng.dispatch(src[None], tgt[None]))
+    again = eng.fetch(eng.dispatch(src[None], tgt[None]))
+    assert np.array_equal(cold, again)
+
+
+def test_engine_swap_fastpath_keeps_tracked_executables(track_params):
+    """A same-structure weight swap takes the fast path (no retrace): the
+    tracked program keeps serving, and only a structurally different tree
+    drops the compiled executables."""
+    eng = BatchMatchEngine(TRACK, track_params)
+    src, tgt = u8(96, 1), u8(96, 2)
+    table = eng.fetch(eng.dispatch(src[None], tgt[None]))
+    pab, pba = prior_from_table(table[0], (6, 6), (6, 6), 2)
+    eng.fetch(eng.dispatch_tracked(src[None], tgt[None],
+                                   pab[None], pba[None]))
+
+    new_params = jax.tree.map(lambda x: x * 1.0, track_params)
+    eng.swap_params(new_params)
+    assert eng.swap_fastpath_hits == 1
+    # the swapped engine still serves tracked frames, coarse passes flat
+    cp = eng.coarse_passes
+    eng.fetch(eng.dispatch_tracked(src[None], tgt[None],
+                                   pab[None], pba[None]))
+    assert eng.coarse_passes == cp
+
+    # structurally different tree (extra leaf) → full retrace path
+    bigger = dict(new_params)
+    bigger["extra"] = np.zeros(3, np.float32)
+    eng.swap_params(bigger)
+    assert eng.swap_fastpath_hits == 1
