@@ -1115,6 +1115,58 @@ def bench_jax(res=None):
                             f"/metrics scrape costs {scrape_ms:.3f} ms — "
                             f">=1% of the {cadence_ms:.1f} ms batch "
                             "cadence; the telemetry plane must be free")
+                # trace-header wire cost (ISSUE 20): the pod trace context
+                # rides every request as an ADDITIVE wire-header field, so
+                # its price is the codec wall.  Differencing two full-size
+                # codec walls is hopeless here — the header costs ~1 us
+                # against a ~1 ms wall, far below big-buffer alloc jitter
+                # (observed +-10% swings would spuriously trip the gate).
+                # Instead measure the header's MARGINAL cost on a tiny
+                # fixed payload (interleaved min-of-chunks, tight timing —
+                # the header cost is payload-independent: one extra dict
+                # field encoded + parsed), then normalize by the real
+                # image-size codec wall, where noise only touches the
+                # denominator.  Same contract as the scrape gate above:
+                # observability must be FREE, so the bench hard-fails at
+                # 1% rather than quietly taxing every request on the wire.
+                from ncnet_tpu.observability.tracing import new_trace
+                from ncnet_tpu.serving.wire import (decode_request,
+                                                    encode_request)
+
+                hdr = new_trace().to_header()
+                src_w, tgt_w = pairs[0]
+                tiny = np.zeros((8, 8, 3), dtype=np.uint8)
+
+                def _codec_wall(img_a, img_b, trace, iters):
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        decode_request(encode_request(
+                            img_a, img_b, client="bench",
+                            request_id="t0", trace=trace))
+                    return (time.perf_counter() - t0) / iters
+
+                bare_wall = traced_wall = float("inf")
+                for _ in range(7):
+                    bare_wall = min(
+                        bare_wall, _codec_wall(tiny, tiny, None, 200))
+                    traced_wall = min(
+                        traced_wall, _codec_wall(tiny, tiny, hdr, 200))
+                header_cost_s = traced_wall - bare_wall
+                base_wall = min(
+                    _codec_wall(src_w, tgt_w, None, 20) for _ in range(3))
+                overhead = (100.0 * header_cost_s / base_wall
+                            if base_wall > 0 else 0.0)
+                # clamp at 0 for the store: "traced was measurably FASTER"
+                # is timing noise, and a negative floor would let real
+                # regressions hide behind one lucky baseline
+                out["serve_trace_overhead_pct"] = round(max(overhead, 0.0),
+                                                        3)
+                if overhead >= 1.0:
+                    raise RuntimeError(
+                        f"trace header costs {overhead:.2f}% of the wire "
+                        f"codec wall ({header_cost_s * 1e6:.3f} us on a "
+                        f"{base_wall * 1e3:.3f} ms round trip) — >= 1%; "
+                        "the trace context must be free on the wire")
                 # cumulative error-budget burn over every phase above
                 # (lower-is-better in the perf store via the burn_pct
                 # token): 0 while serving keeps its promises, jumps the

@@ -44,6 +44,27 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 SCHEMA_VERSION = 1
 _LOG_KIND = "ncnet_tpu_events"
 
+# injected wall-clock skew (seconds), read once at import.  Every wall
+# stamp this process publishes — event `t` fields, the header envelope,
+# the wire's clock-sync request/response stamps — goes through
+# :func:`wall_now`, so setting NCNET_TPU_CLOCK_SKEW_S makes the process
+# behave exactly like a host whose clock is off by that much: the chaos
+# seam the pod-federation tests use to prove skew correction end to end.
+try:
+    _WALL_SKEW_S = float(os.environ.get("NCNET_TPU_CLOCK_SKEW_S", "") or 0.0)
+except ValueError:
+    _WALL_SKEW_S = 0.0
+
+
+def wall_now() -> float:
+    """This process's wall clock as published in telemetry: ``time.time()``
+    plus the injected test skew (``NCNET_TPU_CLOCK_SKEW_S``, normally 0).
+    Every cross-host comparison (event ``t``, clock-sync stamps) MUST use
+    this, never ``time.time()`` directly — otherwise an injected skew would
+    shift some stamps and not others and the federation math would be
+    unverifiable."""
+    return time.time() + _WALL_SKEW_S
+
 
 def make_run_id() -> str:
     """Unique-enough run id: seconds + pid + random suffix (readable in the
@@ -64,7 +85,7 @@ def run_envelope(run_id: Optional[str] = None) -> Dict[str, Any]:
         "run_id": run_id or make_run_id(),
         "host": socket.gethostname(),
         "pid": os.getpid(),
-        "time": time.time(),
+        "time": wall_now(),
     }
     try:
         import jax
@@ -233,7 +254,7 @@ class EventLog:
         on disk (fsynced) or detectably torn on replay."""
         from ncnet_tpu.utils import faults
 
-        rec = {"t": time.time(), "run": self.run_id, "seq": self._seq,
+        rec = {"t": wall_now(), "run": self.run_id, "seq": self._seq,
                "event": str(event)}
         for k, v in fields.items():
             rec[k] = _jsonable(v)

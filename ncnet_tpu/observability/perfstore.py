@@ -305,7 +305,12 @@ _LOWER_TOKENS = ("_ms", "ms_per_pair", "wall", "_s_per_pair", "_eval_s_",
                  # sharded retrieval: hedges are paid redundant work — a
                  # rising hedge rate at fixed shard health means straggler
                  # detection is firing where it should not
-                 "hedge_pct")
+                 "hedge_pct",
+                 # pod tracing (observability/tracing.py): the wire cost of
+                 # carrying the trace header, as a percent of the untraced
+                 # codec wall — the bench hard-fails at 1%, and this token
+                 # lets perf_regress --check gate the drift below that line
+                 "_overhead_pct")
 
 
 def metric_direction(name: str) -> Optional[str]:

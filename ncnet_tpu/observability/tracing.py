@@ -30,6 +30,19 @@ Design constraints, in order:
 Span ids are process-unique monotonic ints; the event envelope's ``run``
 field (stamped by the sink) disambiguates across resume lineages appending
 to one file, so consumers key spans by ``(run, span)``.
+
+**Pod scope** — spans and request timelines are process-local; a pod-wide
+request needs an identity that survives the wire.  :class:`TraceContext`
+is that identity: a W3C-traceparent-style triple (``trace_id``, the
+sender's ``parent_span``, the ``origin`` host) rendered as one additive
+header string (``serving/wire.py`` / ``retrieval/wire.py`` carry it; old
+peers never read the key).  The router stamps a fresh context per admitted
+request — or adopts one a caller already propagated — and every event a
+request touches downstream (``route_*``, ``serve_*``, ``request_timeline``,
+``retrieve_*``) carries the trace id, so ``tools/trace_export.py
+--federate`` can stitch a router slice to its backend and shard slices
+across N logs, and ``tools/run_report.py --pod`` can prove the pod-scope
+outcome identity from the merged logs alone.
 """
 
 from __future__ import annotations
@@ -44,6 +57,102 @@ from ncnet_tpu.observability import events as _events
 
 _ids = itertools.count(1)  # next() is atomic in CPython; no lock needed
 _tls = threading.local()
+
+# traceparent header version.  Like the wire schema byte, but SOFT: an
+# unknown version parses as no-trace (the request still serves; it is
+# merely untraced) — a trace header must never make a request fail.
+TRACE_VERSION = "00"
+
+
+class TraceContext:
+    """One pod-wide request identity: ``trace_id`` (32 hex chars, minted at
+    the stamping tier), the sender's ``parent_span`` (a process-local span
+    id, or None), and the ``origin`` host that stamped the trace."""
+
+    __slots__ = ("trace_id", "parent_span", "origin")
+
+    def __init__(self, trace_id: str, parent_span: Optional[int] = None,
+                 origin: Optional[str] = None):
+        import socket
+
+        self.trace_id = str(trace_id)
+        self.parent_span = parent_span
+        self.origin = origin if origin is not None else socket.gethostname()
+
+    def to_header(self) -> str:
+        """The wire form: ``00-<trace_id>-<parent_span hex>-<origin>``.
+        The origin rides LAST so a hostname containing ``-`` still parses
+        (the reader splits at most three times)."""
+        parent = (f"{self.parent_span:x}"
+                  if isinstance(self.parent_span, int) else "0")
+        return f"{TRACE_VERSION}-{self.trace_id}-{parent}-{self.origin}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TraceContext({self.to_header()!r})"
+
+
+def new_trace(origin: Optional[str] = None) -> TraceContext:
+    """Mint a fresh trace: 16 random bytes as 32 hex chars (the W3C
+    trace-id width), parented to this thread's innermost open span (None
+    outside any span)."""
+    import os as _os
+
+    return TraceContext(_os.urandom(16).hex(),
+                        parent_span=current_span_id(), origin=origin)
+
+
+def parse_trace(header: Optional[str]) -> Optional[TraceContext]:
+    """Tolerant read of a wire trace header: a :class:`TraceContext`, or
+    None for anything this build does not understand (missing, malformed,
+    unknown version).  NEVER raises — an unreadable trace header must cost
+    the caller nothing but the trace."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.split("-", 3)
+    if len(parts) != 4 or parts[0] != TRACE_VERSION or not parts[1]:
+        return None
+    try:
+        parent = int(parts[2], 16) or None
+    except ValueError:
+        return None
+    return TraceContext(parts[1], parent_span=parent, origin=parts[3])
+
+
+def trace_id_of(header: Optional[str]) -> Optional[str]:
+    """Just the trace id out of a wire header (the field events carry), or
+    None when the header does not parse."""
+    ctx = parse_trace(header)
+    return ctx.trace_id if ctx is not None else None
+
+
+def adopt_trace(value, origin: Optional[str] = None) -> TraceContext:
+    """The router's stamp-or-adopt step: a caller-provided context (a
+    :class:`TraceContext`, a wire header, or a bare id) becomes THE
+    context; anything unusable mints a fresh trace.  Always returns a
+    context — at the stamping tier every admitted request is traced."""
+    if isinstance(value, TraceContext):
+        return value
+    if value:
+        ctx = parse_trace(str(value))
+        if ctx is not None:
+            return ctx
+        return TraceContext(str(value), parent_span=current_span_id(),
+                            origin=origin)
+    return new_trace(origin)
+
+
+def normalize_trace(value) -> Optional[str]:
+    """What the serving tiers stamp on events: the bare trace id out of
+    whatever a caller handed them — a :class:`TraceContext`, a full wire
+    header, an already-bare id, or nothing.  Tolerant like
+    :func:`parse_trace`; a junk value degrades to itself as an opaque id
+    rather than raising (the trace is telemetry, never control flow)."""
+    if value is None:
+        return None
+    if isinstance(value, TraceContext):
+        return value.trace_id
+    s = str(value)
+    return trace_id_of(s) or (s or None)
 
 
 def _stack() -> list:

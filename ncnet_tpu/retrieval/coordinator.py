@@ -429,7 +429,8 @@ class RetrievalCoordinator:
     # -- the scatter-gather data plane --------------------------------------
 
     def _attempt(self, desc: np.ndarray, sid: str, panos: List[str],
-                 topk: int, budget_s: Optional[float], request_id: str
+                 topk: int, budget_s: Optional[float], request_id: str,
+                 trace: Optional[str] = None
                  ) -> Tuple[str, str, Any, float]:
         """One shard dispatch, fully self-accounting (acquire/release,
         success/failure notes) so an ABANDONED straggler still settles its
@@ -450,7 +451,7 @@ class RetrievalCoordinator:
             answer = client.retrieve(
                 desc, panos=panos, topk=topk, client="coordinator",
                 budget_s=budget_s, request_id=request_id,
-                timeout_s=timeout)
+                timeout_s=timeout, trace=trace)
             wall = time.monotonic() - t0
             with self._lock:
                 b.note_success(wall)
@@ -487,11 +488,15 @@ class RetrievalCoordinator:
                  topk: Optional[int] = None,
                  budget_s: Optional[float] = None,
                  client: str = "local", request_id: str = "",
-                 probe: bool = False) -> Dict[str, Any]:
+                 probe: bool = False,
+                 trace: Optional[str] = None) -> Dict[str, Any]:
         """One scatter-gather sweep → the coverage-honest answer document
         (see module docstring).  Raises classified ``Overloaded`` /
         ``DeadlineExceeded`` only at coverage ZERO — partial coverage is
         an answered, DEGRADED result, never an exception."""
+        from ncnet_tpu.observability.tracing import normalize_trace
+
+        trace = normalize_trace(trace)
         t0 = time.monotonic()
         with self._lock:
             if self._health.state not in ADMITTING:
@@ -518,10 +523,11 @@ class RetrievalCoordinator:
                        if str(p) not in self._pano_set]
         obs_events.emit("retrieve_admit", request=request_id,
                         client=client, panos=len(targets),
-                        budget_s=budget)
+                        budget_s=budget,
+                        **({"trace": trace} if trace else {}))
         desc = np.ascontiguousarray(np.asarray(desc, np.float32).ravel())
         return self._sweep(desc, targets, unknown, k, deadline_t, t0,
-                           client, request_id)
+                           client, request_id, trace)
 
     def _plan_locked(self, uncovered: List[str],
                      tried: Dict[str, Set[str]]) -> Dict[str, List[str]]:
@@ -542,10 +548,13 @@ class RetrievalCoordinator:
 
     def _sweep(self, desc: np.ndarray, targets: List[str],
                unknown: List[str], k: int, deadline_t: Optional[float],
-               t0: float, client: str, request_id: str) -> Dict[str, Any]:
+               t0: float, client: str, request_id: str,
+               trace: Optional[str] = None) -> Dict[str, Any]:
         pool = self._pool
         if pool is None:
             raise Overloaded("coordinator not started", reason="draining")
+        # conditional event stamp: untraced sweeps keep their event shape
+        tr = {"trace": trace} if trace else {}
         tried: Dict[str, Set[str]] = {p: set() for p in targets}
         scores: Dict[str, float] = {}
         consulted: Set[str] = set()
@@ -561,7 +570,7 @@ class RetrievalCoordinator:
                 remaining = (max(0.01, deadline_t - time.monotonic())
                              if deadline_t is not None else None)
                 fut = pool.submit(self._attempt, desc, sid, group, k,
-                                  remaining, request_id)
+                                  remaining, request_id, trace)
                 pending[fut] = _Attempt(sid, group, time.monotonic(),
                                         hedge=hedge)
                 attempts += 1
@@ -571,7 +580,7 @@ class RetrievalCoordinator:
                         self._n["hedges"] += 1
                         self._backends[sid].hedges_absorbed += 1
                     obs_events.emit("retrieve_hedge", request=request_id,
-                                    shard=sid, panos=len(group))
+                                    shard=sid, panos=len(group), **tr)
 
         while True:
             now = time.monotonic()
@@ -628,7 +637,7 @@ class RetrievalCoordinator:
                         "retrieve_shard_error", request=request_id,
                         shard=sid, kind=kind,
                         error=f"{type(payload).__name__}: {payload}"[:200],
-                        panos=len(att.panos))
+                        panos=len(att.panos), **tr)
         # stragglers still in flight are ABANDONED (their _attempt settles
         # the backend's books when it lands); the query answers now
         total = len(targets)
@@ -642,12 +651,12 @@ class RetrievalCoordinator:
                 self._n["deadline" if expired else "shed"] += 1
             if expired:
                 obs_events.emit("retrieve_deadline", request=request_id,
-                                coverage=coverage, wall_ms=wall_ms)
+                                coverage=coverage, wall_ms=wall_ms, **tr)
                 raise DeadlineExceeded(
                     "budget expired before any shard answered",
                     where="scatter")
             obs_events.emit("retrieve_shed", request=request_id,
-                            reason="no_capacity", wall_ms=wall_ms)
+                            reason="no_capacity", wall_ms=wall_ms, **tr)
             raise Overloaded("no shard could answer the sweep",
                              reason="no_capacity")
         degraded = coverage < self.cfg.min_coverage
@@ -660,7 +669,7 @@ class RetrievalCoordinator:
                         client=client, coverage=coverage,
                         degraded=degraded, hedges=hedges,
                         attempts=attempts, consulted=len(consulted),
-                        total=total, wall_ms=wall_ms)
+                        total=total, wall_ms=wall_ms, **tr)
         return {
             "schema": RETRIEVAL_DOC_SCHEMA,
             "request": request_id,

@@ -199,12 +199,16 @@ class ShardService:
                  topk: Optional[int] = None,
                  budget_s: Optional[float] = None,
                  client: str = "wire", request_id: str = "",
-                 probe: bool = False) -> Dict[str, Any]:
+                 probe: bool = False,
+                 trace: Optional[str] = None) -> Dict[str, Any]:
         """One scoring sweep: requested ∩ assigned panos scored, top-k +
         the consulted/unavailable accounting back.  Raises the classified
         ``serving/request.py`` outcomes (Overloaded when not admitting,
         DeadlineExceeded when the budget expires mid-sweep) — the wire
         maps them onto HTTP, a local caller sees them directly."""
+        from ncnet_tpu.observability.tracing import normalize_trace
+
+        trace = normalize_trace(trace)
         t0 = time.monotonic()
         with self._lock:
             if self._health.state not in ADMITTING:
@@ -264,7 +268,8 @@ class ShardService:
                 "retrieve_shard_result", shard=self.shard_id,
                 request=request_id, client=client,
                 consulted=len(scores), unavailable=len(unavailable),
-                requested=len(targets), wall_ms=round(wall * 1e3, 3))
+                requested=len(targets), wall_ms=round(wall * 1e3, 3),
+                **({"trace": trace} if trace else {}))
             return {
                 "shard": self.shard_id,
                 "scores": [[p, s] for p, s in

@@ -26,11 +26,13 @@ import hashlib
 import http.client
 import json
 import socket
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
 
 import numpy as np
 
+from ncnet_tpu.observability import events as obs_events
 from ncnet_tpu.serving.request import (
     DeadlineExceeded,
     Overloaded,
@@ -40,8 +42,11 @@ from ncnet_tpu.serving.wire import (
     _frame,
     _unframe,
     _OUTCOME_STATUS,
+    CLOCK_SYNC_INTERVAL_S,
     WIRE_SETTLE_MARGIN_S,
     WireError,
+    emit_clock_sync,
+    sync_stamps,
 )
 
 RETRIEVE_CONTENT_TYPE = "application/x-ncnet-retrieve"
@@ -68,12 +73,16 @@ def encode_retrieve_request(desc: np.ndarray, *,
                             client: str = "wire",
                             budget_s: Optional[float] = None,
                             request_id: str = "",
-                            probe: bool = False) -> bytes:
+                            probe: bool = False,
+                            trace: Optional[str] = None) -> bytes:
     """One retrieval query as wire bytes.  ``panos`` scopes the sweep to a
     subset of the receiver's assigned panos (the coordinator's scatter
     plan / failover re-dispatch); None = score everything assigned.
     ``probe=True`` marks the coordinator's resurrection probe — answered
-    through the full data plane without scoring anything."""
+    through the full data plane without scoring anything.  ``trace`` is
+    the additive pod-trace header (old shards ignore the key losslessly);
+    ``sent_t`` always rides so responses can carry the NTP-style clock
+    stamps back (``serving/wire.py::sync_stamps``)."""
     d = np.ascontiguousarray(np.asarray(desc, dtype=np.float32).ravel())
     header = {
         "kind": "retrieve",
@@ -86,7 +95,10 @@ def encode_retrieve_request(desc: np.ndarray, *,
                      if budget_s is not None else None),
         "request": str(request_id),
         "probe": bool(probe),
+        "sent_t": round(obs_events.wall_now(), 6),
     }
+    if trace:
+        header["trace"] = str(trace)
     return _frame(header, d.tobytes())
 
 
@@ -120,6 +132,11 @@ def decode_retrieve_request(data: bytes
                      else None),
         "request": str(header.get("request", "")),
         "probe": bool(header.get("probe", False)),
+        "trace": (str(header["trace"])
+                  if isinstance(header.get("trace"), str) else None),
+        "sent_t": (float(header["sent_t"])
+                   if isinstance(header.get("sent_t"), (int, float))
+                   else None),
     }
     return desc, meta
 
@@ -129,26 +146,36 @@ def decode_retrieve_request(data: bytes
 # ---------------------------------------------------------------------------
 
 
-def encode_retrieve_response(answer: Dict[str, Any]) -> Tuple[int, bytes]:
+def encode_retrieve_response(answer: Dict[str, Any],
+                             extra: Optional[Dict[str, Any]] = None
+                             ) -> Tuple[int, bytes]:
     """``(http_status, wire bytes)`` for a shard's (or coordinator's)
     answer document.  The document travels as canonical JSON payload with
-    its sha256 in the header — the integrity seal the client verifies."""
+    its sha256 in the header — the integrity seal the client verifies.
+    ``extra`` merges additive header fields (the clock-sync stamps);
+    the seal covers the payload only, so stamps stay out of the digest."""
     payload = json.dumps(answer, sort_keys=True).encode("utf-8")
     header = {
         "outcome": "result",
         "kind": "retrieve",
         "sha256": hashlib.sha256(payload).hexdigest(),
     }
+    if extra:
+        header.update(extra)
     return _OUTCOME_STATUS["result"], _frame(header, payload)
 
 
-def encode_retrieve_error(exc: Exception) -> Tuple[int, bytes]:
+def encode_retrieve_error(exc: Exception,
+                          extra: Optional[Dict[str, Any]] = None
+                          ) -> Tuple[int, bytes]:
     """Classified terminal rejection — same outcome classes and status
     mapping as the match wire (``serving/wire.py::encode_error``); an
     unexpected exception encodes as a quarantine-shaped 500 so the wire
     stays outcome-total."""
     header: Dict[str, Any] = {"kind": "retrieve",
                               "message": str(exc)[:500]}
+    if extra:
+        header.update(extra)
     if isinstance(exc, Overloaded):
         header.update(outcome="overloaded", reason=exc.reason,
                       retry_after_s=exc.retry_after_s)
@@ -169,6 +196,14 @@ def decode_retrieve_response(data: bytes) -> Dict[str, Any]:
     bytes from a shard are a SHARD failure (re-route to a replica), never
     a silently reordered shortlist."""
     header, payload = _unframe(data)
+    return _retrieve_response_from(header, payload)
+
+
+def _retrieve_response_from(header: Dict[str, Any],
+                            payload: bytes) -> Dict[str, Any]:
+    """The classify-or-return body of :func:`decode_retrieve_response`,
+    split out so the client can read the clock-sync stamps off the header
+    before the outcome check raises."""
     outcome = header.get("outcome")
     msg = str(header.get("message", ""))
     if outcome == "overloaded":
@@ -216,6 +251,7 @@ def serve_retrieve(retrieve: Callable[..., Dict[str, Any]], body: bytes, *,
     content_type, payload)`` for the HTTP handler.  ``max_wait_s`` is
     advisory here (the call is synchronous); a budgeted request classifies
     its own :class:`DeadlineExceeded` at the scoring loop's checkpoints."""
+    recv_t = obs_events.wall_now()
     try:
         desc, meta = decode_retrieve_request(body)
     except WireError as e:
@@ -223,21 +259,27 @@ def serve_retrieve(retrieve: Callable[..., Dict[str, Any]], body: bytes, *,
         # itself was unserviceable, a caller error
         _, payload = encode_retrieve_error(RequestQuarantined(
             f"unserviceable retrieve request: {e}", kind="wire",
-            attempts=1))
+            attempts=1), extra=sync_stamps(recv_t))
         return 400, RETRIEVE_CONTENT_TYPE, payload
     del max_wait_s  # symmetry with serve_match; the call blocks inline
+    # additive trace pass-through: only traced requests add the kwarg so a
+    # retrieve callable without it keeps working for untraced callers
+    tr = {"trace": meta["trace"]} if meta.get("trace") else {}
     try:
         answer = retrieve(
             desc, panos=meta["panos"], topk=meta["topk"],
             budget_s=meta["budget_s"], client=meta["client"],
-            request_id=meta["request"], probe=meta["probe"])
+            request_id=meta["request"], probe=meta["probe"], **tr)
     except (Overloaded, DeadlineExceeded, RequestQuarantined) as e:
-        status, payload = encode_retrieve_error(e)
+        status, payload = encode_retrieve_error(
+            e, extra=sync_stamps(recv_t))
         return status, RETRIEVE_CONTENT_TYPE, payload
     except Exception as e:  # noqa: BLE001 — the wire stays outcome-total
-        status, payload = encode_retrieve_error(e)
+        status, payload = encode_retrieve_error(
+            e, extra=sync_stamps(recv_t))
         return status, RETRIEVE_CONTENT_TYPE, payload
-    status, payload = encode_retrieve_response(answer)
+    status, payload = encode_retrieve_response(
+        answer, extra=sync_stamps(recv_t))
     return status, RETRIEVE_CONTENT_TYPE, payload
 
 
@@ -266,6 +308,7 @@ class RetrieveClient:
         self._port = int(parts.port)
         self.timeout_s = float(timeout_s)
         self._conn: Optional[http.client.HTTPConnection] = None
+        self._last_sync_t = 0.0  # monotonic; clock_sync emission throttle
 
     def _connection(self, timeout: float) -> http.client.HTTPConnection:
         if self._conn is None:
@@ -282,7 +325,8 @@ class RetrieveClient:
                  topk: Optional[int] = None,
                  client: str = "wire", budget_s: Optional[float] = None,
                  request_id: str = "", probe: bool = False,
-                 timeout_s: Optional[float] = None) -> Dict[str, Any]:
+                 timeout_s: Optional[float] = None,
+                 trace: Optional[str] = None) -> Dict[str, Any]:
         """One wire round trip.  ``timeout_s`` bounds the WHOLE attempt at
         the socket level — the hung-socket backstop that keeps a wedged
         shard from absorbing the coordinator's dispatch slots."""
@@ -294,9 +338,10 @@ class RetrieveClient:
         faults.shard_fault_hook(self.base_url, "send")
         body = encode_retrieve_request(
             desc, panos=panos, topk=topk, client=client, budget_s=budget_s,
-            request_id=request_id, probe=probe)
+            request_id=request_id, probe=probe, trace=trace)
         conn = self._connection(timeout_s if timeout_s is not None
                                 else self.timeout_s)
+        t_send = obs_events.wall_now()
         try:
             conn.request("POST", "/retrieve", body=body,
                          headers={"Content-Type": RETRIEVE_CONTENT_TYPE})
@@ -305,10 +350,15 @@ class RetrieveClient:
         except (OSError, http.client.HTTPException, socket.timeout):
             self.close()  # the connection state is unknowable: reconnect
             raise
+        t_recv = obs_events.wall_now()
         # response-corruption chaos seam: a flipped byte here must fail the
         # checksum in decode_retrieve_response, never reorder a shortlist
         data = faults.shard_payload_hook(self.base_url, data)
-        return decode_retrieve_response(data)
+        header, payload = _unframe(data)
+        if time.monotonic() - self._last_sync_t >= CLOCK_SYNC_INTERVAL_S:
+            self._last_sync_t = time.monotonic()
+            emit_clock_sync(self.base_url, header, t_send, t_recv)
+        return _retrieve_response_from(header, payload)
 
     def close(self) -> None:
         conn, self._conn = self._conn, None

@@ -679,6 +679,16 @@ class IntrospectionServer:
             "drain_timeout_s", "psi_threshold", "error_rate_margin",
             "latency_factor", "min_latency_samples", "state_path",
             "gc_keep_generations") if k in req}
+        # additive trace field (pod observability): the control plane's
+        # rollout order joins the federated trace like any data request
+        from ncnet_tpu.observability import events as obs_events
+        from ncnet_tpu.observability.tracing import normalize_trace
+
+        trace = normalize_trace(req.get("trace"))
+        obs_events.emit(
+            "rollout_control", checkpoint=str(candidate)[:200],
+            knobs=sorted(knobs),
+            **({"trace": trace} if trace else {}))
         try:
             ctl = start(candidate, RolloutConfig(**knobs))
         except RuntimeError as e:  # a rollout is already in progress

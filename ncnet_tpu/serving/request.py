@@ -228,6 +228,12 @@ class MatchRequest:
     prior_ab: Optional[np.ndarray] = None
     prior_ba: Optional[np.ndarray] = None
     src_digest: Optional[str] = None
+    # pod-wide trace id (observability/tracing.py::TraceContext) adopted
+    # from the wire or the submitting caller: every event this request
+    # touches carries it, so the federated pod trace and the pod identity
+    # report can join this process's slice of the request to the rest.
+    # None for an untraced request — the plain path is untouched.
+    trace: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t

@@ -84,6 +84,7 @@ import numpy as np
 
 from ncnet_tpu.observability import MetricsRegistry, events as obs_events
 from ncnet_tpu.observability import get_logger
+from ncnet_tpu.observability.tracing import TraceContext, adopt_trace
 from ncnet_tpu.observability.export import Family, render
 from ncnet_tpu.serving.admission import AdmissionController
 from ncnet_tpu.serving.health import (
@@ -333,6 +334,14 @@ class _RouterRequest:             # the ownership set, never compared
     shed_by: Set[str] = dataclasses.field(default_factory=set)
     shed_hints: List[float] = dataclasses.field(default_factory=list)
     parked_logged: bool = False           # awaiting_capacity emitted once
+    # the pod-wide trace context: stamped (or adopted from the edge
+    # caller) at router admission, propagated on every wire attempt, and
+    # carried by every route_*/retry event this request produces
+    trace: Optional[TraceContext] = None
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t
@@ -542,11 +551,16 @@ class MatchRouter:
     # ------------------------------------------------------------------
 
     def submit(self, src, tgt, *, deadline_s: Optional[float] = None,
-               client: str = "default") -> MatchFuture:
+               client: str = "default",
+               trace: Optional[str] = None) -> MatchFuture:
         """Admit one match query against the pod.  Same contract as
         :meth:`MatchService.submit`: returns a :class:`MatchFuture`,
         raises classified :class:`Overloaded` / :class:`DeadlineExceeded`
-        synchronously at the door."""
+        synchronously at the door.  The router is the pod's trace-stamping
+        tier: it ADOPTS ``trace`` (a traceparent header an upstream tier
+        propagated) or STAMPS a fresh context, and every backend attempt
+        carries it — so one edge request is one trace across every log it
+        touches."""
         src = as_pair_image(src, "src")
         tgt = as_pair_image(tgt, "tgt")
         now = time.monotonic()
@@ -577,6 +591,7 @@ class MatchRouter:
                         submitted_t=now,
                         deadline_t=(now + deadline_s) if deadline_s
                         else None,
+                        trace=adopt_trace(trace),
                     )
                     self._admission.note_admit(client)
                     self._n["admitted"] += 1
@@ -599,7 +614,8 @@ class MatchRouter:
             raise shed
         obs_events.emit(
             "route_admit", request=req.id, client=client,
-            deadline_s=round(deadline_s, 6) if deadline_s else None)
+            deadline_s=round(deadline_s, 6) if deadline_s else None,
+            trace=req.trace_id)
         # phase 2 (the service's admit discipline): make the admitted
         # request visible to the workers only after its admit event is on
         # disk, settling it ourselves if the router died in the window
@@ -619,7 +635,8 @@ class MatchRouter:
                 self._registry.counter("shed").inc()
                 self._admission.note_done(req.client)
             obs_events.emit("route_shed", request=req.id, client=client,
-                            reason="stopped", admitted=True)
+                            reason="stopped", admitted=True,
+                            trace=req.trace_id)
             raise exc
         return req.future
 
@@ -958,7 +975,8 @@ class MatchRouter:
                 # nothing lost (logged once per request, not per tick)
                 obs_events.emit("retry", unit=req.id, kind="connection",
                                 on_budget=False, scope="router",
-                                via="awaiting_capacity")
+                                via="awaiting_capacity",
+                                trace=req.trace_id)
                 continue
             if overloaded is not None:
                 self._settle_overloaded(req, overloaded)
@@ -1006,7 +1024,9 @@ class MatchRouter:
         try:
             result = client.match(
                 req.src, req.tgt, client=req.client, budget_s=budget,
-                request_id=req.id, timeout_s=timeout)
+                request_id=req.id, timeout_s=timeout,
+                trace=(req.trace.to_header()
+                       if req.trace is not None else None))
         except Overloaded as e:
             self._release(backend, client)
             self._on_backpressure(req, backend, e)
@@ -1103,7 +1123,8 @@ class MatchRouter:
         if survivors:
             obs_events.emit("retry", unit=req.id, kind=kind,
                             on_budget=False, scope="router",
-                            backend=backend.id, via="reroute")
+                            backend=backend.id, via="reroute",
+                            trace=req.trace_id)
             self._requeue_front(req)
             return
         if not any_ready:
@@ -1111,14 +1132,16 @@ class MatchRouter:
                 req.parked_logged = True
                 obs_events.emit("retry", unit=req.id, kind=kind,
                                 on_budget=False, scope="router",
-                                backend=backend.id, via="awaiting_capacity")
+                                backend=backend.id, via="awaiting_capacity",
+                                trace=req.trace_id)
             self._requeue_front(req)
             return
         req.attempts += 1
         if req.attempts <= self.cfg.retries:
             obs_events.emit("retry", unit=req.id, kind=kind,
                             attempt=req.attempts, on_budget=True,
-                            scope="router", backend=backend.id)
+                            scope="router", backend=backend.id,
+                            trace=req.trace_id)
             self._requeue_front(req)
         else:
             self._quarantine(req, kind, exc)
@@ -1141,7 +1164,8 @@ class MatchRouter:
                         on_budget=False, scope="router",
                         backend=backend.id, via="backpressure",
                         reason=exc.reason,
-                        retry_after_s=exc.retry_after_s)
+                        retry_after_s=exc.retry_after_s,
+                        trace=req.trace_id)
         if req.expired(time.monotonic()):
             self._resolve_deadline(req, "backpressure")
             return
@@ -1182,7 +1206,7 @@ class MatchRouter:
             "route_result", request=req.id, client=req.client,
             backend=backend.id, wall_ms=round(wall * 1e3, 3),
             backend_wall_ms=round(result.wall_s * 1e3, 3),
-            attempts=req.attempts)
+            attempts=req.attempts, trace=req.trace_id)
         self._terminal(req)
 
     def _resolve_deadline(self, req: _RouterRequest, where: str) -> None:
@@ -1195,7 +1219,8 @@ class MatchRouter:
             self._n["deadline"] += 1
             self._registry.counter("deadline_exceeded").inc()
         obs_events.emit("route_deadline", request=req.id,
-                        client=req.client, where=where, admitted=True)
+                        client=req.client, where=where, admitted=True,
+                        trace=req.trace_id)
         self._terminal(req)
 
     def _quarantine(self, req: _RouterRequest, kind: str,
@@ -1216,7 +1241,7 @@ class MatchRouter:
         obs_events.emit("route_quarantine", request=req.id,
                         client=req.client, kind=kind,
                         attempts=max(1, req.attempts),
-                        error=str(exc)[:300])
+                        error=str(exc)[:300], trace=req.trace_id)
         self._terminal(req)
 
     def _settle_overloaded(self, req: _RouterRequest,
@@ -1229,7 +1254,8 @@ class MatchRouter:
             self._registry.counter("shed").inc()
         obs_events.emit("route_shed", request=req.id, client=req.client,
                         reason=exc.reason,
-                        retry_after_s=exc.retry_after_s, admitted=True)
+                        retry_after_s=exc.retry_after_s, admitted=True,
+                        trace=req.trace_id)
         self._terminal(req)
 
     def _terminal(self, req: _RouterRequest) -> None:
@@ -1278,7 +1304,7 @@ class MatchRouter:
                 self._admission.note_done(req.client)
             obs_events.emit("route_shed", request=req.id,
                             client=req.client, reason=reason,
-                            admitted=True)
+                            admitted=True, trace=req.trace_id)
         obs_events.emit(
             "route_drain", drained=self._draining and crashed is None,
             leftover=len(leftovers),

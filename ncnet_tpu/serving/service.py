@@ -499,19 +499,24 @@ class MatchService:
     # ------------------------------------------------------------------
 
     def submit(self, src, tgt, *, deadline_s: Optional[float] = None,
-               client: str = "default",
+               client: str = "default", trace: Optional[str] = None,
                _stream_fields: Optional[Dict[str, Any]] = None
                ) -> MatchFuture:
         """Admit one match query (raw uint8 pair).  Returns a
         :class:`MatchFuture`; raises :class:`Overloaded` (shed) or
         :class:`DeadlineExceeded` (budget already gone) synchronously —
         rejections are classified at the door, not discovered by timeout.
-        ``_stream_fields`` is the private streaming seam
-        (:meth:`stream_submit` passes the request's session/prior payload);
-        external callers leave it None.
+        ``trace`` adopts a pod-wide trace (a traceparent header or bare
+        trace id — typically the wire's propagated context): every event
+        this request touches then carries the trace id.  ``_stream_fields``
+        is the private streaming seam (:meth:`stream_submit` passes the
+        request's session/prior payload); external callers leave it None.
         """
+        from ncnet_tpu.observability.tracing import normalize_trace
+
         src = as_pair_image(src, "src")
         tgt = as_pair_image(tgt, "tgt")
+        trace = normalize_trace(trace)
         now = time.monotonic()
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
@@ -554,6 +559,7 @@ class MatchService:
                         submitted_t=now,
                         deadline_t=(now + deadline_s) if deadline_s
                         else None,
+                        trace=trace,
                         **(_stream_fields or {}),
                     )
                     self._admission.note_admit(client)
@@ -583,6 +589,7 @@ class MatchService:
             "serve_admit", request=req.id, client=client,
             bucket=bucket_label(req.bucket),
             deadline_s=round(deadline_s, 6) if deadline_s else None,
+            **({"trace": trace} if trace else {}),
         )
         # phase 2: make the admitted request visible to the worker.  If
         # the service died between the phases, the admitted request still
@@ -602,7 +609,8 @@ class MatchService:
                 self._n["shed"] += 1
                 self._registry.counter("shed").inc()
             obs_events.emit("serve_shed", request=req.id, client=client,
-                            reason="stopped", admitted=True)
+                            reason="stopped", admitted=True,
+                            **({"trace": trace} if trace else {}))
             self._observe_slo(req, "shed")
             self._emit_timeline(req, "overloaded")
             self._terminal(req)
@@ -615,7 +623,8 @@ class MatchService:
 
     def stream_submit(self, stream: str, src, tgt, *,
                       deadline_s: Optional[float] = None,
-                      client: Optional[str] = None):
+                      client: Optional[str] = None,
+                      trace: Optional[str] = None):
         """Serve one frame of a video stream — BLOCKING (unlike
         :meth:`submit`): frame ``t+1``'s candidates are seeded from this
         frame's match table, so the data dependence forces one frame in
@@ -639,7 +648,8 @@ class MatchService:
         sess = self._streams.acquire(stream)
         with sess.lock:
             try:
-                out = self._stream_frame(sess, src, tgt, deadline_s, client)
+                out = self._stream_frame(sess, src, tgt, deadline_s, client,
+                                         trace=trace)
             except ServeError:
                 with self._cond:
                     sess.errors += 1
@@ -676,7 +686,8 @@ class MatchService:
             return True  # injected fakes: capability implies eligibility
         return bool(feasible(bucket[0], bucket[1]))
 
-    def _stream_frame(self, sess, src, tgt, deadline_s, client):
+    def _stream_frame(self, sess, src, tgt, deadline_s, client,
+                      trace: Optional[str] = None):
         from ncnet_tpu.serving.stream import StreamFrameResult
 
         src = as_pair_image(src, "src")
@@ -701,7 +712,7 @@ class MatchService:
         recall = None
         if tracked:
             fut = self.submit(
-                src, tgt, deadline_s=deadline_s, client=client,
+                src, tgt, deadline_s=deadline_s, client=client, trace=trace,
                 _stream_fields=dict(
                     stream=sess.id, stream_seq=seq, tracked=True,
                     prior_ab=sess.prior_ab, prior_ba=sess.prior_ba,
@@ -733,7 +744,7 @@ class MatchService:
                 # and the tracker re-seeds from its table below
                 sess.reset_tracking()
                 fut = self.submit(src, tgt, deadline_s=deadline_s,
-                                  client=client,
+                                  client=client, trace=trace,
                                   _stream_fields=dict(
                                       stream=sess.id, stream_seq=seq,
                                       src_digest=digest))
@@ -741,7 +752,7 @@ class MatchService:
                 tracked, fallback = False, True
         else:
             fut = self.submit(src, tgt, deadline_s=deadline_s,
-                              client=client,
+                              client=client, trace=trace,
                               _stream_fields=dict(
                                   stream=sess.id, stream_seq=seq,
                                   src_digest=digest))
@@ -774,12 +785,15 @@ class MatchService:
         self._registry.counter(f"stream_frames_{kind}").inc()
         if recall is not None:
             self._registry.gauge("stream_recall").set(round(recall, 4))
+        from ncnet_tpu.observability.tracing import normalize_trace
+
         obs_events.emit(
             "stream_frame", stream=sess.id, seq=seq, kind=kind,
             tracked=tracked, fallback=fallback,
             recall=(round(recall, 4) if recall is not None else None),
             wall_ms=round(res.wall_s * 1e3, 3),
-            bucket=bucket_label(bucket), client=client)
+            bucket=bucket_label(bucket), client=client,
+            **({"trace": normalize_trace(trace)} if trace else {}))
         return StreamFrameResult(result=res, stream=sess.id, seq=seq,
                                  tracked=tracked, fallback=fallback,
                                  recall=recall)
@@ -1290,6 +1304,7 @@ class MatchService:
                 bucket=bucket_label(inf.bucket),
                 wall_ms=wall_ms, batch_size=len(inf.batch),
                 replica=rid, model_version=ver,
+                **({"trace": req.trace} if req.trace else {}),
             )
             # SLO judged on the SAME rounded wall the event records, so
             # run_report --slo replaying the log reclassifies identically
@@ -1504,7 +1519,8 @@ class MatchService:
             self._registry.counter("quarantined").inc()
         obs_events.emit("serve_quarantine", request=req.id,
                         client=req.client, kind=kind,
-                        attempts=req.attempts, error=str(exc)[:300])
+                        attempts=req.attempts, error=str(exc)[:300],
+                        **({"trace": req.trace} if req.trace else {}))
         self._observe_slo(req, "quarantined")
         self._emit_timeline(req, "quarantined")
         if self._manifest is not None:
@@ -1520,7 +1536,8 @@ class MatchService:
             self._n["deadline"] += 1
             self._registry.counter("deadline_exceeded").inc()
         obs_events.emit("serve_deadline", request=req.id, client=req.client,
-                        where=where, admitted=True)
+                        where=where, admitted=True,
+                        **({"trace": req.trace} if req.trace else {}))
         self._observe_slo(req, "deadline")
         self._emit_timeline(req, "deadline", where=where)
         self._terminal(req)
@@ -1551,12 +1568,18 @@ class MatchService:
         submission instant ``t0``, so ``tools/trace_export.py`` can lay the
         request out as Perfetto async slices keyed by its id."""
         now_m = time.monotonic()
+        # t0 reconstructs the wall-clock submission instant from the
+        # monotonic age — through wall_now(), so an injected clock skew
+        # shifts the timeline exactly like every other stamp this process
+        # publishes (the federation's skew correction must see ONE clock)
         fields: Dict[str, Any] = dict(
             request=req.id, client=req.client,
             bucket=bucket_label(req.bucket), outcome=outcome,
             attempts=req.attempts,
-            t0=round(time.time() - (now_m - req.submitted_t), 6),
+            t0=round(obs_events.wall_now() - (now_m - req.submitted_t), 6),
         )
+        if req.trace:
+            fields["trace"] = req.trace
         if replica is not None:
             fields["replica"] = replica
         if where is not None:
@@ -1869,7 +1892,8 @@ class MatchService:
                 continue  # settled before the crash interrupted its batch
             self._n["shed"] += 1
             obs_events.emit("serve_shed", request=req.id, client=req.client,
-                            reason=reason, admitted=True)
+                            reason=reason, admitted=True,
+                            **({"trace": req.trace} if req.trace else {}))
             self._observe_slo(req, "shed")
             self._emit_timeline(req, "overloaded")
             self._terminal(req)

@@ -325,9 +325,14 @@ def run_stream_load(
     records: List[List[Dict[str, Any]]] = [[] for _ in range(streams)]
 
     def one_stream(si: int) -> None:
+        from ncnet_tpu.observability.tracing import new_trace
+
         sched = stream_schedule(frames, rate_hz, jitter=jitter,
                                 burst_every=burst_every, seed=seed + si)
         sid = f"{stream_prefix}{si}"
+        # one pod trace per stream: every frame of a session shares the
+        # trace id, so the federated view groups a camera's whole life
+        trace = new_trace().trace_id
         t0 = time.monotonic()
         for fi in range(frames):
             due = t0 + sched[fi]
@@ -342,7 +347,7 @@ def run_stream_load(
             try:
                 fr = service.stream_submit(
                     sid, src, tgt, deadline_s=deadline_s,
-                    client=f"{stream_prefix}{si}")
+                    client=f"{stream_prefix}{si}", trace=trace)
                 rec.update(outcome="result", tracked=fr.tracked,
                            fallback=fr.fallback, recall=fr.recall,
                            wall_ms=round((time.monotonic() - t1) * 1e3, 3))

@@ -54,6 +54,7 @@ from urllib.parse import urlsplit
 
 import numpy as np
 
+from ncnet_tpu.observability import events as obs_events
 from ncnet_tpu.serving.request import (
     DeadlineExceeded,
     MatchResult,
@@ -82,6 +83,44 @@ _OUTCOME_STATUS = {"result": 200, "overloaded": 429, "deadline": 504,
 WIRE_SETTLE_MARGIN_S = 2.0
 
 WIRE_CONTENT_TYPE = "application/x-ncnet-match"
+
+# clock-sync sampling cadence per client connection: one NTP-style offset
+# sample (half-RTT from the request/response wall stamps already on the
+# wire) per this many seconds.  Every response CARRIES the stamps; the
+# throttle only bounds the event-log fsync traffic.
+CLOCK_SYNC_INTERVAL_S = 1.0
+
+
+def sync_stamps(recv_t: float) -> Dict[str, Any]:
+    """The ADDITIVE response-header stamps the clock-sync plane rides on:
+    the server's wall clock at request receipt (``recv_t``) and response
+    encode (``resp_t``), plus the server's event-log run id (``peer_run``,
+    None when no sink is bound) — the node identity the federation's skew
+    graph keys corrections by (hostnames collide when a test pod runs
+    every process on one machine; run ids never do)."""
+    sink = obs_events.get_global_sink()
+    return {
+        "recv_t": round(recv_t, 6),
+        "resp_t": round(obs_events.wall_now(), 6),
+        "peer_run": sink.run_id if sink is not None else None,
+    }
+
+
+def emit_clock_sync(peer: str, header: Dict[str, Any],
+                    t_send: float, t_recv: float) -> None:
+    """One NTP-style offset sample from a completed round trip:
+    ``offset_s`` estimates peer_wall − local_wall (positive = the peer's
+    clock is ahead), ``rtt_s`` is the wire time with the peer's serve time
+    subtracted out.  A response without stamps (an old peer) is a no-op —
+    the sync plane is additive end to end."""
+    t1, t2 = header.get("recv_t"), header.get("resp_t")
+    if not isinstance(t1, (int, float)) or not isinstance(t2, (int, float)):
+        return
+    offset = ((float(t1) - t_send) + (float(t2) - t_recv)) / 2.0
+    rtt = (t_recv - t_send) - (float(t2) - float(t1))
+    obs_events.emit(
+        "clock_sync", peer=peer, peer_run=header.get("peer_run"),
+        offset_s=round(offset, 6), rtt_s=round(max(0.0, rtt), 6))
 
 
 class WireError(ValueError):
@@ -129,7 +168,8 @@ def encode_request(src: np.ndarray, tgt: np.ndarray, *,
                    client: str = "wire",
                    budget_s: Optional[float] = None,
                    request_id: str = "",
-                   stream: Optional[str] = None) -> bytes:
+                   stream: Optional[str] = None,
+                   trace: Optional[str] = None) -> bytes:
     """One match query as wire bytes.  ``budget_s`` is the REMAINING
     deadline budget (None = no deadline); the receiving service admits
     with exactly this budget, so edge and backend judge the same promise.
@@ -137,7 +177,12 @@ def encode_request(src: np.ndarray, tgt: np.ndarray, *,
     read the key) tags the request as one frame of a video stream: the
     backend routes it through its per-stream FIFO session
     (``MatchService.stream_submit``) so consecutive frames reuse temporal
-    candidate priors and skip the coarse pass on steady frames."""
+    candidate priors and skip the coarse pass on steady frames.
+    ``trace`` (optional, ADDITIVE like ``stream``) is the traceparent
+    header (``observability/tracing.py::TraceContext.to_header``) that
+    makes the backend's events part of the caller's pod-wide trace; the
+    always-present ``sent_t`` wall stamp pairs with the response's
+    ``recv_t``/``resp_t`` for NTP-style clock-offset sampling."""
     src = np.ascontiguousarray(src)
     tgt = np.ascontiguousarray(tgt)
     for name, a in (("src", src), ("tgt", tgt)):
@@ -152,9 +197,12 @@ def encode_request(src: np.ndarray, tgt: np.ndarray, *,
         "budget_s": (round(float(budget_s), 6)
                      if budget_s is not None else None),
         "request": str(request_id),
+        "sent_t": round(obs_events.wall_now(), 6),
     }
     if stream is not None:
         header["stream"] = str(stream)
+    if trace is not None:
+        header["trace"] = str(trace)
     return _frame(header, src.tobytes() + tgt.tobytes())
 
 
@@ -187,6 +235,11 @@ def decode_request(data: bytes
         "request": str(header.get("request", "")),
         "stream": (str(header["stream"])
                    if header.get("stream") else None),
+        "trace": (str(header["trace"])
+                  if header.get("trace") else None),
+        "sent_t": (float(header["sent_t"])
+                   if isinstance(header.get("sent_t"), (int, float))
+                   else None),
     }
     return src, tgt, meta
 
@@ -196,8 +249,11 @@ def decode_request(data: bytes
 # ---------------------------------------------------------------------------
 
 
-def encode_result(result: MatchResult) -> Tuple[int, bytes]:
-    """``(http_status, wire bytes)`` for a served table."""
+def encode_result(result: MatchResult,
+                  extra: Optional[Dict[str, Any]] = None) -> Tuple[int, bytes]:
+    """``(http_status, wire bytes)`` for a served table.  ``extra`` merges
+    additive header fields (the clock-sync stamps) — old readers ignore
+    keys they do not know."""
     table = np.ascontiguousarray(result.table, dtype=np.float32)
     header = {
         "outcome": "result",
@@ -208,10 +264,13 @@ def encode_result(result: MatchResult) -> Tuple[int, bytes]:
         "wall_ms": round(result.wall_s * 1e3, 3),
         "quality": result.quality,
     }
+    if extra:
+        header.update(extra)
     return _OUTCOME_STATUS["result"], _frame(header, table.tobytes())
 
 
-def encode_error(exc: Exception) -> Tuple[int, bytes]:
+def encode_error(exc: Exception,
+                 extra: Optional[Dict[str, Any]] = None) -> Tuple[int, bytes]:
     """``(http_status, wire bytes)`` for a classified terminal rejection.
     Anything that is not one of the serving outcome classes encodes as a
     quarantine-shaped 500 — the wire stays outcome-total even when the
@@ -227,6 +286,8 @@ def encode_error(exc: Exception) -> Tuple[int, bytes]:
                       attempts=exc.attempts)
     else:
         header.update(outcome="quarantined", kind="internal", attempts=1)
+    if extra:
+        header.update(extra)
     return _OUTCOME_STATUS[header["outcome"]], _frame(header)
 
 
@@ -234,6 +295,10 @@ def decode_response(data: bytes) -> MatchResult:
     """Wire response → :class:`MatchResult`, or RAISES the classified
     terminal error exactly as a local ``MatchFuture.result()`` would."""
     header, payload = _unframe(data)
+    return _response_from(header, payload)
+
+
+def _response_from(header: Dict[str, Any], payload: bytes) -> MatchResult:
     outcome = header.get("outcome")
     msg = str(header.get("message", ""))
     if outcome == "overloaded":
@@ -298,24 +363,35 @@ def serve_match(submit: Callable[..., Any], body: bytes, *,
     — the per-stream FIFO session that carries temporal priors across
     frames.  A host without a streaming plane (a router) serves the frame
     as an ordinary request: correct, just never coarse-skipped.
+
+    Every response — result or classified rejection — carries the
+    clock-sync stamps (:func:`sync_stamps`): ``recv_t`` is taken HERE,
+    before the decode, so the stamped serve interval covers everything the
+    peer's half-RTT estimate must exclude.
     """
+    recv_t = obs_events.wall_now()
     try:
         src, tgt, meta = decode_request(body)
     except WireError as e:
         # deliberate 400 override of the quarantine-shaped body's 500:
         # the frame itself was unserviceable, a caller error
         _, payload = encode_error(RequestQuarantined(
-            f"unserviceable wire request: {e}", kind="wire", attempts=1))
+            f"unserviceable wire request: {e}", kind="wire", attempts=1),
+            extra=sync_stamps(recv_t))
         return 400, WIRE_CONTENT_TYPE, payload
     budget = meta["budget_s"]
+    # the trace rides into the fronted tier as a keyword only when the
+    # peer sent one: an untraced request reaches `submit` with the exact
+    # pre-trace signature, so wrapped/legacy submits keep working
+    tr = {"trace": meta["trace"]} if meta.get("trace") else {}
     try:
         if meta.get("stream") and stream_submit is not None:
             result = stream_submit(
                 meta["stream"], src, tgt, deadline_s=budget,
-                client=meta["client"]).result
+                client=meta["client"], **tr).result
         else:
             fut = submit(src, tgt, deadline_s=budget,
-                         client=meta["client"])
+                         client=meta["client"], **tr)
             result = fut.result(
                 timeout=(budget + WIRE_SETTLE_MARGIN_S)
                 if budget is not None else max_wait_s)
@@ -325,15 +401,15 @@ def serve_match(submit: Callable[..., Any], body: bytes, *,
         # never hold the connection forever
         status, payload = encode_error(DeadlineExceeded(
             "request did not settle within the wire wait bound",
-            where="wire_wait"))
+            where="wire_wait"), extra=sync_stamps(recv_t))
         return status, WIRE_CONTENT_TYPE, payload
     except (Overloaded, DeadlineExceeded, RequestQuarantined) as e:
-        status, payload = encode_error(e)
+        status, payload = encode_error(e, extra=sync_stamps(recv_t))
         return status, WIRE_CONTENT_TYPE, payload
     except Exception as e:  # noqa: BLE001 — the wire stays outcome-total
-        status, payload = encode_error(e)
+        status, payload = encode_error(e, extra=sync_stamps(recv_t))
         return status, WIRE_CONTENT_TYPE, payload
-    status, payload = encode_result(result)
+    status, payload = encode_result(result, extra=sync_stamps(recv_t))
     return status, WIRE_CONTENT_TYPE, payload
 
 
@@ -363,6 +439,7 @@ class MatchClient:
         self._port = int(parts.port)
         self.timeout_s = float(timeout_s)
         self._conn: Optional[http.client.HTTPConnection] = None
+        self._last_sync_t = 0.0  # monotonic; throttles clock_sync events
 
     def _connection(self, timeout: float) -> http.client.HTTPConnection:
         if self._conn is None:
@@ -377,11 +454,17 @@ class MatchClient:
     def match(self, src: np.ndarray, tgt: np.ndarray, *,
               client: str = "wire", budget_s: Optional[float] = None,
               request_id: str = "", stream: Optional[str] = None,
+              trace: Optional[str] = None,
               timeout_s: Optional[float] = None) -> MatchResult:
         """One wire round trip.  ``timeout_s`` bounds the WHOLE attempt at
         the socket level (send + the backend's serve + the response read) —
         the hung-socket backstop the router relies on to keep a wedged host
-        from absorbing its workers."""
+        from absorbing its workers.  ``trace`` propagates the caller's
+        traceparent header; each round trip also yields one NTP-style
+        clock-offset sample against this peer, emitted as a throttled
+        ``clock_sync`` event."""
+        import time as _time
+
         from ncnet_tpu.utils import faults
 
         # the multi-host chaos seam: injected backend death / socket hang
@@ -389,9 +472,11 @@ class MatchClient:
         # kills real processes; this hook covers the in-process tests)
         faults.backend_fault_hook(self.base_url, "send")
         body = encode_request(src, tgt, client=client, budget_s=budget_s,
-                              request_id=request_id, stream=stream)
+                              request_id=request_id, stream=stream,
+                              trace=trace)
         conn = self._connection(timeout_s if timeout_s is not None
                                 else self.timeout_s)
+        t_send = obs_events.wall_now()
         try:
             conn.request("POST", "/match", body=body,
                          headers={"Content-Type": WIRE_CONTENT_TYPE})
@@ -400,7 +485,13 @@ class MatchClient:
         except (OSError, http.client.HTTPException, socket.timeout):
             self.close()  # the connection state is unknowable: reconnect
             raise
-        return decode_response(data)
+        t_recv = obs_events.wall_now()
+        header, payload = _unframe(data)
+        now_m = _time.monotonic()
+        if now_m - self._last_sync_t >= CLOCK_SYNC_INTERVAL_S:
+            self._last_sync_t = now_m
+            emit_clock_sync(self.base_url, header, t_send, t_recv)
+        return _response_from(header, payload)
 
     def close(self) -> None:
         conn, self._conn = self._conn, None

@@ -693,7 +693,21 @@ def test_library_modules_have_no_bare_print(tmp_path):
     # controller runs against a LIVE service — a bare print there reopens
     # the side channel mid-serving — and tools/rollout.py's stdout is its
     # machine-scriptable phase timeline)
-    for target in ("ncnet_tpu/observability/quality.py",
+    # (the ISSUE 20 pod-tracing plane is pinned for the same reason:
+    # tracing.py stamps contexts inside every wire hot path, the
+    # retrieval wire/coordinator/shard modules carry the trace through
+    # scatter-gather dispatch, and tools/trace_export.py writes ONE
+    # parseable Perfetto document — a bare print in any of them corrupts
+    # an artifact or reopens the side channel mid-request.
+    # tools/stall_watchdog.py and tools/run_report.py stay UNPINNED like
+    # serve_backend: their stdout verdict/report text IS the interface)
+    for target in ("ncnet_tpu/observability/tracing.py",
+                   "ncnet_tpu/observability/events.py",
+                   "ncnet_tpu/retrieval/wire.py",
+                   "ncnet_tpu/retrieval/coordinator.py",
+                   "ncnet_tpu/retrieval/shard.py",
+                   "tools/trace_export.py",
+                   "ncnet_tpu/observability/quality.py",
                    "ncnet_tpu/serving/rollout.py",
                    "tools/rollout.py",
                    "ncnet_tpu/ops/conv4d_cp.py",

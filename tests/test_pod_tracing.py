@@ -1,0 +1,405 @@
+"""Pod-scope distributed tracing suite (ISSUE 20).
+
+Three layers under test:
+
+  * **Wire-propagated trace context** (``observability/tracing.py`` +
+    the additive ``trace``/``sent_t`` header fields in
+    ``serving/wire.py``): the router stamps or adopts a traceparent per
+    request, backends attach their events to the remote parent, and
+    every completed round trip yields an NTP-style ``clock_sync``
+    offset sample.
+  * **Multi-log federation** (``tools/trace_export.py --federate``):
+    N per-process event logs merge into ONE Perfetto trace — per-host
+    tracks, skew-corrected timestamps from the clock_sync graph,
+    cross-host flow arrows keyed by trace id — tolerating torn tails,
+    resume lineages / duplicated inputs, and sync-less logs (unaligned
+    fallback: warning, correction 0, and NO arrows — never wrong ones).
+  * **Pod identity report** (``run_report --pod``): the outcome-total
+    identity recomputed across every log of the pod at once, dark
+    trails named, failover re-routes attributed to their traces, and
+    the edge-minus-backend overhead join.
+
+THE acceptance chain (test_acceptance_pod_trace_federation): a real
+3-process pod — router in-process, two backend subprocesses with
+INJECTED ±50 ms clock skew (``NCNET_TPU_CLOCK_SKEW_S``) — one backend
+SIGKILLed mid-batch; ``--federate`` then renders one valid Perfetto
+trace where every cross-host request is a flow whose skew-corrected
+backend slices nest inside the router slice, and ``run_report --pod``
+proves zero lost requests from the merged logs alone with the failover
+attributed to its trace.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ncnet_tpu import ops
+from ncnet_tpu.observability import EventLog
+from ncnet_tpu.observability import events as obs_events
+from ncnet_tpu.serving import (
+    BACKEND_DEAD,
+    MatchRouter,
+    RouterConfig,
+)
+from ncnet_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import run_report  # noqa: E402
+import trace_export  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+    yield
+    faults.clear()
+    ops.reset_fused_tier_demotions()
+    obs_events.set_global_sink(None)
+
+
+def u8(side=32, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+def wait_until(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _spawn_skewed_backend(tmp_path, name, skew_s, latency=0.05,
+                          max_queue=32):
+    """One real backend process whose WHOLE wall clock is shifted by
+    ``skew_s`` (the ``NCNET_TPU_CLOCK_SKEW_S`` chaos seam in
+    observability/events.py — read once at import, so every stamp the
+    child publishes is consistently skewed), with its own event log."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off",
+               NCNET_TPU_CLOCK_SKEW_S=repr(skew_s))
+    log = str(tmp_path / f"{name}.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve_backend.py"),
+         "--fake-engine", "--replicas", "1", "--latency", str(latency),
+         "--max-queue", str(max_queue), "--max-batch", "1",
+         "--events", log],
+        stdout=subprocess.PIPE, text=True, env=env)
+    doc = json.loads(proc.stdout.readline())
+    return proc, doc["url"], log
+
+
+def _run_id_of(log_path):
+    head, _ = obs_events.replay_events(log_path)
+    return str(head.get("header", {}).get("run_id"))
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chain
+# ---------------------------------------------------------------------------
+
+
+def test_acceptance_pod_trace_federation(tmp_path):
+    """ISSUE 20 acceptance: 3-process pod with ±50 ms injected skew, one
+    backend SIGKILLed mid-batch → one federated Perfetto trace (skew
+    recovered from clock_sync, child slices nested, flows drawn) and the
+    pod identity recomputed exactly from the merged logs alone."""
+    router_log = str(tmp_path / "router.jsonl")
+    skews = {"bplus": +0.05, "bminus": -0.05}
+    procs = {}
+    with obs_events.bound(EventLog(router_log)):
+        for name, skew in skews.items():
+            procs[name] = _spawn_skewed_backend(tmp_path, name, skew)
+        router = MatchRouter(
+            [url for _, url, _ in procs.values()],
+            RouterConfig(probe_period_s=0.2, resurrect_after_s=120.0,
+                         backend_max_failures=2, retries=1,
+                         request_timeout_s=15.0, per_backend_depth=2,
+                         max_queue=256,
+                         max_in_flight_per_client=256)).start()
+        img = u8()
+        try:
+            # phase 1: healthy traffic — every request gets a router-
+            # stamped trace that rides the wire to some backend
+            futs = [router.submit(img, img) for _ in range(12)]
+            for f in futs:
+                f.result(timeout=120)
+            assert all(f.outcome == "result" for f in futs)
+
+            # phase 2: SIGKILL one backend mid-batch under load — the
+            # in-flight requests re-route OFF-budget, zero lost
+            p_kill, url_kill, _ = procs["bplus"]
+            victim = next(b for b in router.backends
+                          if b.url in url_kill)
+            futs = [router.submit(img, img) for _ in range(12)]
+            time.sleep(0.06)  # let the victim take batches in flight
+            p_kill.kill()
+            for f in futs:
+                f.result(timeout=120)
+            assert all(f.outcome == "result" for f in futs)
+            assert wait_until(lambda: victim.state == BACKEND_DEAD, 15)
+        finally:
+            router.stop()
+            for p, _, _ in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+            for p, _, _ in procs.values():
+                try:
+                    p.wait(timeout=20)
+                except Exception:  # noqa: BLE001 — wedged child
+                    p.kill()
+
+    logs = [router_log, procs["bplus"][2], procs["bminus"][2]]
+    run_router = _run_id_of(router_log)
+    run_plus = _run_id_of(procs["bplus"][2])
+    run_minus = _run_id_of(procs["bminus"][2])
+
+    # --- federation: one valid Perfetto trace, skew RECOVERED ----------
+    warns = []
+    doc = trace_export.build_federated_trace(logs, warn=warns.append)
+    assert warns == [], warns  # every run reachable via clock_sync
+    json.loads(json.dumps(doc))  # serializable end to end
+    fed = doc["otherData"]["federation"]
+    assert fed["unaligned"] == []
+    assert all(r["aligned"] for r in fed["runs"].values())
+    # the router is the reference clock; each backend's correction must
+    # recover MINUS its injected skew (tolerance ~ the loopback RTT
+    # bound of the NTP sample, far below the 100 ms skew separation)
+    assert fed["runs"][run_router]["correction_s"] == 0.0
+    assert abs(fed["runs"][run_plus]["correction_s"] + 0.05) < 0.02
+    assert abs(fed["runs"][run_minus]["correction_s"] - 0.05) < 0.02
+    assert fed["router_slices"] == 24
+    assert fed["flows"] >= 12
+
+    # every cross-host request is a flow whose skew-corrected backend
+    # slice NESTS inside its router slice
+    route_slice = {}  # trace -> (ts, ts+dur)
+    for e in doc["traceEvents"]:
+        if e.get("cat") == "route_request" and e["ph"] == "X" \
+                and e["args"].get("trace"):
+            route_slice[e["args"]["trace"]] = (e["ts"],
+                                               e["ts"] + e["dur"])
+    nested = 0
+    eps_us = 10_000.0  # residual sync error bound (half-RTT scale)
+    for e in doc["traceEvents"]:
+        if e.get("cat") == "serve_request" and e["ph"] == "X":
+            tr = e["args"]["trace"]
+            assert tr in route_slice, f"orphan backend slice {tr}"
+            r0, r1 = route_slice[tr]
+            assert e["ts"] >= r0 - eps_us
+            assert e["ts"] + e["dur"] <= r1 + eps_us
+            nested += 1
+    assert nested >= 12
+    # flow endpoints exist on both sides of every drawn arrow
+    assert sum(1 for e in doc["traceEvents"] if e["ph"] == "s") >= 12
+    assert sum(1 for e in doc["traceEvents"] if e["ph"] == "f") >= 12
+
+    # --- pod identity: recomputed from the merged logs ALONE -----------
+    events = []
+    for p in logs:
+        _, recs = obs_events.replay_events(p)
+        events.extend(recs)
+    pod = run_report.build_pod_section(events)
+    out = pod["outcomes"]
+    assert out["admitted"] == 24
+    assert out["results"] == 24
+    assert out["terminals"] == out["admitted"]
+    assert out["unresolved"] == 0
+    assert pod["lost_requests"] == []
+    assert pod["traced_admits"] == 24
+    # every routed result is BACKED by a backend trail — nothing dark
+    assert pod["dark_trails"] == []
+    # the failover re-route is attributed to its trace, and that trace
+    # recovered (settled as a result after re-routing)
+    assert pod["failovers"], "SIGKILL produced no attributed re-route"
+    for fo in pod["failovers"]:
+        assert fo["trace"], fo
+        assert fo["recovered"] is True, fo
+    # the clock_sync graph covered both edges
+    syncs = {str(e.get("peer_run")) for e in events
+             if e.get("event") == "clock_sync"}
+    assert {run_plus, run_minus} <= syncs
+    # wire+routing overhead measured per request, trace-joined
+    assert pod["overhead_samples"] >= 12
+    assert pod["overhead_joined_by_trace"] >= 12
+
+    # --- the CLI round trips -------------------------------------------
+    out_path = str(tmp_path / "pod.trace.json")
+    assert trace_export.main(logs + ["--federate", "-o", out_path]) == 0
+    with open(out_path) as f:
+        json.loads(f.read())
+    assert run_report.main(logs + ["--pod"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# federation edge cases (synthetic logs — controlled clocks)
+# ---------------------------------------------------------------------------
+
+
+def _write_log(path, run, events, host="hosta", torn_tail=False):
+    """Hand-crafted event log: one header line + the given event records
+    (each gains run/t defaults), optionally ending in a TORN line — the
+    mid-append SIGKILL shape replay_events must absorb."""
+    header = {"kind": "ncnet_tpu_events",
+              "header": {"schema": 1, "run_id": run, "host": host,
+                         "pid": 1, "time": 0.0}}
+    lines = [json.dumps(header)]
+    for e in events:
+        rec = {"run": run, **e}
+        lines.append(json.dumps(rec))
+    text = "\n".join(lines) + "\n"
+    if torn_tail:
+        text += '{"t": 999.0, "run": "%s", "event": "serve_res' % run
+    with open(path, "w") as f:
+        f.write(text)
+    return str(path)
+
+
+def _router_events(trace, t=100.0, run="r1", request="q1", **extra):
+    return [
+        {"event": "route_admit", "t": t, "request": request,
+         "client": "cam0", "trace": trace},
+        {"event": "route_result", "t": t + 0.2, "request": request,
+         "client": "cam0", "trace": trace, "wall_ms": 200.0,
+         "backend_wall_ms": 50.0},
+        *extra.get("more", []),
+    ]
+
+
+def test_federation_skewless_logs_fall_back_unaligned(tmp_path):
+    """Zero clock_sync samples: the federation must DEGRADE honestly —
+    warning emitted, corrections pinned to 0, and NO flow arrows between
+    the unaligned runs (a confidently wrong arrow is worse than none)."""
+    tr = "a" * 32
+    log1 = _write_log(tmp_path / "router.jsonl", "r1",
+                      _router_events(tr))
+    log2 = _write_log(tmp_path / "backend.jsonl", "b1", [
+        {"event": "request_timeline", "t": 105.1, "t0": 105.05,
+         "total_ms": 50.0, "trace": tr, "request": "q1",
+         "outcome": "result"},
+    ], host="hostb")
+    warns = []
+    doc = trace_export.build_federated_trace([log1, log2],
+                                             warn=warns.append)
+    assert len(warns) == 1 and "b1" in warns[0]
+    fed = doc["otherData"]["federation"]
+    assert fed["unaligned"] == ["b1"]
+    assert fed["runs"]["b1"] == {"correction_s": 0.0, "aligned": False}
+    assert fed["runs"]["r1"]["aligned"] is True
+    # the router slice still renders — only the CROSS-HOST arrow is
+    # withheld
+    assert fed["router_slices"] == 1
+    assert fed["flows"] == 0
+    assert not [e for e in doc["traceEvents"]
+                if e["ph"] in ("s", "t", "f")]
+
+
+def test_federation_absorbs_torn_tails_and_corrects_skew(tmp_path):
+    """A backend log torn mid-append (SIGKILL shape) still federates: the
+    torn line is dropped, the clock_sync edge aligns the run (+5 s skew
+    recovered exactly), and the corrected backend slice lands inside the
+    router slice."""
+    tr = "b" * 32
+    log1 = _write_log(tmp_path / "router.jsonl", "r1",
+                      _router_events(tr) + [
+                          {"event": "clock_sync", "t": 100.21,
+                           "peer": "http://hostb:1", "peer_run": "b1",
+                           "offset_s": 5.0, "rtt_s": 0.001},
+                      ])
+    # backend clock runs 5 s AHEAD: its stamps are t+5 for the same
+    # instants
+    log2 = _write_log(tmp_path / "backend.jsonl", "b1", [
+        {"event": "request_timeline", "t": 105.15, "t0": 105.05,
+         "total_ms": 50.0, "trace": tr, "request": "q1",
+         "outcome": "result"},
+    ], host="hostb", torn_tail=True)
+    warns = []
+    doc = trace_export.build_federated_trace([log1, log2],
+                                             warn=warns.append)
+    assert warns == []
+    fed = doc["otherData"]["federation"]
+    assert fed["runs"]["b1"] == {"correction_s": -5.0, "aligned": True}
+    assert fed["flows"] == 1
+    serve = [e for e in doc["traceEvents"]
+             if e.get("cat") == "serve_request" and e["ph"] == "X"]
+    route = [e for e in doc["traceEvents"]
+             if e.get("cat") == "route_request" and e["ph"] == "X"]
+    assert len(serve) == 1 and len(route) == 1
+    # corrected: 105.05 - 5.0 = 100.05 ∈ [100.0, 100.2]
+    assert route[0]["ts"] <= serve[0]["ts"]
+    assert serve[0]["ts"] + serve[0]["dur"] \
+        <= route[0]["ts"] + route[0]["dur"]
+
+
+def test_federation_tolerates_resume_lineages_and_duplicate_inputs(
+        tmp_path):
+    """Resume lineages (two run ids in ONE file under one header) and the
+    same log given TWICE must not double-count: slices are keyed
+    (run, request), so every request renders exactly once."""
+    tr1, tr2 = "c" * 32, "d" * 32
+    log1 = str(tmp_path / "router.jsonl")
+    header = {"kind": "ncnet_tpu_events",
+              "header": {"schema": 1, "run_id": "r1", "host": "hosta",
+                         "pid": 1, "time": 0.0}}
+    recs = [header]
+    for e in _router_events(tr1, t=100.0, run="r1", request="q1"):
+        recs.append({"run": "r1", **e})
+    # the resumed lineage appends under a FRESH run id, same file
+    for e in _router_events(tr2, t=200.0, run="r1b", request="q1"):
+        recs.append({"run": "r1b", **e})
+    with open(log1, "w") as f:
+        f.write("\n".join(json.dumps(r) for r in recs) + "\n")
+    doc = trace_export.build_federated_trace([log1, log1],
+                                             warn=lambda m: None)
+    fed = doc["otherData"]["federation"]
+    # same request id "q1" under two lineages = two distinct slices;
+    # the duplicated input path adds NOTHING
+    assert fed["router_slices"] == 2
+    assert sorted(fed["runs"]) == ["r1", "r1b"]
+    route = [e for e in doc["traceEvents"]
+             if e.get("cat") == "route_request"]
+    assert len(route) == 2
+
+
+# ---------------------------------------------------------------------------
+# pod identity edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_pod_report_names_dark_trails(tmp_path):
+    """A trace the router settled as result with NO backend trail in any
+    merged log is named individually — the 'trail goes dark' verdict the
+    acceptance criteria demand, never averaged away."""
+    tr_ok, tr_dark = "e" * 32, "f" * 32
+    events = []
+    for e in _router_events(tr_ok, t=100.0, request="q1"):
+        events.append({"run": "r1", **e})
+    for e in _router_events(tr_dark, t=101.0, request="q2"):
+        events.append({"run": "r1", **e})
+    # only q1's trace has a backend-side trail
+    events += [
+        {"run": "b1", "event": "serve_admit", "t": 100.01,
+         "request": "s1", "trace": tr_ok},
+        {"run": "b1", "event": "serve_result", "t": 100.06,
+         "request": "s1", "trace": tr_ok, "wall_ms": 50.0},
+    ]
+    pod = run_report.build_pod_section(events)
+    assert pod["outcomes"]["unresolved"] == 0
+    assert len(pod["dark_trails"]) == 1
+    d = pod["dark_trails"][0]
+    assert d["trace"] == tr_dark
+    assert d["router_requests"] == ["q2"]
+    assert d["backend_results"] == 0
+    # the healthy trace joined for the overhead measurement
+    assert pod["overhead_joined_by_trace"] == 1

@@ -160,8 +160,12 @@ def test_wire_request_roundtrip_and_refusal():
                                request_id="r9")
     src2, tgt2, meta = wire.decode_request(blob)
     assert (src2 == src).all() and (tgt2 == tgt).all()
+    # the clock-sync send stamp always rides; an untraced request still
+    # decodes trace=None (the additive pod-trace field)
+    assert isinstance(meta.pop("sent_t"), float)
     assert meta == {"client": "cam0", "budget_s": 0.25, "request": "r9",
-                    "stream": None}  # untagged request: no stream session
+                    "stream": None,  # untagged request: no stream session
+                    "trace": None}
     # a peer speaking another wire schema is REFUSED, not misread: flip
     # the version byte and the decode must raise before trusting anything
     with pytest.raises(WireError, match="schema"):

@@ -68,8 +68,16 @@ def post_rollout(base: str, checkpoint: str, knobs: Dict[str, Any],
                  timeout: float = 10.0) -> Tuple[int, Dict[str, Any]]:
     """``POST /rollout``; returns ``(http_status, parsed_or_error_doc)``.
     202 carries the controller's first status snapshot; 4xx/5xx carry
-    ``{"error": <the server's plain-text answer>}``."""
-    body = json.dumps({"checkpoint": checkpoint, **knobs}).encode("utf-8")
+    ``{"error": <the server's plain-text answer>}``.  The body carries a
+    fresh pod trace (additive ``trace`` key — old hosts ignore it), so
+    the operator's rollout order shows up in the federated pod trace."""
+    try:  # best-effort: the tool must work without the package on path
+        from ncnet_tpu.observability.tracing import new_trace
+        tr = {"trace": new_trace().to_header()}
+    except ImportError:
+        tr = {}
+    body = json.dumps({"checkpoint": checkpoint, **tr,
+                       **knobs}).encode("utf-8")
     req = urllib.request.Request(
         base + "/rollout", data=body,
         headers={"Content-Type": "application/json"})

@@ -754,6 +754,163 @@ def build_router_section(events: List[dict]) -> Dict[str, Any]:
     }
 
 
+def build_pod_section(events: List[dict]) -> Dict[str, Any]:
+    """The POD-scope identity report (``--pod log1 log2 ...``): the
+    outcome-total invariant recomputed across EVERY log of a pod at once,
+    joined by the wire-propagated trace id (observability/tracing.py).
+
+    What only the merged logs can prove:
+
+      * **edge totality** — every router-admitted request reaches exactly
+        one terminal ``route_*`` outcome (same identity as the router
+        section, but over all router logs/lineages in the pod);
+      * **trail continuity** — every ``route_result`` was BACKED by a
+        ``serve_result`` carrying the same trace id in some backend log.
+        A trace whose router says "result" but whose backend trail shows
+        fewer results has GONE DARK (a backend log lost/torn past its
+        settle) and is named, never averaged away;
+      * **failover attribution** — each ``retry`` ``scope=router``
+        ``via=reroute`` is tied to its trace, with the backend runs that
+        admitted the request before and after, so a SIGKILLed backend's
+        re-routed requests are individually accounted;
+      * **hedge attribution** — ``retrieve_hedge`` events joined by
+        trace, the shard tier's duplicate-dispatch accounting;
+      * **pod overhead** — per routed result, the edge wall minus the
+        wall the backend measured for the SAME trace = wire + routing
+        overhead (falls back to the in-band ``backend_wall_ms`` when the
+        trace join finds no unique backend twin, e.g. shared stream
+        traces).
+    """
+    def _key(e: dict):
+        return (e.get("run"), e.get("request"))
+
+    admits = [e for e in events if e.get("event") == "route_admit"]
+    r_results = [e for e in events if e.get("event") == "route_result"]
+    r_deadlines = [e for e in events if e.get("event") == "route_deadline"
+                   and e.get("admitted") is not False]
+    r_quar = [e for e in events if e.get("event") == "route_quarantine"]
+    r_sheds = [e for e in events if e.get("event") == "route_shed"
+               and e.get("admitted") is True]
+    terminals = (len(r_results) + len(r_deadlines) + len(r_quar)
+                 + len(r_sheds))
+    settled = {_key(e) for e in r_results + r_quar}
+    settled |= {_key(e) for e in r_deadlines}
+    settled |= {_key(e) for e in r_sheds}
+    lost = [f"{e.get('request')} (run {e.get('run')})" for e in admits
+            if _key(e) not in settled]
+
+    # --- the trace join across logs -----------------------------------
+    s_results = [e for e in events if e.get("event") == "serve_result"
+                 and e.get("trace")]
+    s_admit_runs: Dict[str, List[Any]] = {}
+    for e in events:
+        if e.get("event") == "serve_admit" and e.get("trace"):
+            runs = s_admit_runs.setdefault(str(e["trace"]), [])
+            if e.get("run") not in runs:
+                runs.append(e.get("run"))
+    serve_by_trace: Dict[str, List[dict]] = {}
+    for e in s_results:
+        serve_by_trace.setdefault(str(e["trace"]), []).append(e)
+    route_by_trace: Dict[str, List[dict]] = {}
+    for e in r_results:
+        if e.get("trace"):
+            route_by_trace.setdefault(str(e["trace"]), []).append(e)
+
+    # trail continuity: a trace the router settled as result must show at
+    # least as many backend results across the pod's logs
+    dark: List[Dict[str, Any]] = []
+    for tr, routed in sorted(route_by_trace.items()):
+        served = serve_by_trace.get(tr, [])
+        if len(served) < len(routed):
+            dark.append({
+                "trace": tr,
+                "router_requests": sorted(
+                    str(e.get("request")) for e in routed),
+                "route_results": len(routed),
+                "backend_results": len(served),
+                "backend_runs": s_admit_runs.get(tr, []),
+            })
+    # admitted at a backend under a router trace but never settled there:
+    # the in-flight-at-SIGKILL population, attributed by trace
+    s_settled = {_key(e) for e in events
+                 if e.get("event") in ("serve_result", "serve_quarantine")
+                 or (e.get("event") == "serve_deadline"
+                     and e.get("admitted") is not False)
+                 or (e.get("event") == "serve_shed"
+                     and e.get("admitted") is True)}
+    backend_lost = [
+        {"trace": str(e.get("trace")), "request": str(e.get("request")),
+         "run": e.get("run")}
+        for e in events
+        if e.get("event") == "serve_admit" and e.get("trace")
+        and _key(e) not in s_settled]
+
+    # failover attribution: every router reroute tied to its trace and
+    # the backend runs that saw the request before/after
+    failovers = []
+    for e in events:
+        if e.get("event") == "retry" and e.get("scope") == "router" \
+                and e.get("via") == "reroute":
+            tr = str(e.get("trace")) if e.get("trace") else None
+            failovers.append({
+                "request": e.get("unit"), "trace": tr,
+                "kind": e.get("kind"), "from_backend": e.get("backend"),
+                "backend_runs": (s_admit_runs.get(tr, [])
+                                 if tr else []),
+                "recovered": bool(tr and route_by_trace.get(tr)),
+            })
+    hedges = [
+        {"request": e.get("request"), "trace": e.get("trace"),
+         "shard": e.get("shard"), "panos": e.get("panos")}
+        for e in events
+        if e.get("event") == "retrieve_hedge" and e.get("trace")]
+
+    # pod overhead: edge wall minus the backend's own wall per request —
+    # via the trace join when it is unique, in-band backend_wall_ms else
+    overhead: List[float] = []
+    joined = 0
+    for e in r_results:
+        if not isinstance(e.get("wall_ms"), (int, float)):
+            continue
+        tr = str(e.get("trace")) if e.get("trace") else None
+        twins = serve_by_trace.get(tr, []) if tr else []
+        if tr and len(twins) == 1 and len(route_by_trace.get(tr, [])) == 1 \
+                and isinstance(twins[0].get("wall_ms"), (int, float)):
+            overhead.append(float(e["wall_ms"])
+                            - float(twins[0]["wall_ms"]))
+            joined += 1
+        elif isinstance(e.get("backend_wall_ms"), (int, float)):
+            overhead.append(float(e["wall_ms"])
+                            - float(e["backend_wall_ms"]))
+
+    traced_admits = sum(1 for e in admits if e.get("trace"))
+    return {
+        "outcomes": {
+            "admitted": len(admits),
+            "results": len(r_results),
+            "deadline_exceeded": len(r_deadlines),
+            "quarantined": len(r_quar),
+            "shed_admitted": len(r_sheds),
+            "terminals": terminals,
+            "unresolved": max(0, len(admits) - terminals),
+        },
+        "lost_requests": lost,
+        "traced_admits": traced_admits,
+        "traces": {
+            "routed": len(route_by_trace),
+            "backed": sum(1 for tr in route_by_trace
+                          if tr in serve_by_trace),
+        },
+        "dark_trails": dark,
+        "backend_lost": backend_lost,
+        "failovers": failovers,
+        "hedges": hedges,
+        "overhead_ms": _percentiles(overhead),
+        "overhead_joined_by_trace": joined,
+        "overhead_samples": len(overhead),
+    }
+
+
 def build_retrieval_section(events: List[dict]) -> Dict[str, Any]:
     """The retrieval-tier postmortem (ncnet_tpu/retrieval/): the
     outcome-total identity at the COORDINATOR level (``retrieve_admit ==
@@ -1019,6 +1176,7 @@ def build_report(paths: List[str],
         report["rollout"] = build_rollout_section(events)
     if any(str(e.get("event", "")).startswith("route_") for e in events):
         report["router"] = build_router_section(events)
+        report["pod"] = build_pod_section(events)
     if any(str(e.get("event", "")).startswith(("retrieve_", "retrieval_"))
            for e in events):
         report["retrieval"] = build_retrieval_section(events)
@@ -1255,6 +1413,73 @@ def render_router(report: Dict[str, Any]) -> str:
             f"{pod.get('ready')}/{pod.get('total')} backends ready "
             f"({pod.get('replicas_ready')}/{pod.get('replicas_total')} "
             f"replica units)  counters={fh.get('counters')}")
+    return "\n".join(lines)
+
+
+def render_pod(report: Dict[str, Any]) -> str:
+    pod = report.get("pod")
+    if not pod:
+        return "(no route_* events in the logs — a pod report needs the " \
+               "router's log alongside the backend logs)"
+    lines = ["pod (trace-joined across all given logs):"]
+    o = pod["outcomes"]
+    lines.append(
+        f"  edge outcomes: admitted={o['admitted']}  "
+        f"results={o['results']}  deadline={o['deadline_exceeded']}  "
+        f"quarantined={o['quarantined']}  "
+        f"shed_admitted={o['shed_admitted']}")
+    if o["unresolved"]:
+        lines.append(
+            f"  EDGE UNRESOLVED: {o['unresolved']} admitted request(s) "
+            f"died without an outcome: "
+            f"{', '.join(str(r) for r in pod['lost_requests'][:16])}")
+    else:
+        lines.append("  edge outcome-total: every router-admitted request "
+                     "reached exactly one terminal outcome")
+    tr = pod["traces"]
+    lines.append(
+        f"  traces: {pod['traced_admits']}/{o['admitted']} admits traced"
+        f"  routed-result traces={tr['routed']}  "
+        f"backed-by-backend={tr['backed']}")
+    if pod["dark_trails"]:
+        lines.append(f"  DARK TRAILS: {len(pod['dark_trails'])} trace(s) "
+                     "the router settled as result without a matching "
+                     "backend serve_result in ANY log:")
+        for d in pod["dark_trails"][:16]:
+            lines.append(
+                f"    {d['trace'][:16]}…  router req(s) "
+                f"{','.join(d['router_requests'])}  "
+                f"route_results={d['route_results']} "
+                f"backend_results={d['backend_results']}")
+    else:
+        lines.append("  trail continuity: every routed result is backed "
+                     "by a same-trace backend result")
+    if pod["backend_lost"]:
+        lines.append(f"  backend in-flight at death: "
+                     f"{len(pod['backend_lost'])} traced admit(s) never "
+                     "settled on their backend:")
+        for b in pod["backend_lost"][:16]:
+            lines.append(f"    {b['trace'][:16]}…  {b['request']} "
+                         f"(run {b['run']})")
+    if pod["failovers"]:
+        lines.append(f"  failovers: {len(pod['failovers'])} router "
+                     "re-route(s), each attributed to its trace:")
+        for f in pod["failovers"][:16]:
+            t = (f["trace"][:16] + "…") if f.get("trace") else "(untraced)"
+            runs = ",".join(str(r) for r in f.get("backend_runs", []))
+            lines.append(
+                f"    {f['request']}  {t}  kind={f['kind']} "
+                f"from={f['from_backend']}  backend runs [{runs}]  "
+                + ("recovered" if f.get("recovered") else "NOT recovered"))
+    if pod["hedges"]:
+        lines.append(f"  hedged shard dispatches: {len(pod['hedges'])} "
+                     "(trace-attributed)")
+    if pod["overhead_ms"]:
+        lines.append(
+            f"  wire+routing overhead (edge wall − backend wall): "
+            f"{_fmt_stats(pod['overhead_ms'], 'ms')}  "
+            f"[{pod['overhead_joined_by_trace']}/"
+            f"{pod['overhead_samples']} joined by trace]")
     return "\n".join(lines)
 
 
@@ -1677,6 +1902,13 @@ def main(argv=None) -> int:
                          "distribution, hedge rate, per-shard outcome "
                          "accounting, and the shard death/resurrection "
                          "timeline replayed from retrieve_* events")
+    ap.add_argument("--pod", action="store_true",
+                    help="append the pod section: the outcome-total "
+                         "identity recomputed ACROSS all given logs at "
+                         "once, trace-joined — edge totality, router-to-"
+                         "backend trail continuity (dark trails named), "
+                         "failover/hedge attribution by trace, and the "
+                         "edge-minus-backend wall = wire+routing overhead")
     ap.add_argument("--store", action="store_true",
                     help="append the feature-store section: hit/miss/"
                          "corrupt/evict counters, the DEGRADED->recovered "
@@ -1711,6 +1943,9 @@ def main(argv=None) -> int:
         if args.memory:
             print()
             print(render_memory(report))
+        if args.pod:
+            print()
+            print(render_pod(report))
         if args.retrieval:
             print()
             print(render_retrieval(report))

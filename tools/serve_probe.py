@@ -62,7 +62,7 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -276,11 +276,15 @@ def probe(sides: List[int], n_pairs: int, tiny: bool, demote: bool,
 
 
 def spawn_backends(n: int, side: int, *, fake: bool = False,
-                   latency_s: float = 0.02, max_queue: int = 64):
+                   latency_s: float = 0.02, max_queue: int = 64,
+                   events_dir: Optional[str] = None):
     """Spawn ``n`` serve_backend subprocesses (CPU-forced — the pod tier's
     fan-out overhead is wire+routing, measured honestly off-device) and
     block for their startup JSON lines.  Returns ``[(Popen, url), ...]``;
-    the caller owns teardown (:func:`stop_backends`)."""
+    the caller owns teardown (:func:`stop_backends`).  ``events_dir``
+    gives each backend its own ``--events`` log there
+    (``backend<i>.jsonl``) — the per-process logs ``trace_export
+    --federate`` merges into one pod trace."""
     import subprocess
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -288,11 +292,14 @@ def spawn_backends(n: int, side: int, *, fake: bool = False,
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                NCNET_TPU_PERF_STORE="off", NCNET_TPU_TIER_CACHE="off")
     procs = []
-    for _ in range(n):
+    for i in range(n):
         cmd = [sys.executable, script, "--bucket-side", str(side),
                "--max-queue", str(max_queue)]
         cmd += ["--fake-engine", "--latency", str(latency_s)] if fake \
             else ["--tiny"]
+        if events_dir:
+            cmd += ["--events",
+                    os.path.join(events_dir, f"backend{i}.jsonl")]
         procs.append(subprocess.Popen(
             cmd, stdout=subprocess.PIPE, text=True, env=env))
     out = []
@@ -327,16 +334,27 @@ def stop_backends(procs) -> None:
 
 
 def probe_router(n_backends: int, side: int, n_pairs: int,
-                 burst_factor: float, tiny: bool) -> Dict[str, Any]:
+                 burst_factor: float, tiny: bool,
+                 keep_logs: bool = False) -> Dict[str, Any]:
     """The pod-tier sweep: capacity/failover/shed walls through a real
-    ``MatchRouter`` over ``n_backends`` spawned backend processes."""
+    ``MatchRouter`` over ``n_backends`` spawned backend processes.
+    ``keep_logs`` gives every backend its own event log in a directory
+    that OUTLIVES the probe, and names the paths in the report — feed
+    them straight to ``tools/trace_export.py --federate`` (plus the
+    router-side log, when the caller installed a sink) for the one-pod
+    Perfetto view of the sweep."""
     import numpy as np
 
     from ncnet_tpu.serving import MatchRouter, RouterConfig
     from ncnet_tpu.utils.faults import paced_burst
 
     side = min(side, 64) if tiny else side
-    procs = spawn_backends(n_backends, side)
+    events_dir = None
+    if keep_logs:
+        import tempfile
+
+        events_dir = tempfile.mkdtemp(prefix="serve_probe_pod_logs_")
+    procs = spawn_backends(n_backends, side, events_dir=events_dir)
     rng = np.random.default_rng(0)
 
     def pair():
@@ -345,6 +363,10 @@ def probe_router(n_backends: int, side: int, n_pairs: int,
 
     out: Dict[str, Any] = {"backends": n_backends, "side": side,
                            "n_pairs": n_pairs}
+    if events_dir:
+        out["event_logs"] = [
+            os.path.join(events_dir, f"backend{i}.jsonl")
+            for i in range(n_backends)]
     router = None
     try:
         # router construction INSIDE the try: a ctor/start failure must
@@ -769,6 +791,12 @@ def main(argv=None) -> int:
                     help="queries per retrieval sweep phase")
     ap.add_argument("--replication", type=int, default=2,
                     help="replica count for the --shards sweep")
+    ap.add_argument("--keep-logs", action="store_true",
+                    help="(--router mode) give each spawned backend its "
+                         "own --events log in a directory that survives "
+                         "the probe, and name the paths in the report — "
+                         "the inputs tools/trace_export.py --federate "
+                         "merges into one pod trace")
     ap.add_argument("--json", default=None, help="also write the JSON here")
     args = ap.parse_args(argv)
 
@@ -809,7 +837,7 @@ def main(argv=None) -> int:
         elif args.router > 0:
             out = {"router": probe_router(
                 args.router, sides[0], args.pairs, args.burst_factor,
-                args.tiny)}
+                args.tiny, keep_logs=args.keep_logs)}
         else:
             out = probe(sides, args.pairs, args.tiny, not args.no_demote,
                         args.burst_factor, replicas=replicas)

@@ -15,6 +15,7 @@ Usage::
 
     python tools/serve_top.py http://127.0.0.1:8080 [--interval 2]
         [--once] [--json]
+    python tools/serve_top.py --pod http://h0:8080 http://h1:8080 ...
 
 ``--once`` renders a single frame and exits (0 = service reachable and
 admitting, 3 = reachable but draining/stopped, 2 = unreachable) — the
@@ -22,6 +23,16 @@ scripting / smoke-test mode.  Without it the tool refreshes in place
 (ANSI clear) every ``--interval`` seconds until interrupted.  ``--json``
 emits the merged raw payloads instead of the rendered frame (``--once``
 implied).
+
+``--pod`` is the FEDERATED scrape: every given host's ``/metrics`` +
+``/healthz`` in one frame — per-host state rows, the ``ncnet_serve_*``
+counter families SUMMED across hosts, and the cumulative latency
+histogram buckets merged by ``le`` edge so the p50/p95/p99 shown are the
+POD's percentiles (bucket counts are additive; merged-then-interpolated
+is exact at the bucket resolution, unlike averaging per-host
+percentiles, which is wrong).  An unreachable host degrades to a named
+row, never a crash; exit 0 only if every host is reachable and
+admitting.
 """
 
 from __future__ import annotations
@@ -179,12 +190,127 @@ def render_frame(health: Dict[str, Any], fams: Dict[str, Any],
     return "\n".join(lines) + "\n"
 
 
+def merge_pod_metrics(per_host: List[Tuple[str, Optional[Dict[str, Any]]]]
+                      ) -> Dict[str, Any]:
+    """Sum the ``ncnet_serve_*`` families across hosts: counter/gauge
+    samples add by (family, labels); histogram ``_bucket``/``_count``/
+    ``_sum`` series are themselves cumulative counters, so the same
+    summation merges the histograms exactly — ``histogram_percentile``
+    over the merged buckets IS the pod percentile."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    for _, fams in per_host:
+        if not fams:
+            continue
+        for name, fam in fams.items():
+            if not name.startswith("ncnet_serve_"):
+                continue
+            m = merged.setdefault(
+                name, {"type": fam.get("type", "untyped"),
+                       "help": fam.get("help", ""), "acc": {}})
+            for sname, labels, value in fam["samples"]:
+                key = (sname, tuple(sorted(labels.items())))
+                m["acc"][key] = m["acc"].get(key, 0.0) + float(value)
+    out: Dict[str, Any] = {}
+    for name, m in merged.items():
+        out[name] = {
+            "type": m["type"], "help": m["help"],
+            "samples": [(sname, dict(lbl), v)
+                        for (sname, lbl), v in sorted(m["acc"].items())],
+        }
+    return out
+
+
+def render_pod_frame(per_host, merged: Dict[str, Any]) -> str:
+    """One federated frame: per-host state rows + pod-summed outcome
+    counters + pod-merged latency percentiles."""
+    lines: List[str] = []
+    add = lines.append
+    n_up = sum(1 for _, h, _, e in per_host if e is None)
+    add(f"ncnet serve_top — POD of {len(per_host)} host(s), "
+        f"{n_up} reachable")
+    add(f"{'host':<28} {'state':<9} {'queue':>7} {'ready':>7} "
+        f"{'results':>8} {'shed':>6}")
+    for base, health, fams, err in per_host:
+        if err is not None:
+            add(f"{base:<28} {'UNREACH':<9} {'-':>7} {'-':>7} {'-':>8} "
+                f"{'-':>6}  ({err})")
+            continue
+        q = health.get("queue", {})
+        pool = health.get("pool", {})
+        c = health.get("counters", {})
+        ready = f"{pool.get('ready')}/{pool.get('total')}"
+        add(f"{base:<28} {str(health.get('state')):<9} "
+            f"{q.get('depth', '-'):>7} {ready:>7} "
+            f"{c.get('results', '-'):>8} {c.get('shed', '-'):>6}")
+    # pod-summed outcome counters from the merged families
+    fam = merged.get("ncnet_serve_requests_total")
+    if fam:
+        totals: Dict[str, float] = {}
+        for _, labels, v in fam["samples"]:
+            key = labels.get("outcome", labels.get("state", "?"))
+            totals[key] = totals.get(key, 0.0) + v
+        add("")
+        add("pod outcomes: " + "  ".join(
+            f"{k}={int(v)}" for k, v in sorted(totals.items())))
+    lat = _bucket_latencies(merged)
+    if lat:
+        add("")
+        add(f"pod latency   {'bucket':<16} {'n':>6} {'p50_ms':>9} "
+            f"{'p95_ms':>9} {'p99_ms':>9}")
+        for row in lat:
+            fmt = lambda v: f"{v:.2f}" if v is not None else "-"  # noqa: E731
+            add(f"{'':<14}{row['bucket']:<16} {row['n']:>6} "
+                f"{fmt(row['p50']):>9} {fmt(row['p95']):>9} "
+                f"{fmt(row['p99']):>9}")
+    return "\n".join(lines) + "\n"
+
+
+def run_pod(urls: List[str], args) -> int:
+    while True:
+        per_host = []
+        for u in urls:
+            health, fams, err = fetch(u)
+            per_host.append((u.rstrip("/"), health, fams, err))
+        merged = merge_pod_metrics(
+            [(b, f) for b, _, f, _ in per_host])
+        if args.json:
+            doc = {
+                "hosts": {b: {"healthz": h, "error": e}
+                          for b, h, _, e in per_host},
+                "pod_metrics": {name: fam["samples"]
+                                for name, fam in sorted(merged.items())},
+            }
+            _out(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        else:
+            frame = render_pod_frame(per_host, merged)
+            if not args.once:
+                _out("\x1b[2J\x1b[H")
+            _out(frame)
+        if args.once or args.json:
+            if any(e is not None for _, _, _, e in per_host):
+                return 2
+            return 0 if all(
+                h.get("state") in ("STARTING", "READY", "DEGRADED")
+                for _, h, _, _ in per_host) else 3
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Live console over a match service's /metrics + "
                     "/healthz introspection plane")
-    ap.add_argument("url", help="base URL of the introspection endpoint "
-                                "(e.g. http://127.0.0.1:8080)")
+    ap.add_argument("url", nargs="?", default=None,
+                    help="base URL of the introspection endpoint "
+                         "(e.g. http://127.0.0.1:8080)")
+    ap.add_argument("--pod", nargs="+", metavar="URL", default=None,
+                    help="federated mode: scrape EVERY given host, sum "
+                         "the ncnet_serve_* counters and merge the "
+                         "cumulative latency buckets into pod "
+                         "p50/p95/p99 (one frame for the whole pod)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh period in seconds (default 2)")
     ap.add_argument("--once", action="store_true",
@@ -194,6 +320,10 @@ def main(argv=None) -> int:
                     help="emit the merged raw payloads as one JSON "
                          "document (implies --once)")
     args = ap.parse_args(argv)
+    if args.pod:
+        return run_pod(args.pod, args)
+    if not args.url:
+        ap.error("a URL is required (or use --pod url1 url2 ...)")
 
     while True:
         health, fams, err = fetch(args.url)

@@ -56,12 +56,22 @@ backend's own wall EWMA) ship as a staleness breakdown — a recent backend
 overrides a stale aggregate, the router-tier mirror of the per-replica
 backstop, with no shared filesystem needed.
 
+**Pod mode (repeated ``--url``)**: one watchdog invocation judges every
+process of a pod — pass ``--url`` once per host (router, backends,
+retrieval coordinator) and the tool renders ONE staleness table, one row
+per target, each judged by its own document exactly as in single-URL
+mode.  The exit status is the WORST verdict across the pod (stalled=3 >
+missing=2 > alive=0), so a supervisor watching the whole deployment
+needs exactly one cron line.
+
 Usage::
 
     python tools/stall_watchdog.py <telemetry_dir>/heartbeat.json
         [--events <events.jsonl>] [--factor 10] [--min-age 60] [--json]
     python tools/stall_watchdog.py --url http://host:8080
         [--events <events.jsonl>] [--factor 10] [--min-age 60] [--json]
+    python tools/stall_watchdog.py --url http://router:8080 \
+        --url http://backend1:8081 --url http://backend2:8082 [--json]
 """
 
 from __future__ import annotations
@@ -415,6 +425,64 @@ def judge(heartbeat_path: str, events_path: Optional[str] = None,
     return verdict
 
 
+_EXIT_OF_STATUS = {"alive": 0, "missing": 2, "stalled": 3}
+
+
+def judge_pod(urls: List[str], events_path: Optional[str] = None,
+              factor: float = 10.0, min_age: float = 60.0,
+              hbm_warn_pct: float = 90.0) -> Dict[str, Any]:
+    """One verdict per ``--url`` target plus a pod roll-up: each target is
+    judged independently by :func:`judge_url` (so a wedged backend cannot
+    hide behind a healthy router, and vice versa), and the pod status is
+    the WORST individual verdict — stalled beats missing beats alive —
+    because a supervisor acting on the exit code must react to the
+    sickest process, not the average one."""
+    targets: Dict[str, Any] = {}
+    worst = "alive"
+    for url in urls:
+        v = judge_url(url, events_path=events_path, factor=factor,
+                      min_age=min_age, hbm_warn_pct=hbm_warn_pct)
+        targets[url] = v
+        if _EXIT_OF_STATUS[v["status"]] > _EXIT_OF_STATUS[worst]:
+            worst = v["status"]
+    return {"status": worst, "mode": "pod", "targets": targets}
+
+
+def render_pod_table(pod: Dict[str, Any]) -> str:
+    """The pod staleness table: one row per ``--url`` target with its own
+    age-vs-threshold evidence, advisory flags compressed into a notes
+    column, and a one-line worst-verdict summary on top."""
+    lines = [f"POD {pod['status'].upper()}: "
+             f"{len(pod['targets'])} target(s), worst verdict wins"]
+    lines.append(f"  {'STATUS':<8} {'STATE':<10} {'AGE':>8} {'THRESH':>8} "
+                 f"{'ROLE':<10} TARGET")
+    for url, v in pod["targets"].items():
+        if v["status"] == "missing":
+            note = v.get("error", "no liveness signal")
+            lines.append(f"  {'MISSING':<8} {'-':<10} {'-':>8} {'-':>8} "
+                         f"{'-':<10} {url}  [{note}]")
+            continue
+        notes = []
+        if v.get("alive_via"):
+            notes.append(f"alive via {v['alive_via']}")
+        stale = [bid for bid, b in (v.get("backends") or {}).items()
+                 if not b["recent"]]
+        if stale:
+            notes.append("stale backends: " + ", ".join(stale))
+        if (v.get("model") or {}).get("rollout"):
+            notes.append(f"rollout {v['model']['rollout'].get('phase')}")
+        if v.get("hbm_warning"):
+            notes.append("HBM pressure")
+        if (v.get("store") or {}).get("state") == "DEGRADED":
+            notes.append("store DEGRADED")
+        tail = ("  [" + "; ".join(notes) + "]") if notes else ""
+        lines.append(f"  {v['status'].upper():<8} "
+                     f"{str(v.get('state')):<10} "
+                     f"{v['age_s']:>7.1f}s {v['threshold_s']:>7.1f}s "
+                     f"{str(v.get('role')):<10} {url}{tail}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Judge a training run's or serving process's liveness "
@@ -422,12 +490,14 @@ def main(argv=None) -> int:
                     "the serving introspection plane (--url)")
     ap.add_argument("heartbeat", nargs="?", default=None,
                     help="path to heartbeat.json (omit when using --url)")
-    ap.add_argument("--url", default=None,
+    ap.add_argument("--url", action="append", default=None, metavar="URL",
                     help="poll a serving process's /healthz instead of a "
                          "heartbeat file (base URL or full /healthz URL) — "
                          "the cross-host mode; --events still feeds the "
                          "cadence threshold + replica backstop when the "
-                         "log is readable from here")
+                         "log is readable from here.  Repeat the flag to "
+                         "judge a whole pod in one invocation: one "
+                         "staleness table, worst verdict as exit status")
     ap.add_argument("--events", default=None,
                     help="event log for the step-wall cadence (default: "
                          "events.jsonl beside the heartbeat file; no "
@@ -445,11 +515,21 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the verdict as one JSON document")
     args = ap.parse_args(argv)
-    if (args.heartbeat is None) == (args.url is None):
+    if (args.heartbeat is None) == (not args.url):
         ap.error("give exactly one of: a heartbeat path, or --url")
 
-    if args.url is not None:
-        verdict = judge_url(args.url, events_path=args.events,
+    if args.url and len(args.url) > 1:
+        pod = judge_pod(args.url, events_path=args.events,
+                        factor=args.factor, min_age=args.min_age,
+                        hbm_warn_pct=args.hbm_warn_pct)
+        if args.json:
+            print(json.dumps(pod, indent=2, sort_keys=True))
+        else:
+            print(render_pod_table(pod))
+        return _EXIT_OF_STATUS[pod["status"]]
+
+    if args.url:
+        verdict = judge_url(args.url[0], events_path=args.events,
                             factor=args.factor, min_age=args.min_age,
                             hbm_warn_pct=args.hbm_warn_pct)
     else:
@@ -536,7 +616,7 @@ def main(argv=None) -> int:
                 hp = st.get("hit_pct")
                 print(f"  feature store {st.get('state')}"
                       + (f" (hit% {hp})" if hp is not None else ""))
-    return {"alive": 0, "missing": 2, "stalled": 3}[verdict["status"]]
+    return _EXIT_OF_STATUS[verdict["status"]]
 
 
 if __name__ == "__main__":

@@ -38,9 +38,33 @@ https://ui.perfetto.dev and chrome://tracing load directly:
 Replay is torn-tail tolerant (``replay_events``): a log whose writer was
 SIGKILLed mid-append still exports minus at most the torn trailing line.
 
+**Pod federation** (``--federate``): N event logs from DIFFERENT hosts
+merge into ONE trace.  Three things plain multi-file export cannot do:
+
+  * **clock alignment** — every wire round trip leaves ``clock_sync``
+    events (``serving/wire.py``: NTP-style half-RTT offset samples,
+    ``offset_s`` = peer wall − local wall).  The exporter builds the
+    sync graph over RUN IDS (hostnames collide in test pods; run ids
+    never do), takes the minimum-RTT sample per edge, and BFS-propagates
+    corrections from the first log's run so every process's timestamps
+    land on one pod clock.  A run unreachable in the graph keeps its raw
+    clock, is listed under ``otherData.federation.unaligned``, and gets
+    NO flow arrows — an unaligned arrow would be a wrong arrow;
+  * **router request slices** — each ``route_admit`` paired with its
+    terminal ``route_*`` event becomes an "X" slice on the router run's
+    ``requests`` track, so the pod view shows the edge-observed request
+    wall above the backend's queue/device/fetch attribution;
+  * **cross-host flow arrows** — requests stamped with a pod trace id
+    (``observability/tracing.py``) link router slice → backend request
+    slice(s) with Chrome flow events ("s"/"t"/"f" sharing the trace id),
+    so one click in Perfetto follows a request across processes —
+    including a failover's second backend.
+
 Usage::
 
     python tools/trace_export.py <events.jsonl> [more.jsonl ...] [-o trace.json]
+    python tools/trace_export.py --federate router.jsonl b0.jsonl b1.jsonl \
+        [-o pod.trace.json]
 
 ``-o -`` writes the trace JSON to stdout.  Default output:
 ``<first input>.trace.json``.
@@ -151,44 +175,56 @@ def counter_events(e: dict) -> List[Dict[str, Any]]:
     return [{"name": name, "args": args}]
 
 
-def build_trace(paths: List[str]) -> Dict[str, Any]:
-    """One Chrome trace document over every given event log."""
-    trace_events: List[Dict[str, Any]] = []
-    headers: List[Dict[str, Any]] = []
-    pid_of_run: Dict[str, int] = {}
-    tid_of: Dict[Tuple[int, Any], int] = {}  # (pid, raw tid) -> track id
+class _TraceBuilder:
+    """Incremental Chrome-trace assembly shared by the single-log and the
+    federated exports: process/track allocation plus the per-log event
+    rendering loop."""
 
-    def pid_for(run: Any, header: Dict[str, Any]) -> int:
+    def __init__(self) -> None:
+        self.trace_events: List[Dict[str, Any]] = []
+        self.headers: List[Dict[str, Any]] = []
+        self.pid_of_run: Dict[str, int] = {}
+        # (pid, raw tid) -> track id
+        self.tid_of: Dict[Tuple[int, Any], int] = {}
+
+    def pid_for(self, run: Any, header: Dict[str, Any]) -> int:
         key = str(run)
-        if key not in pid_of_run:
-            pid_of_run[key] = len(pid_of_run) + 1
-            trace_events.append({
-                "ph": "M", "name": "process_name", "pid": pid_of_run[key],
+        if key not in self.pid_of_run:
+            self.pid_of_run[key] = len(self.pid_of_run) + 1
+            self.trace_events.append({
+                "ph": "M", "name": "process_name",
+                "pid": self.pid_of_run[key],
                 "tid": 0, "args": {"name": (
                     f"run {key} @ {header.get('host', '?')}"
                     f" [{header.get('device_kind') or 'no-device'}]")},
             })
-            trace_events.append({
-                "ph": "M", "name": "thread_name", "pid": pid_of_run[key],
+            self.trace_events.append({
+                "ph": "M", "name": "thread_name",
+                "pid": self.pid_of_run[key],
                 "tid": 0, "args": {"name": "events"},
             })
-        return pid_of_run[key]
+        return self.pid_of_run[key]
 
-    def tid_for(pid: int, raw) -> int:
+    def tid_for(self, pid: int, raw) -> int:
         key = (pid, raw)
-        if key not in tid_of:
+        if key not in self.tid_of:
             # track 0 is the instant-marker track; spans start at 1
-            tid_of[key] = 1 + sum(1 for k in tid_of if k[0] == pid)
-            trace_events.append({
+            self.tid_of[key] = 1 + sum(1 for k in self.tid_of
+                                       if k[0] == pid)
+            self.trace_events.append({
                 "ph": "M", "name": "thread_name", "pid": pid,
-                "tid": tid_of[key], "args": {"name": f"thread {raw}"},
+                "tid": self.tid_of[key],
+                "args": {"name": (raw if isinstance(raw, str)
+                                  else f"thread {raw}")},
             })
-        return tid_of[key]
+        return self.tid_of[key]
 
-    for path in paths:
-        header, events = replay_events(path)
-        head = header.get("header", {})
-        headers.append({"path": path, **head})
+    def add_log(self, path: str, head: Dict[str, Any],
+                events: List[Dict[str, Any]]) -> None:
+        trace_events = self.trace_events
+        pid_for, tid_for = self.pid_for, self.tid_for
+        self.headers.append({"path": path, **head})
+        pid_of_run = self.pid_of_run
         # pair span B/E by (run, span id) — ids are process-unique ints, so
         # the run id disambiguates resume lineages appending to one file
         open_spans: Dict[Tuple[Any, Any], Dict[str, Any]] = {}
@@ -258,22 +294,240 @@ def build_trace(paths: List[str]) -> Dict[str, Any]:
                 "cat": "span", "args": args,
             })
 
-    return {
-        "traceEvents": trace_events,
-        "displayTimeUnit": "ms",
-        "otherData": {"logs": headers, "exporter": "ncnet_tpu trace_export"},
+    def doc(self, federation: "Dict[str, Any] | None" = None
+            ) -> Dict[str, Any]:
+        other: Dict[str, Any] = {"logs": self.headers,
+                                 "exporter": "ncnet_tpu trace_export"}
+        if federation is not None:
+            other["federation"] = federation
+        return {
+            "traceEvents": self.trace_events,
+            "displayTimeUnit": "ms",
+            "otherData": other,
+        }
+
+
+def _load_logs(paths: List[str]
+               ) -> List[Tuple[str, Dict[str, Any], List[Dict[str, Any]]]]:
+    out = []
+    for path in paths:
+        header, events = replay_events(path)
+        out.append((path, header.get("header", {}), events))
+    return out
+
+
+def build_trace(paths: List[str]) -> Dict[str, Any]:
+    """One Chrome trace document over every given event log."""
+    b = _TraceBuilder()
+    for path, head, events in _load_logs(paths):
+        b.add_log(path, head, events)
+    return b.doc()
+
+
+# terminal route_* events that close a router request slice (mirrors the
+# router's outcome-total contract; `route_admit` opens the slice)
+_ROUTE_TERMINALS = ("route_result", "route_deadline", "route_shed",
+                    "route_quarantine")
+
+
+def _clock_corrections(
+    logs, warn,
+) -> Tuple[Dict[str, float], List[str], List[str]]:
+    """Per-run additive clock corrections from the ``clock_sync`` graph.
+
+    Nodes are run ids; an edge is the MINIMUM-RTT sample between a pair
+    (lowest-RTT exchange = tightest offset bound, the classic NTP filter).
+    The first log's first run is the reference (correction 0); BFS
+    propagates ``corrected = t + c[run]`` both ways across each edge.
+    Returns ``(corrections, aligned, unaligned)``; unaligned runs keep
+    correction 0 and the caller must not draw cross-host arrows to them.
+    """
+    runs: List[str] = []
+    for _, head, events in logs:
+        for e in events:
+            r = str(e.get("run", "?"))
+            if r not in runs:
+                runs.append(r)
+    # min-RTT sample per undirected pair, kept directed as measured
+    best: Dict[Tuple[str, str], Tuple[float, float]] = {}
+    for _, _, events in logs:
+        for e in events:
+            if e.get("event") != "clock_sync":
+                continue
+            a, b = str(e.get("run", "?")), e.get("peer_run")
+            off, rtt = e.get("offset_s"), e.get("rtt_s")
+            if not b or not isinstance(off, (int, float)) \
+                    or not isinstance(rtt, (int, float)) or rtt < 0:
+                continue
+            key = tuple(sorted((a, str(b))))
+            if key not in best or rtt < best[key][0]:
+                # store as (rtt, offset a->b) normalized to key order
+                o = float(off) if (a, str(b)) == key else -float(off)
+                best[key] = (float(rtt), o)
+    adj: Dict[str, List[Tuple[str, float]]] = {}
+    for (a, b), (_, off) in best.items():
+        # off = wall_b − wall_a at one instant ⇒ c[b] = c[a] − off
+        adj.setdefault(a, []).append((b, -off))
+        adj.setdefault(b, []).append((a, +off))
+    corr: Dict[str, float] = {}
+    if runs:
+        ref = runs[0]
+        corr[ref] = 0.0
+        queue = [ref]
+        while queue:
+            u = queue.pop(0)
+            for v, d in adj.get(u, []):
+                if v not in corr:
+                    corr[v] = corr[u] + d
+                    queue.append(v)
+    aligned = [r for r in runs if r in corr]
+    unaligned = [r for r in runs if r not in corr]
+    for r in unaligned:
+        corr[r] = 0.0
+    if unaligned:
+        warn(f"no clock_sync path to run(s) {', '.join(unaligned)}: "
+             "their timestamps stay UNALIGNED (raw local clock) and no "
+             "cross-host flow arrows are drawn to them")
+    return corr, aligned, unaligned
+
+
+def build_federated_trace(paths: List[str],
+                          warn=None) -> Dict[str, Any]:
+    """N per-host event logs → ONE pod trace: clock-skew-corrected
+    timestamps, per-run process tracks, router request slices, and
+    trace-id flow arrows stitching each pod request across processes.
+    ``warn`` (a callable, default stderr) receives human-readable
+    degradation notes (unaligned runs)."""
+    if warn is None:
+        def warn(msg: str) -> None:
+            sys.stderr.write(f"federate: WARNING: {msg}\n")
+    logs = _load_logs(paths)
+    corr, aligned, unaligned = _clock_corrections(logs, warn)
+    aligned_set = set(aligned)
+    # shift every wall stamp onto the pod clock BEFORE rendering, so the
+    # ordinary renderer needs no knowledge of federation
+    for _, _, events in logs:
+        for e in events:
+            c = corr.get(str(e.get("run", "?")), 0.0)
+            if isinstance(e.get("t"), (int, float)):
+                e["t"] = float(e["t"]) + c
+            if e.get("event") == "request_timeline" \
+                    and isinstance(e.get("t0"), (int, float)):
+                e["t0"] = float(e["t0"]) + c
+    b = _TraceBuilder()
+    for path, head, events in logs:
+        b.add_log(path, head, events)
+    # --- router request slices: route_admit paired with its terminal ----
+    # (run, request) -> admit event / terminal event
+    admits: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    terminals: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    # trace id -> router slice / backend timeline slices, for the flows
+    router_of: Dict[str, List[Tuple[str, float, float]]] = {}
+    backend_of: Dict[str, List[Tuple[str, float, float]]] = {}
+    head_of_run: Dict[str, Dict[str, Any]] = {}
+    for _, head, events in logs:
+        for e in events:
+            run = str(e.get("run", "?"))
+            head_of_run.setdefault(run, head)
+            name = e.get("event")
+            rid = e.get("request")
+            if name == "route_admit" and rid is not None:
+                admits[(run, str(rid))] = e
+            elif name in _ROUTE_TERMINALS and rid is not None:
+                terminals.setdefault((run, str(rid)), e)
+            elif name == "request_timeline" and e.get("trace") \
+                    and isinstance(e.get("t0"), (int, float)) \
+                    and isinstance(e.get("total_ms"), (int, float)):
+                backend_of.setdefault(str(e["trace"]), []).append(
+                    (run, float(e["t0"]),
+                     float(e["t0"]) + float(e["total_ms"]) * 1e-3))
+    n_router_slices = 0
+    for (run, rid), adm in sorted(
+            admits.items(), key=lambda kv: kv[1].get("t", 0.0)):
+        term = terminals.get((run, rid))
+        if term is None:
+            continue  # request still in flight when the log was cut
+        t0 = float(adm.get("t", 0.0))
+        t1 = max(t0, float(term.get("t", t0)))
+        pid = b.pid_for(run, head_of_run.get(run, {}))
+        tid = b.tid_for(pid, "requests")
+        outcome = str(term.get("event", "?"))[len("route_"):]
+        args = {k: adm[k] for k in ("request", "client", "trace")
+                if k in adm}
+        args["outcome"] = outcome
+        b.trace_events.append({
+            "ph": "X", "name": f"req {rid} [{outcome}]", "pid": pid,
+            "tid": tid, "ts": _us(t0), "dur": _us(t1 - t0),
+            "cat": "route_request", "args": args,
+        })
+        n_router_slices += 1
+        tr = adm.get("trace") or term.get("trace")
+        if tr:
+            router_of.setdefault(str(tr), []).append((run, t0, t1))
+    # --- cross-host flow arrows, keyed by trace id ----------------------
+    # drawn ONLY between runs the sync graph aligned: a flow between
+    # uncorrected clocks would render a confidently WRONG arrow
+    n_flows = 0
+    for tr, routers in sorted(router_of.items()):
+        backends = sorted(backend_of.get(tr, []), key=lambda s: s[1])
+        if not backends:
+            continue
+        involved = {r for r, _, _ in routers} | {r for r, _, _ in backends}
+        if not involved <= aligned_set:
+            continue
+        run, t0, _ = routers[0]
+        pid = b.pid_for(run, head_of_run.get(run, {}))
+        b.trace_events.append({
+            "ph": "s", "id": tr, "name": "pod_request",
+            "cat": "pod_request", "pid": pid,
+            "tid": b.tid_for(pid, "requests"), "ts": _us(t0),
+        })
+        for i, (brun, bt0, _bt1) in enumerate(backends):
+            bpid = b.pid_for(brun, head_of_run.get(brun, {}))
+            b.trace_events.append({
+                # "t" = intermediate step (a failover's first backend),
+                # "f" with bp=e binds the arrowhead to the LAST slice
+                "ph": ("f" if i == len(backends) - 1 else "t"),
+                "id": tr, "name": "pod_request", "cat": "pod_request",
+                "pid": bpid, "tid": b.tid_for(bpid, "requests"),
+                "ts": _us(bt0),
+                **({"bp": "e"} if i == len(backends) - 1 else {}),
+            })
+            # flow endpoints must land INSIDE a slice on their track:
+            # mirror the backend's request wall as an X slice there
+            b.trace_events.append({
+                "ph": "X", "name": f"req[{tr[:8]}]", "pid": bpid,
+                "tid": b.tid_for(bpid, "requests"), "ts": _us(bt0),
+                "dur": _us(max(0.0, _bt1 - bt0)),
+                "cat": "serve_request", "args": {"trace": tr},
+            })
+            n_flows += 1
+    federation = {
+        "runs": {r: {"correction_s": round(corr.get(r, 0.0), 6),
+                     "aligned": r in aligned_set}
+                 for r in sorted(set(corr))},
+        "unaligned": sorted(unaligned),
+        "router_slices": n_router_slices,
+        "flows": n_flows,
     }
+    return b.doc(federation=federation)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Export ncnet_tpu event logs as Chrome trace JSON")
     ap.add_argument("logs", nargs="+", help="events.jsonl file(s)")
+    ap.add_argument("--federate", action="store_true",
+                    help="merge the logs as one POD: clock-skew-corrected "
+                         "timestamps from the clock_sync graph, router "
+                         "request slices, and cross-host flow arrows "
+                         "keyed by pod trace id")
     ap.add_argument("-o", "--output", default=None,
                     help="output path ('-' for stdout; default: "
                          "<first input>.trace.json)")
     args = ap.parse_args(argv)
-    trace = build_trace(args.logs)
+    trace = (build_federated_trace(args.logs) if args.federate
+             else build_trace(args.logs))
     out = args.output or (args.logs[0] + ".trace.json")
     text = json.dumps(trace)
     if out == "-":
@@ -282,9 +536,12 @@ def main(argv=None) -> int:
         with open(out, "w") as f:
             f.write(text)
         n_spans = sum(1 for e in trace["traceEvents"] if e["ph"] in "XB")
+        fed = trace["otherData"].get("federation")
+        extra = (f", {fed['router_slices']} router slices, "
+                 f"{fed['flows']} flow steps" if fed else "")
         sys.stderr.write(
             f"wrote {out}: {n_spans} spans, "
-            f"{len(trace['traceEvents'])} trace events — open in "
+            f"{len(trace['traceEvents'])} trace events{extra} — open in "
             "https://ui.perfetto.dev\n")
     return 0
 
